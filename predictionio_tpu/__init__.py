@@ -1,0 +1,24 @@
+"""predictionio_tpu — a TPU-native ML serving framework.
+
+A ground-up rebuild of the capabilities of Apache PredictionIO (reference:
+event collection REST API, pluggable event/metadata/model storage, templated
+DASE engines, train -> model repository -> deploy lifecycle, metric-driven
+evaluation, low-latency query serving) with the execution substrate replaced
+by JAX/XLA on TPU: sharded `jax.Array` ingestion instead of Spark RDDs,
+`jit`/`shard_map` over an ICI/DCN `jax.sharding.Mesh` instead of a Spark
+cluster, pytree checkpoints instead of Kryo blobs, and asyncio HTTP servers
+instead of Akka/Spray.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  - ``predictionio_tpu.data``       event model + storage SPI + event server (ref: data/)
+  - ``predictionio_tpu.controller`` DASE controller API (ref: core/ controller)
+  - ``predictionio_tpu.workflow``   train/eval/deploy/batch-predict workflows (ref: core/ workflow)
+  - ``predictionio_tpu.eval``       metrics + evaluator + grid search (ref: core/ evaluation)
+  - ``predictionio_tpu.ops``        TPU math: ALS solvers, top-k, cooccurrence (pallas/XLA)
+  - ``predictionio_tpu.parallel``   mesh construction, sharding, host->device ingest
+  - ``predictionio_tpu.models``     bundled engine templates (ref: examples/)
+  - ``predictionio_tpu.e2``         engine-building algorithm library (ref: e2/)
+  - ``predictionio_tpu.tools``      CLI + admin/dashboard servers (ref: tools/)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+"""Event model, property maps, storage SPI and engine-facing stores.
+
+Reference parity: ``data/src/main/scala/org/apache/predictionio/data`` —
+``storage/Event.scala``, ``storage/DataMap.scala``, ``storage/Storage.scala``,
+``store/LEventStore.scala``, ``store/PEventStore.scala``, ``api/EventServer.scala``.
+"""
+
+from predictionio_tpu.data.datamap import DataMap, PropertyMap
+from predictionio_tpu.data.event import Event, EventValidation
+from predictionio_tpu.data.bimap import BiMap
+
+__all__ = ["DataMap", "PropertyMap", "Event", "EventValidation", "BiMap"]

@@ -1,0 +1,1 @@
+"""REST event-collection API (ref ``data/.../api/EventServer.scala``)."""

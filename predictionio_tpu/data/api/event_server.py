@@ -1,0 +1,395 @@
+"""REST event-collection server (aiohttp).
+
+Reference parity: ``data/.../api/EventServer.scala:54-663``. Route surface:
+
+  GET  /                       -> {"status": "alive"}
+  POST /events.json            -> 201 {"eventId": ...} (single event)
+  GET  /events.json            -> filtered query (default limit 20)
+  GET  /events/<id>.json       -> one event
+  DELETE /events/<id>.json     -> {"message": "Found"} | 404
+  POST /batch/events.json      -> per-event status array, <= 50 events
+  GET  /stats.json             -> ingestion stats (requires --stats)
+  GET  /plugins.json           -> plugin inventory
+  GET  /plugins/<type>/<name>/...  -> plugin REST surface
+  POST /webhooks/<name>.json   -> JSON connector ingestion
+  GET  /webhooks/<name>.json   -> connector presence check
+  POST /webhooks/<name>        -> form connector ingestion
+
+Auth (ref :92-130): ``accessKey`` query param, or HTTP Basic where the
+username is the access key; per-key allowed-event enforcement; optional
+``channel`` query param must name an existing channel of the key's app.
+
+The reference's Akka actor concurrency maps to asyncio: storage calls run in
+a thread pool via ``loop.run_in_executor`` so a slow backend never blocks the
+event loop (the analog of Spray's detached futures).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import dataclasses
+import logging
+from typing import Any
+
+from aiohttp import web
+
+from predictionio_tpu.data.api.plugins import EventInfo, EventServerPluginContext
+from predictionio_tpu.data.api.stats import StatsCollector
+from predictionio_tpu.data.event import Event, parse_event_time
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.data.webhooks import (
+    ConnectorException,
+    connector_to_event,
+    form_connector,
+    json_connector,
+)
+
+logger = logging.getLogger(__name__)
+
+MAX_EVENTS_PER_BATCH_REQUEST = 50  # ref EventServer.scala:70
+
+
+@dataclasses.dataclass
+class EventServerConfig:
+    ip: str = "0.0.0.0"
+    port: int = 7070
+    plugins: str = "plugins"
+    stats: bool = False
+
+
+class BlockedEvent(Exception):
+    """An input-blocker plugin rejected the event."""
+
+
+@dataclasses.dataclass
+class AuthData:
+    app_id: int
+    channel_id: int | None
+    events: tuple[str, ...]
+
+    def allows(self, event_name: str) -> bool:
+        return not self.events or event_name in self.events
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"message": message}, status=status)
+
+
+class EventServer:
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        config: EventServerConfig | None = None,
+        plugin_context: EventServerPluginContext | None = None,
+    ):
+        self.storage = storage or Storage.instance()
+        self.config = config or EventServerConfig()
+        self.levents = self.storage.get_l_events()
+        self.access_keys = self.storage.get_meta_data_access_keys()
+        self.channels = self.storage.get_meta_data_channels()
+        self.stats = StatsCollector()
+        self.plugin_context = plugin_context or EventServerPluginContext()
+        self._runner: web.AppRunner | None = None
+
+    # ------------------------------------------------------------------ auth
+    def _authenticate(self, request: web.Request) -> AuthData | web.Response:
+        access_key = request.query.get("accessKey")
+        channel_name = request.query.get("channel")
+        if access_key is None:
+            auth_header = request.headers.get("Authorization", "")
+            if auth_header.startswith("Basic "):
+                try:
+                    decoded = base64.b64decode(auth_header[6:]).decode()
+                    access_key = decoded.strip().split(":")[0]
+                except Exception:
+                    return _json_error(401, "Invalid accessKey.")
+            else:
+                return _json_error(401, "Missing accessKey.")
+        key = self.access_keys.get(access_key)
+        if key is None:
+            return _json_error(401, "Invalid accessKey.")
+        channel_id = None
+        if channel_name is not None:
+            channel_map = {
+                c.name: c.id for c in self.channels.get_by_app_id(key.appid)
+            }
+            if channel_name not in channel_map:
+                return _json_error(401, f"Invalid channel '{channel_name}'.")
+            channel_id = channel_map[channel_name]
+        return AuthData(key.appid, channel_id, tuple(key.events))
+
+    async def _run(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    def _bookkeep(self, app_id: int, status: int, event: Event) -> None:
+        if self.config.stats:
+            self.stats.bookkeeping(app_id, status, event)
+
+    def _insert_one(self, auth: AuthData, event: Event) -> tuple[int, dict[str, Any]]:
+        """Shared blocker -> insert -> sniffer path. Runs in executor.
+
+        Raises BlockedEvent when an input blocker rejects (-> 403); any other
+        exception is a storage failure (-> 500)."""
+        info = EventInfo(auth.app_id, auth.channel_id, event)
+        for blocker in self.plugin_context.input_blockers.values():
+            try:
+                blocker.process(info, self.plugin_context)
+            except Exception as exc:
+                raise BlockedEvent(str(exc)) from exc
+        event_id = self.levents.insert(event, auth.app_id, auth.channel_id)
+        for sniffer in self.plugin_context.input_sniffers.values():
+            try:
+                sniffer.process(info, self.plugin_context)
+            except Exception:  # sniffers must never fail the request
+                logger.exception("input sniffer failed")
+        return 201, {"eventId": event_id}
+
+    # ---------------------------------------------------------------- routes
+    async def handle_root(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "alive"})
+
+    async def handle_post_event(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        if isinstance(auth, web.Response):
+            return auth
+        try:
+            payload = await request.json()
+            event = Event.from_json_dict(payload)
+        except Exception as exc:
+            return _json_error(400, str(exc))
+        if not auth.allows(event.event):
+            return _json_error(403, f"{event.event} events are not allowed")
+        try:
+            status, body = await self._run(self._insert_one, auth, event)
+        except BlockedEvent as exc:
+            return _json_error(403, str(exc))
+        except Exception as exc:
+            logger.exception("event insert failed")
+            return _json_error(500, str(exc))
+        self._bookkeep(auth.app_id, status, event)
+        return web.json_response(body, status=status)
+
+    async def handle_get_events(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        if isinstance(auth, web.Response):
+            return auth
+        q = request.query
+        try:
+            reversed_ = q.get("reversed", "false").lower() == "true"
+            if reversed_ and not (q.get("entityType") and q.get("entityId")):
+                raise ValueError(
+                    "the parameter reversed can only be used with both entityType "
+                    "and entityId specified."
+                )
+            start_time = parse_event_time(q["startTime"]) if "startTime" in q else None
+            until_time = parse_event_time(q["untilTime"]) if "untilTime" in q else None
+            limit = int(q.get("limit", 20))
+            kwargs: dict[str, Any] = dict(
+                app_id=auth.app_id,
+                channel_id=auth.channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=q.get("entityType"),
+                entity_id=q.get("entityId"),
+                event_names=[q["event"]] if "event" in q else None,
+                limit=limit,
+                reversed=reversed_,
+            )
+            if "targetEntityType" in q:
+                kwargs["target_entity_type"] = q["targetEntityType"]
+            if "targetEntityId" in q:
+                kwargs["target_entity_id"] = q["targetEntityId"]
+            events = list(await self._run(lambda: list(self.levents.find(**kwargs))))
+        except Exception as exc:
+            return _json_error(400, str(exc))
+        if not events:
+            return _json_error(404, "Not Found")
+        return web.json_response([e.to_json_dict() for e in events])
+
+    async def handle_get_event(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        if isinstance(auth, web.Response):
+            return auth
+        event_id = request.match_info["event_id"]
+        event = await self._run(
+            self.levents.get, event_id, auth.app_id, auth.channel_id
+        )
+        if event is None:
+            return _json_error(404, "Not Found")
+        return web.json_response(event.to_json_dict())
+
+    async def handle_delete_event(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        if isinstance(auth, web.Response):
+            return auth
+        event_id = request.match_info["event_id"]
+        found = await self._run(
+            self.levents.delete, event_id, auth.app_id, auth.channel_id
+        )
+        if not found:
+            return _json_error(404, "Not Found")
+        return web.json_response({"message": "Found"})
+
+    async def handle_batch_events(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        if isinstance(auth, web.Response):
+            return auth
+        try:
+            payload = await request.json()
+            if not isinstance(payload, list):
+                raise ValueError("batch request body must be a JSON array")
+        except Exception as exc:
+            return _json_error(400, str(exc))
+        if len(payload) > MAX_EVENTS_PER_BATCH_REQUEST:
+            return _json_error(
+                400,
+                "Batch request must have less than or equal to "
+                f"{MAX_EVENTS_PER_BATCH_REQUEST} events",
+            )
+        results: list[dict[str, Any]] = []
+        for item in payload:
+            try:
+                event = Event.from_json_dict(item)
+            except Exception as exc:
+                results.append({"status": 400, "message": str(exc)})
+                continue
+            if not auth.allows(event.event):
+                results.append(
+                    {"status": 403, "message": f"{event.event} events are not allowed"}
+                )
+                continue
+            try:
+                status, body = await self._run(self._insert_one, auth, event)
+                results.append({"status": status, **body})
+                self._bookkeep(auth.app_id, status, event)
+            except BlockedEvent as exc:
+                results.append({"status": 403, "message": str(exc)})
+            except Exception as exc:
+                results.append({"status": 500, "message": str(exc)})
+        return web.json_response(results)
+
+    async def handle_stats(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        if isinstance(auth, web.Response):
+            return auth
+        if not self.config.stats:
+            return _json_error(
+                404, "To see stats, launch Event Server with --stats argument."
+            )
+        return web.json_response(self.stats.get_stats(auth.app_id))
+
+    async def handle_plugins_json(self, request: web.Request) -> web.Response:
+        return web.json_response(self.plugin_context.to_json_dict())
+
+    async def handle_plugin_rest(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        if isinstance(auth, web.Response):
+            return auth
+        tail = request.match_info["tail"].split("/")
+        if len(tail) < 2:
+            return _json_error(404, "Not Found")
+        plugin_type, plugin_name, *args = tail
+        registry = (
+            self.plugin_context.input_blockers
+            if plugin_type == "inputblocker"
+            else self.plugin_context.input_sniffers
+        )
+        plugin = registry.get(plugin_name)
+        if plugin is None:
+            return _json_error(404, f"Unknown plugin {plugin_name}")
+        result = await self._run(
+            plugin.handle_rest, auth.app_id, auth.channel_id, args
+        )
+        return web.json_response(result)
+
+    async def handle_webhook_json(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        if isinstance(auth, web.Response):
+            return auth
+        name = request.match_info["name"]
+        connector = json_connector(name)
+        if connector is None:
+            return _json_error(404, f"webhooks connection for {name} is not supported.")
+        if request.method == "GET":
+            return web.json_response({"message": f"webhooks {name} connected."})
+        try:
+            payload = await request.json()
+            event = connector_to_event(connector, payload)
+        except (ConnectorException, ValueError) as exc:
+            return _json_error(400, str(exc))
+        status, body = await self._run(self._insert_one, auth, event)
+        self._bookkeep(auth.app_id, status, event)
+        return web.json_response(body, status=status)
+
+    async def handle_webhook_form(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        if isinstance(auth, web.Response):
+            return auth
+        name = request.match_info["name"]
+        connector = form_connector(name)
+        if connector is None:
+            return _json_error(404, f"webhooks connection for {name} is not supported.")
+        if request.method == "GET":
+            return web.json_response({"message": f"webhooks {name} connected."})
+        form = dict(await request.post())
+        try:
+            event = connector_to_event(connector, form)
+        except (ConnectorException, ValueError) as exc:
+            return _json_error(400, str(exc))
+        status, body = await self._run(self._insert_one, auth, event)
+        self._bookkeep(auth.app_id, status, event)
+        return web.json_response(body, status=status)
+
+    # ------------------------------------------------------------------- app
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/", self.handle_root),
+                web.post("/events.json", self.handle_post_event),
+                web.get("/events.json", self.handle_get_events),
+                web.get("/events/{event_id}.json", self.handle_get_event),
+                web.delete("/events/{event_id}.json", self.handle_delete_event),
+                web.post("/batch/events.json", self.handle_batch_events),
+                web.get("/stats.json", self.handle_stats),
+                web.get("/plugins.json", self.handle_plugins_json),
+                web.get("/plugins/{tail:.+}", self.handle_plugin_rest),
+                web.post("/webhooks/{name}.json", self.handle_webhook_json),
+                web.get("/webhooks/{name}.json", self.handle_webhook_json),
+                web.post("/webhooks/{name}", self.handle_webhook_form),
+                web.get("/webhooks/{name}", self.handle_webhook_form),
+            ]
+        )
+        return app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.make_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.config.ip, self.config.port)
+        await site.start()
+        logger.info(
+            "Event server started on %s:%d", self.config.ip, self.config.port
+        )
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+def create_event_server(
+    config: EventServerConfig | None = None, storage: Storage | None = None
+) -> EventServer:
+    return EventServer(storage=storage, config=config)
+
+
+def run_event_server(config: EventServerConfig | None = None) -> None:
+    """Blocking entry point (ref EventServer.createEventServer + actor boot)."""
+    server = create_event_server(config)
+    web.run_app(
+        server.make_app(),
+        host=server.config.ip,
+        port=server.config.port,
+        print=None,
+    )

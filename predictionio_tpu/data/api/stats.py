@@ -1,0 +1,97 @@
+"""Event-server ingestion statistics.
+
+Reference parity: ``data/.../api/Stats.scala:18-82`` + ``StatsActor.scala:35-77``
+— per-app counters keyed by HTTP status code and by
+(entityType, targetEntityType, event), kept for the current hour and for the
+server lifetime, surfaced at ``/stats.json``.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+from collections import Counter
+from typing import Any
+
+from predictionio_tpu.data.event import UTC, Event, format_event_time
+
+
+class Stats:
+    """One counting window (ref Stats.scala)."""
+
+    def __init__(self, start_time: _dt.datetime):
+        self.start_time = start_time
+        self.end_time: _dt.datetime | None = None
+        self.status_code_count: Counter[tuple[int, int]] = Counter()
+        self.ete_count: Counter[tuple[int, tuple[str, str | None, str]]] = Counter()
+
+    def cutoff(self, end_time: _dt.datetime) -> None:
+        self.end_time = end_time
+
+    def update(self, app_id: int, status_code: int, event: Event) -> None:
+        self.status_code_count[(app_id, status_code)] += 1
+        key = (event.entity_type, event.target_entity_type, event.event)
+        self.ete_count[(app_id, key)] += 1
+
+    def snapshot(self, app_id: int) -> dict[str, Any]:
+        return {
+            "startTime": format_event_time(self.start_time),
+            "endTime": format_event_time(self.end_time) if self.end_time else None,
+            "basic": [
+                {
+                    "entityType": k[0],
+                    "targetEntityType": k[1],
+                    "event": k[2],
+                    "count": v,
+                }
+                for (aid, k), v in sorted(
+                    self.ete_count.items(),
+                    key=lambda item: (item[0][0], item[0][1][0], item[0][1][1] or "", item[0][1][2]),
+                )
+                if aid == app_id
+            ],
+            "statusCode": [
+                {"status": code, "count": v}
+                for (aid, code), v in sorted(self.status_code_count.items())
+                if aid == app_id
+            ],
+        }
+
+
+class StatsCollector:
+    """Hourly + lifetime windows (ref StatsActor hour-bucketing)."""
+
+    def __init__(self):
+        now = _dt.datetime.now(tz=UTC)
+        self._lock = threading.Lock()
+        self.long_live = Stats(now)
+        self.hourly = Stats(self._floor_hour(now))
+        self.prev_hourly: Stats | None = None
+
+    @staticmethod
+    def _floor_hour(t: _dt.datetime) -> _dt.datetime:
+        return t.replace(minute=0, second=0, microsecond=0)
+
+    def _roll(self, now: _dt.datetime) -> None:
+        hour = self._floor_hour(now)
+        if hour > self.hourly.start_time:
+            self.hourly.cutoff(hour)
+            self.prev_hourly = self.hourly
+            self.hourly = Stats(hour)
+
+    def bookkeeping(self, app_id: int, status_code: int, event: Event) -> None:
+        with self._lock:
+            self._roll(_dt.datetime.now(tz=UTC))
+            self.long_live.update(app_id, status_code, event)
+            self.hourly.update(app_id, status_code, event)
+
+    def get_stats(self, app_id: int) -> dict[str, Any]:
+        with self._lock:
+            self._roll(_dt.datetime.now(tz=UTC))
+            out = {
+                "currentHour": self.hourly.snapshot(app_id),
+                "longLive": self.long_live.snapshot(app_id),
+            }
+            if self.prev_hourly is not None:
+                out["prevHour"] = self.prev_hourly.snapshot(app_id)
+            return out

@@ -1,0 +1,199 @@
+"""The Event model and validation rules.
+
+Reference parity: ``data/.../storage/Event.scala`` (fields :42-60, validation
+:112-166) and the REST wire format in ``EventJson4sSupport.scala:46-108``
+(required event/entityType/entityId; optional eventId, targetEntityType/Id,
+properties, eventTime ISO8601 defaulting to now-UTC, prId; ``tags`` and
+``creationTime`` exist on the model but are disabled on the API).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from typing import Any, Mapping
+
+from predictionio_tpu.data.datamap import DataMap
+
+UTC = _dt.timezone.utc
+
+
+def now_utc() -> _dt.datetime:
+    return _dt.datetime.now(tz=UTC)
+
+
+def ensure_aware(t: _dt.datetime | None) -> _dt.datetime | None:
+    """Interpret naive datetimes as UTC (filters from user code may be naive;
+    stored event times are always aware)."""
+    if t is not None and t.tzinfo is None:
+        return t.replace(tzinfo=UTC)
+    return t
+
+
+def parse_event_time(value: str) -> _dt.datetime:
+    """Parse an ISO8601 timestamp; must carry a timezone (ref wire contract)."""
+    # Python's fromisoformat handles 'Z' from 3.11 on.
+    t = _dt.datetime.fromisoformat(value)
+    if t.tzinfo is None:
+        raise ValueError(f"eventTime {value!r} must include a timezone offset")
+    return t
+
+
+def format_event_time(t: _dt.datetime) -> str:
+    """ISO8601 with milliseconds, matching the reference's joda output."""
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=UTC)
+    s = t.isoformat(timespec="milliseconds")
+    return s.replace("+00:00", "Z")
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One immutable event record (ref Event.scala:42-60)."""
+
+    event: str
+    entity_type: str
+    entity_id: str
+    target_entity_type: str | None = None
+    target_entity_id: str | None = None
+    properties: DataMap = dataclasses.field(default_factory=DataMap)
+    event_time: _dt.datetime = dataclasses.field(default_factory=now_utc)
+    event_id: str | None = None
+    tags: tuple[str, ...] = ()
+    pr_id: str | None = None
+    creation_time: _dt.datetime = dataclasses.field(default_factory=now_utc)
+
+    def __post_init__(self):
+        if not isinstance(self.properties, DataMap):
+            object.__setattr__(self, "properties", DataMap(self.properties))
+        if self.event_time.tzinfo is None:
+            object.__setattr__(self, "event_time", self.event_time.replace(tzinfo=UTC))
+        if self.creation_time.tzinfo is None:
+            object.__setattr__(
+                self, "creation_time", self.creation_time.replace(tzinfo=UTC)
+            )
+
+    # -- wire format --------------------------------------------------------
+    def to_json_dict(self, with_creation_time: bool = False) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "eventId": self.event_id,
+            "event": self.event,
+            "entityType": self.entity_type,
+            "entityId": self.entity_id,
+        }
+        if self.target_entity_type is not None:
+            d["targetEntityType"] = self.target_entity_type
+        if self.target_entity_id is not None:
+            d["targetEntityId"] = self.target_entity_id
+        d["properties"] = self.properties.fields
+        d["eventTime"] = format_event_time(self.event_time)
+        if self.pr_id is not None:
+            d["prId"] = self.pr_id
+        if with_creation_time:
+            d["creationTime"] = format_event_time(self.creation_time)
+        return d
+
+    @staticmethod
+    def from_json_dict(d: Mapping[str, Any]) -> "Event":
+        """Decode the REST payload. Raises ValueError/KeyError on contract
+        violations mirroring EventJson4sSupport read rules."""
+        for field in ("event", "entityType", "entityId"):
+            if field not in d or not isinstance(d[field], str):
+                raise ValueError(f"field {field} is required and must be a string")
+        props = d.get("properties") or {}
+        if not isinstance(props, Mapping):
+            raise ValueError("properties must be a JSON object")
+        raw_time = d.get("eventTime")
+        event_time = parse_event_time(raw_time) if raw_time else now_utc()
+        e = Event(
+            event=d["event"],
+            entity_type=d["entityType"],
+            entity_id=d["entityId"],
+            target_entity_type=d.get("targetEntityType"),
+            target_entity_id=d.get("targetEntityId"),
+            properties=DataMap(props),
+            event_time=event_time,
+            event_id=d.get("eventId"),
+            pr_id=d.get("prId"),
+        )
+        EventValidation.validate(e)
+        return e
+
+
+class EventValidation:
+    """Validation rules for events (ref Event.scala:112-166)."""
+
+    DEFAULT_TZ = UTC
+    SPECIAL_EVENTS = frozenset({"$set", "$unset", "$delete"})
+    BUILTIN_ENTITY_TYPES = frozenset({"pio_pr"})
+    BUILTIN_PROPERTIES: frozenset[str] = frozenset()
+
+    @classmethod
+    def is_reserved_prefix(cls, name: str) -> bool:
+        return name.startswith("$") or name.startswith("pio_")
+
+    @classmethod
+    def is_special_event(cls, name: str) -> bool:
+        return name in cls.SPECIAL_EVENTS
+
+    @classmethod
+    def is_builtin_entity_type(cls, name: str) -> bool:
+        return name in cls.BUILTIN_ENTITY_TYPES
+
+    @classmethod
+    def validate(cls, e: Event) -> None:
+        def require(cond: bool, msg: str) -> None:
+            if not cond:
+                raise ValueError(msg)
+
+        require(bool(e.event), "event must not be empty.")
+        require(bool(e.entity_type), "entityType must not be empty string.")
+        require(bool(e.entity_id), "entityId must not be empty string.")
+        require(
+            e.target_entity_type is None or bool(e.target_entity_type),
+            "targetEntityType must not be empty string",
+        )
+        require(
+            e.target_entity_id is None or bool(e.target_entity_id),
+            "targetEntityId must not be empty string.",
+        )
+        require(
+            (e.target_entity_type is None) == (e.target_entity_id is None),
+            "targetEntityType and targetEntityId must be specified together.",
+        )
+        require(
+            not (e.event == "$unset" and e.properties.is_empty()),
+            "properties cannot be empty for $unset event",
+        )
+        require(
+            not cls.is_reserved_prefix(e.event) or cls.is_special_event(e.event),
+            f"{e.event} is not a supported reserved event name.",
+        )
+        require(
+            not cls.is_special_event(e.event)
+            or (e.target_entity_type is None and e.target_entity_id is None),
+            f"Reserved event {e.event} cannot have targetEntity",
+        )
+        require(
+            not cls.is_reserved_prefix(e.entity_type)
+            or cls.is_builtin_entity_type(e.entity_type),
+            f"The entityType {e.entity_type} is not allowed. "
+            "'pio_' is a reserved name prefix.",
+        )
+        require(
+            e.target_entity_type is None
+            or not cls.is_reserved_prefix(e.target_entity_type)
+            or cls.is_builtin_entity_type(e.target_entity_type),
+            f"The targetEntityType {e.target_entity_type} is not allowed. "
+            "'pio_' is a reserved name prefix.",
+        )
+        cls.validate_properties(e)
+
+    @classmethod
+    def validate_properties(cls, e: Event) -> None:
+        for k in e.properties.keyset():
+            if cls.is_reserved_prefix(k) and k not in cls.BUILTIN_PROPERTIES:
+                raise ValueError(
+                    f"The property {k} is not allowed. "
+                    "'pio_' is a reserved name prefix."
+                )

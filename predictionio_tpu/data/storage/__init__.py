@@ -1,0 +1,45 @@
+"""Storage SPI: metadata/event/model DAOs + pluggable backend registry.
+
+Reference parity: ``data/.../storage/Storage.scala`` (env-var source
+discovery, reflection instantiation, repository accessors) and the DAO traits
+``LEvents.scala`` / ``PEvents.scala`` / ``Apps.scala`` / ``AccessKeys.scala``
+/ ``Channels.scala`` / ``EngineInstances.scala`` / ``EvaluationInstances.scala``
+/ ``Models.scala``.
+"""
+
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    AccessKeys,
+    App,
+    Apps,
+    Channel,
+    Channels,
+    EngineInstance,
+    EngineInstances,
+    EvaluationInstance,
+    EvaluationInstances,
+    LEvents,
+    Model,
+    Models,
+    PEvents,
+)
+from predictionio_tpu.data.storage.registry import Storage, StorageError
+
+__all__ = [
+    "AccessKey",
+    "AccessKeys",
+    "App",
+    "Apps",
+    "Channel",
+    "Channels",
+    "EngineInstance",
+    "EngineInstances",
+    "EvaluationInstance",
+    "EvaluationInstances",
+    "LEvents",
+    "Model",
+    "Models",
+    "PEvents",
+    "Storage",
+    "StorageError",
+]

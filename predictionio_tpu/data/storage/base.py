@@ -1,0 +1,522 @@
+"""Metadata records and abstract DAO interfaces.
+
+Reference parity (record shapes verified in SURVEY.md Appendix A):
+  - ``App(id, name, description)``                    Apps.scala:31-34
+  - ``AccessKey(key, appid, events)``                 AccessKeys.scala:34-49
+  - ``Channel(id, name, appid)``                      Channels.scala:31-57
+  - ``EngineInstance(...)``                           EngineInstances.scala:44-61
+  - ``EvaluationInstance(...)``                       EvaluationInstances.scala:41-54
+  - ``Model(id, models)``                             Models.scala:32-80
+  - ``LEvents`` row CRUD + filtered find + aggregate  LEvents.scala:40-513
+  - ``PEvents`` bulk find/write/delete                PEvents.scala:38-189
+
+The reference's L (local, row-at-a-time, async futures) vs P (parallel,
+RDD-valued) DAO split maps here to: ``LEvents`` = synchronous row API (the
+event server wraps calls in a thread executor), ``PEvents`` = bulk scan API
+returning event iterators plus a columnar export for the TPU ingest path.
+"""
+
+from __future__ import annotations
+
+import abc
+import base64
+import dataclasses
+import datetime as _dt
+import re
+import secrets
+from typing import Any, Iterable, Iterator, Sequence
+
+import numpy as np
+
+from predictionio_tpu.data.aggregator import (
+    SPECIAL_EVENTS,
+    aggregate_properties,
+    aggregate_properties_single,
+)
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event
+
+# ---------------------------------------------------------------------------
+# Metadata records
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class App:
+    id: int
+    name: str
+    description: str | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AccessKey:
+    key: str
+    appid: int
+    events: tuple[str, ...] = ()  # empty = all events allowed
+
+
+@dataclasses.dataclass(frozen=True)
+class Channel:
+    id: int
+    name: str
+    appid: int
+
+    NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")
+
+    @staticmethod
+    def is_valid_name(name: str) -> bool:
+        return bool(Channel.NAME_RE.match(name))
+
+
+class EngineInstanceStatus:
+    INIT = "INIT"
+    TRAINING = "TRAINING"
+    COMPLETED = "COMPLETED"
+    FAILED = "FAILED"
+
+
+@dataclasses.dataclass
+class EngineInstance:
+    """One training run (ref EngineInstances.scala:44-61)."""
+
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: _dt.datetime
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    batch: str = ""
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    spark_conf: dict[str, str] = dataclasses.field(default_factory=dict)
+    data_source_params: str = "{}"
+    preparator_params: str = "{}"
+    algorithms_params: str = "[]"
+    serving_params: str = "{}"
+
+
+class EvaluationInstanceStatus:
+    INIT = "INIT"
+    EVALUATING = "EVALUATING"
+    EVALCOMPLETED = "EVALCOMPLETED"
+
+
+@dataclasses.dataclass
+class EvaluationInstance:
+    """One evaluation run (ref EvaluationInstances.scala:41-54)."""
+
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: _dt.datetime
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    spark_conf: dict[str, str] = dataclasses.field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+@dataclasses.dataclass
+class Model:
+    """Serialized model blob keyed by engine-instance id (ref Models.scala:32)."""
+
+    id: str
+    models: bytes
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return {"id": self.id, "models": base64.b64encode(self.models).decode()}
+
+
+def generate_access_key() -> str:
+    """64 random bytes, base64 url-safe, no padding (ref AccessKeys.scala:44-49)."""
+    return base64.urlsafe_b64encode(secrets.token_bytes(48)).decode().rstrip("=")
+
+
+# ---------------------------------------------------------------------------
+# Metadata DAO interfaces
+# ---------------------------------------------------------------------------
+
+
+class Apps(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, app: App) -> int | None:
+        """Insert; auto-generate id when app.id == 0. Returns the id."""
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> App | None: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> App | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> None: ...
+
+
+class AccessKeys(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, k: AccessKey) -> str | None:
+        """Insert; auto-generate key when blank. Returns the key."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> AccessKey | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, k: AccessKey) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> None: ...
+
+
+class Channels(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> int | None:
+        """Insert; auto-generate id when 0; reject invalid names."""
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Channel | None: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> list[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> None: ...
+
+
+class EngineInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EngineInstance) -> str:
+        """Insert; auto-generate id when blank. Returns the id."""
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> EngineInstance | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> EngineInstance | None:
+        """Most recent COMPLETED instance for the tuple — drives deploy
+        (ref EngineInstances.scala getLatestCompleted)."""
+
+    @abc.abstractmethod
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, instance: EngineInstance) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> None: ...
+
+
+class EvaluationInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> EvaluationInstance | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> list[EvaluationInstance]:
+        """EVALCOMPLETED instances, newest first (drives the dashboard)."""
+
+    @abc.abstractmethod
+    def update(self, instance: EvaluationInstance) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> None: ...
+
+
+class Models(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, model: Model) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, model_id: str) -> Model | None: ...
+
+    @abc.abstractmethod
+    def delete(self, model_id: str) -> None: ...
+
+
+# ---------------------------------------------------------------------------
+# Event DAOs
+# ---------------------------------------------------------------------------
+
+
+class LEvents(abc.ABC):
+    """Row-level event CRUD with the reference's filter surface
+    (ref LEvents.scala futureFind :188-200 — 9 filter dimensions + limit +
+    reversed)."""
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        """Initialize storage for an app/channel (ref init)."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        """Drop all events for an app/channel (ref remove)."""
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        """Insert one event, returning its id."""
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    @abc.abstractmethod
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None: ...
+
+    @abc.abstractmethod
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool: ...
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None | type(...) = ...,
+        target_entity_id: str | None | type(...) = ...,
+        limit: int | None = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        """Filtered scan ordered by eventTime asc (desc when reversed).
+
+        ``target_entity_type``/``target_entity_id`` are tri-state like the
+        reference's Option[Option[String]]: ``...`` (ellipsis) = no filter,
+        ``None`` = must be absent, a string = must equal. ``limit=None`` means
+        no cap; the reference treats limit=-1 the same way.
+        """
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        entity_type: str = "",
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        required: Sequence[str] | None = None,
+    ) -> dict[str, PropertyMap]:
+        """Replay $set/$unset/$delete into per-entity PropertyMaps
+        (ref futureAggregateProperties, LEvents.scala:393-428)."""
+        events = self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=list(SPECIAL_EVENTS),
+        )
+        result = aggregate_properties(events)
+        if required:
+            req = set(required)
+            result = {
+                k: v for k, v in result.items() if req.issubset(v.keyset())
+            }
+        return result
+
+    def aggregate_properties_of_entity(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_id: str,
+        channel_id: int | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+    ) -> PropertyMap | None:
+        events = self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=list(SPECIAL_EVENTS),
+        )
+        return aggregate_properties_single(events)
+
+
+@dataclasses.dataclass
+class ColumnarEvents:
+    """Dictionary-encoded column block for TPU ingest.
+
+    Replaces the reference's RDD partition feed (JdbcRDD / TableInputFormat /
+    EsInputFormat in the L3 drivers): entity/target/event strings are
+    dictionary-encoded to dense int32 ids so the training path can go straight
+    to device gathers, and ratings/weights ride in a float32 column.
+    """
+
+    event_ids: list[str]
+    event_names: list[str]  # per-row event name (small vocab)
+    entity_ids: np.ndarray  # int32 index into entity_vocab
+    target_ids: np.ndarray  # int32 index into target_vocab, -1 when absent
+    event_codes: np.ndarray  # int32 index into event_vocab
+    timestamps: np.ndarray  # float64 epoch seconds
+    ratings: np.ndarray  # float32, value of properties[rating_key] or nan
+    entity_vocab: list[str]
+    target_vocab: list[str]
+    event_vocab: list[str]
+
+    def __len__(self) -> int:
+        return len(self.event_ids)
+
+
+class PEvents(abc.ABC):
+    """Bulk scan API (ref PEvents.scala:38-189). ``find`` streams events;
+    ``to_columnar`` is the TPU feed path."""
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None | type(...) = ...,
+        target_entity_id: str | None | type(...) = ...,
+    ) -> Iterator[Event]: ...
+
+    @abc.abstractmethod
+    def write(
+        self, events: Iterable[Event], app_id: int, channel_id: int | None = None
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def delete(
+        self, event_ids: Iterable[str], app_id: int, channel_id: int | None = None
+    ) -> None: ...
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        entity_type: str = "",
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        required: Sequence[str] | None = None,
+    ) -> dict[str, PropertyMap]:
+        events = self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=list(SPECIAL_EVENTS),
+        )
+        result = aggregate_properties(events)
+        if required:
+            req = set(required)
+            result = {k: v for k, v in result.items() if req.issubset(v.keyset())}
+        return result
+
+    def extract_entity_map(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: int | None = None,
+    ) -> dict[str, PropertyMap]:
+        """ref PEvents.extractEntityMap — properties per entity of a type."""
+        return self.aggregate_properties(
+            app_id=app_id, channel_id=channel_id, entity_type=entity_type
+        )
+
+    def to_columnar(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        event_names: Sequence[str] | None = None,
+        rating_key: str = "rating",
+        entity_vocab: Sequence[str] | None = None,
+        target_vocab: Sequence[str] | None = None,
+        **find_kwargs: Any,
+    ) -> ColumnarEvents:
+        """Scan once and dictionary-encode into dense arrays.
+
+        Pass pre-built ``entity_vocab``/``target_vocab`` to encode an eval
+        split with the training split's index space (unknown ids get -1).
+        """
+        ent_index: dict[str, int] = (
+            {v: i for i, v in enumerate(entity_vocab)} if entity_vocab else {}
+        )
+        tgt_index: dict[str, int] = (
+            {v: i for i, v in enumerate(target_vocab)} if target_vocab else {}
+        )
+        frozen_ent = entity_vocab is not None
+        frozen_tgt = target_vocab is not None
+        ev_index: dict[str, int] = {}
+        event_ids: list[str] = []
+        names: list[str] = []
+        ent_col: list[int] = []
+        tgt_col: list[int] = []
+        ev_col: list[int] = []
+        ts_col: list[float] = []
+        rating_col: list[float] = []
+        for e in self.find(
+            app_id=app_id, channel_id=channel_id, event_names=event_names, **find_kwargs
+        ):
+            event_ids.append(e.event_id or "")
+            names.append(e.event)
+            if frozen_ent:
+                ent_col.append(ent_index.get(e.entity_id, -1))
+            else:
+                ent_col.append(ent_index.setdefault(e.entity_id, len(ent_index)))
+            if e.target_entity_id is None:
+                tgt_col.append(-1)
+            elif frozen_tgt:
+                tgt_col.append(tgt_index.get(e.target_entity_id, -1))
+            else:
+                tgt_col.append(tgt_index.setdefault(e.target_entity_id, len(tgt_index)))
+            ev_col.append(ev_index.setdefault(e.event, len(ev_index)))
+            ts_col.append(e.event_time.timestamp())
+            r = e.properties.get_opt(rating_key)
+            rating_col.append(float(r) if isinstance(r, (int, float)) else float("nan"))
+        return ColumnarEvents(
+            event_ids=event_ids,
+            event_names=names,
+            entity_ids=np.asarray(ent_col, dtype=np.int32),
+            target_ids=np.asarray(tgt_col, dtype=np.int32),
+            event_codes=np.asarray(ev_col, dtype=np.int32),
+            timestamps=np.asarray(ts_col, dtype=np.float64),
+            ratings=np.asarray(rating_col, dtype=np.float32),
+            entity_vocab=list(entity_vocab) if frozen_ent else list(ent_index),
+            target_vocab=list(target_vocab) if frozen_tgt else list(tgt_index),
+            event_vocab=list(ev_index),
+        )

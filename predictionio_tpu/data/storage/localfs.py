@@ -1,0 +1,57 @@
+"""Local-filesystem model blob store.
+
+Reference parity: ``storage/localfs/.../LocalFSModels.scala`` (files named
+``pio_model_<id>`` under a base dir) — also subsumes the hdfs and s3 drivers'
+role (model blobs only) for single-host deployments.
+"""
+
+from __future__ import annotations
+
+import os
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import Model
+
+
+class LocalFSModels(base.Models):
+    def __init__(self, basedir: str):
+        self._basedir = basedir
+        os.makedirs(basedir, exist_ok=True)
+
+    def _path(self, model_id: str) -> str:
+        safe = model_id.replace(os.sep, "_")
+        return os.path.join(self._basedir, f"pio_model_{safe}")
+
+    def insert(self, model: Model) -> None:
+        tmp = self._path(model.id) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(model.models)
+        os.replace(tmp, self._path(model.id))
+
+    def get(self, model_id: str) -> Model | None:
+        path = self._path(model_id)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return Model(model_id, f.read())
+
+    def delete(self, model_id: str) -> None:
+        try:
+            os.remove(self._path(model_id))
+        except FileNotFoundError:
+            pass
+
+
+class LocalFSStorageClient:
+    """Backend entry point (type name: ``localfs``). Config key ``PATH``
+    selects the directory."""
+
+    def __init__(self, config: dict | None = None):
+        self.config = config or {}
+        path = self.config.get("PATH") or self.config.get("path")
+        if not path:
+            path = os.path.join(os.path.expanduser("~"), ".pio_store", "models")
+        self._models = LocalFSModels(path)
+
+    def models(self) -> LocalFSModels:
+        return self._models

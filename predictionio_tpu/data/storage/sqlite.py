@@ -1,0 +1,709 @@
+"""SQLite storage backend — the single-host development default.
+
+Plays the role of the reference's JDBC driver
+(``storage/jdbc/.../JDBCLEvents.scala`` / ``JDBCPEvents.scala`` /
+``JDBCApps.scala`` etc., 2,051 LoC of scalikejdbc): a full implementation of
+every DAO on one embedded SQL database. The event-column layout mirrors the
+reference's JDBC DDL (``JDBCLEvents.scala:54-68``) — id, event, entityType,
+entityId, targetEntityType, targetEntityId, properties JSON, eventTime +
+zone, tags, prId, creationTime + zone — with timestamps stored as UTC epoch
+micros plus the original offset, which preserves the wire-format round trip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import sqlite3
+import threading
+import uuid
+from typing import Iterable, Iterator, Sequence
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import UTC, Event
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS apps (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL UNIQUE,
+  description TEXT
+);
+CREATE TABLE IF NOT EXISTS accesskeys (
+  accesskey TEXT PRIMARY KEY,
+  appid INTEGER NOT NULL,
+  events TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS channels (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL,
+  appid INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS engineinstances (
+  id TEXT PRIMARY KEY,
+  status TEXT NOT NULL,
+  startTime INTEGER NOT NULL,
+  endTime INTEGER NOT NULL,
+  engineId TEXT NOT NULL,
+  engineVersion TEXT NOT NULL,
+  engineVariant TEXT NOT NULL,
+  engineFactory TEXT NOT NULL,
+  batch TEXT NOT NULL DEFAULT '',
+  env TEXT NOT NULL DEFAULT '{}',
+  sparkConf TEXT NOT NULL DEFAULT '{}',
+  dataSourceParams TEXT NOT NULL DEFAULT '{}',
+  preparatorParams TEXT NOT NULL DEFAULT '{}',
+  algorithmsParams TEXT NOT NULL DEFAULT '[]',
+  servingParams TEXT NOT NULL DEFAULT '{}'
+);
+CREATE TABLE IF NOT EXISTS evaluationinstances (
+  id TEXT PRIMARY KEY,
+  status TEXT NOT NULL,
+  startTime INTEGER NOT NULL,
+  endTime INTEGER NOT NULL,
+  evaluationClass TEXT NOT NULL DEFAULT '',
+  engineParamsGeneratorClass TEXT NOT NULL DEFAULT '',
+  batch TEXT NOT NULL DEFAULT '',
+  env TEXT NOT NULL DEFAULT '{}',
+  sparkConf TEXT NOT NULL DEFAULT '{}',
+  evaluatorResults TEXT NOT NULL DEFAULT '',
+  evaluatorResultsHTML TEXT NOT NULL DEFAULT '',
+  evaluatorResultsJSON TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS models (
+  id TEXT PRIMARY KEY,
+  models BLOB NOT NULL
+);
+"""
+
+_EVENT_TABLE_DDL = """
+CREATE TABLE IF NOT EXISTS {table} (
+  id TEXT PRIMARY KEY,
+  event TEXT NOT NULL,
+  entityType TEXT NOT NULL,
+  entityId TEXT NOT NULL,
+  targetEntityType TEXT,
+  targetEntityId TEXT,
+  properties TEXT,
+  eventTime INTEGER NOT NULL,
+  eventTimeZone TEXT NOT NULL,
+  tags TEXT,
+  prId TEXT,
+  creationTime INTEGER NOT NULL,
+  creationTimeZone TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS {table}_time ON {table} (eventTime);
+CREATE INDEX IF NOT EXISTS {table}_entity ON {table} (entityType, entityId);
+"""
+
+
+def _micros(t: _dt.datetime) -> int:
+    if t.tzinfo is None:  # naive filters/timestamps are interpreted as UTC
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return int(t.timestamp() * 1_000_000)
+
+
+def _from_micros(us: int, offset: str) -> _dt.datetime:
+    t = _dt.datetime.fromtimestamp(us / 1_000_000, tz=UTC)
+    if offset and offset != "Z":
+        hh, _, mm = offset.lstrip("+-").partition(":")
+        delta = _dt.timedelta(hours=int(hh), minutes=int(mm or 0))
+        if offset.startswith("-"):
+            delta = -delta
+        t = t.astimezone(_dt.timezone(delta))
+    return t
+
+
+def _offset_of(t: _dt.datetime) -> str:
+    off = t.utcoffset() or _dt.timedelta(0)
+    if not off:
+        return "Z"
+    total = int(off.total_seconds())
+    sign = "+" if total >= 0 else "-"
+    total = abs(total)
+    return f"{sign}{total // 3600:02d}:{(total % 3600) // 60:02d}"
+
+
+def _event_table(app_id: int, channel_id: int | None) -> str:
+    return f"events_{app_id}" if channel_id is None else f"events_{app_id}_{channel_id}"
+
+
+class SQLiteStorageClient:
+    """Backend entry point (type name: ``sqlite``). Config key ``path``
+    selects the database file; ``:memory:`` works for tests but is
+    per-connection, so a shared connection is used throughout."""
+
+    def __init__(self, config: dict | None = None):
+        self.config = config or {}
+        self.path = self.config.get("PATH") or self.config.get("path") or ":memory:"
+        self._conn = sqlite3.connect(self.path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._lock = threading.RLock()
+        self._initialized_event_tables: set[str] = set()
+        with self._lock, self._conn:
+            self._conn.executescript(_SCHEMA)
+
+    # -- connection helpers -------------------------------------------------
+    def execute(self, sql: str, params: Sequence = ()) -> sqlite3.Cursor:
+        with self._lock, self._conn:
+            return self._conn.execute(sql, params)
+
+    def query(self, sql: str, params: Sequence = ()) -> list[tuple]:
+        with self._lock:
+            return self._conn.execute(sql, params).fetchall()
+
+    def close(self) -> None:
+        self._conn.close()
+
+    # DAO accessors used by registry reflection
+    def l_events(self) -> "SQLiteLEvents":
+        return SQLiteLEvents(self)
+
+    def p_events(self) -> "SQLitePEvents":
+        return SQLitePEvents(self)
+
+    def apps(self) -> "SQLiteApps":
+        return SQLiteApps(self)
+
+    def access_keys(self) -> "SQLiteAccessKeys":
+        return SQLiteAccessKeys(self)
+
+    def channels(self) -> "SQLiteChannels":
+        return SQLiteChannels(self)
+
+    def engine_instances(self) -> "SQLiteEngineInstances":
+        return SQLiteEngineInstances(self)
+
+    def evaluation_instances(self) -> "SQLiteEvaluationInstances":
+        return SQLiteEvaluationInstances(self)
+
+    def models(self) -> "SQLiteModels":
+        return SQLiteModels(self)
+
+
+class SQLiteLEvents(base.LEvents):
+    def __init__(self, client: SQLiteStorageClient):
+        self._c = client
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        table = _event_table(app_id, channel_id)
+        if table in self._c._initialized_event_tables:
+            return True
+        with self._c._lock, self._c._conn:
+            self._c._conn.executescript(_EVENT_TABLE_DDL.format(table=table))
+            self._c._initialized_event_tables.add(table)
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        table = _event_table(app_id, channel_id)
+        self._c.execute(f"DROP TABLE IF EXISTS {table}")
+        self._c._initialized_event_tables.discard(table)
+        return True
+
+    def close(self) -> None:
+        pass
+
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        self.init(app_id, channel_id)
+        event_id = event.event_id or uuid.uuid4().hex
+        table = _event_table(app_id, channel_id)
+        self._c.execute(
+            f"INSERT OR REPLACE INTO {table} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                event_id,
+                event.event,
+                event.entity_type,
+                event.entity_id,
+                event.target_entity_type,
+                event.target_entity_id,
+                event.properties.to_json(),
+                _micros(event.event_time),
+                _offset_of(event.event_time),
+                json.dumps(list(event.tags)),
+                event.pr_id,
+                _micros(event.creation_time),
+                _offset_of(event.creation_time),
+            ),
+        )
+        return event_id
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        self.init(app_id, channel_id)
+        table = _event_table(app_id, channel_id)
+        ids, rows = [], []
+        for event in events:
+            event_id = event.event_id or uuid.uuid4().hex
+            ids.append(event_id)
+            rows.append(
+                (
+                    event_id,
+                    event.event,
+                    event.entity_type,
+                    event.entity_id,
+                    event.target_entity_type,
+                    event.target_entity_id,
+                    event.properties.to_json(),
+                    _micros(event.event_time),
+                    _offset_of(event.event_time),
+                    json.dumps(list(event.tags)),
+                    event.pr_id,
+                    _micros(event.creation_time),
+                    _offset_of(event.creation_time),
+                )
+            )
+        with self._c._lock, self._c._conn:
+            self._c._conn.executemany(
+                f"INSERT OR REPLACE INTO {table} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                rows,
+            )
+        return ids
+
+    @staticmethod
+    def _row_to_event(row: tuple) -> Event:
+        (
+            event_id,
+            name,
+            entity_type,
+            entity_id,
+            tet,
+            tei,
+            properties,
+            event_time,
+            event_tz,
+            tags,
+            pr_id,
+            creation_time,
+            creation_tz,
+        ) = row
+        return Event(
+            event=name,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            target_entity_type=tet,
+            target_entity_id=tei,
+            properties=DataMap.from_json(properties or "{}"),
+            event_time=_from_micros(event_time, event_tz),
+            event_id=event_id,
+            tags=tuple(json.loads(tags or "[]")),
+            pr_id=pr_id,
+            creation_time=_from_micros(creation_time, creation_tz),
+        )
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None:
+        table = _event_table(app_id, channel_id)
+        try:
+            rows = self._c.query(f"SELECT * FROM {table} WHERE id = ?", (event_id,))
+        except sqlite3.OperationalError:
+            return None
+        return self._row_to_event(rows[0]) if rows else None
+
+    def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
+        table = _event_table(app_id, channel_id)
+        try:
+            cur = self._c.execute(f"DELETE FROM {table} WHERE id = ?", (event_id,))
+        except sqlite3.OperationalError:
+            return False
+        return cur.rowcount > 0
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        table = _event_table(app_id, channel_id)
+        clauses, params = [], []
+        if start_time is not None:
+            clauses.append("eventTime >= ?")
+            params.append(_micros(start_time))
+        if until_time is not None:
+            clauses.append("eventTime < ?")
+            params.append(_micros(until_time))
+        if entity_type is not None:
+            clauses.append("entityType = ?")
+            params.append(entity_type)
+        if entity_id is not None:
+            clauses.append("entityId = ?")
+            params.append(entity_id)
+        if event_names is not None:
+            placeholders = ",".join("?" for _ in event_names)
+            clauses.append(f"event IN ({placeholders})")
+            params.extend(event_names)
+        if target_entity_type is not ...:
+            if target_entity_type is None:
+                clauses.append("targetEntityType IS NULL")
+            else:
+                clauses.append("targetEntityType = ?")
+                params.append(target_entity_type)
+        if target_entity_id is not ...:
+            if target_entity_id is None:
+                clauses.append("targetEntityId IS NULL")
+            else:
+                clauses.append("targetEntityId = ?")
+                params.append(target_entity_id)
+        where = f" WHERE {' AND '.join(clauses)}" if clauses else ""
+        order = "DESC" if reversed else "ASC"
+        sql = f"SELECT * FROM {table}{where} ORDER BY eventTime {order}"
+        if limit is not None and limit >= 0:
+            sql += f" LIMIT {int(limit)}"
+        try:
+            rows = self._c.query(sql, params)
+        except sqlite3.OperationalError:  # table not yet created = no events
+            return iter(())
+        return (self._row_to_event(r) for r in rows)
+
+
+class SQLitePEvents(base.PEvents):
+    def __init__(self, client: SQLiteStorageClient):
+        self._c = client
+        self._l = SQLiteLEvents(client)
+
+    def find(self, app_id: int, channel_id: int | None = None, **kw) -> Iterator[Event]:
+        return self._l.find(app_id, channel_id, **kw)
+
+    def write(
+        self, events: Iterable[Event], app_id: int, channel_id: int | None = None
+    ) -> None:
+        self._l.insert_batch(list(events), app_id, channel_id)
+
+    def delete(
+        self, event_ids: Iterable[str], app_id: int, channel_id: int | None = None
+    ) -> None:
+        for eid in event_ids:
+            self._l.delete(eid, app_id, channel_id)
+
+
+class SQLiteApps(base.Apps):
+    def __init__(self, client: SQLiteStorageClient):
+        self._c = client
+
+    def insert(self, app: App) -> int | None:
+        try:
+            if app.id:
+                self._c.execute(
+                    "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
+                    (app.id, app.name, app.description),
+                )
+                return app.id
+            cur = self._c.execute(
+                "INSERT INTO apps (name, description) VALUES (?,?)",
+                (app.name, app.description),
+            )
+            return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, app_id: int) -> App | None:
+        rows = self._c.query("SELECT id, name, description FROM apps WHERE id=?", (app_id,))
+        return App(*rows[0]) if rows else None
+
+    def get_by_name(self, name: str) -> App | None:
+        rows = self._c.query(
+            "SELECT id, name, description FROM apps WHERE name=?", (name,)
+        )
+        return App(*rows[0]) if rows else None
+
+    def get_all(self) -> list[App]:
+        return [App(*r) for r in self._c.query("SELECT id, name, description FROM apps ORDER BY id")]
+
+    def update(self, app: App) -> None:
+        self._c.execute(
+            "UPDATE apps SET name=?, description=? WHERE id=?",
+            (app.name, app.description, app.id),
+        )
+
+    def delete(self, app_id: int) -> None:
+        self._c.execute("DELETE FROM apps WHERE id=?", (app_id,))
+
+
+class SQLiteAccessKeys(base.AccessKeys):
+    def __init__(self, client: SQLiteStorageClient):
+        self._c = client
+
+    def insert(self, k: AccessKey) -> str | None:
+        key = k.key or base.generate_access_key()
+        try:
+            self._c.execute(
+                "INSERT INTO accesskeys (accesskey, appid, events) VALUES (?,?,?)",
+                (key, k.appid, ",".join(k.events)),
+            )
+            return key
+        except sqlite3.IntegrityError:
+            return None
+
+    @staticmethod
+    def _row(r: tuple) -> AccessKey:
+        return AccessKey(r[0], r[1], tuple(e for e in r[2].split(",") if e))
+
+    def get(self, key: str) -> AccessKey | None:
+        rows = self._c.query("SELECT * FROM accesskeys WHERE accesskey=?", (key,))
+        return self._row(rows[0]) if rows else None
+
+    def get_all(self) -> list[AccessKey]:
+        return [self._row(r) for r in self._c.query("SELECT * FROM accesskeys")]
+
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
+        return [
+            self._row(r)
+            for r in self._c.query("SELECT * FROM accesskeys WHERE appid=?", (app_id,))
+        ]
+
+    def update(self, k: AccessKey) -> None:
+        self._c.execute(
+            "UPDATE accesskeys SET appid=?, events=? WHERE accesskey=?",
+            (k.appid, ",".join(k.events), k.key),
+        )
+
+    def delete(self, key: str) -> None:
+        self._c.execute("DELETE FROM accesskeys WHERE accesskey=?", (key,))
+
+
+class SQLiteChannels(base.Channels):
+    def __init__(self, client: SQLiteStorageClient):
+        self._c = client
+
+    def insert(self, channel: Channel) -> int | None:
+        if not Channel.is_valid_name(channel.name):
+            return None
+        try:
+            if channel.id:
+                self._c.execute(
+                    "INSERT INTO channels (id, name, appid) VALUES (?,?,?)",
+                    (channel.id, channel.name, channel.appid),
+                )
+                return channel.id
+            cur = self._c.execute(
+                "INSERT INTO channels (name, appid) VALUES (?,?)",
+                (channel.name, channel.appid),
+            )
+            return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, channel_id: int) -> Channel | None:
+        rows = self._c.query(
+            "SELECT id, name, appid FROM channels WHERE id=?", (channel_id,)
+        )
+        return Channel(*rows[0]) if rows else None
+
+    def get_by_app_id(self, app_id: int) -> list[Channel]:
+        return [
+            Channel(*r)
+            for r in self._c.query(
+                "SELECT id, name, appid FROM channels WHERE appid=?", (app_id,)
+            )
+        ]
+
+    def delete(self, channel_id: int) -> None:
+        self._c.execute("DELETE FROM channels WHERE id=?", (channel_id,))
+
+
+_EI_COLS = (
+    "id, status, startTime, endTime, engineId, engineVersion, engineVariant, "
+    "engineFactory, batch, env, sparkConf, dataSourceParams, preparatorParams, "
+    "algorithmsParams, servingParams"
+)
+
+
+class SQLiteEngineInstances(base.EngineInstances):
+    def __init__(self, client: SQLiteStorageClient):
+        self._c = client
+
+    def insert(self, i: EngineInstance) -> str:
+        iid = i.id or uuid.uuid4().hex
+        i.id = iid
+        self._c.execute(
+            f"INSERT OR REPLACE INTO engineinstances ({_EI_COLS}) "
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                iid,
+                i.status,
+                _micros(i.start_time),
+                _micros(i.end_time),
+                i.engine_id,
+                i.engine_version,
+                i.engine_variant,
+                i.engine_factory,
+                i.batch,
+                json.dumps(i.env),
+                json.dumps(i.spark_conf),
+                i.data_source_params,
+                i.preparator_params,
+                i.algorithms_params,
+                i.serving_params,
+            ),
+        )
+        return iid
+
+    @staticmethod
+    def _row(r: tuple) -> EngineInstance:
+        return EngineInstance(
+            id=r[0],
+            status=r[1],
+            start_time=_from_micros(r[2], "Z"),
+            end_time=_from_micros(r[3], "Z"),
+            engine_id=r[4],
+            engine_version=r[5],
+            engine_variant=r[6],
+            engine_factory=r[7],
+            batch=r[8],
+            env=json.loads(r[9]),
+            spark_conf=json.loads(r[10]),
+            data_source_params=r[11],
+            preparator_params=r[12],
+            algorithms_params=r[13],
+            serving_params=r[14],
+        )
+
+    def get(self, instance_id: str) -> EngineInstance | None:
+        rows = self._c.query(
+            f"SELECT {_EI_COLS} FROM engineinstances WHERE id=?", (instance_id,)
+        )
+        return self._row(rows[0]) if rows else None
+
+    def get_all(self) -> list[EngineInstance]:
+        return [self._row(r) for r in self._c.query(f"SELECT {_EI_COLS} FROM engineinstances")]
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]:
+        rows = self._c.query(
+            f"SELECT {_EI_COLS} FROM engineinstances WHERE status=? AND engineId=? "
+            "AND engineVersion=? AND engineVariant=? ORDER BY startTime DESC",
+            (
+                base.EngineInstanceStatus.COMPLETED,
+                engine_id,
+                engine_version,
+                engine_variant,
+            ),
+        )
+        return [self._row(r) for r in rows]
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> EngineInstance | None:
+        completed = self.get_completed(engine_id, engine_version, engine_variant)
+        return completed[0] if completed else None
+
+    def update(self, i: EngineInstance) -> None:
+        self.insert(i)
+
+    def delete(self, instance_id: str) -> None:
+        self._c.execute("DELETE FROM engineinstances WHERE id=?", (instance_id,))
+
+
+_EVI_COLS = (
+    "id, status, startTime, endTime, evaluationClass, engineParamsGeneratorClass, "
+    "batch, env, sparkConf, evaluatorResults, evaluatorResultsHTML, evaluatorResultsJSON"
+)
+
+
+class SQLiteEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, client: SQLiteStorageClient):
+        self._c = client
+
+    def insert(self, i: EvaluationInstance) -> str:
+        iid = i.id or uuid.uuid4().hex
+        i.id = iid
+        self._c.execute(
+            f"INSERT OR REPLACE INTO evaluationinstances ({_EVI_COLS}) "
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                iid,
+                i.status,
+                _micros(i.start_time),
+                _micros(i.end_time),
+                i.evaluation_class,
+                i.engine_params_generator_class,
+                i.batch,
+                json.dumps(i.env),
+                json.dumps(i.spark_conf),
+                i.evaluator_results,
+                i.evaluator_results_html,
+                i.evaluator_results_json,
+            ),
+        )
+        return iid
+
+    @staticmethod
+    def _row(r: tuple) -> EvaluationInstance:
+        return EvaluationInstance(
+            id=r[0],
+            status=r[1],
+            start_time=_from_micros(r[2], "Z"),
+            end_time=_from_micros(r[3], "Z"),
+            evaluation_class=r[4],
+            engine_params_generator_class=r[5],
+            batch=r[6],
+            env=json.loads(r[7]),
+            spark_conf=json.loads(r[8]),
+            evaluator_results=r[9],
+            evaluator_results_html=r[10],
+            evaluator_results_json=r[11],
+        )
+
+    def get(self, instance_id: str) -> EvaluationInstance | None:
+        rows = self._c.query(
+            f"SELECT {_EVI_COLS} FROM evaluationinstances WHERE id=?", (instance_id,)
+        )
+        return self._row(rows[0]) if rows else None
+
+    def get_all(self) -> list[EvaluationInstance]:
+        return [
+            self._row(r)
+            for r in self._c.query(f"SELECT {_EVI_COLS} FROM evaluationinstances")
+        ]
+
+    def get_completed(self) -> list[EvaluationInstance]:
+        rows = self._c.query(
+            f"SELECT {_EVI_COLS} FROM evaluationinstances WHERE status=? "
+            "ORDER BY startTime DESC",
+            (base.EvaluationInstanceStatus.EVALCOMPLETED,),
+        )
+        return [self._row(r) for r in rows]
+
+    def update(self, i: EvaluationInstance) -> None:
+        self.insert(i)
+
+    def delete(self, instance_id: str) -> None:
+        self._c.execute("DELETE FROM evaluationinstances WHERE id=?", (instance_id,))
+
+
+class SQLiteModels(base.Models):
+    def __init__(self, client: SQLiteStorageClient):
+        self._c = client
+
+    def insert(self, model: Model) -> None:
+        self._c.execute(
+            "INSERT OR REPLACE INTO models (id, models) VALUES (?,?)",
+            (model.id, model.models),
+        )
+
+    def get(self, model_id: str) -> Model | None:
+        rows = self._c.query("SELECT id, models FROM models WHERE id=?", (model_id,))
+        return Model(rows[0][0], rows[0][1]) if rows else None
+
+    def delete(self, model_id: str) -> None:
+        self._c.execute("DELETE FROM models WHERE id=?", (model_id,))

@@ -1,0 +1,9 @@
+"""Engine-facing event stores (app-name addressed).
+
+Reference parity: ``data/.../store/LEventStore.scala``, ``PEventStore.scala``,
+``Common.scala`` (appName -> appId / channelName -> channelId resolution).
+"""
+
+from predictionio_tpu.data.store.event_store import LEventStore, PEventStore
+
+__all__ = ["LEventStore", "PEventStore"]

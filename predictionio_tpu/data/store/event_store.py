@@ -1,0 +1,152 @@
+"""LEventStore / PEventStore — what engine code calls to read events.
+
+Reference parity: ``data/.../store/LEventStore.scala:33-143`` (blocking
+row-level reads by app *name*, used at predict time by e-commerce-style
+algorithms), ``PEventStore.scala:35-119`` (bulk reads for training),
+``Common.scala`` (name->id resolution with channel validation).
+
+The P store's ``to_columnar`` is the TPU on-ramp: one bulk scan,
+dictionary-encoded to dense int32/float32 numpy columns ready for
+``jax.device_put`` / sharded ingest (see ``predictionio_tpu.parallel.ingest``).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Iterator, Sequence
+
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import ColumnarEvents
+from predictionio_tpu.data.storage.registry import Storage, StorageError
+
+
+def resolve_app(
+    storage: Storage, app_name: str, channel_name: str | None = None
+) -> tuple[int, int | None]:
+    """appName -> (appId, channelId) (ref Common.appNameToId)."""
+    app = storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise StorageError(f"App {app_name!r} does not exist.")
+    if channel_name is None:
+        return app.id, None
+    channels = storage.get_meta_data_channels().get_by_app_id(app.id)
+    for c in channels:
+        if c.name == channel_name:
+            return app.id, c.id
+    raise StorageError(
+        f"Channel {channel_name!r} does not exist for app {app_name!r}."
+    )
+
+
+class LEventStore:
+    """Blocking row-level reads, safe to call on the serving hot path."""
+
+    def __init__(self, storage: Storage | None = None):
+        self._storage = storage or Storage.instance()
+
+    def find(
+        self,
+        app_name: str,
+        channel_name: str | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        latest: bool = True,
+    ) -> Iterator[Event]:
+        app_id, channel_id = resolve_app(self._storage, app_name, channel_name)
+        return self._storage.get_l_events().find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit,
+            reversed=latest,
+        )
+
+    def find_by_entity(
+        self,
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        limit: int | None = None,
+        latest: bool = True,
+    ) -> Iterator[Event]:
+        """ref LEventStore.findByEntity — newest-first by default."""
+        return self.find(
+            app_name=app_name,
+            channel_name=channel_name,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit,
+            latest=latest,
+        )
+
+
+class PEventStore:
+    """Bulk reads for training; mirror of ``PEventStore.scala``."""
+
+    def __init__(self, storage: Storage | None = None):
+        self._storage = storage or Storage.instance()
+
+    def find(
+        self,
+        app_name: str,
+        channel_name: str | None = None,
+        **kwargs,
+    ) -> Iterator[Event]:
+        app_id, channel_id = resolve_app(self._storage, app_name, channel_name)
+        return self._storage.get_p_events().find(
+            app_id=app_id, channel_id=channel_id, **kwargs
+        )
+
+    def aggregate_properties(
+        self,
+        app_name: str,
+        entity_type: str,
+        channel_name: str | None = None,
+        start_time: _dt.datetime | None = None,
+        until_time: _dt.datetime | None = None,
+        required: Sequence[str] | None = None,
+    ) -> dict[str, PropertyMap]:
+        app_id, channel_id = resolve_app(self._storage, app_name, channel_name)
+        return self._storage.get_p_events().aggregate_properties(
+            app_id=app_id,
+            channel_id=channel_id,
+            entity_type=entity_type,
+            start_time=start_time,
+            until_time=until_time,
+            required=required,
+        )
+
+    def to_columnar(
+        self,
+        app_name: str,
+        channel_name: str | None = None,
+        **kwargs,
+    ) -> ColumnarEvents:
+        app_id, channel_id = resolve_app(self._storage, app_name, channel_name)
+        return self._storage.get_p_events().to_columnar(
+            app_id=app_id, channel_id=channel_id, **kwargs
+        )

@@ -1,0 +1,121 @@
+"""Property-replay golden tests (ref LEventAggregatorSpec.scala semantics)."""
+
+import datetime as dt
+
+from predictionio_tpu.data.aggregator import (
+    aggregate_properties,
+    aggregate_properties_single,
+)
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+
+UTC = dt.timezone.utc
+
+
+def t(n):
+    return dt.datetime(2024, 1, 1, 0, 0, n, tzinfo=UTC)
+
+
+def ev(name, entity_id, props, n):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=entity_id,
+        properties=DataMap(props),
+        event_time=t(n),
+    )
+
+
+def test_set_merges_latest_wins():
+    result = aggregate_properties(
+        [
+            ev("$set", "u1", {"a": 1, "b": 2}, 1),
+            ev("$set", "u1", {"b": 3, "c": 4}, 2),
+        ]
+    )
+    pm = result["u1"]
+    assert pm.fields == {"a": 1, "b": 3, "c": 4}
+    assert pm.first_updated == t(1)
+    assert pm.last_updated == t(2)
+
+
+def test_order_is_by_event_time_not_arrival():
+    result = aggregate_properties(
+        [
+            ev("$set", "u1", {"b": 3}, 2),
+            ev("$set", "u1", {"a": 1, "b": 2}, 1),
+        ]
+    )
+    assert result["u1"].fields == {"a": 1, "b": 3}
+
+
+def test_unset_removes_keys():
+    result = aggregate_properties(
+        [
+            ev("$set", "u1", {"a": 1, "b": 2}, 1),
+            ev("$unset", "u1", {"a": None}, 2),
+        ]
+    )
+    assert result["u1"].fields == {"b": 2}
+
+
+def test_unset_before_any_set_is_noop():
+    result = aggregate_properties([ev("$unset", "u1", {"a": 1}, 1)])
+    assert "u1" not in result
+
+
+def test_delete_drops_entity():
+    result = aggregate_properties(
+        [
+            ev("$set", "u1", {"a": 1}, 1),
+            ev("$delete", "u1", {}, 2),
+        ]
+    )
+    assert result == {}
+
+
+def test_set_after_delete_resurrects():
+    result = aggregate_properties(
+        [
+            ev("$set", "u1", {"a": 1}, 1),
+            ev("$delete", "u1", {}, 2),
+            ev("$set", "u1", {"b": 2}, 3),
+        ]
+    )
+    assert result["u1"].fields == {"b": 2}
+    # first/lastUpdated span all special events, including pre-delete ones
+    assert result["u1"].first_updated == t(1)
+    assert result["u1"].last_updated == t(3)
+
+
+def test_non_special_events_ignored():
+    result = aggregate_properties(
+        [
+            ev("$set", "u1", {"a": 1}, 1),
+            ev("rate", "u1", {"rating": 5}, 2),
+        ]
+    )
+    assert result["u1"].fields == {"a": 1}
+    assert result["u1"].last_updated == t(1)
+
+
+def test_multiple_entities_grouped():
+    result = aggregate_properties(
+        [
+            ev("$set", "u1", {"a": 1}, 1),
+            ev("$set", "u2", {"b": 2}, 2),
+        ]
+    )
+    assert set(result) == {"u1", "u2"}
+
+
+def test_aggregate_single():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", {"a": 1}, 1),
+            ev("$set", "u1", {"b": 2}, 2),
+        ]
+    )
+    assert pm is not None
+    assert pm.fields == {"a": 1, "b": 2}
+    assert aggregate_properties_single([ev("buy", "u1", {}, 1)]) is None

@@ -1,0 +1,60 @@
+"""DataMap spec — ported behaviors from reference DataMapSpec.scala."""
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap, DataMapError
+
+
+def test_get_required_field():
+    dm = DataMap({"a": 1, "b": "x", "c": [1, 2], "d": 2.5})
+    assert dm.get("a") == 1
+    assert dm.get_string("b") == "x"
+    assert dm.get_list("c") == [1, 2]
+    assert dm.get_double("d") == 2.5
+    assert dm.get_int("a") == 1
+
+
+def test_get_missing_raises():
+    dm = DataMap({"a": 1})
+    with pytest.raises(DataMapError):
+        dm.get("missing")
+
+
+def test_get_null_raises():
+    dm = DataMap({"a": None})
+    with pytest.raises(DataMapError):
+        dm.get("a")
+
+
+def test_get_opt_and_or_else():
+    dm = DataMap({"a": 1, "n": None})
+    assert dm.get_opt("a") == 1
+    assert dm.get_opt("missing") is None
+    assert dm.get_opt("n") is None
+    assert dm.get_or_else("missing", 9) == 9
+    assert dm.get_or_else("n", 9) == 9
+    assert dm.get_or_else("a", 9) == 1
+
+
+def test_union_right_wins():
+    a = DataMap({"x": 1, "y": 2})
+    b = DataMap({"y": 3, "z": 4})
+    assert a.union(b) == DataMap({"x": 1, "y": 3, "z": 4})
+
+
+def test_diff_removes_keys():
+    a = DataMap({"x": 1, "y": 2, "z": 3})
+    assert a.diff(["y", "nope"]) == DataMap({"x": 1, "z": 3})
+
+
+def test_json_roundtrip():
+    dm = DataMap({"a": 1, "b": [1, "two"], "c": {"nested": True}})
+    assert DataMap.from_json(dm.to_json()) == dm
+
+
+def test_mapping_protocol():
+    dm = DataMap({"a": 1})
+    assert "a" in dm
+    assert len(dm) == 1
+    assert dict(dm) == {"a": 1}
+    assert dm.keyset() == {"a"}

@@ -1,0 +1,121 @@
+"""Event model + validation spec (ref Event.scala:112-166,
+EventJson4sSupport wire contract)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event, EventValidation, format_event_time
+
+UTC = dt.timezone.utc
+
+
+def ev(**kw):
+    defaults = dict(event="rate", entity_type="user", entity_id="u1")
+    defaults.update(kw)
+    return Event(**defaults)
+
+
+def test_valid_plain_event():
+    EventValidation.validate(ev())
+
+
+def test_valid_event_with_target():
+    EventValidation.validate(
+        ev(target_entity_type="item", target_entity_id="i1")
+    )
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(event=""),
+        dict(entity_type=""),
+        dict(entity_id=""),
+        dict(target_entity_type="item"),  # target type without id
+        dict(target_entity_id="i1"),  # target id without type
+        dict(target_entity_type="", target_entity_id="i1"),
+        dict(event="$custom"),  # reserved prefix, not special
+        dict(event="pio_thing"),
+        dict(event="$set", target_entity_type="item", target_entity_id="i1"),
+        dict(entity_type="pio_users"),  # reserved non-builtin entity type
+        dict(target_entity_type="pio_x", target_entity_id="i1"),
+    ],
+)
+def test_invalid_events(kw):
+    with pytest.raises(ValueError):
+        EventValidation.validate(ev(**kw))
+
+
+def test_unset_requires_properties():
+    with pytest.raises(ValueError):
+        EventValidation.validate(ev(event="$unset"))
+    EventValidation.validate(ev(event="$unset", properties=DataMap({"a": 1})))
+
+
+def test_special_events_allowed():
+    for name in ("$set", "$unset", "$delete"):
+        props = DataMap({"a": 1}) if name != "$delete" else DataMap()
+        EventValidation.validate(ev(event=name, properties=props))
+
+
+def test_builtin_entity_type_allowed():
+    EventValidation.validate(ev(entity_type="pio_pr"))
+
+
+def test_reserved_property_rejected():
+    with pytest.raises(ValueError):
+        EventValidation.validate(ev(properties=DataMap({"pio_x": 1})))
+    with pytest.raises(ValueError):
+        EventValidation.validate(ev(properties=DataMap({"$weird": 1})))
+
+
+def test_wire_roundtrip():
+    e = Event(
+        event="buy",
+        entity_type="user",
+        entity_id="u1",
+        target_entity_type="item",
+        target_entity_id="i3",
+        properties=DataMap({"price": 9.99}),
+        event_time=dt.datetime(2024, 1, 2, 3, 4, 5, 600000, tzinfo=UTC),
+        pr_id="abc",
+    )
+    d = e.to_json_dict()
+    assert d["eventTime"] == "2024-01-02T03:04:05.600Z"
+    e2 = Event.from_json_dict(d)
+    assert e2.event == e.event
+    assert e2.entity_id == e.entity_id
+    assert e2.target_entity_id == e.target_entity_id
+    assert e2.properties == e.properties
+    assert e2.event_time == e.event_time
+    assert e2.pr_id == "abc"
+
+
+def test_wire_requires_fields():
+    with pytest.raises(ValueError):
+        Event.from_json_dict({"event": "x", "entityType": "user"})
+
+
+def test_wire_default_event_time_is_utc_now():
+    e = Event.from_json_dict({"event": "x", "entityType": "u", "entityId": "1"})
+    assert e.event_time.tzinfo is not None
+    assert abs((dt.datetime.now(tz=UTC) - e.event_time).total_seconds()) < 5
+
+
+def test_wire_rejects_naive_event_time():
+    with pytest.raises(ValueError):
+        Event.from_json_dict(
+            {
+                "event": "x",
+                "entityType": "u",
+                "entityId": "1",
+                "eventTime": "2024-01-02T03:04:05",
+            }
+        )
+
+
+def test_non_utc_offset_formats():
+    t = dt.datetime(2024, 1, 2, 12, 0, 0, tzinfo=dt.timezone(dt.timedelta(hours=8)))
+    assert format_event_time(t) == "2024-01-02T12:00:00.000+08:00"

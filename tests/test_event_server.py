@@ -1,0 +1,327 @@
+"""Event-server REST contract tests (ref EventServiceSpec.scala +
+SegmentIOAuthSpec.scala, run with an in-memory LEvents stub)."""
+
+import asyncio
+import base64
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.data.api.event_server import (
+    EventServer,
+    EventServerConfig,
+)
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+from predictionio_tpu.data.storage.registry import Storage
+
+
+def make_storage() -> tuple[Storage, str]:
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    app_id = storage.get_meta_data_apps().insert(App(0, "testapp"))
+    key = storage.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    return storage, key
+
+
+def with_client(fn, stats: bool = False, storage_and_key=None):
+    """Run an async test body with a live TestClient."""
+
+    async def body():
+        storage, key = storage_and_key or make_storage()
+        server = EventServer(storage=storage, config=EventServerConfig(stats=stats))
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            await fn(client, key, storage)
+        finally:
+            await client.close()
+
+    asyncio.run(body())
+
+
+EVENT = {"event": "rate", "entityType": "user", "entityId": "u1"}
+
+
+def test_root_alive():
+    async def body(client, key, storage):
+        resp = await client.get("/")
+        assert resp.status == 200
+        assert await resp.json() == {"status": "alive"}
+
+    with_client(body)
+
+
+def test_post_event_created():
+    async def body(client, key, storage):
+        resp = await client.post(f"/events.json?accessKey={key}", json=EVENT)
+        assert resp.status == 201
+        data = await resp.json()
+        assert "eventId" in data
+        # event actually landed
+        app_id = storage.get_meta_data_apps().get_by_name("testapp").id
+        stored = storage.get_l_events().get(data["eventId"], app_id)
+        assert stored is not None and stored.event == "rate"
+
+    with_client(body)
+
+
+def test_post_event_missing_auth():
+    async def body(client, key, storage):
+        resp = await client.post("/events.json", json=EVENT)
+        assert resp.status == 401
+
+    with_client(body)
+
+
+def test_post_event_wrong_key():
+    async def body(client, key, storage):
+        resp = await client.post("/events.json?accessKey=WRONG", json=EVENT)
+        assert resp.status == 401
+
+    with_client(body)
+
+
+def test_post_event_basic_auth_header():
+    async def body(client, key, storage):
+        creds = base64.b64encode(f"{key}:".encode()).decode()
+        resp = await client.post(
+            "/events.json", json=EVENT, headers={"Authorization": f"Basic {creds}"}
+        )
+        assert resp.status == 201
+
+    with_client(body)
+
+
+def test_post_event_invalid_payload():
+    async def body(client, key, storage):
+        resp = await client.post(
+            f"/events.json?accessKey={key}",
+            json={"event": "$custom", "entityType": "user", "entityId": "u1"},
+        )
+        assert resp.status == 400
+
+    with_client(body)
+
+
+def test_allowed_events_enforced():
+    storage, _ = make_storage()
+    app_id = storage.get_meta_data_apps().get_by_name("testapp").id
+    restricted = storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ("view",))
+    )
+
+    async def body(client, key, storage):
+        resp = await client.post(f"/events.json?accessKey={restricted}", json=EVENT)
+        assert resp.status == 403
+        ok = await client.post(
+            f"/events.json?accessKey={restricted}",
+            json={**EVENT, "event": "view"},
+        )
+        assert ok.status == 201
+
+    with_client(body, storage_and_key=(storage, restricted))
+
+
+def test_channel_routing():
+    storage, key = make_storage()
+    app_id = storage.get_meta_data_apps().get_by_name("testapp").id
+    storage.get_meta_data_channels().insert(Channel(0, "mobile", app_id))
+
+    async def body(client, key, storage):
+        resp = await client.post(
+            f"/events.json?accessKey={key}&channel=mobile", json=EVENT
+        )
+        assert resp.status == 201
+        bad = await client.post(
+            f"/events.json?accessKey={key}&channel=nope", json=EVENT
+        )
+        assert bad.status == 401
+        # channel events are isolated from the default channel
+        main = await client.get(f"/events.json?accessKey={key}")
+        assert main.status == 404
+        chan = await client.get(f"/events.json?accessKey={key}&channel=mobile")
+        assert chan.status == 200
+
+    with_client(body, storage_and_key=(storage, key))
+
+
+def test_get_events_filters_and_limit():
+    async def body(client, key, storage):
+        for i in range(25):
+            await client.post(
+                f"/events.json?accessKey={key}",
+                json={"event": "rate", "entityType": "user", "entityId": f"u{i}"},
+            )
+        resp = await client.get(f"/events.json?accessKey={key}")
+        assert resp.status == 200
+        assert len(await resp.json()) == 20  # default limit
+        resp = await client.get(f"/events.json?accessKey={key}&limit=5")
+        assert len(await resp.json()) == 5
+        resp = await client.get(f"/events.json?accessKey={key}&entityId=u3")
+        data = await resp.json()
+        assert len(data) == 1 and data[0]["entityId"] == "u3"
+
+    with_client(body)
+
+
+def test_get_events_reversed_requires_entity():
+    async def body(client, key, storage):
+        await client.post(f"/events.json?accessKey={key}", json=EVENT)
+        bad = await client.get(f"/events.json?accessKey={key}&reversed=true")
+        assert bad.status == 400
+        ok = await client.get(
+            f"/events.json?accessKey={key}&reversed=true&entityType=user&entityId=u1"
+        )
+        assert ok.status == 200
+
+    with_client(body)
+
+
+def test_get_delete_single_event():
+    async def body(client, key, storage):
+        resp = await client.post(f"/events.json?accessKey={key}", json=EVENT)
+        eid = (await resp.json())["eventId"]
+        got = await client.get(f"/events/{eid}.json?accessKey={key}")
+        assert got.status == 200
+        assert (await got.json())["entityId"] == "u1"
+        deleted = await client.delete(f"/events/{eid}.json?accessKey={key}")
+        assert deleted.status == 200
+        assert (await deleted.json()) == {"message": "Found"}
+        gone = await client.get(f"/events/{eid}.json?accessKey={key}")
+        assert gone.status == 404
+        again = await client.delete(f"/events/{eid}.json?accessKey={key}")
+        assert again.status == 404
+
+    with_client(body)
+
+
+def test_batch_events():
+    async def body(client, key, storage):
+        batch = [
+            EVENT,
+            {"event": "$custom", "entityType": "user", "entityId": "u2"},  # invalid
+            {**EVENT, "entityId": "u3"},
+        ]
+        resp = await client.post(f"/batch/events.json?accessKey={key}", json=batch)
+        assert resp.status == 200
+        results = await resp.json()
+        assert [r["status"] for r in results] == [201, 400, 201]
+        assert "eventId" in results[0] and "message" in results[1]
+
+    with_client(body)
+
+
+def test_batch_cap_50():
+    async def body(client, key, storage):
+        batch = [EVENT] * 51
+        resp = await client.post(f"/batch/events.json?accessKey={key}", json=batch)
+        assert resp.status == 400
+
+    with_client(body)
+
+
+def test_stats_disabled_and_enabled():
+    async def body_disabled(client, key, storage):
+        resp = await client.get(f"/stats.json?accessKey={key}")
+        assert resp.status == 404
+
+    with_client(body_disabled, stats=False)
+
+    async def body_enabled(client, key, storage):
+        await client.post(f"/events.json?accessKey={key}", json=EVENT)
+        resp = await client.get(f"/stats.json?accessKey={key}")
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["longLive"]["statusCode"] == [{"status": 201, "count": 1}]
+        assert data["longLive"]["basic"][0]["event"] == "rate"
+
+    with_client(body_enabled, stats=True)
+
+
+def test_webhook_segmentio():
+    async def body(client, key, storage):
+        payload = {
+            "version": "2",
+            "type": "track",
+            "userId": "seg-user",
+            "event": "Signed Up",
+            "properties": {"plan": "Pro"},
+            "timestamp": "2024-01-01T00:00:00.000Z",
+        }
+        resp = await client.post(
+            f"/webhooks/segmentio.json?accessKey={key}", json=payload
+        )
+        assert resp.status == 201
+        app_id = storage.get_meta_data_apps().get_by_name("testapp").id
+        events = list(storage.get_l_events().find(app_id))
+        assert len(events) == 1
+        e = events[0]
+        assert e.event == "track" and e.entity_id == "seg-user"
+        assert e.properties.get("properties") == {"plan": "Pro"}
+
+    with_client(body)
+
+
+def test_webhook_unknown_connector():
+    async def body(client, key, storage):
+        resp = await client.post(
+            f"/webhooks/nonexistent.json?accessKey={key}", json={}
+        )
+        assert resp.status == 404
+
+    with_client(body)
+
+
+def test_webhook_bad_payload():
+    async def body(client, key, storage):
+        resp = await client.post(
+            f"/webhooks/segmentio.json?accessKey={key}", json={"type": "track"}
+        )
+        assert resp.status == 400
+
+    with_client(body)
+
+
+def test_webhook_form_mailchimp():
+    async def body(client, key, storage):
+        form = {
+            "type": "subscribe",
+            "fired_at": "2009-03-26 21:35:57",
+            "data[id]": "8a25ff1d98",
+            "data[list_id]": "a6b5da1054",
+            "data[email]": "api@mailchimp.com",
+            "data[email_type]": "html",
+            "data[merges][EMAIL]": "api@mailchimp.com",
+            "data[merges][FNAME]": "MailChimp",
+            "data[merges][LNAME]": "API",
+            "data[ip_opt]": "10.20.10.30",
+            "data[ip_signup]": "10.20.10.30",
+        }
+        resp = await client.post(f"/webhooks/mailchimp?accessKey={key}", data=form)
+        assert resp.status == 201
+        app_id = storage.get_meta_data_apps().get_by_name("testapp").id
+        events = list(storage.get_l_events().find(app_id))
+        assert len(events) == 1
+        e = events[0]
+        assert e.event == "subscribe"
+        assert e.entity_id == "8a25ff1d98"
+        assert e.target_entity_id == "a6b5da1054"
+        assert e.event_time.year == 2009
+
+    with_client(body)
+
+
+def test_plugins_json():
+    async def body(client, key, storage):
+        resp = await client.get("/plugins.json")
+        assert resp.status == 200
+        data = await resp.json()
+        assert "inputblockers" in data["plugins"]
+
+    with_client(body)

@@ -1,0 +1,396 @@
+"""DAO contract tests run against every backend (ref per-backend
+LEventsSpec/PEventsSpec + metadata DAO specs)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineInstanceStatus,
+    EvaluationInstance,
+    EvaluationInstanceStatus,
+    Model,
+)
+from predictionio_tpu.data.storage.jsonl import JSONLStorageClient
+from predictionio_tpu.data.storage.memory import MemoryStorageClient
+from predictionio_tpu.data.storage.registry import Storage, StorageError
+from predictionio_tpu.data.storage.sqlite import SQLiteStorageClient
+
+UTC = dt.timezone.utc
+APP = 7
+
+
+@pytest.fixture(params=["memory", "sqlite", "jsonl"])
+def client(request, tmp_path):
+    if request.param == "memory":
+        return MemoryStorageClient()
+    if request.param == "sqlite":
+        return SQLiteStorageClient({"PATH": str(tmp_path / "t.db")})
+    return JSONLStorageClient({"PATH": str(tmp_path / "events")})
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def meta_client(request, tmp_path):
+    if request.param == "memory":
+        return MemoryStorageClient()
+    return SQLiteStorageClient({"PATH": str(tmp_path / "m.db")})
+
+
+def t(n):
+    return dt.datetime(2024, 1, 1, 0, 0, n, tzinfo=UTC)
+
+
+def ev(name="rate", eid="u1", target=None, n=0, props=None):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=t(n),
+    )
+
+
+# ---------------------------------------------------------------------------
+# LEvents contract
+# ---------------------------------------------------------------------------
+
+
+class TestLEvents:
+    def test_insert_get_delete(self, client):
+        l = client.l_events()
+        l.init(APP)
+        eid = l.insert(ev(), APP)
+        got = l.get(eid, APP)
+        assert got is not None and got.event == "rate" and got.event_id == eid
+        assert l.delete(eid, APP) is True
+        assert l.get(eid, APP) is None
+        assert l.delete(eid, APP) is False
+
+    def test_find_ordering_and_reverse(self, client):
+        l = client.l_events()
+        l.init(APP)
+        for n in (3, 1, 2):
+            l.insert(ev(n=n, eid=f"u{n}"), APP)
+        found = list(l.find(APP))
+        assert [e.entity_id for e in found] == ["u1", "u2", "u3"]
+        rev = list(l.find(APP, reversed=True))
+        assert [e.entity_id for e in rev] == ["u3", "u2", "u1"]
+
+    def test_find_time_window(self, client):
+        l = client.l_events()
+        l.init(APP)
+        for n in range(5):
+            l.insert(ev(n=n, eid=f"u{n}"), APP)
+        found = list(l.find(APP, start_time=t(1), until_time=t(3)))
+        assert [e.entity_id for e in found] == ["u1", "u2"]  # until exclusive
+
+    def test_find_filters(self, client):
+        l = client.l_events()
+        l.init(APP)
+        l.insert(ev("view", "u1", target="i1", n=1), APP)
+        l.insert(ev("buy", "u1", target="i2", n=2), APP)
+        l.insert(ev("view", "u2", target="i1", n=3), APP)
+        l.insert(ev("$set", "u2", n=4, props={"a": 1}), APP)
+        assert len(list(l.find(APP, event_names=["view"]))) == 2
+        assert len(list(l.find(APP, entity_id="u1"))) == 2
+        assert len(list(l.find(APP, target_entity_id="i1"))) == 2
+        # tri-state: None means target must be absent
+        assert len(list(l.find(APP, target_entity_id=None))) == 1
+        assert len(list(l.find(APP, limit=2))) == 2
+
+    def test_channels_isolated(self, client):
+        l = client.l_events()
+        l.init(APP)
+        l.init(APP, 5)
+        l.insert(ev(eid="main"), APP)
+        l.insert(ev(eid="chan"), APP, 5)
+        assert [e.entity_id for e in l.find(APP)] == ["main"]
+        assert [e.entity_id for e in l.find(APP, 5)] == ["chan"]
+
+    def test_apps_isolated(self, client):
+        l = client.l_events()
+        l.init(APP)
+        l.init(APP + 1)
+        l.insert(ev(), APP)
+        assert list(l.find(APP + 1)) == []
+
+    def test_properties_roundtrip(self, client):
+        l = client.l_events()
+        l.init(APP)
+        props = {"rating": 4.5, "tags": ["a", "b"], "nested": {"x": 1}}
+        eid = l.insert(ev(props=props), APP)
+        got = l.get(eid, APP)
+        assert got.properties.fields == props
+
+    def test_aggregate_properties(self, client):
+        l = client.l_events()
+        l.init(APP)
+        l.insert(ev("$set", "u1", n=1, props={"a": 1}), APP)
+        l.insert(ev("$set", "u1", n=2, props={"b": 2}), APP)
+        l.insert(ev("$delete", "u2", n=1), APP)
+        result = l.aggregate_properties(APP, entity_type="user")
+        assert result["u1"].fields == {"a": 1, "b": 2}
+        assert "u2" not in result
+
+    def test_insert_batch(self, client):
+        l = client.l_events()
+        l.init(APP)
+        ids = l.insert_batch([ev(eid=f"u{i}", n=i) for i in range(10)], APP)
+        assert len(ids) == len(set(ids)) == 10
+        assert len(list(l.find(APP))) == 10
+
+    def test_remove(self, client):
+        l = client.l_events()
+        l.init(APP)
+        l.insert(ev(), APP)
+        l.remove(APP)
+        assert list(l.find(APP)) == []
+
+
+# ---------------------------------------------------------------------------
+# PEvents contract + columnar export
+# ---------------------------------------------------------------------------
+
+
+class TestPEvents:
+    def test_write_find(self, client):
+        p = client.p_events()
+        p.write([ev(eid=f"u{i}", n=i) for i in range(4)], APP)
+        assert len(list(p.find(APP))) == 4
+
+    def test_to_columnar(self, client):
+        p = client.p_events()
+        p.write(
+            [
+                ev("rate", "u1", target="i1", n=1, props={"rating": 4.0}),
+                ev("rate", "u2", target="i1", n=2, props={"rating": 3.0}),
+                ev("rate", "u1", target="i2", n=3, props={"rating": 5.0}),
+                ev("view", "u2", target="i2", n=4),
+            ],
+            APP,
+        )
+        col = p.to_columnar(APP, event_names=["rate", "view"])
+        assert len(col) == 4
+        assert col.entity_vocab == ["u1", "u2"]
+        assert col.target_vocab == ["i1", "i2"]
+        np.testing.assert_array_equal(col.entity_ids, [0, 1, 0, 1])
+        np.testing.assert_array_equal(col.target_ids, [0, 0, 1, 1])
+        assert col.ratings[0] == 4.0 and np.isnan(col.ratings[3])
+        assert col.event_names[3] == "view"
+
+    def test_to_columnar_frozen_vocab(self, client):
+        p = client.p_events()
+        p.write([ev("rate", "u1", target="i9", n=1, props={"rating": 1.0})], APP)
+        col = p.to_columnar(
+            APP, entity_vocab=["u0", "u1"], target_vocab=["i1"]
+        )
+        np.testing.assert_array_equal(col.entity_ids, [1])
+        np.testing.assert_array_equal(col.target_ids, [-1])  # unknown item
+
+
+# ---------------------------------------------------------------------------
+# Metadata DAO contracts
+# ---------------------------------------------------------------------------
+
+
+class TestMetadata:
+    def test_apps(self, meta_client):
+        apps = meta_client.apps()
+        aid = apps.insert(App(0, "myapp", "desc"))
+        assert aid and apps.get(aid).name == "myapp"
+        assert apps.get_by_name("myapp").id == aid
+        assert apps.insert(App(0, "myapp")) is None  # duplicate name
+        apps.update(App(aid, "myapp", "newdesc"))
+        assert apps.get(aid).description == "newdesc"
+        aid2 = apps.insert(App(0, "other"))
+        assert aid2 != aid
+        assert len(apps.get_all()) == 2
+        apps.delete(aid)
+        assert apps.get(aid) is None
+
+    def test_access_keys(self, meta_client):
+        keys = meta_client.access_keys()
+        k = keys.insert(AccessKey("", 1, ("buy", "view")))
+        assert k and len(k) > 20
+        got = keys.get(k)
+        assert got.appid == 1 and got.events == ("buy", "view")
+        k2 = keys.insert(AccessKey("explicit", 2, ()))
+        assert k2 == "explicit"
+        assert {x.key for x in keys.get_by_app_id(1)} == {k}
+        keys.delete(k)
+        assert keys.get(k) is None
+
+    def test_channels(self, meta_client):
+        ch = meta_client.channels()
+        cid = ch.insert(Channel(0, "mobile", 1))
+        assert cid and ch.get(cid).name == "mobile"
+        assert ch.insert(Channel(0, "bad name!", 1)) is None
+        assert ch.insert(Channel(0, "x" * 17, 1)) is None
+        assert [c.id for c in ch.get_by_app_id(1)] == [cid]
+        ch.delete(cid)
+        assert ch.get(cid) is None
+
+    def test_engine_instances(self, meta_client):
+        eis = meta_client.engine_instances()
+
+        def make(status, n):
+            return EngineInstance(
+                id="",
+                status=status,
+                start_time=t(n),
+                end_time=t(n),
+                engine_id="e1",
+                engine_version="1",
+                engine_variant="default",
+                engine_factory="f",
+            )
+
+        i1 = eis.insert(make(EngineInstanceStatus.COMPLETED, 1))
+        i2 = eis.insert(make(EngineInstanceStatus.COMPLETED, 5))
+        eis.insert(make(EngineInstanceStatus.TRAINING, 9))
+        latest = eis.get_latest_completed("e1", "1", "default")
+        assert latest.id == i2
+        assert eis.get_latest_completed("e1", "1", "other") is None
+        inst = eis.get(i1)
+        inst.status = EngineInstanceStatus.FAILED
+        eis.update(inst)
+        assert eis.get(i1).status == EngineInstanceStatus.FAILED
+        assert len(eis.get_all()) == 3
+
+    def test_evaluation_instances(self, meta_client):
+        evis = meta_client.evaluation_instances()
+        i1 = evis.insert(
+            EvaluationInstance(
+                id="",
+                status=EvaluationInstanceStatus.EVALCOMPLETED,
+                start_time=t(1),
+                end_time=t(2),
+                evaluator_results="ok",
+            )
+        )
+        assert evis.get(i1).evaluator_results == "ok"
+        assert [i.id for i in evis.get_completed()] == [i1]
+
+    def test_models(self, meta_client):
+        models = meta_client.models()
+        models.insert(Model("abc", b"\x00\x01binary"))
+        assert models.get("abc").models == b"\x00\x01binary"
+        models.delete("abc")
+        assert models.get("abc") is None
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_env_wiring(self, tmp_path):
+        storage = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "pio.db"),
+                "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+                "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "models"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+            }
+        )
+        storage.get_meta_data_apps().insert(App(0, "a"))
+        storage.get_model_data_models().insert(Model("m1", b"blob"))
+        assert (tmp_path / "models" / "pio_model_m1").exists()
+        assert storage.verify_all_data_objects() == []
+
+    def test_default_zero_config(self, tmp_path):
+        storage = Storage(env={"PIO_FS_BASEDIR": str(tmp_path / "store")})
+        assert storage.verify_all_data_objects() == []
+        assert (tmp_path / "store" / "pio.db").exists()
+
+    def test_missing_type_raises(self):
+        with pytest.raises(StorageError):
+            Storage(env={"PIO_STORAGE_SOURCES_X_PATH": "/tmp/x"})
+
+    def test_undeclared_source_raises(self):
+        with pytest.raises(StorageError):
+            Storage(
+                env={
+                    "PIO_STORAGE_SOURCES_A_TYPE": "memory",
+                    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NOPE",
+                }
+            )
+
+    def test_memory_fixture(self, memory_storage):
+        memory_storage.get_meta_data_apps().insert(App(0, "x"))
+        assert memory_storage.get_meta_data_apps().get_by_name("x") is not None
+
+
+# ---------------------------------------------------------------------------
+# BiMap
+# ---------------------------------------------------------------------------
+
+
+class TestBiMap:
+    def test_string_int_dense(self):
+        bm = BiMap.string_int(["b", "a", "b", "c"])
+        assert bm("b") == 0 and bm("a") == 1 and bm("c") == 2
+        assert len(bm) == 3
+
+    def test_inverse(self):
+        bm = BiMap.string_int(["x", "y"])
+        inv = bm.inverse()
+        assert inv(0) == "x" and inv(1) == "y"
+
+    def test_unique_values_enforced(self):
+        with pytest.raises(ValueError):
+            BiMap({"a": 1, "b": 1})
+
+    def test_misc(self):
+        bm = BiMap.string_int(["a", "b", "c"])
+        assert bm.contains("a") and not bm.contains("z")
+        assert bm.get_or_else("z", -1) == -1
+        assert bm.take(2).to_map() == {"a": 0, "b": 1}
+
+
+# ---------------------------------------------------------------------------
+# Regressions from review/verification
+# ---------------------------------------------------------------------------
+
+
+class TestRegressions:
+    def test_upsert_by_event_id_consistent(self, client):
+        """Re-inserting an event with the same id must upsert, not duplicate."""
+        l = client.l_events()
+        l.init(APP)
+        e = ev(props={"v": 1})
+        eid = l.insert(e, APP)
+        import dataclasses as dc
+
+        l.insert(dc.replace(e, event_id=eid, properties={"v": 2}), APP)
+        events = list(l.find(APP))
+        assert len(events) == 1
+        assert events[0].properties.get("v") == 2
+
+    def test_naive_datetime_filters_mean_utc(self, client):
+        l = client.l_events()
+        l.init(APP)
+        for n in range(4):
+            l.insert(ev(n=n, eid=f"u{n}"), APP)
+        naive_start = dt.datetime(2024, 1, 1, 0, 0, 2)  # no tzinfo
+        found = list(l.find(APP, start_time=naive_start))
+        assert [e.entity_id for e in found] == ["u2", "u3"]
+
+    def test_duplicate_channel_id_returns_none(self, meta_client):
+        ch = meta_client.channels()
+        cid = ch.insert(Channel(0, "first", 1))
+        assert ch.insert(Channel(cid, "second", 1)) is None
