@@ -1,0 +1,62 @@
+"""DASE controller API — what engine templates import.
+
+Reference parity: ``core/.../controller/`` package object; the names here
+mirror the reference's public controller surface.
+"""
+
+from predictionio_tpu.controller.base import (
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Doer,
+    IdentityPreparator,
+    SanityCheck,
+)
+from predictionio_tpu.controller.algorithm import (
+    JaxAlgorithm,
+    LocalAlgorithm,
+    PersistentModel,
+    PersistentModelManifest,
+    model_to_host,
+)
+from predictionio_tpu.controller.engine import (
+    Engine,
+    EngineFactory,
+    EngineParams,
+    TrainOptions,
+)
+from predictionio_tpu.controller.params import (
+    EmptyParams,
+    Params,
+    ParamsError,
+    params_from_dict,
+    params_from_json,
+)
+from predictionio_tpu.controller.serving import AverageServing, FirstServing
+
+__all__ = [
+    "AverageServing",
+    "BaseAlgorithm",
+    "BaseDataSource",
+    "BasePreparator",
+    "BaseServing",
+    "Doer",
+    "EmptyParams",
+    "Engine",
+    "EngineFactory",
+    "EngineParams",
+    "FirstServing",
+    "IdentityPreparator",
+    "JaxAlgorithm",
+    "LocalAlgorithm",
+    "Params",
+    "ParamsError",
+    "PersistentModel",
+    "PersistentModelManifest",
+    "SanityCheck",
+    "TrainOptions",
+    "model_to_host",
+    "params_from_dict",
+    "params_from_json",
+]

@@ -1,0 +1,143 @@
+"""DASE base classes and the component-instantiation Doer.
+
+Reference parity: ``core/.../core/BaseDataSource.scala``,
+``BasePreparator.scala``, ``BaseAlgorithm.scala``, ``BaseServing.scala``,
+``AbstractDoer.scala`` (reflective ctor(Params) instantiation),
+``controller/SanityCheck.scala``.
+
+Type parameters follow the reference's ``Engine[TD, EI, PD, Q, P, A]``:
+  TD = training data, EI = evaluation info, PD = prepared data,
+  Q = query, P = predicted result, A = actual result.
+
+The reference's L/P duality (local objects vs RDDs) collapses here: training
+data is whatever the DataSource returns (typically a ``ColumnarEvents`` block
+or jax arrays); distribution is expressed by sharding inside the algorithm,
+not by the type system.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Generic, Sequence, TypeVar
+
+from predictionio_tpu.controller.params import EmptyParams, Params
+from predictionio_tpu.workflow.context import WorkflowContext
+
+TD = TypeVar("TD")
+EI = TypeVar("EI")
+PD = TypeVar("PD")
+Q = TypeVar("Q")
+P = TypeVar("P")
+A = TypeVar("A")
+M = TypeVar("M")  # model
+
+
+class SanityCheck:
+    """Optional mixin for TD/PD/model types: ``sanity_check`` is invoked
+    after each stage unless --skip-sanity-check (ref Engine.scala:650-706)."""
+
+    def sanity_check(self) -> None:
+        raise NotImplementedError
+
+
+class Doer:
+    """Instantiate a DASE component class with its Params
+    (ref AbstractDoer.scala:69 — ctor(params) with fallback to no-arg)."""
+
+    @staticmethod
+    def apply(cls: type, params: Params | None = None) -> Any:
+        params = params if params is not None else EmptyParams()
+        try:
+            sig = inspect.signature(cls.__init__)
+            takes_params = len(sig.parameters) > 1  # beyond self
+        except (TypeError, ValueError):
+            takes_params = False
+        if takes_params:
+            return cls(params)
+        return cls()
+
+
+class BaseDataSource(Generic[TD, EI, Q, A]):
+    """Reads training and evaluation data (ref BaseDataSource.scala:55)."""
+
+    params: Params
+
+    def __init__(self, params: Params | None = None):
+        self.params = params if params is not None else EmptyParams()
+
+    def read_training(self, ctx: WorkflowContext) -> TD:
+        raise NotImplementedError
+
+    def read_eval(self, ctx: WorkflowContext) -> Sequence[tuple[TD, EI, Sequence[tuple[Q, A]]]]:
+        """k folds of (trainingData, evalInfo, [(query, actual)])."""
+        raise NotImplementedError
+
+
+class BasePreparator(Generic[TD, PD]):
+    """ref BasePreparator.scala:45."""
+
+    params: Params
+
+    def __init__(self, params: Params | None = None):
+        self.params = params if params is not None else EmptyParams()
+
+    def prepare(self, ctx: WorkflowContext, training_data: TD) -> PD:
+        raise NotImplementedError
+
+
+class IdentityPreparator(BasePreparator[TD, TD]):
+    """Pass-through preparator (ref IdentityPreparator.scala:91)."""
+
+    def prepare(self, ctx: WorkflowContext, training_data: TD) -> TD:
+        return training_data
+
+
+class BaseAlgorithm(Generic[PD, M, Q, P]):
+    """ref BaseAlgorithm.scala:58-126. Subclasses are the three flavors in
+    ``controller/algorithm.py``; this class defines the train/predict
+    contract plus model-persistence hooks."""
+
+    params: Params
+
+    def __init__(self, params: Params | None = None):
+        self.params = params if params is not None else EmptyParams()
+
+    def train(self, ctx: WorkflowContext, prepared_data: PD) -> Any:
+        raise NotImplementedError
+
+    def predict(self, model: Any, query: Q) -> P:
+        raise NotImplementedError
+
+    def batch_predict(self, model: Any, queries: Sequence[tuple[int, Q]]) -> list[tuple[int, P]]:
+        """Default: map predict over indexed queries (ref P2LAlgorithm
+        default batchPredict :69-71). Jax algorithms override with a
+        vectorized path."""
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+    # -- persistence hooks (ref makePersistentModel, BaseAlgorithm.scala:95)
+    def make_persistent_model(self, ctx: WorkflowContext, model: Any) -> Any:
+        """Return the object to persist for this model. Default: the model
+        itself (everything here is a picklable pytree; the reference's
+        'unit sentinel, retrain on deploy' mode is intentionally dropped —
+        see SURVEY.md section 7 hard part (c))."""
+        return model
+
+    def prepare_model(self, ctx: WorkflowContext, persisted: Any) -> Any:
+        """Rehydrate the persisted object at deploy time (inverse of
+        make_persistent_model)."""
+        return persisted
+
+
+class BaseServing(Generic[Q, P]):
+    """ref BaseServing.scala:54."""
+
+    params: Params
+
+    def __init__(self, params: Params | None = None):
+        self.params = params if params is not None else EmptyParams()
+
+    def supplement(self, query: Q) -> Q:
+        return query
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        raise NotImplementedError

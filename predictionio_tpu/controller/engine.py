@@ -1,0 +1,290 @@
+"""Engine — the DASE composition and its train/eval dataflow.
+
+Reference parity: ``core/.../controller/Engine.scala`` — name->class maps for
+the four roles (:82-118), ``train`` with sanity checks and stop-after flags
+(static :623-710), ``eval`` multi-algo join graph (:728-817), engine-params
+extraction from the engine.json variant (:355-418), ``EngineFactory``
+(``EngineFactory.scala:44``).
+
+The reference's eval join (union + groupByKey over RDDs) becomes a plain
+indexed merge: queries get dense indices, each algorithm batch-predicts over
+the indexed list, predictions regroup by index, serving folds them. Same
+dataflow, no shuffle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Any, Generic, Mapping, Sequence
+
+from predictionio_tpu.controller.base import (
+    A,
+    EI,
+    P,
+    PD,
+    Q,
+    TD,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Doer,
+    SanityCheck,
+)
+from predictionio_tpu.controller.base import BaseAlgorithm
+from predictionio_tpu.controller.params import Params, params_from_dict
+from predictionio_tpu.workflow.context import WorkflowContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class EngineParams:
+    """Named component params (ref EngineParams.scala:35-44)."""
+
+    data_source: tuple[str, Params] = ("", None)  # type: ignore[assignment]
+    preparator: tuple[str, Params] = ("", None)  # type: ignore[assignment]
+    algorithms: list[tuple[str, Params]] = dataclasses.field(default_factory=list)
+    serving: tuple[str, Params] = ("", None)  # type: ignore[assignment]
+
+
+@dataclasses.dataclass
+class TrainOptions:
+    """Sanity-check / stop-after flags (ref WorkflowParams)."""
+
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+
+
+def _maybe_sanity_check(obj: Any, what: str, skip: bool) -> None:
+    if skip:
+        return
+    if isinstance(obj, SanityCheck):
+        logger.info("sanity check %s", what)
+        obj.sanity_check()
+
+
+class Engine(Generic[TD, EI, PD, Q, P, A]):
+    def __init__(
+        self,
+        data_source_classes: Mapping[str, type] | type,
+        preparator_classes: Mapping[str, type] | type,
+        algorithm_classes: Mapping[str, type] | type,
+        serving_classes: Mapping[str, type] | type,
+        query_class: type | None = None,
+    ):
+        def as_map(x) -> dict[str, type]:
+            return dict(x) if isinstance(x, Mapping) else {"": x}
+
+        self.data_source_classes = as_map(data_source_classes)
+        self.preparator_classes = as_map(preparator_classes)
+        self.algorithm_classes = as_map(algorithm_classes)
+        self.serving_classes = as_map(serving_classes)
+        # Serving-side codec (ref BaseAlgorithm.queryClass via TypeResolver):
+        # a class with from_json_dict() for decoding POST /queries.json bodies.
+        self.query_class = query_class
+
+    def decode_query(self, payload: Any) -> Any:
+        if self.query_class is not None and hasattr(
+            self.query_class, "from_json_dict"
+        ):
+            return self.query_class.from_json_dict(payload)
+        return payload
+
+    @staticmethod
+    def encode_result(result: Any) -> Any:
+        if hasattr(result, "to_json_dict"):
+            return result.to_json_dict()
+        if dataclasses.is_dataclass(result) and not isinstance(result, type):
+            return dataclasses.asdict(result)
+        return result
+
+    # ----------------------------------------------------------------- build
+    def _pick(self, classes: dict[str, type], name: str, role: str) -> type:
+        if name in classes:
+            return classes[name]
+        if name == "" and len(classes) == 1:
+            return next(iter(classes.values()))
+        raise KeyError(f"unknown {role} {name!r}; available: {sorted(classes)}")
+
+    def make_components(
+        self, engine_params: EngineParams
+    ) -> tuple[
+        BaseDataSource, BasePreparator, list[BaseAlgorithm], BaseServing
+    ]:
+        ds_name, ds_params = engine_params.data_source
+        prep_name, prep_params = engine_params.preparator
+        serv_name, serv_params = engine_params.serving
+        data_source = Doer.apply(
+            self._pick(self.data_source_classes, ds_name, "datasource"), ds_params
+        )
+        preparator = Doer.apply(
+            self._pick(self.preparator_classes, prep_name, "preparator"), prep_params
+        )
+        algo_list = engine_params.algorithms or [("", None)]
+        algorithms = [
+            Doer.apply(self._pick(self.algorithm_classes, name, "algorithm"), p)
+            for name, p in algo_list
+        ]
+        serving = Doer.apply(
+            self._pick(self.serving_classes, serv_name, "serving"), serv_params
+        )
+        return data_source, preparator, algorithms, serving
+
+    # ----------------------------------------------------------------- train
+    def train(
+        self,
+        ctx: WorkflowContext,
+        engine_params: EngineParams,
+        options: TrainOptions | None = None,
+    ) -> list[Any]:
+        """ref Engine.train static (Engine.scala:623-710): read -> sanity ->
+        prepare -> sanity -> train each algo -> sanity. Returns one model per
+        algorithm."""
+        options = options or TrainOptions()
+        data_source, preparator, algorithms, _ = self.make_components(engine_params)
+
+        td = data_source.read_training(ctx)
+        _maybe_sanity_check(td, "training data", options.skip_sanity_check)
+        if options.stop_after_read:
+            logger.info("stopping after read_training")
+            return []
+
+        pd = preparator.prepare(ctx, td)
+        _maybe_sanity_check(pd, "prepared data", options.skip_sanity_check)
+        if options.stop_after_prepare:
+            logger.info("stopping after prepare")
+            return []
+
+        models: list[Any] = []
+        for i, algo in enumerate(algorithms):
+            logger.info("training algorithm %d: %s", i, type(algo).__name__)
+            model = algo.train(ctx, pd)
+            _maybe_sanity_check(model, f"model {i}", options.skip_sanity_check)
+            models.append(model)
+        return models
+
+    def make_serializable_models(
+        self, ctx: WorkflowContext, engine_params: EngineParams, models: list[Any]
+    ) -> list[Any]:
+        """ref Engine.makeSerializableModels (:284-302)."""
+        _, _, algorithms, _ = self.make_components(engine_params)
+        return [
+            algo.make_persistent_model(ctx, model)
+            for algo, model in zip(algorithms, models)
+        ]
+
+    def prepare_deploy(
+        self, ctx: WorkflowContext, engine_params: EngineParams, persisted: list[Any]
+    ) -> list[Any]:
+        """ref Engine.prepareDeploy (:198-267), minus the retrain-on-deploy
+        mode: every model here is persistable, so deploy only re-lays-out."""
+        _, _, algorithms, _ = self.make_components(engine_params)
+        return [
+            algo.prepare_model(ctx, blob)
+            for algo, blob in zip(algorithms, persisted)
+        ]
+
+    # ------------------------------------------------------------------ eval
+    def eval(
+        self, ctx: WorkflowContext, engine_params: EngineParams
+    ) -> list[tuple[EI, list[tuple[Q, P, A]]]]:
+        """ref Engine.eval (:728-817): per fold, train all algorithms, then
+        supplement -> batch-predict per algo -> regroup by query index ->
+        serve."""
+        data_source, preparator, algorithms, serving = self.make_components(
+            engine_params
+        )
+        results: list[tuple[EI, list[tuple[Q, P, A]]]] = []
+        for fold_idx, (td, ei, qa_pairs) in enumerate(data_source.read_eval(ctx)):
+            logger.info("eval fold %d: %d queries", fold_idx, len(list(qa_pairs)))
+            pd = preparator.prepare(ctx, td)
+            models = [algo.train(ctx, pd) for algo in algorithms]
+            qa_list = list(qa_pairs)
+            supplemented = [
+                (i, serving.supplement(q)) for i, (q, _) in enumerate(qa_list)
+            ]
+            # per-algo batch predict, regrouped by query index
+            per_query: list[list[P]] = [[] for _ in qa_list]
+            for algo, model in zip(algorithms, models):
+                for i, p in algo.batch_predict(model, supplemented):
+                    per_query[i].append(p)
+            joined = [
+                (qa_list[i][0], serving.serve(qa_list[i][0], preds), qa_list[i][1])
+                for i, preds in enumerate(per_query)
+            ]
+            results.append((ei, joined))
+        return results
+
+    # ------------------------------------------------- engine.json extraction
+    def engine_params_from_variant(
+        self, variant: Mapping[str, Any]
+    ) -> EngineParams:
+        """Build EngineParams from a parsed engine.json variant
+        (ref Engine.jValueToEngineParams, Engine.scala:355-418).
+
+        Expected shape::
+
+            {"datasource": {"params": {...}},
+             "preparator": {"params": {...}},
+             "algorithms": [{"name": "als", "params": {...}}, ...],
+             "serving": {"params": {...}}}
+        """
+
+        def one(role: str, classes: dict[str, type]) -> tuple[str, Params]:
+            node = variant.get(role) or {}
+            name = node.get("name", "")
+            cls = self._pick(classes, name, role)
+            params_cls = getattr(cls, "params_class", None)
+            raw = node.get("params") or {}
+            params = params_from_dict(params_cls, raw) if params_cls else None
+            return name, params  # type: ignore[return-value]
+
+        algorithms: list[tuple[str, Params]] = []
+        for node in variant.get("algorithms") or []:
+            name = node.get("name", "")
+            cls = self._pick(self.algorithm_classes, name, "algorithm")
+            params_cls = getattr(cls, "params_class", None)
+            raw = node.get("params") or {}
+            params = params_from_dict(params_cls, raw) if params_cls else None
+            algorithms.append((name, params))  # type: ignore[arg-type]
+        return EngineParams(
+            data_source=one("datasource", self.data_source_classes),
+            preparator=one("preparator", self.preparator_classes),
+            algorithms=algorithms,
+            serving=one("serving", self.serving_classes),
+        )
+
+    @staticmethod
+    def engine_params_to_json(engine_params: EngineParams) -> dict[str, str]:
+        """Flatten params for EngineInstance persistence
+        (ref CreateWorkflow EngineInstance record fields)."""
+
+        def dump(p: Params | None) -> str:
+            return p.to_json() if p is not None else "{}"
+
+        return {
+            "data_source_params": dump(engine_params.data_source[1]),
+            "preparator_params": dump(engine_params.preparator[1]),
+            "algorithms_params": json.dumps(
+                [
+                    {"name": name, "params": json.loads(dump(p))}
+                    for name, p in (engine_params.algorithms or [("", None)])
+                ]
+            ),
+            "serving_params": dump(engine_params.serving[1]),
+        }
+
+
+class EngineFactory:
+    """ref EngineFactory.scala:44 — a callable returning an Engine. Engine
+    templates expose a module-level ``engine_factory()`` function or subclass
+    this."""
+
+    def apply(self) -> Engine:
+        raise NotImplementedError
+
+    def __call__(self) -> Engine:
+        return self.apply()

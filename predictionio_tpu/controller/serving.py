@@ -1,0 +1,22 @@
+"""Serving flavors (ref ``core/.../controller/LServing.scala:55``,
+``LFirstServing.scala:42``, ``LAverageServing.scala:44``)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from predictionio_tpu.controller.base import P, Q, BaseServing
+
+
+class FirstServing(BaseServing[Q, P]):
+    """Serve the first algorithm's prediction (ref LFirstServing)."""
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        return predictions[0]
+
+
+class AverageServing(BaseServing[Q, P]):
+    """Average numeric predictions across algorithms (ref LAverageServing)."""
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        return sum(predictions) / len(predictions)  # type: ignore[return-value]

@@ -93,7 +93,7 @@ class EventServer:
         self._runner: web.AppRunner | None = None
 
     # ------------------------------------------------------------------ auth
-    def _authenticate(self, request: web.Request) -> AuthData | web.Response:
+    async def _authenticate(self, request: web.Request) -> AuthData | web.Response:
         access_key = request.query.get("accessKey")
         channel_name = request.query.get("channel")
         if access_key is None:
@@ -106,14 +106,13 @@ class EventServer:
                     return _json_error(401, "Invalid accessKey.")
             else:
                 return _json_error(401, "Missing accessKey.")
-        key = self.access_keys.get(access_key)
+        key = await self._run(self.access_keys.get, access_key)
         if key is None:
             return _json_error(401, "Invalid accessKey.")
         channel_id = None
         if channel_name is not None:
-            channel_map = {
-                c.name: c.id for c in self.channels.get_by_app_id(key.appid)
-            }
+            channels = await self._run(self.channels.get_by_app_id, key.appid)
+            channel_map = {c.name: c.id for c in channels}
             if channel_name not in channel_map:
                 return _json_error(401, f"Invalid channel '{channel_name}'.")
             channel_id = channel_map[channel_name]
@@ -150,7 +149,7 @@ class EventServer:
         return web.json_response({"status": "alive"})
 
     async def handle_post_event(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._authenticate(request)
         if isinstance(auth, web.Response):
             return auth
         try:
@@ -171,7 +170,7 @@ class EventServer:
         return web.json_response(body, status=status)
 
     async def handle_get_events(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._authenticate(request)
         if isinstance(auth, web.Response):
             return auth
         q = request.query
@@ -208,7 +207,7 @@ class EventServer:
         return web.json_response([e.to_json_dict() for e in events])
 
     async def handle_get_event(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._authenticate(request)
         if isinstance(auth, web.Response):
             return auth
         event_id = request.match_info["event_id"]
@@ -220,7 +219,7 @@ class EventServer:
         return web.json_response(event.to_json_dict())
 
     async def handle_delete_event(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._authenticate(request)
         if isinstance(auth, web.Response):
             return auth
         event_id = request.match_info["event_id"]
@@ -232,7 +231,7 @@ class EventServer:
         return web.json_response({"message": "Found"})
 
     async def handle_batch_events(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._authenticate(request)
         if isinstance(auth, web.Response):
             return auth
         try:
@@ -270,7 +269,7 @@ class EventServer:
         return web.json_response(results)
 
     async def handle_stats(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._authenticate(request)
         if isinstance(auth, web.Response):
             return auth
         if not self.config.stats:
@@ -283,7 +282,7 @@ class EventServer:
         return web.json_response(self.plugin_context.to_json_dict())
 
     async def handle_plugin_rest(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._authenticate(request)
         if isinstance(auth, web.Response):
             return auth
         tail = request.match_info["tail"].split("/")
@@ -304,7 +303,7 @@ class EventServer:
         return web.json_response(result)
 
     async def handle_webhook_json(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._authenticate(request)
         if isinstance(auth, web.Response):
             return auth
         name = request.match_info["name"]
@@ -318,12 +317,28 @@ class EventServer:
             event = connector_to_event(connector, payload)
         except (ConnectorException, ValueError) as exc:
             return _json_error(400, str(exc))
-        status, body = await self._run(self._insert_one, auth, event)
+        return await self._ingest_webhook_event(auth, event)
+
+    async def _ingest_webhook_event(
+        self, auth: AuthData, event: Event
+    ) -> web.Response:
+        """Shared webhook tail: same allowed-events + error contract as
+        POST /events.json (stricter than the reference, which skipped the
+        per-key event check on webhook routes)."""
+        if not auth.allows(event.event):
+            return _json_error(403, f"{event.event} events are not allowed")
+        try:
+            status, body = await self._run(self._insert_one, auth, event)
+        except BlockedEvent as exc:
+            return _json_error(403, str(exc))
+        except Exception as exc:
+            logger.exception("webhook event insert failed")
+            return _json_error(500, str(exc))
         self._bookkeep(auth.app_id, status, event)
         return web.json_response(body, status=status)
 
     async def handle_webhook_form(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._authenticate(request)
         if isinstance(auth, web.Response):
             return auth
         name = request.match_info["name"]
@@ -337,9 +352,7 @@ class EventServer:
             event = connector_to_event(connector, form)
         except (ConnectorException, ValueError) as exc:
             return _json_error(400, str(exc))
-        status, body = await self._run(self._insert_one, auth, event)
-        self._bookkeep(auth.app_id, status, event)
-        return web.json_response(body, status=status)
+        return await self._ingest_webhook_event(auth, event)
 
     # ------------------------------------------------------------------- app
     def make_app(self) -> web.Application:

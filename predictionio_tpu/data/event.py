@@ -100,7 +100,9 @@ class Event:
         for field in ("event", "entityType", "entityId"):
             if field not in d or not isinstance(d[field], str):
                 raise ValueError(f"field {field} is required and must be a string")
-        props = d.get("properties") or {}
+        props = d.get("properties")
+        if props is None:
+            props = {}
         if not isinstance(props, Mapping):
             raise ValueError("properties must be a JSON object")
         raw_time = d.get("eventTime")

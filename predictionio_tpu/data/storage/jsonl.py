@@ -94,6 +94,27 @@ class JSONLEventFiles:
                     f.write(json.dumps(_event_to_row(e), sort_keys=True) + "\n")
             os.replace(tmp, path)
 
+    def remove_ids(
+        self, drop: set[str], app_id: int, channel_id: int | None
+    ) -> int:
+        """Atomically scan + rewrite without the dropped ids, holding the
+        lock throughout so concurrent appends are never lost."""
+        with self._lock:
+            kept, found = [], 0
+            for e in self.scan(app_id, channel_id):
+                if e.event_id in drop:
+                    found += 1
+                else:
+                    kept.append(e)
+            if found:
+                path = self.path(app_id, channel_id)
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    for e in kept:
+                        f.write(json.dumps(_event_to_row(e), sort_keys=True) + "\n")
+                os.replace(tmp, path)
+            return found
+
     def drop(self, app_id: int, channel_id: int | None) -> None:
         with self._lock:
             try:
@@ -143,15 +164,7 @@ class JSONLLEvents(base.LEvents):
         return None
 
     def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
-        kept, found = [], False
-        for e in self._files.scan(app_id, channel_id):
-            if e.event_id == event_id:
-                found = True
-            else:
-                kept.append(e)
-        if found:
-            self._files.rewrite(kept, app_id, channel_id)
-        return found
+        return self._files.remove_ids({event_id}, app_id, channel_id) > 0
 
     def find(
         self,
@@ -203,9 +216,7 @@ class JSONLPEvents(base.PEvents):
     def delete(
         self, event_ids: Iterable[str], app_id: int, channel_id: int | None = None
     ) -> None:
-        drop = set(event_ids)
-        kept = [e for e in self._files.scan(app_id, channel_id) if e.event_id not in drop]
-        self._files.rewrite(kept, app_id, channel_id)
+        self._files.remove_ids(set(event_ids), app_id, channel_id)
 
 
 class JSONLStorageClient:

@@ -137,6 +137,12 @@ def _event_table(app_id: int, channel_id: int | None) -> str:
     return f"events_{app_id}" if channel_id is None else f"events_{app_id}_{channel_id}"
 
 
+def _is_missing_table(exc: sqlite3.OperationalError) -> bool:
+    """Only 'no such table' means 'no events yet'; other operational errors
+    (locked, I/O) must propagate instead of reading as empty data."""
+    return "no such table" in str(exc)
+
+
 class SQLiteStorageClient:
     """Backend entry point (type name: ``sqlite``). Config key ``path``
     selects the database file; ``:memory:`` works for tests but is
@@ -214,28 +220,7 @@ class SQLiteLEvents(base.LEvents):
         pass
 
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
-        self.init(app_id, channel_id)
-        event_id = event.event_id or uuid.uuid4().hex
-        table = _event_table(app_id, channel_id)
-        self._c.execute(
-            f"INSERT OR REPLACE INTO {table} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
-            (
-                event_id,
-                event.event,
-                event.entity_type,
-                event.entity_id,
-                event.target_entity_type,
-                event.target_entity_id,
-                event.properties.to_json(),
-                _micros(event.event_time),
-                _offset_of(event.event_time),
-                json.dumps(list(event.tags)),
-                event.pr_id,
-                _micros(event.creation_time),
-                _offset_of(event.creation_time),
-            ),
-        )
-        return event_id
+        return self.insert_batch([event], app_id, channel_id)[0]
 
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: int | None = None
@@ -307,16 +292,20 @@ class SQLiteLEvents(base.LEvents):
         table = _event_table(app_id, channel_id)
         try:
             rows = self._c.query(f"SELECT * FROM {table} WHERE id = ?", (event_id,))
-        except sqlite3.OperationalError:
-            return None
+        except sqlite3.OperationalError as exc:
+            if _is_missing_table(exc):  # app has no events yet
+                return None
+            raise
         return self._row_to_event(rows[0]) if rows else None
 
     def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
         table = _event_table(app_id, channel_id)
         try:
             cur = self._c.execute(f"DELETE FROM {table} WHERE id = ?", (event_id,))
-        except sqlite3.OperationalError:
-            return False
+        except sqlite3.OperationalError as exc:
+            if _is_missing_table(exc):
+                return False
+            raise
         return cur.rowcount > 0
 
     def find(
@@ -370,8 +359,10 @@ class SQLiteLEvents(base.LEvents):
             sql += f" LIMIT {int(limit)}"
         try:
             rows = self._c.query(sql, params)
-        except sqlite3.OperationalError:  # table not yet created = no events
-            return iter(())
+        except sqlite3.OperationalError as exc:
+            if _is_missing_table(exc):  # table not yet created = no events
+                return iter(())
+            raise
         return (self._row_to_event(r) for r in rows)
 
 
@@ -447,7 +438,7 @@ class SQLiteAccessKeys(base.AccessKeys):
         try:
             self._c.execute(
                 "INSERT INTO accesskeys (accesskey, appid, events) VALUES (?,?,?)",
-                (key, k.appid, ",".join(k.events)),
+                (key, k.appid, json.dumps(list(k.events))),
             )
             return key
         except sqlite3.IntegrityError:
@@ -455,7 +446,10 @@ class SQLiteAccessKeys(base.AccessKeys):
 
     @staticmethod
     def _row(r: tuple) -> AccessKey:
-        return AccessKey(r[0], r[1], tuple(e for e in r[2].split(",") if e))
+        # JSON list; event names may contain any non-reserved characters
+        raw = r[2] or "[]"
+        events = json.loads(raw) if raw.startswith("[") else [e for e in raw.split(",") if e]
+        return AccessKey(r[0], r[1], tuple(events))
 
     def get(self, key: str) -> AccessKey | None:
         rows = self._c.query("SELECT * FROM accesskeys WHERE accesskey=?", (key,))
@@ -473,7 +467,7 @@ class SQLiteAccessKeys(base.AccessKeys):
     def update(self, k: AccessKey) -> None:
         self._c.execute(
             "UPDATE accesskeys SET appid=?, events=? WHERE accesskey=?",
-            (k.appid, ",".join(k.events), k.key),
+            (k.appid, json.dumps(list(k.events)), k.key),
         )
 
     def delete(self, key: str) -> None:

@@ -1,0 +1,7 @@
+"""Bundled engine templates (ref ``examples/`` + the integration-test
+recommendation engine).
+
+Each template package exposes ``engine_factory()`` plus its Query /
+PredictedResult types and ships a default ``engine.json`` in
+``predictionio_tpu/models/<name>/engine.json``.
+"""

@@ -1,0 +1,40 @@
+"""ALS recommendation template.
+
+Reference parity: the quickstart recommendation engine
+(``tests/pio_tests/engines/recommendation-engine/src/main/scala/`` —
+Engine.scala Query/PredictedResult, DataSource.scala rate/buy ingestion with
+k-fold readEval, ALSAlgorithm.scala MLlib ALS, Serving.scala first-serving)
+re-built on the TPU ALS solver in ``predictionio_tpu.ops.als``.
+"""
+
+from predictionio_tpu.models.recommendation.engine import (
+    ActualResult,
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    ALSModel,
+    DataSource,
+    DataSourceParams,
+    ItemScore,
+    PredictedResult,
+    Preparator,
+    Query,
+    Serving,
+    TrainingData,
+    engine_factory,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "ALSAlgorithmParams",
+    "ALSModel",
+    "ActualResult",
+    "DataSource",
+    "DataSourceParams",
+    "ItemScore",
+    "PredictedResult",
+    "Preparator",
+    "Query",
+    "Serving",
+    "TrainingData",
+    "engine_factory",
+]

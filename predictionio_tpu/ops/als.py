@@ -1,0 +1,242 @@
+"""Alternating least squares on TPU.
+
+Replaces MLlib ALS (used by every reference recommendation template, e.g.
+``tests/pio_tests/engines/recommendation-engine/src/main/scala/ALSAlgorithm.scala:79-85``)
+with an ALX-style formulation (PAPERS.md: "ALX: Large Scale Matrix
+Factorization on TPUs"): instead of Spark's shuffle-join of factor blocks,
+each half-iteration builds per-entity normal equations with static-shape
+chunked scatter-adds over the COO rating list, then solves all f-by-f systems
+batched (MXU-friendly einsums + batched Cholesky).
+
+Design notes (TPU):
+  - COO triples are padded to a chunk multiple; padded rows scatter into a
+    dummy entity row so shapes stay static under jit.
+  - The nnz loop is a ``lax.scan`` over fixed-size chunks: each chunk gathers
+    opposite-side factors, forms rank-1 Gram contributions via one einsum
+    (``cf,cg->cfg``), and scatter-adds into the per-entity ``A``/``b``
+    accumulators. No data-dependent shapes anywhere.
+  - Explicit mode solves ``(A_u + reg*I) x = b_u`` per entity.
+    Implicit mode (ref ``ALS.trainImplicit``) uses the classic trick:
+    ``A_u = V^T V + Σ_i (c_i - 1) v_i v_i^T + reg*I`` with confidence
+    ``c = 1 + alpha * r``, so the dense term is a single f×f matmul shared
+    across entities.
+  - Under a mesh, entity accumulators are sharded over the ``data`` axis and
+    the COO chunks are sharded the same way; GSPMD inserts the all-gathers /
+    reduce-scatters for cross-shard scatters. Callers annotate via
+    ``in_shardings`` on the jitted step (see models/recommendation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSConfig:
+    rank: int = 16
+    iterations: int = 10
+    reg: float = 0.1  # lambda
+    implicit: bool = False
+    alpha: float = 1.0  # implicit confidence scale
+    seed: int = 3
+    chunk: int = 16384  # COO rows per scan step
+
+
+def _pad_coo(
+    rows: np.ndarray, cols: np.ndarray, vals: np.ndarray, chunk: int, dummy_row: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    n = rows.shape[0]
+    pad = (-n) % chunk
+    if pad:
+        rows = np.concatenate([rows, np.full(pad, dummy_row, rows.dtype)])
+        cols = np.concatenate([cols, np.zeros(pad, cols.dtype)])
+        vals = np.concatenate([vals, np.zeros(pad, vals.dtype)])
+    return rows, cols, vals
+
+
+def _normal_equations(
+    rows: jnp.ndarray,  # [nnz] entity index being solved (incl. dummy)
+    cols: jnp.ndarray,  # [nnz] opposite entity index
+    vals: jnp.ndarray,  # [nnz] rating / confidence input
+    opposite: jnp.ndarray,  # [n_opp, f] fixed factors
+    n_entities: int,  # includes dummy row
+    chunk: int,
+    implicit: bool,
+    alpha: float,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Accumulate A [E, f, f] and b [E, f] over fixed-size COO chunks."""
+    f = opposite.shape[1]
+    n_chunks = rows.shape[0] // chunk
+    A0 = jnp.zeros((n_entities, f, f), opposite.dtype)
+    b0 = jnp.zeros((n_entities, f), opposite.dtype)
+
+    r_ch = rows.reshape(n_chunks, chunk)
+    c_ch = cols.reshape(n_chunks, chunk)
+    v_ch = vals.reshape(n_chunks, chunk)
+
+    def step(carry, inputs):
+        A, b = carry
+        r, c, v = inputs
+        vecs = opposite[c]  # [chunk, f] gather
+        if implicit:
+            # confidence c_i = 1 + alpha * r; contribution (c_i - 1) v v^T,
+            # preference p = 1 -> b contribution c_i * v
+            conf_minus_1 = alpha * v
+            outer_w = conf_minus_1
+            b_w = 1.0 + alpha * v
+        else:
+            outer_w = jnp.ones_like(v)
+            b_w = v
+        outers = jnp.einsum("c,cf,cg->cfg", outer_w, vecs, vecs)
+        A = A.at[r].add(outers)
+        b = b.at[r].add(b_w[:, None] * vecs)
+        return (A, b), None
+
+    (A, b), _ = lax.scan(step, (A0, b0), (r_ch, c_ch, v_ch))
+    return A, b
+
+
+def _solve_side(
+    rows, cols, vals, opposite, n_entities, chunk, reg, implicit, alpha
+):
+    f = opposite.shape[1]
+    A, b = _normal_equations(
+        rows, cols, vals, opposite, n_entities, chunk, implicit, alpha
+    )
+    eye = jnp.eye(f, dtype=opposite.dtype)
+    if implicit:
+        gram = opposite.T @ opposite  # shared dense term, one f x f matmul
+        A = A + gram[None, :, :]
+    A = A + reg * eye[None, :, :]
+    # batched SPD solve; Cholesky maps well to the MXU at small f
+    factors = jax.scipy.linalg.cho_solve((jnp.linalg.cholesky(A), True), b)
+    return factors
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_users",
+        "n_items",
+        "rank",
+        "iterations",
+        "reg",
+        "implicit",
+        "alpha",
+        "chunk",
+    ),
+)
+def _als_iterate(
+    u_rows,
+    i_cols,
+    vals_by_u,
+    i_rows,
+    u_cols,
+    vals_by_i,
+    *,
+    n_users: int,
+    n_items: int,
+    rank: int,
+    iterations: int,
+    reg: float,
+    implicit: bool,
+    alpha: float,
+    chunk: int,
+    seed: int = 0,
+):
+    key = jax.random.PRNGKey(seed)
+    # +1 dummy row absorbs padding scatters
+    item_factors = (
+        jax.random.normal(key, (n_items + 1, rank), jnp.float32) / jnp.sqrt(rank)
+    )
+    user_factors = jnp.zeros((n_users + 1, rank), jnp.float32)
+
+    def body(_, carry):
+        user_f, item_f = carry
+        user_f = _solve_side(
+            u_rows, i_cols, vals_by_u, item_f, n_users + 1, chunk, reg, implicit, alpha
+        )
+        item_f = _solve_side(
+            i_rows, u_cols, vals_by_i, user_f, n_items + 1, chunk, reg, implicit, alpha
+        )
+        return user_f, item_f
+
+    user_factors, item_factors = lax.fori_loop(
+        0, iterations, body, (user_factors, item_factors)
+    )
+    return user_factors[:n_users], item_factors[:n_items]
+
+
+def als_train(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    config: ALSConfig,
+) -> tuple[jax.Array, jax.Array]:
+    """Train explicit or implicit ALS; returns (user_factors [n_users, f],
+    item_factors [n_items, f])."""
+    user_idx = np.asarray(user_idx, np.int32)
+    item_idx = np.asarray(item_idx, np.int32)
+    ratings = np.asarray(ratings, np.float32)
+    valid = (user_idx >= 0) & (item_idx >= 0)
+    user_idx, item_idx, ratings = user_idx[valid], item_idx[valid], ratings[valid]
+    chunk = min(config.chunk, max(256, 1 << int(np.ceil(np.log2(max(1, len(ratings)))))))
+
+    u_rows, i_cols, vals_u = _pad_coo(user_idx, item_idx, ratings, chunk, n_users)
+    i_rows, u_cols, vals_i = _pad_coo(item_idx, user_idx, ratings, chunk, n_items)
+    return _als_iterate(
+        u_rows,
+        i_cols,
+        vals_u,
+        i_rows,
+        u_cols,
+        vals_i,
+        n_users=n_users,
+        n_items=n_items,
+        rank=config.rank,
+        iterations=config.iterations,
+        reg=config.reg,
+        implicit=config.implicit,
+        alpha=config.alpha,
+        chunk=chunk,
+        seed=config.seed,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Serving-side scoring
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_scores(user_vec, item_factors, mask, k: int):
+    scores = item_factors @ user_vec  # [n_items]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    return lax.top_k(scores, k)
+
+
+def predict_scores(user_vec: jax.Array, item_factors: jax.Array) -> jax.Array:
+    return item_factors @ user_vec
+
+
+def top_k_items(
+    user_vec: jax.Array,
+    item_factors: jax.Array,
+    k: int,
+    mask: jax.Array | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Resident compiled top-k over item factors (serving hot path —
+    BASELINE's <10ms p50 target). ``mask`` False = excluded item."""
+    if mask is None:
+        mask = jnp.ones((item_factors.shape[0],), bool)
+    scores, idx = _topk_scores(user_vec, item_factors, mask, k)
+    return np.asarray(scores), np.asarray(idx)

@@ -1,0 +1,20 @@
+"""Mesh construction, sharding helpers and host->device ingest.
+
+This package replaces the reference's Spark substrate (RDD partitioning,
+spark-submit driver/executor topology, netty shuffle — see SURVEY.md section
+2.1): parallelism is expressed as a `jax.sharding.Mesh` over TPU devices with
+named axes, data is ingested host-side and laid out as sharded `jax.Array`s,
+and all cross-device communication is XLA collectives over ICI/DCN.
+"""
+
+from predictionio_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    local_mesh,
+)
+from predictionio_tpu.parallel.ingest import (
+    shard_columns,
+    pad_to_multiple,
+)
+
+__all__ = ["MeshSpec", "make_mesh", "local_mesh", "shard_columns", "pad_to_multiple"]

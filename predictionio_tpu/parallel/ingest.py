@@ -1,0 +1,62 @@
+"""Host -> device ingest: columnar event blocks to sharded jax.Arrays.
+
+Replaces the reference's storage-scan parallelism (JdbcRDD time-range
+partitions, HBase region splits, ES shard splits — SURVEY.md section 2.1):
+the host reads a columnar block once, pads it to a multiple of the data-axis
+size (static shapes for XLA), and lays it out across the mesh with
+``jax.device_put`` / ``make_array_from_process_local_data`` so each device
+holds a contiguous row shard.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def pad_to_multiple(
+    x: np.ndarray, multiple: int, pad_value: Any = 0
+) -> tuple[np.ndarray, int]:
+    """Pad axis 0 to a multiple; returns (padded, original_length)."""
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    pad_width = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad_width, constant_values=pad_value), n
+
+
+def shard_columns(
+    mesh: Mesh,
+    columns: dict[str, np.ndarray],
+    *,
+    axis: str = "data",
+    pad_values: dict[str, Any] | None = None,
+) -> tuple[dict[str, jax.Array], int]:
+    """Shard equal-length host columns over the mesh's data axis.
+
+    Rows are padded to a multiple of the axis size; callers mask with the
+    returned original length. In multi-process mode each process passes its
+    local rows and the result is a globally-sharded array
+    (``make_array_from_process_local_data``); single-process mode uses a
+    plain sharded device_put.
+    """
+    pad_values = pad_values or {}
+    axis_size = mesh.shape[axis]
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+    out: dict[str, jax.Array] = {}
+    n_rows = None
+    for name, col in columns.items():
+        padded, n = pad_to_multiple(col, axis_size, pad_values.get(name, 0))
+        if n_rows is None:
+            n_rows = n
+        elif n != n_rows:
+            raise ValueError("all columns must have the same length")
+        if jax.process_count() > 1:
+            out[name] = jax.make_array_from_process_local_data(sharding, padded)
+        else:
+            out[name] = jax.device_put(padded, sharding)
+    return out, int(n_rows or 0)

@@ -1,0 +1,98 @@
+"""Device-mesh construction.
+
+The standard axes, by convention across the framework:
+
+  - ``data``  — batch/data parallelism (the reference's RDD-partition axis)
+  - ``model`` — sharded factor/embedding tables (the reference delegated this
+                to MLlib ALS block partitioning)
+
+Single-chip and CPU test environments get a 1xN or Nx1 mesh transparently;
+multi-host TPU slices get all addressable devices laid out by
+``mesh_utils.create_device_mesh`` so the ``data`` axis rides ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named axis sizes; -1 means 'all remaining devices'."""
+
+    axes: tuple[tuple[str, int], ...] = (("data", -1),)
+
+    @staticmethod
+    def parse(spec: str | None) -> "MeshSpec":
+        """Parse "data=8,model=2" (engine.json / CLI surface)."""
+        if not spec:
+            return MeshSpec()
+        axes = []
+        for part in spec.split(","):
+            name, _, size = part.partition("=")
+            axes.append((name.strip(), int(size) if size else -1))
+        return MeshSpec(tuple(axes))
+
+
+def _resolve_sizes(axis_sizes: Sequence[int], n_devices: int) -> list[int]:
+    sizes = list(axis_sizes)
+    fixed = 1
+    free = -1
+    for i, s in enumerate(sizes):
+        if s == -1:
+            if free != -1:
+                raise ValueError("at most one mesh axis may be -1")
+            free = i
+        else:
+            fixed *= s
+    if free != -1:
+        if n_devices % fixed:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes {fixed}"
+            )
+        sizes[free] = n_devices // fixed
+    else:
+        if fixed != n_devices:
+            raise ValueError(
+                f"mesh axes {sizes} require {fixed} devices, have {n_devices}"
+            )
+    return sizes
+
+
+def make_mesh(
+    spec: MeshSpec | str | None = None, devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    if isinstance(spec, str) or spec is None:
+        spec = MeshSpec.parse(spec)
+    devs = list(devices) if devices is not None else list(jax.devices())
+    names = tuple(name for name, _ in spec.axes)
+    sizes = _resolve_sizes([s for _, s in spec.axes], len(devs))
+    if devices is not None:
+        mesh_devices = np.asarray(devs).reshape(sizes)
+    else:
+        try:
+            mesh_devices = mesh_utils.create_device_mesh(sizes, devices=devs)
+        except (ValueError, AssertionError):
+            mesh_devices = np.asarray(devs).reshape(sizes)
+    return Mesh(mesh_devices, names)
+
+
+def local_mesh() -> Mesh:
+    """All local devices on one ``data`` axis — the dev/serving default."""
+    return make_mesh(MeshSpec())
+
+
+def data_sharding(mesh: Mesh, *, axis: str = "data") -> NamedSharding:
+    """Rows sharded over the data axis, everything else replicated."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

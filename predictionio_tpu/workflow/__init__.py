@@ -1,0 +1,11 @@
+"""Workflow engine: train/eval/deploy/batch-predict orchestration.
+
+Reference parity: ``core/.../workflow/`` — ``CreateWorkflow`` (train/eval
+main), ``CoreWorkflow`` (train persistence), ``CreateServer`` (deploy),
+``BatchPredict``, ``WorkflowUtils``, ``CleanupFunctions``.
+"""
+
+from predictionio_tpu.workflow.context import WorkflowContext
+from predictionio_tpu.workflow.cleanup import CleanupFunctions
+
+__all__ = ["WorkflowContext", "CleanupFunctions"]

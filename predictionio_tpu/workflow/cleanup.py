@@ -1,0 +1,29 @@
+"""Global cleanup hooks run in the ``finally`` of every workflow main
+(ref ``core/.../workflow/CleanupFunctions.scala:1-65``)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+
+class CleanupFunctions:
+    _fns: list[Callable[[], None]] = []
+
+    @classmethod
+    def add(cls, fn: Callable[[], None]) -> None:
+        cls._fns.append(fn)
+
+    @classmethod
+    def run(cls) -> None:
+        for fn in cls._fns:
+            try:
+                fn()
+            except Exception:
+                logger.exception("cleanup function failed")
+
+    @classmethod
+    def clear(cls) -> None:
+        cls._fns.clear()

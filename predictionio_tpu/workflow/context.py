@@ -1,0 +1,59 @@
+"""WorkflowContext — what DASE components receive instead of a SparkContext.
+
+Reference parity: ``core/.../workflow/WorkflowContext.scala:28-47`` created a
+SparkContext per run with a mode tag ("training"/"evaluation"/"serving").
+Here the context carries the storage locator, the device mesh the run is
+pinned to, the app addressing, and the mode. It is cheap to construct;
+nothing opens until used.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.data.storage.registry import Storage
+
+
+@dataclasses.dataclass
+class WorkflowContext:
+    mode: str = "training"  # training | evaluation | serving
+    app_name: str | None = None
+    channel_name: str | None = None
+    batch: str = ""
+    _storage: "Storage | None" = None
+    _mesh: "Mesh | None" = None
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def storage(self) -> "Storage":
+        if self._storage is None:
+            from predictionio_tpu.data.storage.registry import Storage
+
+            self._storage = Storage.instance()
+        return self._storage
+
+    @property
+    def mesh(self) -> "Mesh":
+        if self._mesh is None:
+            from predictionio_tpu.parallel.mesh import local_mesh
+
+            self._mesh = local_mesh()
+        return self._mesh
+
+    def with_mode(self, mode: str) -> "WorkflowContext":
+        return dataclasses.replace(self, mode=mode)
+
+    # Engine-facing store accessors (what templates actually use)
+    def p_event_store(self):
+        from predictionio_tpu.data.store.event_store import PEventStore
+
+        return PEventStore(self.storage)
+
+    def l_event_store(self):
+        from predictionio_tpu.data.store.event_store import LEventStore
+
+        return LEventStore(self.storage)

@@ -1,0 +1,152 @@
+"""CoreWorkflow — train/eval runs with metadata + model persistence.
+
+Reference parity: ``core/.../workflow/CoreWorkflow.scala`` — ``runTrain``
+(:45-102): insert EngineInstance, engine.train, serialize models into the
+Models repo, mark COMPLETED; ``runEvaluation`` (:104-164): insert
+EvaluationInstance, run evaluator, persist one-liner/HTML/JSON results.
+Train wall-clock is recorded explicitly (the reference only kept
+startTime/endTime implicitly — SURVEY.md section 6 calls this out as a gap).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import time
+from typing import Any
+
+from predictionio_tpu.controller.engine import Engine, EngineParams, TrainOptions
+from predictionio_tpu.data.storage.base import (
+    EngineInstance,
+    EngineInstanceStatus,
+    EvaluationInstance,
+    EvaluationInstanceStatus,
+    Model,
+)
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.workflow import model_io
+from predictionio_tpu.workflow.cleanup import CleanupFunctions
+from predictionio_tpu.workflow.context import WorkflowContext
+from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+logger = logging.getLogger(__name__)
+UTC = _dt.timezone.utc
+
+
+def run_train(
+    engine: Engine,
+    manifest: EngineManifest,
+    engine_params: EngineParams,
+    ctx: WorkflowContext | None = None,
+    options: TrainOptions | None = None,
+    storage: Storage | None = None,
+    batch: str = "",
+    env: dict[str, str] | None = None,
+) -> str:
+    """Run training end-to-end; returns the engine-instance id."""
+    storage = storage or Storage.instance()
+    ctx = ctx or WorkflowContext(mode="training", _storage=storage, batch=batch)
+    instances = storage.get_meta_data_engine_instances()
+    params_json = Engine.engine_params_to_json(engine_params)
+    instance = EngineInstance(
+        id="",
+        status=EngineInstanceStatus.INIT,
+        start_time=_dt.datetime.now(tz=UTC),
+        end_time=_dt.datetime.now(tz=UTC),
+        engine_id=manifest.engine_id,
+        engine_version=manifest.version,
+        engine_variant=manifest.variant,
+        engine_factory=manifest.engine_factory,
+        batch=batch,
+        env=env or {},
+        **params_json,
+    )
+    instance_id = instances.insert(instance)
+    logger.info("engine instance %s created", instance_id)
+    t0 = time.perf_counter()
+    try:
+        instance.status = EngineInstanceStatus.TRAINING
+        instances.update(instance)
+        models = engine.train(ctx, engine_params, options)
+        if options and (options.stop_after_read or options.stop_after_prepare):
+            instance.status = EngineInstanceStatus.COMPLETED
+            instance.end_time = _dt.datetime.now(tz=UTC)
+            instances.update(instance)
+            return instance_id
+        persistable = engine.make_serializable_models(ctx, engine_params, models)
+        blob = model_io.serialize_models(persistable)
+        storage.get_model_data_models().insert(Model(instance_id, blob))
+        wall = time.perf_counter() - t0
+        instance.status = EngineInstanceStatus.COMPLETED
+        instance.end_time = _dt.datetime.now(tz=UTC)
+        instance.spark_conf = {"train_wall_clock_sec": f"{wall:.3f}"}
+        instances.update(instance)
+        logger.info(
+            "training completed: instance %s, %.2fs, %d model(s), %d byte blob",
+            instance_id,
+            wall,
+            len(models),
+            len(blob),
+        )
+        return instance_id
+    except Exception:
+        instance.status = EngineInstanceStatus.FAILED
+        instance.end_time = _dt.datetime.now(tz=UTC)
+        instances.update(instance)
+        raise
+    finally:
+        CleanupFunctions.run()
+
+
+def load_models_for_instance(
+    engine: Engine,
+    engine_params: EngineParams,
+    instance_id: str,
+    ctx: WorkflowContext | None = None,
+    storage: Storage | None = None,
+) -> list[Any]:
+    """Model-repo blob -> deployable models (ref CreateServer.scala:196-220)."""
+    storage = storage or Storage.instance()
+    ctx = ctx or WorkflowContext(mode="serving", _storage=storage)
+    record = storage.get_model_data_models().get(instance_id)
+    if record is None:
+        raise RuntimeError(f"no model blob for engine instance {instance_id}")
+    persisted = model_io.deserialize_models(record.models)
+    return engine.prepare_deploy(ctx, engine_params, persisted)
+
+
+def run_evaluation(
+    evaluation: "Any",
+    ctx: WorkflowContext | None = None,
+    storage: Storage | None = None,
+    batch: str = "",
+) -> tuple[str, Any]:
+    """Run an Evaluation (engine + metric + params list); persists an
+    EvaluationInstance with one-liner/JSON/HTML results. Returns
+    (instance_id, evaluator result)."""
+    storage = storage or Storage.instance()
+    ctx = ctx or WorkflowContext(mode="evaluation", _storage=storage, batch=batch)
+    instances = storage.get_meta_data_evaluation_instances()
+    instance = EvaluationInstance(
+        id="",
+        status=EvaluationInstanceStatus.INIT,
+        start_time=_dt.datetime.now(tz=UTC),
+        end_time=_dt.datetime.now(tz=UTC),
+        evaluation_class=type(evaluation).__module__
+        + "."
+        + type(evaluation).__qualname__,
+        batch=batch,
+    )
+    instance_id = instances.insert(instance)
+    instance.status = EvaluationInstanceStatus.EVALUATING
+    instances.update(instance)
+    result = evaluation.run(ctx)
+    instance.status = EvaluationInstanceStatus.EVALCOMPLETED
+    instance.end_time = _dt.datetime.now(tz=UTC)
+    instance.evaluator_results = result.one_liner()
+    instance.evaluator_results_json = json.dumps(result.to_json_dict())
+    instance.evaluator_results_html = result.to_html()
+    instances.update(instance)
+    CleanupFunctions.run()
+    return instance_id, result

@@ -1,0 +1,285 @@
+"""Engine (query) server — the deploy surface.
+
+Reference parity: ``core/.../workflow/CreateServer.scala`` —
+  POST /queries.json  (:464-616): decode query -> serving.supplement ->
+                      per-algorithm predict -> serving.serve -> JSON;
+                      optional async feedback POST of a `predict` event
+                      (entityType ``pio_pr``, prId) to the event server
+                      (:500-570); per-request latency bookkeeping (:578-585).
+  GET /               engine status incl. requestCount / avgServingSec /
+                      lastServingSec (:385-420).
+  GET /reload         hot-swap to the latest COMPLETED engine instance
+                      (MasterActor :317-343).
+  POST/GET /stop      graceful undeploy (used by the CLI's undeploy).
+  GET /plugins.json   engine-server plugin inventory.
+
+TPU notes: models are re-laid-out on device once at (re)load via
+``Engine.prepare_deploy``; the predict path calls resident jitted functions
+(e.g. the ALS top-k) so a request does one small host->device transfer and
+one device->host top-k readback. Serving latency histogram kept in-process
+(the measurement machinery BASELINE.md requires).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import datetime as _dt
+import json
+import logging
+import time
+from typing import Any
+
+from aiohttp import web
+
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.data.storage.base import EngineInstance
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.workflow.context import WorkflowContext
+from predictionio_tpu.workflow.core_workflow import load_models_for_instance
+from predictionio_tpu.workflow.engine_loader import EngineManifest, load_engine
+from predictionio_tpu.utils.histogram import LatencyHistogram
+
+logger = logging.getLogger(__name__)
+UTC = _dt.timezone.utc
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    ip: str = "0.0.0.0"
+    port: int = 8000
+    accesskey: str | None = None  # optional auth for /queries.json
+    feedback: bool = False
+    event_server_url: str | None = None  # e.g. http://localhost:7070
+    feedback_access_key: str | None = None
+
+
+class QueryServer:
+    def __init__(
+        self,
+        engine: Engine,
+        engine_params: EngineParams,
+        models: list[Any],
+        manifest: EngineManifest,
+        instance_id: str,
+        storage: Storage | None = None,
+        config: ServerConfig | None = None,
+    ):
+        self.engine = engine
+        self.engine_params = engine_params
+        self.manifest = manifest
+        self.instance_id = instance_id
+        self.storage = storage or Storage.instance()
+        self.config = config or ServerConfig()
+        _, _, self.algorithms, self.serving = engine.make_components(engine_params)
+        self.models = models
+        self.start_time = _dt.datetime.now(tz=UTC)
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self.latency = LatencyHistogram()
+        self._runner: web.AppRunner | None = None
+        self._stop_event = asyncio.Event()
+
+    # ---------------------------------------------------------------- routes
+    async def handle_queries(self, request: web.Request) -> web.Response:
+        if self.config.accesskey:
+            supplied = request.query.get("accessKey") or request.headers.get(
+                "Authorization", ""
+            ).removeprefix("Bearer ").strip()
+            if supplied != self.config.accesskey:
+                return web.json_response({"message": "Invalid accessKey."}, status=401)
+        t0 = time.perf_counter()
+        try:
+            payload = await request.json()
+        except Exception as exc:
+            return web.json_response({"message": str(exc)}, status=400)
+        try:
+            query = self.engine.decode_query(payload)
+            supplemented = self.serving.supplement(query)
+            predictions = [
+                algo.predict(model, supplemented)
+                for algo, model in zip(self.algorithms, self.models)
+            ]
+            result = self.serving.serve(query, predictions)
+            body = Engine.encode_result(result)
+        except Exception as exc:
+            logger.exception("query failed")
+            return web.json_response({"message": str(exc)}, status=400)
+        elapsed = time.perf_counter() - t0
+        self.request_count += 1
+        self.last_serving_sec = elapsed
+        self.avg_serving_sec += (elapsed - self.avg_serving_sec) / self.request_count
+        self.latency.observe(elapsed)
+        if self.config.feedback:
+            asyncio.ensure_future(self._send_feedback(payload, body))
+        return web.json_response(body)
+
+    async def _send_feedback(self, query: Any, prediction: Any) -> None:
+        """POST a `predict` event back to the event server
+        (ref CreateServer.scala:500-570)."""
+        url = self.config.event_server_url
+        key = self.config.feedback_access_key
+        if not url or not key:
+            return
+        import aiohttp
+
+        event = {
+            "event": "predict",
+            "entityType": "pio_pr",
+            "entityId": self.manifest.engine_id,
+            "properties": {"query": query, "prediction": prediction},
+        }
+        try:
+            async with aiohttp.ClientSession() as session:
+                await session.post(
+                    f"{url}/events.json", params={"accessKey": key}, json=event
+                )
+        except Exception:
+            logger.exception("feedback POST failed")
+
+    async def handle_status(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "status": "alive",
+                "engineId": self.manifest.engine_id,
+                "engineVersion": self.manifest.version,
+                "engineVariant": self.manifest.variant,
+                "engineFactory": self.manifest.engine_factory,
+                "engineInstanceId": self.instance_id,
+                "startTime": self.start_time.isoformat(),
+                "requestCount": self.request_count,
+                "avgServingSec": self.avg_serving_sec,
+                "lastServingSec": self.last_serving_sec,
+                "latency": self.latency.summary(),
+            }
+        )
+
+    async def handle_reload(self, request: web.Request) -> web.Response:
+        """Swap in the latest COMPLETED instance (ref MasterActor reload)."""
+        instances = self.storage.get_meta_data_engine_instances()
+        latest = instances.get_latest_completed(
+            self.manifest.engine_id, self.manifest.version, self.manifest.variant
+        )
+        if latest is None:
+            return web.json_response(
+                {"message": "no completed engine instance found"}, status=404
+            )
+        try:
+            engine_params = self._engine_params_of(latest)
+            models = load_models_for_instance(
+                self.engine, engine_params, latest.id, storage=self.storage
+            )
+        except Exception as exc:
+            logger.exception("reload failed")
+            return web.json_response({"message": str(exc)}, status=500)
+        _, _, self.algorithms, self.serving = self.engine.make_components(
+            engine_params
+        )
+        self.engine_params = engine_params
+        self.models = models
+        self.instance_id = latest.id
+        logger.info("reloaded engine instance %s", latest.id)
+        return web.json_response({"message": "Reload successful", "instanceId": latest.id})
+
+    def _engine_params_of(self, instance: EngineInstance) -> EngineParams:
+        variant = {
+            "datasource": {"params": json.loads(instance.data_source_params or "{}")},
+            "preparator": {"params": json.loads(instance.preparator_params or "{}")},
+            "algorithms": json.loads(instance.algorithms_params or "[]"),
+            "serving": {"params": json.loads(instance.serving_params or "{}")},
+        }
+        return self.engine.engine_params_from_variant(variant)
+
+    async def handle_stop(self, request: web.Request) -> web.Response:
+        self._stop_event.set()
+        return web.json_response({"message": "Stopping."})
+
+    async def handle_plugins(self, request: web.Request) -> web.Response:
+        return web.json_response({"plugins": {"outputblockers": {}, "outputsniffers": {}}})
+
+    # ------------------------------------------------------------------- app
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/", self.handle_status),
+                web.post("/queries.json", self.handle_queries),
+                web.get("/reload", self.handle_reload),
+                web.post("/stop", self.handle_stop),
+                web.get("/stop", self.handle_stop),
+                web.get("/plugins.json", self.handle_plugins),
+            ]
+        )
+        return app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.make_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.config.ip, self.config.port)
+        await site.start()
+        logger.info("engine server on %s:%d", self.config.ip, self.config.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def run_until_stopped(self) -> None:
+        await self.start()
+        await self._stop_event.wait()
+        await self.stop()
+
+
+def create_query_server(
+    engine_dir: str,
+    variant_path: str | None = None,
+    storage: Storage | None = None,
+    config: ServerConfig | None = None,
+    instance_id: str | None = None,
+) -> QueryServer:
+    """Resolve the latest COMPLETED instance for the engine dir and build a
+    server (ref commands/Engine.deploy :207-242)."""
+    storage = storage or Storage.instance()
+    manifest, engine = load_engine(engine_dir, variant_path)
+    instances = storage.get_meta_data_engine_instances()
+    if instance_id:
+        instance = instances.get(instance_id)
+        if instance is None:
+            raise RuntimeError(f"engine instance {instance_id} not found")
+    else:
+        instance = instances.get_latest_completed(
+            manifest.engine_id, manifest.version, manifest.variant
+        )
+        if instance is None:
+            raise RuntimeError(
+                f"no COMPLETED engine instance for {manifest.engine_id} "
+                f"{manifest.version} {manifest.variant}; run train first"
+            )
+    engine_params = engine.engine_params_from_variant(manifest.variant_json)
+    ctx = WorkflowContext(mode="serving", _storage=storage)
+    models = load_models_for_instance(
+        engine, engine_params, instance.id, ctx=ctx, storage=storage
+    )
+    return QueryServer(
+        engine=engine,
+        engine_params=engine_params,
+        models=models,
+        manifest=manifest,
+        instance_id=instance.id,
+        storage=storage,
+        config=config,
+    )
+
+
+def run_query_server(
+    engine_dir: str,
+    variant_path: str | None = None,
+    config: ServerConfig | None = None,
+) -> None:
+    server = create_query_server(engine_dir, variant_path, config=config)
+
+    async def main():
+        await server.run_until_stopped()
+
+    asyncio.run(main())

@@ -1,0 +1,129 @@
+"""Engine discovery: engine.json variants + factory loading.
+
+Reference parity: ``WorkflowUtils.getEngine`` (reflective factory loading,
+``core/.../workflow/WorkflowUtils.scala``), the engine.json variant format
+(``tests/pio_tests/engines/recommendation-engine/engine.json``), and
+``template.json`` min-version checking (``tools/.../commands/Template.scala:35-69``).
+
+An engine directory contains::
+
+    engine.json     {"id", "description", "engineFactory": "pkg.module.fn",
+                     "datasource": ..., "algorithms": [...], "serving": ...}
+    template.json   {"pio": {"version": {"min": "x.y.z"}}}   (optional)
+    <python files>  importable because the engine dir is added to sys.path
+
+``engineFactory`` is a dotted path to a callable returning an Engine, or to
+an EngineFactory class. The reference compiled jars with sbt; here there is
+no build step — the CLI's `build` verb only validates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+import os
+import sys
+from typing import Any, Mapping
+
+import predictionio_tpu
+from predictionio_tpu.controller.engine import Engine, EngineFactory
+
+
+class EngineLoadError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class EngineManifest:
+    engine_id: str
+    version: str
+    variant: str  # variant id from engine.json ("id" key, default "default")
+    engine_factory: str
+    description: str = ""
+    variant_json: dict[str, Any] = dataclasses.field(default_factory=dict)
+    engine_dir: str = "."
+
+
+def load_engine_factory(dotted: str) -> Engine:
+    """Resolve "pkg.module.attr" to an Engine instance."""
+    module_name, _, attr = dotted.rpartition(".")
+    if not module_name:
+        raise EngineLoadError(f"engineFactory {dotted!r} must be a dotted path")
+    try:
+        module = importlib.import_module(module_name)
+    except ImportError as exc:
+        raise EngineLoadError(f"cannot import {module_name}: {exc}") from exc
+    try:
+        factory = getattr(module, attr)
+    except AttributeError as exc:
+        raise EngineLoadError(f"{module_name} has no attribute {attr}") from exc
+    if isinstance(factory, Engine):
+        return factory
+    if isinstance(factory, type) and issubclass(factory, EngineFactory):
+        return factory()()
+    if callable(factory):
+        engine = factory()
+        if not isinstance(engine, Engine):
+            raise EngineLoadError(
+                f"{dotted} returned {type(engine).__name__}, not an Engine"
+            )
+        return engine
+    raise EngineLoadError(f"{dotted} is not an Engine factory")
+
+
+def _check_template_version(engine_dir: str) -> None:
+    path = os.path.join(engine_dir, "template.json")
+    if not os.path.exists(path):
+        return
+    with open(path) as f:
+        data = json.load(f)
+    min_version = ((data.get("pio") or {}).get("version") or {}).get("min")
+    if not min_version:
+        return
+
+    def vtuple(v: str) -> tuple[int, ...]:
+        return tuple(int(x) for x in v.split(".") if x.isdigit())
+
+    if vtuple(predictionio_tpu.__version__) < vtuple(min_version):
+        raise EngineLoadError(
+            f"template requires framework >= {min_version}, "
+            f"this is {predictionio_tpu.__version__}"
+        )
+
+
+def load_manifest(
+    engine_dir: str, variant_path: str | None = None
+) -> EngineManifest:
+    """Read engine.json (or an alternate variant file) from an engine dir."""
+    engine_dir = os.path.abspath(engine_dir)
+    variant_path = variant_path or os.path.join(engine_dir, "engine.json")
+    if not os.path.isabs(variant_path):
+        variant_path = os.path.join(engine_dir, variant_path)
+    if not os.path.exists(variant_path):
+        raise EngineLoadError(f"engine variant file not found: {variant_path}")
+    _check_template_version(engine_dir)
+    with open(variant_path) as f:
+        variant = json.load(f)
+    factory = variant.get("engineFactory")
+    if not factory:
+        raise EngineLoadError(f"{variant_path} missing engineFactory")
+    return EngineManifest(
+        engine_id=variant.get("id", os.path.basename(engine_dir)),
+        version=variant.get("version", "1"),
+        variant=os.path.basename(variant_path),
+        engine_factory=factory,
+        description=variant.get("description", ""),
+        variant_json=variant,
+        engine_dir=engine_dir,
+    )
+
+
+def load_engine(
+    engine_dir: str, variant_path: str | None = None
+) -> tuple[EngineManifest, Engine]:
+    manifest = load_manifest(engine_dir, variant_path)
+    if manifest.engine_dir not in sys.path:
+        sys.path.insert(0, manifest.engine_dir)
+    engine = load_engine_factory(manifest.engine_factory)
+    return manifest, engine

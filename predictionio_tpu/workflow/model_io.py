@@ -1,0 +1,30 @@
+"""Model serialization for the model repository.
+
+Reference parity: Kryo blobs via ``KryoInstantiator``
+(``CreateServer.scala:59-73``, ``CoreWorkflow.scala:76-81``). Here models are
+pickled pytrees; every jax array has already been pulled to host numpy by
+``make_persistent_model`` so checkpoints are device- and sharding-agnostic
+(train on a pod slice, deploy on one host). A small header versions the
+format.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import zlib
+from typing import Any
+
+MAGIC = b"PIOTPU01"
+
+
+def serialize_models(models: list[Any]) -> bytes:
+    payload = pickle.dumps(models, protocol=pickle.HIGHEST_PROTOCOL)
+    return MAGIC + zlib.compress(payload, level=1)
+
+
+def deserialize_models(blob: bytes) -> list[Any]:
+    if not blob.startswith(MAGIC):
+        raise ValueError("not a predictionio_tpu model blob (bad magic)")
+    payload = zlib.decompress(blob[len(MAGIC):])
+    return pickle.loads(payload)

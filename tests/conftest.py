@@ -8,12 +8,26 @@ exercised without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: env may point at a TPU tunnel
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# A site-installed TPU-tunnel PJRT plugin (sitecustomize) may have imported
+# jax already (so the env vars above are stale) and registered a backend
+# whose device init can block even when the platform is cpu. Re-point the
+# live jax config at cpu and drop non-CPU backend factories.
+try:
+    import jax as _jax
+    from jax._src import xla_bridge as _xb
+
+    _jax.config.update("jax_platforms", "cpu")
+    for _name in [n for n in _xb._backend_factories if n != "cpu"]:
+        _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 

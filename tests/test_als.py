@@ -1,0 +1,104 @@
+"""ALS solver correctness tests (CPU, small synthetic problems)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.als import ALSConfig, als_train, top_k_items
+
+
+def synthetic_ratings(n_users=30, n_items=20, rank=4, density=0.5, seed=0):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(n_users, rank)) / np.sqrt(rank)
+    V = rng.normal(size=(n_items, rank)) / np.sqrt(rank)
+    full = U @ V.T + 3.0
+    mask = rng.random((n_users, n_items)) < density
+    users, items = np.nonzero(mask)
+    return users, items, full[users, items].astype(np.float32)
+
+
+class TestExplicitALS:
+    def test_reconstructs_observed_ratings(self):
+        users, items, vals = synthetic_ratings()
+        uf, vf = als_train(
+            users, items, vals, 30, 20, ALSConfig(rank=8, iterations=15, reg=0.01)
+        )
+        uf, vf = np.asarray(uf), np.asarray(vf)
+        assert uf.shape == (30, 8) and vf.shape == (20, 8)
+        pred = np.sum(uf[users] * vf[items], axis=1)
+        rmse = float(np.sqrt(np.mean((pred - vals) ** 2)))
+        assert rmse < 0.15, f"rmse too high: {rmse}"
+
+    def test_loss_better_than_mean_baseline(self):
+        users, items, vals = synthetic_ratings(density=0.7, seed=1)
+        uf, vf = als_train(
+            users, items, vals, 30, 20, ALSConfig(rank=6, iterations=10, reg=0.05)
+        )
+        pred = np.sum(np.asarray(uf)[users] * np.asarray(vf)[items], axis=1)
+        rmse = np.sqrt(np.mean((pred - vals) ** 2))
+        baseline = np.sqrt(np.mean((vals - vals.mean()) ** 2))
+        assert rmse < baseline / 3
+
+    def test_deterministic_given_seed(self):
+        users, items, vals = synthetic_ratings()
+        cfg = ALSConfig(rank=4, iterations=3, seed=7)
+        uf1, _ = als_train(users, items, vals, 30, 20, cfg)
+        uf2, _ = als_train(users, items, vals, 30, 20, cfg)
+        np.testing.assert_allclose(np.asarray(uf1), np.asarray(uf2))
+
+    def test_negative_indices_dropped(self):
+        users = np.array([0, 1, -1, 2], np.int32)
+        items = np.array([0, 1, 2, -1], np.int32)
+        vals = np.array([5, 4, 3, 2], np.float32)
+        uf, vf = als_train(users, items, vals, 3, 3, ALSConfig(rank=2, iterations=2))
+        assert np.all(np.isfinite(np.asarray(uf)))
+
+    def test_cold_entities_zero_safe(self):
+        # user 2 and item 2 have no ratings; solve must stay finite
+        users = np.array([0, 1], np.int32)
+        items = np.array([0, 1], np.int32)
+        vals = np.array([4.0, 3.0], np.float32)
+        uf, vf = als_train(users, items, vals, 3, 3, ALSConfig(rank=4, iterations=3))
+        assert np.all(np.isfinite(np.asarray(uf)))
+        assert np.all(np.isfinite(np.asarray(vf)))
+
+
+class TestImplicitALS:
+    def test_ranks_positive_interactions_higher(self):
+        rng = np.random.default_rng(2)
+        # two user groups preferring two item groups
+        users, items, vals = [], [], []
+        for u in range(20):
+            group = u % 2
+            for _ in range(8):
+                i = rng.integers(0, 10) + group * 10
+                users.append(u)
+                items.append(int(i))
+                vals.append(1.0)
+        uf, vf = als_train(
+            np.array(users, np.int32),
+            np.array(items, np.int32),
+            np.array(vals, np.float32),
+            20,
+            20,
+            ALSConfig(rank=8, iterations=10, implicit=True, alpha=40.0, reg=0.1),
+        )
+        uf, vf = np.asarray(uf), np.asarray(vf)
+        scores = uf @ vf.T
+        # group-0 users should score group-0 items higher on average
+        g0 = scores[0, :10].mean() - scores[0, 10:].mean()
+        g1 = scores[1, 10:].mean() - scores[1, :10].mean()
+        assert g0 > 0 and g1 > 0
+
+
+class TestTopK:
+    def test_top_k_and_mask(self):
+        import jax.numpy as jnp
+
+        vf = jnp.asarray(np.diag(np.arange(1.0, 6.0)))  # 5 items, rank 5
+        user = jnp.ones(5)
+        scores, idx = top_k_items(user, vf, 3)
+        assert list(idx) == [4, 3, 2]
+        mask = np.ones(5, bool)
+        mask[4] = False  # blacklist best item
+        scores, idx = top_k_items(user, vf, 3, jnp.asarray(mask))
+        assert list(idx) == [3, 2, 1]

@@ -1,0 +1,197 @@
+"""Engine dataflow tests (ref EngineTest / EngineWorkflowTest semantics)."""
+
+import dataclasses
+import json
+
+import pytest
+
+from predictionio_tpu.controller import (
+    Engine,
+    EngineParams,
+    EmptyParams,
+    Params,
+    ParamsError,
+    TrainOptions,
+    params_from_dict,
+)
+from predictionio_tpu.workflow.context import WorkflowContext
+from tests.sample_engine import (
+    Algo0,
+    AlgoParams,
+    DataSource0,
+    DSParams,
+    Model0,
+    Preparator0,
+    Query,
+    Serving0,
+    ServingSum,
+)
+
+
+def make_engine(serving=Serving0):
+    return Engine(
+        {"ds": DataSource0},
+        {"prep": Preparator0},
+        {"a": Algo0},
+        {"s": serving},
+    )
+
+
+def params(ds_id=1, prep_id=2, algos=((3,),)):
+    return EngineParams(
+        data_source=("ds", DSParams(id=ds_id)),
+        preparator=("prep", DSParams(id=prep_id)),
+        algorithms=[("a", AlgoParams(id=a[0])) for a in algos],
+        serving=("s", EmptyParams()),
+    )
+
+
+CTX = WorkflowContext(mode="training")
+
+
+class TestTrain:
+    def test_single_algo_dataflow(self):
+        models = make_engine().train(CTX, params())
+        assert models == [Model0(3, 1, 2)]
+
+    def test_multi_algo(self):
+        models = make_engine().train(CTX, params(algos=((3,), (4,), (5,))))
+        assert [m.algo_id for m in models] == [3, 4, 5]
+        assert all(m.ds_id == 1 and m.prep_id == 2 for m in models)
+
+    def test_sanity_check_failure_propagates(self):
+        ep = params()
+        ep.data_source = ("ds", DSParams(id=1, fail_sanity=True))
+        with pytest.raises(AssertionError):
+            make_engine().train(CTX, ep)
+
+    def test_skip_sanity_check(self):
+        ep = params()
+        ep.data_source = ("ds", DSParams(id=1, fail_sanity=True))
+        models = make_engine().train(
+            CTX, ep, TrainOptions(skip_sanity_check=True)
+        )
+        assert len(models) == 1
+
+    def test_stop_after_read(self):
+        models = make_engine().train(CTX, params(), TrainOptions(stop_after_read=True))
+        assert models == []
+
+    def test_stop_after_prepare(self):
+        models = make_engine().train(
+            CTX, params(), TrainOptions(stop_after_prepare=True)
+        )
+        assert models == []
+
+    def test_unknown_component_name(self):
+        ep = params()
+        ep.algorithms = [("nope", AlgoParams(id=1))]
+        with pytest.raises(KeyError):
+            make_engine().train(CTX, ep)
+
+
+class TestEval:
+    def test_join_graph_multi_algo_multi_fold(self):
+        engine = make_engine(serving=ServingSum)
+        ep = params(algos=((7,), (8,)))
+        results = engine.eval(CTX, ep)
+        assert len(results) == 2  # two folds
+        for fold, (ei, qpa) in enumerate(results):
+            assert ei == {"fold": fold}
+            assert len(qpa) == 3
+            for q, p, a in qpa:
+                assert q.qid == a.qid  # actual joined to right query
+                assert p["qid"] == q.qid
+                assert p["algo_ids"] == [7, 8]  # both algos contributed
+
+    def test_fold_training_data_differs(self):
+        engine = make_engine()
+        results = engine.eval(CTX, params())
+        (_, fold0), (_, fold1) = results
+        # fold index shifts the ds_id through TrainingData
+        assert fold0[0][1].ds_id == 1
+        assert fold1[0][1].ds_id == 2
+
+
+class TestVariantExtraction:
+    def test_engine_params_from_variant(self):
+        variant = {
+            "id": "default",
+            "engineFactory": "x",
+            "datasource": {"name": "ds", "params": {"id": 9}},
+            "preparator": {"name": "prep", "params": {"id": 10}},
+            "algorithms": [
+                {"name": "a", "params": {"id": 11}},
+                {"name": "a", "params": {"id": 12}},
+            ],
+            "serving": {"name": "s"},
+        }
+        engine = make_engine()
+        ep = engine.engine_params_from_variant(variant)
+        assert ep.data_source[1].id == 9
+        assert ep.preparator[1].id == 10
+        assert [p.id for _, p in ep.algorithms] == [11, 12]
+        models = engine.train(CTX, ep)
+        assert [m.algo_id for m in models] == [11, 12]
+
+    def test_unknown_param_field_rejected(self):
+        variant = {
+            "datasource": {"name": "ds", "params": {"id": 1, "typo_field": 2}},
+            "algorithms": [],
+            "preparator": {"name": "prep"},
+            "serving": {"name": "s"},
+        }
+        with pytest.raises(ParamsError):
+            make_engine().engine_params_from_variant(variant)
+
+    def test_params_to_json_roundtrip(self):
+        ep = params(algos=((3,),))
+        flat = Engine.engine_params_to_json(ep)
+        assert json.loads(flat["data_source_params"])["id"] == 1
+        algos = json.loads(flat["algorithms_params"])
+        assert algos[0]["name"] == "a" and algos[0]["params"]["id"] == 3
+
+
+class TestParamsCoercion:
+    def test_types(self):
+        @dataclasses.dataclass(frozen=True)
+        class P(Params):
+            n: int
+            rate: float
+            name: str = "x"
+            flags: list = dataclasses.field(default_factory=list)
+
+        p = params_from_dict(P, {"n": 5, "rate": 1, "flags": ["a"]})
+        assert p.rate == 1.0 and isinstance(p.rate, float)
+        assert p.name == "x"
+
+    def test_required_missing(self):
+        @dataclasses.dataclass(frozen=True)
+        class P(Params):
+            n: int
+
+        with pytest.raises(ParamsError):
+            params_from_dict(P, {})
+
+    def test_optional_fields(self):
+        from typing import Optional
+
+        @dataclasses.dataclass(frozen=True)
+        class P(Params):
+            cap: Optional[int] = None
+
+        assert params_from_dict(P, {}).cap is None
+        assert params_from_dict(P, {"cap": 3}).cap == 3
+        assert params_from_dict(P, {"cap": None}).cap is None
+
+    def test_nested_dataclass(self):
+        @dataclasses.dataclass(frozen=True)
+        class Inner(Params):
+            k: int = 1
+
+        @dataclasses.dataclass(frozen=True)
+        class Outer(Params):
+            inner: Inner = dataclasses.field(default_factory=Inner)
+
+        o = params_from_dict(Outer, {"inner": {"k": 7}})
+        assert o.inner.k == 7

@@ -1,0 +1,68 @@
+"""Mesh + ingest tests on the virtual 8-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+
+from predictionio_tpu.parallel import (
+    MeshSpec,
+    make_mesh,
+    pad_to_multiple,
+    shard_columns,
+)
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() == 8
+
+
+class TestMesh:
+    def test_default_all_data(self):
+        mesh = make_mesh()
+        assert mesh.axis_names == ("data",)
+        assert mesh.shape["data"] == 8
+
+    def test_spec_parse(self):
+        spec = MeshSpec.parse("data=4,model=2")
+        mesh = make_mesh(spec)
+        assert mesh.shape == {"data": 4, "model": 2}
+
+    def test_free_axis(self):
+        mesh = make_mesh("data=-1,model=2")
+        assert mesh.shape == {"data": 4, "model": 2}
+
+    def test_bad_sizes(self):
+        with pytest.raises(ValueError):
+            make_mesh("data=3,model=3")
+        with pytest.raises(ValueError):
+            make_mesh("data=-1,model=-1")
+
+
+class TestIngest:
+    def test_pad_to_multiple(self):
+        x = np.arange(10)
+        padded, n = pad_to_multiple(x, 8, pad_value=-1)
+        assert n == 10 and padded.shape == (16,)
+        assert list(padded[10:]) == [-1] * 6
+        same, n2 = pad_to_multiple(np.arange(16), 8)
+        assert n2 == 16 and same.shape == (16,)
+
+    def test_shard_columns(self):
+        mesh = make_mesh()
+        cols, n = shard_columns(
+            mesh,
+            {"u": np.arange(10, dtype=np.int32), "r": np.ones(10, np.float32)},
+            pad_values={"u": -1},
+        )
+        assert n == 10
+        assert cols["u"].shape == (16,)
+        assert cols["u"].sharding.is_fully_addressable
+        # each of the 8 devices holds 2 rows
+        assert len(cols["u"].addressable_shards) == 8
+        assert cols["u"].addressable_shards[0].data.shape == (2,)
+        np.testing.assert_array_equal(np.asarray(cols["u"])[:10], np.arange(10))
+
+    def test_shard_columns_length_mismatch(self):
+        mesh = make_mesh()
+        with pytest.raises(ValueError):
+            shard_columns(mesh, {"a": np.arange(4), "b": np.arange(5)})

@@ -1,0 +1,251 @@
+"""Quickstart integration test: full lifecycle on the ALS recommendation
+template (ref tests/pio_tests/scenarios/quickstart_test.py — app new ->
+import events -> train -> deploy -> query assertions)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.controller import TrainOptions
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import AccessKey, App
+from predictionio_tpu.models.recommendation import engine_factory
+from predictionio_tpu.workflow.context import WorkflowContext
+from predictionio_tpu.workflow.core_workflow import run_train
+from predictionio_tpu.workflow.create_server import QueryServer, ServerConfig
+from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+
+APP_NAME = "quickstartapp"
+N_USERS, N_ITEMS = 12, 8
+
+
+@pytest.fixture
+def seeded_storage(memory_storage):
+    """App + deterministic rating events: user u likes items i where
+    (u + i) % 3 == 0 strongly (rating 5), weakly otherwise."""
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, APP_NAME))
+    memory_storage.get_meta_data_access_keys().insert(AccessKey("testkey", app_id, ()))
+    levents = memory_storage.get_l_events()
+    rng = np.random.default_rng(0)
+    events = []
+    for u in range(N_USERS):
+        for i in range(N_ITEMS):
+            if rng.random() < 0.25:
+                continue  # leave some unrated for recommendation headroom
+            rating = 5.0 if (u + i) % 3 == 0 else 1.0
+            events.append(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": rating}),
+                )
+            )
+    # a few buy events (mapped to rating 4.0 by the template)
+    events.append(
+        Event(
+            event="buy",
+            entity_type="user",
+            entity_id="u0",
+            target_entity_type="item",
+            target_entity_id="i1",
+        )
+    )
+    levents.insert_batch(events, app_id)
+    return memory_storage
+
+
+def manifest():
+    return EngineManifest(
+        engine_id="recommendation",
+        version="1",
+        variant="engine.json",
+        engine_factory="predictionio_tpu.models.recommendation.engine_factory",
+    )
+
+
+def variant():
+    return {
+        "datasource": {"params": {"appName": APP_NAME}},
+        "algorithms": [
+            {
+                "name": "als",
+                "params": {"rank": 8, "numIterations": 12, "lambda": 0.05, "seed": 3},
+            }
+        ],
+    }
+
+
+def train(storage):
+    engine = engine_factory()
+    ep = engine.engine_params_from_variant(variant())
+    ctx = WorkflowContext(mode="training", _storage=storage)
+    return engine, ep, run_train(
+        engine, manifest(), ep, ctx=ctx, storage=storage
+    )
+
+
+class TestQuickstart:
+    def test_train_then_query_via_http(self, seeded_storage):
+        engine, ep, instance_id = train(seeded_storage)
+
+        from predictionio_tpu.workflow.core_workflow import load_models_for_instance
+
+        models = load_models_for_instance(
+            engine, ep, instance_id, storage=seeded_storage
+        )
+        server = QueryServer(
+            engine=engine,
+            engine_params=ep,
+            models=models,
+            manifest=manifest(),
+            instance_id=instance_id,
+            storage=seeded_storage,
+            config=ServerConfig(),
+        )
+
+        async def body():
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                # status page
+                resp = await client.get("/")
+                assert resp.status == 200
+                status = await resp.json()
+                assert status["engineInstanceId"] == instance_id
+                assert status["requestCount"] == 0
+
+                # query for a known user
+                resp = await client.post(
+                    "/queries.json", json={"user": "u0", "num": 4}
+                )
+                assert resp.status == 200
+                data = await resp.json()
+                assert len(data["itemScores"]) == 4
+                for item_score in data["itemScores"]:
+                    assert item_score["item"].startswith("i")
+                    assert isinstance(item_score["score"], float)
+                # scores descending
+                scores = [s["score"] for s in data["itemScores"]]
+                assert scores == sorted(scores, reverse=True)
+
+                # high-affinity item ((u+i)%3==0) should outrank low-affinity
+                resp = await client.post(
+                    "/queries.json", json={"user": "u1", "num": N_ITEMS}
+                )
+                ranked = [s["item"] for s in (await resp.json())["itemScores"]]
+                top_half = set(ranked[: N_ITEMS // 2])
+                liked = {f"i{i}" for i in range(N_ITEMS) if (1 + i) % 3 == 0}
+                assert liked & top_half, f"expected {liked} near top of {ranked}"
+
+                # unknown user -> empty result, not an error
+                resp = await client.post(
+                    "/queries.json", json={"user": "ghost", "num": 4}
+                )
+                assert resp.status == 200
+                assert (await resp.json())["itemScores"] == []
+
+                # malformed query -> 400
+                resp = await client.post("/queries.json", json={"wrong": 1})
+                assert resp.status == 400
+
+                # bookkeeping advanced
+                resp = await client.get("/")
+                status = await resp.json()
+                assert status["requestCount"] == 3
+                assert status["avgServingSec"] > 0
+                assert status["latency"]["count"] == 3
+
+                # stop endpoint responds
+                resp = await client.post("/stop")
+                assert resp.status == 200
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+
+    def test_reload_picks_latest_instance(self, seeded_storage):
+        engine, ep, first_id = train(seeded_storage)
+        from predictionio_tpu.workflow.core_workflow import load_models_for_instance
+
+        models = load_models_for_instance(engine, ep, first_id, storage=seeded_storage)
+        server = QueryServer(
+            engine=engine,
+            engine_params=ep,
+            models=models,
+            manifest=manifest(),
+            instance_id=first_id,
+            storage=seeded_storage,
+        )
+        # retrain -> new instance
+        _, _, second_id = train(seeded_storage)
+        assert second_id != first_id
+
+        async def body():
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                resp = await client.get("/reload")
+                assert resp.status == 200
+                assert (await resp.json())["instanceId"] == second_id
+                resp = await client.get("/")
+                assert (await resp.json())["engineInstanceId"] == second_id
+                # still serves correctly after reload
+                resp = await client.post("/queries.json", json={"user": "u0"})
+                assert resp.status == 200
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+
+    def test_access_key_auth(self, seeded_storage):
+        engine, ep, instance_id = train(seeded_storage)
+        from predictionio_tpu.workflow.core_workflow import load_models_for_instance
+
+        models = load_models_for_instance(engine, ep, instance_id, storage=seeded_storage)
+        server = QueryServer(
+            engine=engine,
+            engine_params=ep,
+            models=models,
+            manifest=manifest(),
+            instance_id=instance_id,
+            storage=seeded_storage,
+            config=ServerConfig(accesskey="sekrit"),
+        )
+
+        async def body():
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                resp = await client.post("/queries.json", json={"user": "u0"})
+                assert resp.status == 401
+                resp = await client.post(
+                    "/queries.json?accessKey=sekrit", json={"user": "u0"}
+                )
+                assert resp.status == 200
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+
+    def test_eval_readEval_folds(self, seeded_storage):
+        engine = engine_factory()
+        v = variant()
+        v["datasource"]["params"]["evalParams"] = {"kFold": 2, "queryNum": 3}
+        ep = engine.engine_params_from_variant(v)
+        ctx = WorkflowContext(mode="evaluation", _storage=seeded_storage)
+        results = engine.eval(ctx, ep)
+        assert len(results) == 2
+        for _, qpa in results:
+            assert len(qpa) > 0
+            for q, p, a in qpa:
+                assert q.num == 3
+                assert all(r.user == q.user for r in a.ratings)

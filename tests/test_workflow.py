@@ -1,0 +1,145 @@
+"""Workflow persistence tests (ref CoreWorkflow + engine-loader behavior)."""
+
+import json
+import textwrap
+
+import pytest
+
+from predictionio_tpu.controller import EmptyParams, EngineParams
+from predictionio_tpu.data.storage.base import EngineInstanceStatus
+from predictionio_tpu.workflow import model_io
+from predictionio_tpu.workflow.core_workflow import (
+    load_models_for_instance,
+    run_train,
+)
+from predictionio_tpu.workflow.engine_loader import (
+    EngineLoadError,
+    EngineManifest,
+    load_engine,
+)
+from tests.sample_engine import AlgoParams, DSParams, Model0
+from tests.test_engine import make_engine, params
+
+
+def manifest():
+    return EngineManifest(
+        engine_id="sample",
+        version="1",
+        variant="engine.json",
+        engine_factory="tests.test_engine.make_engine",
+    )
+
+
+class TestRunTrain:
+    def test_train_persists_instance_and_model(self, memory_storage):
+        instance_id = run_train(
+            make_engine(), manifest(), params(), storage=memory_storage
+        )
+        inst = memory_storage.get_meta_data_engine_instances().get(instance_id)
+        assert inst.status == EngineInstanceStatus.COMPLETED
+        assert float(inst.spark_conf["train_wall_clock_sec"]) >= 0
+        assert json.loads(inst.data_source_params)["id"] == 1
+        blob = memory_storage.get_model_data_models().get(instance_id)
+        assert blob is not None
+        models = model_io.deserialize_models(blob.models)
+        assert models == [Model0(3, 1, 2)]
+
+    def test_get_latest_completed_finds_it(self, memory_storage):
+        run_train(make_engine(), manifest(), params(), storage=memory_storage)
+        iid2 = run_train(make_engine(), manifest(), params(), storage=memory_storage)
+        latest = memory_storage.get_meta_data_engine_instances().get_latest_completed(
+            "sample", "1", "engine.json"
+        )
+        assert latest.id == iid2
+
+    def test_failure_marks_failed(self, memory_storage):
+        ep = params()
+        ep.data_source = ("ds", DSParams(id=1, fail_sanity=True))
+        with pytest.raises(AssertionError):
+            run_train(make_engine(), manifest(), ep, storage=memory_storage)
+        instances = memory_storage.get_meta_data_engine_instances().get_all()
+        assert [i.status for i in instances] == [EngineInstanceStatus.FAILED]
+        assert (
+            memory_storage.get_meta_data_engine_instances().get_latest_completed(
+                "sample", "1", "engine.json"
+            )
+            is None
+        )
+
+    def test_load_models_for_instance(self, memory_storage):
+        iid = run_train(make_engine(), manifest(), params(), storage=memory_storage)
+        models = load_models_for_instance(
+            make_engine(), params(), iid, storage=memory_storage
+        )
+        assert models == [Model0(3, 1, 2)]
+
+
+class TestModelIO:
+    def test_roundtrip_with_jax_arrays(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        model = {"w": jnp.arange(8.0), "meta": "x", "n": 3}
+        from predictionio_tpu.controller import model_to_host
+
+        blob = model_io.serialize_models([model_to_host(model)])
+        (restored,) = model_io.deserialize_models(blob)
+        assert isinstance(restored["w"], np.ndarray)
+        np.testing.assert_array_equal(restored["w"], np.arange(8.0))
+        assert restored["meta"] == "x"
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            model_io.deserialize_models(b"garbage")
+
+
+class TestEngineLoader:
+    def test_load_engine_dir(self, tmp_path):
+        (tmp_path / "myengine.py").write_text(
+            textwrap.dedent(
+                """
+                from predictionio_tpu.controller import Engine
+                from tests.sample_engine import (
+                    Algo0, DataSource0, Preparator0, Serving0)
+
+                def engine_factory():
+                    return Engine(
+                        {"ds": DataSource0}, {"prep": Preparator0},
+                        {"a": Algo0}, {"s": Serving0})
+                """
+            )
+        )
+        (tmp_path / "engine.json").write_text(
+            json.dumps(
+                {
+                    "id": "default",
+                    "description": "test engine",
+                    "engineFactory": "myengine.engine_factory",
+                    "datasource": {"name": "ds", "params": {"id": 4}},
+                    "preparator": {"name": "prep", "params": {"id": 5}},
+                    "algorithms": [{"name": "a", "params": {"id": 6}}],
+                    "serving": {"name": "s"},
+                }
+            )
+        )
+        man, engine = load_engine(str(tmp_path))
+        assert man.engine_factory == "myengine.engine_factory"
+        ep = engine.engine_params_from_variant(man.variant_json)
+        from predictionio_tpu.workflow.context import WorkflowContext
+
+        models = engine.train(WorkflowContext(), ep)
+        assert models == [Model0(6, 4, 5)]
+
+    def test_template_min_version_enforced(self, tmp_path):
+        (tmp_path / "engine.json").write_text(
+            json.dumps({"engineFactory": "x.y"})
+        )
+        (tmp_path / "template.json").write_text(
+            json.dumps({"pio": {"version": {"min": "99.0.0"}}})
+        )
+        with pytest.raises(EngineLoadError):
+            load_engine(str(tmp_path))
+
+    def test_missing_variant(self, tmp_path):
+        with pytest.raises(EngineLoadError):
+            load_engine(str(tmp_path))
