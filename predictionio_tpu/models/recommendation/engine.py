@@ -124,7 +124,7 @@ def _columnar_to_ratings(
     col: ColumnarEvents, buy_rating: float
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     ratings = col.ratings.copy()
-    buys = np.asarray([n == "buy" for n in col.event_names])
+    buys = np.asarray([n == "buy" for n in col.event_names], dtype=bool)
     ratings[buys] = buy_rating
     valid = np.isfinite(ratings) & (col.entity_ids >= 0) & (col.target_ids >= 0)
     return col.entity_ids[valid], col.target_ids[valid], ratings[valid]

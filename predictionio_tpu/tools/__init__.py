@@ -1,0 +1,1 @@
+"""CLI console, import/export, admin API, dashboard (ref ``tools/``)."""

@@ -1,0 +1,107 @@
+"""REST admin server (port 7071).
+
+Reference parity: ``tools/.../admin/AdminAPI.scala:39-160`` +
+``CommandClient.scala`` — GET /, GET /cmd/app, POST /cmd/app (new),
+DELETE /cmd/app/{name} and /cmd/app/{name}/data.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from predictionio_tpu.data.storage.base import AccessKey, App
+from predictionio_tpu.data.storage.registry import Storage
+
+
+class AdminServer:
+    def __init__(self, storage: Storage | None = None):
+        self.storage = storage or Storage.instance()
+
+    async def handle_root(self, request: web.Request) -> web.Response:
+        import predictionio_tpu
+
+        return web.json_response(
+            {"status": "alive", "version": predictionio_tpu.__version__}
+        )
+
+    async def handle_list_apps(self, request: web.Request) -> web.Response:
+        apps = self.storage.get_meta_data_apps().get_all()
+        keys = self.storage.get_meta_data_access_keys()
+        return web.json_response(
+            [
+                {
+                    "name": a.name,
+                    "id": a.id,
+                    "description": a.description,
+                    "accessKeys": [k.key for k in keys.get_by_app_id(a.id)],
+                }
+                for a in apps
+            ]
+        )
+
+    async def handle_new_app(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            name = body["name"]
+            requested_id = int(body.get("id") or 0)
+        except Exception:
+            return web.json_response(
+                {"message": "name required (id, if given, must be an integer)"},
+                status=400,
+            )
+        apps = self.storage.get_meta_data_apps()
+        if apps.get_by_name(name):
+            return web.json_response(
+                {"message": f"App {name} already exists."}, status=409
+            )
+        app_id = apps.insert(App(requested_id, name, body.get("description")))
+        if app_id is None:
+            return web.json_response({"message": "unable to create app"}, status=500)
+        self.storage.get_l_events().init(app_id)
+        key = self.storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id, ())
+        )
+        return web.json_response({"name": name, "id": app_id, "accessKey": key}, status=201)
+
+    async def handle_delete_app(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        apps = self.storage.get_meta_data_apps()
+        app = apps.get_by_name(name)
+        if app is None:
+            return web.json_response({"message": "Not Found"}, status=404)
+        channels = self.storage.get_meta_data_channels()
+        for c in channels.get_by_app_id(app.id):
+            self.storage.get_l_events().remove(app.id, c.id)
+            channels.delete(c.id)
+        self.storage.get_l_events().remove(app.id)
+        for k in self.storage.get_meta_data_access_keys().get_by_app_id(app.id):
+            self.storage.get_meta_data_access_keys().delete(k.key)
+        apps.delete(app.id)
+        return web.json_response({"message": f"App {name} deleted."})
+
+    async def handle_delete_app_data(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        app = self.storage.get_meta_data_apps().get_by_name(name)
+        if app is None:
+            return web.json_response({"message": "Not Found"}, status=404)
+        self.storage.get_l_events().remove(app.id)
+        self.storage.get_l_events().init(app.id)
+        return web.json_response({"message": f"Data of app {name} deleted."})
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/", self.handle_root),
+                web.get("/cmd/app", self.handle_list_apps),
+                web.post("/cmd/app", self.handle_new_app),
+                web.delete("/cmd/app/{name}", self.handle_delete_app),
+                web.delete("/cmd/app/{name}/data", self.handle_delete_app_data),
+            ]
+        )
+        return app
+
+
+def run_admin_server(ip: str = "127.0.0.1", port: int = 7071) -> None:
+    server = AdminServer()
+    web.run_app(server.make_app(), host=ip, port=port, print=None)

@@ -1,0 +1,612 @@
+"""`pio`-style command-line console.
+
+Reference parity: ``tools/.../console/Console.scala:134-630`` verb set —
+  version, status, build, train, eval, deploy, undeploy, batchpredict,
+  eventserver, adminserver, dashboard,
+  app {new, list, show, delete, data-delete, channel-new, channel-delete},
+  accesskey {new, list, delete}, template {list, get}, import, export, run.
+
+Where the reference assembled a spark-submit command line around JVM mains
+(``Runner.runOnSpark``, process boundary #1 in SURVEY.md section 3), this CLI
+*is* the workflow process: train/eval/deploy run in-process on the local
+devices; multi-host jobs launch this same CLI once per host with
+``JAX_COORDINATOR`` env (jax.distributed) — no submission layer needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import shutil
+import sys
+
+import predictionio_tpu
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+from predictionio_tpu.data.storage.registry import Storage
+
+logger = logging.getLogger(__name__)
+
+
+def _storage() -> Storage:
+    return Storage.instance()
+
+
+def _die(msg: str, code: int = 1) -> int:
+    print(f"[ERROR] {msg}", file=sys.stderr)
+    return code
+
+
+# ---------------------------------------------------------------------------
+# app / accesskey / channel management (ref commands/App.scala)
+# ---------------------------------------------------------------------------
+
+
+def cmd_app_new(args) -> int:
+    storage = _storage()
+    apps = storage.get_meta_data_apps()
+    if apps.get_by_name(args.name):
+        return _die(f"App {args.name} already exists.")
+    app_id = apps.insert(App(args.id or 0, args.name, args.description))
+    if app_id is None:
+        return _die(f"Unable to create app {args.name}.")
+    storage.get_l_events().init(app_id)
+    key = storage.get_meta_data_access_keys().insert(
+        AccessKey(args.access_key or "", app_id, ())
+    )
+    if key is None:
+        return _die(
+            f"App {args.name} created (ID {app_id}) but access key "
+            f"{args.access_key!r} already exists; create one with `accesskey new`."
+        )
+    print(f"Created a new app:")
+    print(f"      Name: {args.name}")
+    print(f"        ID: {app_id}")
+    print(f"Access Key: {key}")
+    return 0
+
+
+def cmd_app_list(args) -> int:
+    storage = _storage()
+    keys = storage.get_meta_data_access_keys()
+    print(f"{'Name':<20} | {'ID':>4} | Access Key")
+    for app in storage.get_meta_data_apps().get_all():
+        app_keys = keys.get_by_app_id(app.id)
+        first = app_keys[0].key if app_keys else ""
+        print(f"{app.name:<20} | {app.id:>4} | {first}")
+    return 0
+
+
+def cmd_app_show(args) -> int:
+    storage = _storage()
+    app = storage.get_meta_data_apps().get_by_name(args.name)
+    if app is None:
+        return _die(f"App {args.name} does not exist.")
+    print(f"    App Name: {app.name}")
+    print(f"      App ID: {app.id}")
+    print(f" Description: {app.description or ''}")
+    for k in storage.get_meta_data_access_keys().get_by_app_id(app.id):
+        events = ",".join(k.events) if k.events else "(all)"
+        print(f"  Access Key: {k.key} | {events}")
+    for c in storage.get_meta_data_channels().get_by_app_id(app.id):
+        print(f"     Channel: {c.name} (ID {c.id})")
+    return 0
+
+
+def cmd_app_delete(args) -> int:
+    storage = _storage()
+    apps = storage.get_meta_data_apps()
+    app = apps.get_by_name(args.name)
+    if app is None:
+        return _die(f"App {args.name} does not exist.")
+    if not args.force:
+        return _die("Refusing to delete without --force (destructive).")
+    for c in storage.get_meta_data_channels().get_by_app_id(app.id):
+        storage.get_l_events().remove(app.id, c.id)
+        storage.get_meta_data_channels().delete(c.id)
+    storage.get_l_events().remove(app.id)
+    for k in storage.get_meta_data_access_keys().get_by_app_id(app.id):
+        storage.get_meta_data_access_keys().delete(k.key)
+    apps.delete(app.id)
+    print(f"Deleted app {args.name}.")
+    return 0
+
+
+def cmd_app_data_delete(args) -> int:
+    storage = _storage()
+    app = storage.get_meta_data_apps().get_by_name(args.name)
+    if app is None:
+        return _die(f"App {args.name} does not exist.")
+    if not args.force:
+        return _die("Refusing to delete data without --force (destructive).")
+    if args.channel:
+        channels = storage.get_meta_data_channels().get_by_app_id(app.id)
+        ch = next((c for c in channels if c.name == args.channel), None)
+        if ch is None:
+            return _die(f"Channel {args.channel} does not exist.")
+        storage.get_l_events().remove(app.id, ch.id)
+        storage.get_l_events().init(app.id, ch.id)
+    else:
+        storage.get_l_events().remove(app.id)
+        storage.get_l_events().init(app.id)
+    print(f"Deleted data of app {args.name}.")
+    return 0
+
+
+def cmd_channel_new(args) -> int:
+    storage = _storage()
+    app = storage.get_meta_data_apps().get_by_name(args.app_name)
+    if app is None:
+        return _die(f"App {args.app_name} does not exist.")
+    cid = storage.get_meta_data_channels().insert(Channel(0, args.channel, app.id))
+    if cid is None:
+        return _die(
+            f"Unable to create channel {args.channel} "
+            "(name must match ^[a-zA-Z0-9-]{1,16}$)."
+        )
+    storage.get_l_events().init(app.id, cid)
+    print(f"Created channel {args.channel} (ID {cid}) for app {args.app_name}.")
+    return 0
+
+
+def cmd_channel_delete(args) -> int:
+    storage = _storage()
+    app = storage.get_meta_data_apps().get_by_name(args.app_name)
+    if app is None:
+        return _die(f"App {args.app_name} does not exist.")
+    channels = storage.get_meta_data_channels().get_by_app_id(app.id)
+    ch = next((c for c in channels if c.name == args.channel), None)
+    if ch is None:
+        return _die(f"Channel {args.channel} does not exist.")
+    if not args.force:
+        return _die("Refusing to delete without --force (destructive).")
+    storage.get_l_events().remove(app.id, ch.id)
+    storage.get_meta_data_channels().delete(ch.id)
+    print(f"Deleted channel {args.channel}.")
+    return 0
+
+
+def cmd_accesskey_new(args) -> int:
+    storage = _storage()
+    app = storage.get_meta_data_apps().get_by_name(args.app_name)
+    if app is None:
+        return _die(f"App {args.app_name} does not exist.")
+    key = storage.get_meta_data_access_keys().insert(
+        AccessKey(args.key or "", app.id, tuple(args.event or ()))
+    )
+    if key is None:
+        return _die(f"Access key {args.key!r} already exists.")
+    print(f"Created new access key: {key}")
+    return 0
+
+
+def cmd_accesskey_list(args) -> int:
+    storage = _storage()
+    keys = storage.get_meta_data_access_keys()
+    if args.app_name:
+        app = storage.get_meta_data_apps().get_by_name(args.app_name)
+        if app is None:
+            return _die(f"App {args.app_name} does not exist.")
+        listing = keys.get_by_app_id(app.id)
+    else:
+        listing = keys.get_all()
+    print(f"{'Access Key':<66} | {'App ID':>6} | Allowed Events")
+    for k in listing:
+        events = ",".join(k.events) if k.events else "(all)"
+        print(f"{k.key:<66} | {k.appid:>6} | {events}")
+    return 0
+
+
+def cmd_accesskey_delete(args) -> int:
+    _storage().get_meta_data_access_keys().delete(args.key)
+    print(f"Deleted access key {args.key}.")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# engine lifecycle (ref commands/Engine.scala)
+# ---------------------------------------------------------------------------
+
+
+def cmd_build(args) -> int:
+    """No compilation step exists (Python); build = validate the engine dir
+    loads and its variant parses (ref `pio build` sbt packaging)."""
+    from predictionio_tpu.workflow.engine_loader import load_engine
+
+    manifest, engine = load_engine(args.engine_dir, args.variant)
+    engine.engine_params_from_variant(manifest.variant_json)
+    print(f"Engine {manifest.engine_id} is ready (factory {manifest.engine_factory}).")
+    return 0
+
+
+def cmd_train(args) -> int:
+    from predictionio_tpu.controller.engine import TrainOptions
+    from predictionio_tpu.workflow.core_workflow import run_train
+    from predictionio_tpu.workflow.engine_loader import load_engine
+
+    manifest, engine = load_engine(args.engine_dir, args.variant)
+    engine_params = engine.engine_params_from_variant(manifest.variant_json)
+    options = TrainOptions(
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+    )
+    instance_id = run_train(
+        engine,
+        manifest,
+        engine_params,
+        options=options,
+        batch=args.batch or "",
+    )
+    print(f"Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from predictionio_tpu.workflow.core_workflow import run_evaluation
+    import importlib
+
+    module_name, _, attr = args.evaluation.rpartition(".")
+    evaluation = getattr(importlib.import_module(module_name), attr)
+    if isinstance(evaluation, type):
+        evaluation = evaluation()
+    if args.engine_params_generator:
+        module_name, _, attr = args.engine_params_generator.rpartition(".")
+        generator = getattr(importlib.import_module(module_name), attr)
+        if isinstance(generator, type):
+            generator = generator()
+        evaluation.engine_params_generator = generator
+    instance_id, result = run_evaluation(evaluation, batch=args.batch or "")
+    print(result.one_liner())
+    print(f"Evaluation instance ID: {instance_id}")
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        run_query_server,
+    )
+
+    config = ServerConfig(
+        ip=args.ip,
+        port=args.port,
+        accesskey=args.accesskey,
+        feedback=args.feedback,
+        event_server_url=args.event_server_url,
+        feedback_access_key=args.feedback_access_key,
+    )
+    print(f"Engine server starting on {args.ip}:{args.port} ...")
+    run_query_server(args.engine_dir, args.variant, config=config)
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    """POST /stop to a running engine server (ref commands/Engine.scala:244-267)."""
+    import urllib.request
+
+    url = f"http://{args.ip}:{args.port}/stop"
+    try:
+        with urllib.request.urlopen(
+            urllib.request.Request(url, method="POST"), timeout=10
+        ) as resp:
+            print(resp.read().decode())
+        return 0
+    except Exception as exc:
+        return _die(f"undeploy failed: {exc}")
+
+
+def cmd_batchpredict(args) -> int:
+    from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+    n = run_batch_predict(
+        args.engine_dir,
+        args.input,
+        args.output,
+        variant_path=args.variant,
+    )
+    print(f"Batch predict completed: {n} queries -> {args.output}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# servers / status / data
+# ---------------------------------------------------------------------------
+
+
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.data.api.event_server import (
+        EventServerConfig,
+        run_event_server,
+    )
+
+    print(f"Event server starting on {args.ip}:{args.port} ...")
+    run_event_server(EventServerConfig(ip=args.ip, port=args.port, stats=args.stats))
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    from predictionio_tpu.tools.admin_api import run_admin_server
+
+    print(f"Admin server starting on {args.ip}:{args.port} ...")
+    run_admin_server(args.ip, args.port)
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from predictionio_tpu.tools.dashboard import run_dashboard
+
+    print(f"Dashboard starting on {args.ip}:{args.port} ...")
+    run_dashboard(args.ip, args.port)
+    return 0
+
+
+def cmd_status(args) -> int:
+    """ref commands/Management.status + Storage.verifyAllDataObjects."""
+    print(f"predictionio_tpu {predictionio_tpu.__version__}")
+    try:
+        storage = _storage()
+    except Exception as exc:
+        return _die(f"storage configuration invalid: {exc}")
+    failures = storage.verify_all_data_objects()
+    if failures:
+        for f in failures:
+            print(f"  [FAILED] {f}")
+        return _die("storage verification failed")
+    print("  storage: all data objects verified")
+    try:
+        import jax
+
+        print(f"  jax {jax.__version__}; devices: {jax.device_count()}")
+    except Exception as exc:  # TPU tunnel down should not fail `status`
+        print(f"  jax devices unavailable: {exc}")
+    print("(sleeping)   <- your engine is ready to train")
+    return 0
+
+
+def cmd_import(args) -> int:
+    from predictionio_tpu.tools.import_export import import_events
+
+    n = import_events(args.input, args.app_name, args.channel)
+    print(f"Imported {n} events.")
+    return 0
+
+
+def cmd_export(args) -> int:
+    from predictionio_tpu.tools.import_export import export_events
+
+    n = export_events(args.output, args.app_name, args.channel, format=args.format)
+    print(f"Exported {n} events.")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# templates (ref commands/Template.scala — gallery replaced by bundled dirs)
+# ---------------------------------------------------------------------------
+
+BUNDLED_TEMPLATES = (
+    "recommendation",
+    "similarproduct",
+    "classification",
+    "ecommerce",
+    "twotower",
+)
+
+
+def cmd_template_list(args) -> int:
+    base = os.path.dirname(
+        os.path.abspath(sys.modules["predictionio_tpu"].__file__)
+    )
+    for name in BUNDLED_TEMPLATES:
+        path = os.path.join(base, "models", name)
+        marker = "" if os.path.isdir(path) else " (planned)"
+        print(f"  {name}{marker}")
+    return 0
+
+
+def cmd_template_get(args) -> int:
+    """Copy a bundled template's engine.json (+ optional scaffold) into a new
+    engine dir the user can customize."""
+    base = os.path.dirname(os.path.abspath(sys.modules["predictionio_tpu"].__file__))
+    src = os.path.join(base, "models", args.name)
+    if not os.path.isdir(src):
+        return _die(f"unknown template {args.name}; see `template list`")
+    dst = args.directory or args.name
+    if os.path.exists(dst) and os.listdir(dst):
+        return _die(f"directory {dst} exists and is not empty")
+    os.makedirs(dst, exist_ok=True)
+    shutil.copy(os.path.join(src, "engine.json"), os.path.join(dst, "engine.json"))
+    with open(os.path.join(dst, "template.json"), "w") as f:
+        json.dump({"pio": {"version": {"min": "0.1.0"}}}, f)
+    print(f"Engine template {args.name} created at {dst}/")
+    print("Edit engine.json (appName, algorithm params) and run `pio train`.")
+    return 0
+
+
+def cmd_run(args) -> int:
+    """Run an arbitrary python main with the framework importable
+    (ref `pio run` spark-submit of a custom main)."""
+    import runpy
+
+    sys.argv = [args.main] + (args.args or [])
+    runpy.run_path(args.main, run_name="__main__")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(predictionio_tpu.__version__)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio",
+        description="TPU-native PredictionIO-class ML framework console",
+    )
+    p.add_argument("-v", "--verbose", action="store_true")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+
+    # app
+    app = sub.add_parser("app").add_subparsers(dest="subcommand", required=True)
+    x = app.add_parser("new")
+    x.add_argument("name")
+    x.add_argument("--id", type=int, default=0)
+    x.add_argument("--description")
+    x.add_argument("--access-key", default="")
+    x.set_defaults(fn=cmd_app_new)
+    app.add_parser("list").set_defaults(fn=cmd_app_list)
+    x = app.add_parser("show")
+    x.add_argument("name")
+    x.set_defaults(fn=cmd_app_show)
+    x = app.add_parser("delete")
+    x.add_argument("name")
+    x.add_argument("-f", "--force", action="store_true")
+    x.set_defaults(fn=cmd_app_delete)
+    x = app.add_parser("data-delete")
+    x.add_argument("name")
+    x.add_argument("--channel")
+    x.add_argument("-f", "--force", action="store_true")
+    x.set_defaults(fn=cmd_app_data_delete)
+    x = app.add_parser("channel-new")
+    x.add_argument("app_name")
+    x.add_argument("channel")
+    x.set_defaults(fn=cmd_channel_new)
+    x = app.add_parser("channel-delete")
+    x.add_argument("app_name")
+    x.add_argument("channel")
+    x.add_argument("-f", "--force", action="store_true")
+    x.set_defaults(fn=cmd_channel_delete)
+
+    # accesskey
+    ak = sub.add_parser("accesskey").add_subparsers(dest="subcommand", required=True)
+    x = ak.add_parser("new")
+    x.add_argument("app_name")
+    x.add_argument("--key", default="")
+    x.add_argument("--event", action="append")
+    x.set_defaults(fn=cmd_accesskey_new)
+    x = ak.add_parser("list")
+    x.add_argument("app_name", nargs="?")
+    x.set_defaults(fn=cmd_accesskey_list)
+    x = ak.add_parser("delete")
+    x.add_argument("key")
+    x.set_defaults(fn=cmd_accesskey_delete)
+
+    # engine lifecycle
+    def engine_args(x):
+        x.add_argument("--engine-dir", default=".")
+        x.add_argument("--variant")
+
+    x = sub.add_parser("build")
+    engine_args(x)
+    x.set_defaults(fn=cmd_build)
+
+    x = sub.add_parser("train")
+    engine_args(x)
+    x.add_argument("--batch", default="")
+    x.add_argument("--skip-sanity-check", action="store_true")
+    x.add_argument("--stop-after-read", action="store_true")
+    x.add_argument("--stop-after-prepare", action="store_true")
+    x.set_defaults(fn=cmd_train)
+
+    x = sub.add_parser("eval")
+    x.add_argument("evaluation", help="dotted path to an Evaluation")
+    x.add_argument("engine_params_generator", nargs="?")
+    x.add_argument("--batch", default="")
+    x.set_defaults(fn=cmd_eval)
+
+    x = sub.add_parser("deploy")
+    engine_args(x)
+    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--port", type=int, default=8000)
+    x.add_argument("--accesskey")
+    x.add_argument("--feedback", action="store_true")
+    x.add_argument("--event-server-url")
+    x.add_argument("--feedback-access-key")
+    x.set_defaults(fn=cmd_deploy)
+
+    x = sub.add_parser("undeploy")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=8000)
+    x.set_defaults(fn=cmd_undeploy)
+
+    x = sub.add_parser("batchpredict")
+    engine_args(x)
+    x.add_argument("--input", default="batchpredict-input.json")
+    x.add_argument("--output", default="batchpredict-output.json")
+    x.set_defaults(fn=cmd_batchpredict)
+
+    # servers
+    x = sub.add_parser("eventserver")
+    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--port", type=int, default=7070)
+    x.add_argument("--stats", action="store_true")
+    x.set_defaults(fn=cmd_eventserver)
+
+    x = sub.add_parser("adminserver")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=7071)
+    x.set_defaults(fn=cmd_adminserver)
+
+    x = sub.add_parser("dashboard")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=9000)
+    x.set_defaults(fn=cmd_dashboard)
+
+    # data
+    x = sub.add_parser("import")
+    x.add_argument("--appname", dest="app_name", required=True)
+    x.add_argument("--input", required=True)
+    x.add_argument("--channel")
+    x.set_defaults(fn=cmd_import)
+
+    x = sub.add_parser("export")
+    x.add_argument("--appname", dest="app_name", required=True)
+    x.add_argument("--output", required=True)
+    x.add_argument("--channel")
+    x.add_argument("--format", default="json", choices=["json", "npz"])
+    x.set_defaults(fn=cmd_export)
+
+    # templates
+    tpl = sub.add_parser("template").add_subparsers(dest="subcommand", required=True)
+    tpl.add_parser("list").set_defaults(fn=cmd_template_list)
+    x = tpl.add_parser("get")
+    x.add_argument("name")
+    x.add_argument("directory", nargs="?")
+    x.set_defaults(fn=cmd_template_get)
+
+    # run
+    x = sub.add_parser("run")
+    x.add_argument("main")
+    x.add_argument("args", nargs="*")
+    x.set_defaults(fn=cmd_run)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="[%(levelname)s] [%(name)s] %(message)s",
+    )
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        return 130
+    except Exception as exc:
+        if args.verbose:
+            raise
+        return _die(str(exc))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Evaluation dashboard (port 9000).
+
+Reference parity: ``tools/.../dashboard/Dashboard.scala:44-107`` — an HTML
+page listing completed EvaluationInstances newest-first with links to their
+HTML metric reports, plus the JSON results.
+"""
+
+from __future__ import annotations
+
+import html
+
+from aiohttp import web
+
+from predictionio_tpu.data.storage.registry import Storage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>predictionio_tpu dashboard</title>
+<style>
+body {{ font-family: sans-serif; margin: 2rem; }}
+table {{ border-collapse: collapse; width: 100%; }}
+th, td {{ border: 1px solid #ccc; padding: 0.4rem 0.8rem; text-align: left; }}
+th {{ background: #f0f0f0; }}
+</style></head>
+<body>
+<h1>Evaluation Dashboard</h1>
+<table>
+<tr><th>ID</th><th>Start</th><th>End</th><th>Evaluation</th><th>Batch</th>
+<th>Result</th><th></th></tr>
+{rows}
+</table>
+</body></html>"""
+
+
+class Dashboard:
+    def __init__(self, storage: Storage | None = None):
+        self.storage = storage or Storage.instance()
+
+    async def handle_index(self, request: web.Request) -> web.Response:
+        instances = self.storage.get_meta_data_evaluation_instances().get_completed()
+        rows = []
+        for i in instances:
+            rows.append(
+                "<tr>"
+                f"<td>{html.escape(i.id)}</td>"
+                f"<td>{i.start_time.isoformat()}</td>"
+                f"<td>{i.end_time.isoformat()}</td>"
+                f"<td>{html.escape(i.evaluation_class)}</td>"
+                f"<td>{html.escape(i.batch)}</td>"
+                f"<td>{html.escape(i.evaluator_results)}</td>"
+                f'<td><a href="/engine_instances/{html.escape(i.id)}/'
+                'evaluator_results.html">HTML</a> '
+                f'<a href="/engine_instances/{html.escape(i.id)}/'
+                'evaluator_results.json">JSON</a></td>'
+                "</tr>"
+            )
+        return web.Response(
+            text=_PAGE.format(rows="\n".join(rows)), content_type="text/html"
+        )
+
+    async def handle_results_html(self, request: web.Request) -> web.Response:
+        instance = self.storage.get_meta_data_evaluation_instances().get(
+            request.match_info["iid"]
+        )
+        if instance is None:
+            return web.Response(status=404, text="Not Found")
+        return web.Response(
+            text=instance.evaluator_results_html or "<p>(no HTML results)</p>",
+            content_type="text/html",
+        )
+
+    async def handle_results_json(self, request: web.Request) -> web.Response:
+        instance = self.storage.get_meta_data_evaluation_instances().get(
+            request.match_info["iid"]
+        )
+        if instance is None:
+            return web.json_response({"message": "Not Found"}, status=404)
+        return web.Response(
+            text=instance.evaluator_results_json or "{}",
+            content_type="application/json",
+        )
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/", self.handle_index),
+                web.get(
+                    "/engine_instances/{iid}/evaluator_results.html",
+                    self.handle_results_html,
+                ),
+                web.get(
+                    "/engine_instances/{iid}/evaluator_results.json",
+                    self.handle_results_json,
+                ),
+            ]
+        )
+        return app
+
+
+def run_dashboard(ip: str = "127.0.0.1", port: int = 9000) -> None:
+    web.run_app(Dashboard().make_app(), host=ip, port=port, print=None)

@@ -1,0 +1,103 @@
+"""Admin API + dashboard route tests (ref AdminAPI.scala, Dashboard.scala)."""
+
+import asyncio
+import datetime as dt
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.data.storage.base import (
+    EvaluationInstance,
+    EvaluationInstanceStatus,
+)
+from predictionio_tpu.tools.admin_api import AdminServer
+from predictionio_tpu.tools.dashboard import Dashboard
+
+UTC = dt.timezone.utc
+
+
+def with_client(app, fn):
+    async def body():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await fn(client)
+        finally:
+            await client.close()
+
+    asyncio.run(body())
+
+
+class TestAdminAPI:
+    def test_app_lifecycle(self, memory_storage):
+        server = AdminServer(storage=memory_storage)
+
+        async def body(client):
+            resp = await client.get("/")
+            assert resp.status == 200
+            assert (await resp.json())["status"] == "alive"
+
+            resp = await client.post("/cmd/app", json={"name": "adminapp"})
+            assert resp.status == 201
+            data = await resp.json()
+            assert data["name"] == "adminapp" and data["accessKey"]
+
+            resp = await client.post("/cmd/app", json={"name": "adminapp"})
+            assert resp.status == 409
+
+            resp = await client.get("/cmd/app")
+            listing = await resp.json()
+            assert [a["name"] for a in listing] == ["adminapp"]
+            assert listing[0]["accessKeys"]
+
+            resp = await client.delete("/cmd/app/adminapp/data")
+            assert resp.status == 200
+            resp = await client.delete("/cmd/app/adminapp")
+            assert resp.status == 200
+            resp = await client.delete("/cmd/app/adminapp")
+            assert resp.status == 404
+
+        with_client(server.make_app(), body)
+
+    def test_new_app_requires_name(self, memory_storage):
+        server = AdminServer(storage=memory_storage)
+
+        async def body(client):
+            resp = await client.post("/cmd/app", json={})
+            assert resp.status == 400
+
+        with_client(server.make_app(), body)
+
+
+class TestDashboard:
+    def test_lists_completed_evaluations(self, memory_storage):
+        evis = memory_storage.get_meta_data_evaluation_instances()
+        iid = evis.insert(
+            EvaluationInstance(
+                id="",
+                status=EvaluationInstanceStatus.EVALCOMPLETED,
+                start_time=dt.datetime(2024, 1, 1, tzinfo=UTC),
+                end_time=dt.datetime(2024, 1, 2, tzinfo=UTC),
+                evaluation_class="my.Evaluation",
+                evaluator_results="[Metric] best: 0.9",
+                evaluator_results_html="<h2>results</h2>",
+                evaluator_results_json='{"bestScore": 0.9}',
+            )
+        )
+        dash = Dashboard(storage=memory_storage)
+
+        async def body(client):
+            resp = await client.get("/")
+            assert resp.status == 200
+            page = await resp.text()
+            assert "my.Evaluation" in page and "best: 0.9" in page
+
+            resp = await client.get(f"/engine_instances/{iid}/evaluator_results.html")
+            assert (await resp.text()) == "<h2>results</h2>"
+
+            resp = await client.get(f"/engine_instances/{iid}/evaluator_results.json")
+            assert (await resp.json())["bestScore"] == 0.9
+
+            resp = await client.get("/engine_instances/nope/evaluator_results.json")
+            assert resp.status == 404
+
+        with_client(dash.make_app(), body)

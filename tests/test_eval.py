@@ -1,0 +1,211 @@
+"""Evaluation stack tests (ref MetricEvaluatorTest / EvaluationTest /
+FastEvalEngineTest)."""
+
+import json
+
+import pytest
+
+from predictionio_tpu.controller import EmptyParams, EngineParams
+from predictionio_tpu.eval import (
+    AverageMetric,
+    Evaluation,
+    FastEvalEngine,
+    MetricEvaluator,
+    OptionAverageMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+    grid_search,
+)
+from predictionio_tpu.eval.generator import EngineParamsGenerator
+from predictionio_tpu.workflow.context import WorkflowContext
+from predictionio_tpu.workflow.core_workflow import run_evaluation
+from tests.sample_engine import (
+    Algo0,
+    AlgoParams,
+    DataSource0,
+    DSParams,
+    Preparator0,
+    Serving0,
+)
+
+CTX = WorkflowContext(mode="evaluation")
+
+
+class QidMetric(AverageMetric):
+    """Score = prediction's algo id (deterministic, param-sensitive)."""
+
+    def calculate_score(self, ei, q, p, a) -> float:
+        return float(p.algo_id)
+
+
+class MatchMetric(AverageMetric):
+    def calculate_score(self, ei, q, p, a) -> float:
+        return 1.0 if p.qid == a.qid else 0.0
+
+
+def make_engine(cls=None):
+    from predictionio_tpu.controller import Engine
+
+    cls = cls or Engine
+    return cls({"ds": DataSource0}, {"prep": Preparator0}, {"a": Algo0}, {"s": Serving0})
+
+
+def params(algo_id):
+    return EngineParams(
+        data_source=("ds", DSParams(id=1)),
+        preparator=("prep", DSParams(id=2)),
+        algorithms=[("a", AlgoParams(id=algo_id))],
+        serving=("s", EmptyParams()),
+    )
+
+
+class TestMetrics:
+    DATA = [
+        ("ei0", [("q", type("P", (), {"v": 1.0})(), "a")]),
+    ]
+
+    def test_average_pools_folds(self):
+        class M(AverageMetric):
+            def calculate_score(self, ei, q, p, a):
+                return p
+
+        data = [(None, [(0, 1.0, 0), (0, 2.0, 0)]), (None, [(0, 6.0, 0)])]
+        assert M().calculate(data) == 3.0
+
+    def test_option_average_skips_none(self):
+        class M(OptionAverageMetric):
+            def calculate_score(self, ei, q, p, a):
+                return p
+
+        data = [(None, [(0, 1.0, 0), (0, None, 0), (0, 3.0, 0)])]
+        assert M().calculate(data) == 2.0
+
+    def test_stdev(self):
+        class M(StdevMetric):
+            def calculate_score(self, ei, q, p, a):
+                return p
+
+        data = [(None, [(0, 2.0, 0), (0, 4.0, 0)])]
+        assert M().calculate(data) == 1.0
+
+    def test_sum_and_zero(self):
+        class M(SumMetric):
+            def calculate_score(self, ei, q, p, a):
+                return p
+
+        data = [(None, [(0, 2.0, 0), (0, 4.0, 0)])]
+        assert M().calculate(data) == 6.0
+        assert ZeroMetric().calculate(data) == 0.0
+
+
+class TestMetricEvaluator:
+    def test_tracks_best(self, tmp_path):
+        evaluator = MetricEvaluator(
+            QidMetric(), [MatchMetric()], output_path=str(tmp_path / "best.json")
+        )
+        result = evaluator.evaluate_base(
+            CTX, make_engine(), [params(3), params(9), params(5)]
+        )
+        assert result.best_index == 1
+        assert result.best_score == 9.0
+        assert result.best_engine_params.algorithms[0][1].id == 9
+        # all candidates scored, secondary metric present
+        assert [s.score for s in result.engine_params_scores] == [3.0, 9.0, 5.0]
+        assert all(s.other_scores == [1.0] for s in result.engine_params_scores)
+        # best.json written
+        best = json.loads((tmp_path / "best.json").read_text())
+        assert best["score"] == 9.0
+        # renderings
+        assert "best: 9.0" in result.one_liner()
+        assert result.to_json_dict()["bestIndex"] == 1
+        assert "<table" in result.to_html()
+
+    def test_empty_params_list_rejected(self):
+        with pytest.raises(ValueError):
+            MetricEvaluator(QidMetric()).evaluate_base(CTX, make_engine(), [])
+
+
+class TestGridSearch:
+    def test_cartesian(self):
+        gen = grid_search(params(1), {"id": [10, 20, 30]})
+        assert [ep.algorithms[0][1].id for ep in gen.engine_params_list] == [10, 20, 30]
+
+    def test_multi_field(self):
+        import dataclasses
+
+        from predictionio_tpu.controller import Params
+
+        @dataclasses.dataclass(frozen=True)
+        class P2(Params):
+            a: int = 0
+            b: str = "x"
+
+        base = EngineParams(
+            data_source=("ds", DSParams(id=1)),
+            preparator=("prep", DSParams(id=2)),
+            algorithms=[("a", P2())],
+            serving=("s", EmptyParams()),
+        )
+        gen = grid_search(base, {"a": [1, 2], "b": ["p", "q"]})
+        combos = {(ep.algorithms[0][1].a, ep.algorithms[0][1].b) for ep in gen.engine_params_list}
+        assert combos == {(1, "p"), (1, "q"), (2, "p"), (2, "q")}
+
+
+class TestEvaluationRun:
+    def test_run_evaluation_persists_instance(self, memory_storage):
+        evaluation = Evaluation(
+            engine=make_engine(),
+            metric=QidMetric(),
+            engine_params_generator=EngineParamsGenerator([params(4), params(2)]),
+        )
+        ctx = WorkflowContext(mode="evaluation", _storage=memory_storage)
+        iid, result = run_evaluation(evaluation, ctx=ctx, storage=memory_storage)
+        assert result.best_score == 4.0
+        inst = memory_storage.get_meta_data_evaluation_instances().get(iid)
+        assert inst.status == "EVALCOMPLETED"
+        assert "best: 4.0" in inst.evaluator_results
+        assert json.loads(inst.evaluator_results_json)["bestScore"] == 4.0
+        assert inst.evaluator_results_html.startswith("<h2>")
+        assert [i.id for i in
+                memory_storage.get_meta_data_evaluation_instances().get_completed()] == [iid]
+
+
+class TestFastEval:
+    def test_memoizes_shared_prefixes(self):
+        calls = {"read": 0, "prepare": 0, "train": 0}
+
+        class CountingDS(DataSource0):
+            def read_eval(self, ctx):
+                calls["read"] += 1
+                return super().read_eval(ctx)
+
+        class CountingPrep(Preparator0):
+            def prepare(self, ctx, td):
+                calls["prepare"] += 1
+                return super().prepare(ctx, td)
+
+        class CountingAlgo(Algo0):
+            def train(self, ctx, pd):
+                calls["train"] += 1
+                return super().train(ctx, pd)
+
+        engine = FastEvalEngine(
+            {"ds": CountingDS}, {"prep": CountingPrep}, {"a": CountingAlgo}, {"s": Serving0}
+        )
+        grid = [params(1), params(2), params(1)]  # params(1) repeated
+        evaluator = MetricEvaluator(QidMetric())
+        result = evaluator.evaluate_base(CTX, engine, grid)
+        assert result.best_score == 2.0
+        assert calls["read"] == 1  # same datasource params across grid
+        assert calls["prepare"] == 2  # 2 folds, once each
+        # 2 folds x 2 distinct algo params = 4 trains (not 6)
+        assert calls["train"] == 4
+
+    def test_results_match_plain_engine(self):
+        plain = make_engine()
+        fast = make_engine(FastEvalEngine)
+        ep = params(7)
+        plain_result = QidMetric().calculate(plain.eval(CTX, ep))
+        fast_result = QidMetric().calculate(fast.eval(CTX, ep))
+        assert plain_result == fast_result
