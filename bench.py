@@ -1,0 +1,110 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.md): ALS recommendation train wall-clock at
+MovieLens-20M scale plus serving p50/qps of the deployed top-k predict.
+The reference publishes no numbers (BASELINE.json ``published: {}``), so
+``vs_baseline`` is reported against the north-star serving target of
+10 ms p50 (value < 1.0 means better than target).
+
+Scale selection: full ML-20M shape on TPU; a reduced ML-100K shape
+elsewhere (CPU dev boxes) or when PIO_BENCH_SCALE=ml100k.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def synthesize_ratings(n_users: int, n_items: int, n_ratings: int, seed: int = 0):
+    """Synthetic low-rank + noise ratings with a realistic popularity skew."""
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, n_users, n_ratings).astype(np.int32)
+    # zipf-ish item popularity
+    raw = rng.zipf(1.3, n_ratings).astype(np.int64) % n_items
+    items = raw.astype(np.int32)
+    k = 8
+    U = rng.normal(size=(n_users, k)) / np.sqrt(k)
+    V = rng.normal(size=(n_items, k)) / np.sqrt(k)
+    vals = np.clip(
+        np.sum(U[users] * V[items], axis=1) + 3.0 + 0.3 * rng.normal(size=n_ratings),
+        1.0,
+        5.0,
+    ).astype(np.float32)
+    return users, items, vals
+
+
+def main() -> int:
+    import jax
+
+    platform = jax.devices()[0].platform
+    scale = os.environ.get(
+        "PIO_BENCH_SCALE", "ml20m" if platform in ("tpu", "axon") else "ml100k"
+    )
+    if scale == "ml20m":
+        n_users, n_items, n_ratings = 138_000, 27_000, 20_000_000
+        rank, iterations = 32, 5
+    elif scale == "ml1m":
+        n_users, n_items, n_ratings = 6_040, 3_700, 1_000_000
+        rank, iterations = 32, 10
+    else:  # ml100k
+        n_users, n_items, n_ratings = 943, 1_682, 100_000
+        rank, iterations = 32, 10
+
+    from predictionio_tpu.ops.als import ALSConfig, als_train, top_k_items
+
+    users, items, vals = synthesize_ratings(n_users, n_items, n_ratings)
+    config = ALSConfig(rank=rank, iterations=iterations, reg=0.05, chunk=65536)
+
+    # warm-up compile on a small slice so the timed run measures steady state
+    als_train(users[:4096], items[:4096], vals[:4096], n_users, n_items, config)
+
+    t0 = time.perf_counter()
+    uf, vf = als_train(users, items, vals, n_users, n_items, config)
+    jax.block_until_ready((uf, vf))
+    train_wall = time.perf_counter() - t0
+
+    # serving: resident jitted top-k, per-query latency
+    import jax.numpy as jnp
+
+    vf_dev = jnp.asarray(vf)
+    k = 10
+    # warm-up
+    s, i = top_k_items(vf_dev[0] * 0 + jnp.asarray(np.asarray(uf[0])), vf_dev, k)
+    latencies = []
+    rng = np.random.default_rng(1)
+    q_users = rng.integers(0, n_users, 200)
+    t_all0 = time.perf_counter()
+    for q in q_users:
+        t0 = time.perf_counter()
+        top_k_items(jnp.asarray(np.asarray(uf[int(q)])), vf_dev, k)
+        latencies.append(time.perf_counter() - t0)
+    qps = len(q_users) / (time.perf_counter() - t_all0)
+    p50_ms = float(np.percentile(np.array(latencies) * 1000.0, 50))
+
+    result = {
+        "metric": f"als_{scale}_train_wall_clock",
+        "value": round(train_wall, 3),
+        "unit": "s",
+        "vs_baseline": round(p50_ms / 10.0, 4),  # serving p50 vs 10ms target
+        "serving_p50_ms": round(p50_ms, 3),
+        "serving_qps": round(qps, 1),
+        "platform": platform,
+        "scale": {
+            "n_users": n_users,
+            "n_items": n_items,
+            "n_ratings": n_ratings,
+            "rank": rank,
+            "iterations": iterations,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""Engine-building algorithm library ("e2").
+
+Reference parity: ``e2/src/main/scala/org/apache/predictionio/e2/`` —
+``CategoricalNaiveBayes`` (:23-170), ``MarkovChain`` (:26-55),
+``BinaryVectorizer`` (:26-60), ``CrossValidation.splitData`` (:25-67).
+Spark ``combineByKey``/``CoordinateMatrix`` plumbing is replaced by numpy /
+jax reductions.
+"""
+
+from predictionio_tpu.e2.naive_bayes import (
+    CategoricalNaiveBayesModel,
+    LabeledPoint,
+    train_categorical_naive_bayes,
+)
+from predictionio_tpu.e2.markov_chain import MarkovChainModel, train_markov_chain
+from predictionio_tpu.e2.vectorizer import BinaryVectorizer
+from predictionio_tpu.e2.cross_validation import k_fold_split
+
+__all__ = [
+    "BinaryVectorizer",
+    "CategoricalNaiveBayesModel",
+    "LabeledPoint",
+    "MarkovChainModel",
+    "k_fold_split",
+    "train_categorical_naive_bayes",
+    "train_markov_chain",
+]

@@ -1,0 +1,23 @@
+"""k-fold splitting helper.
+
+Reference parity: ``e2/.../evaluation/CrossValidation.scala:25-67``
+(``CommonHelperFunctions.splitData``): fold membership by index modulo k,
+yielding (training, testing) per fold.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+def k_fold_split(data: Sequence[T], k: int) -> list[tuple[list[T], list[T]]]:
+    if k <= 0:
+        raise ValueError("k must be positive")
+    folds = []
+    for fold in range(k):
+        train = [x for i, x in enumerate(data) if i % k != fold]
+        test = [x for i, x in enumerate(data) if i % k == fold]
+        folds.append((train, test))
+    return folds

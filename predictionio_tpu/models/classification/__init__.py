@@ -1,0 +1,34 @@
+"""Classification template (naive Bayes + random forest).
+
+Reference parity: ``examples/scala-parallel-classification/add-algorithm/``
+— reads entity *properties* (not events), trains MLlib NaiveBayes plus an
+added RandomForest, Query{attr0,attr1,attr2} -> PredictedResult{label}.
+"""
+
+from predictionio_tpu.models.classification.engine import (
+    ActualResult,
+    DataSource,
+    DataSourceParams,
+    NaiveBayesAlgorithm,
+    PredictedResult,
+    Preparator,
+    Query,
+    RandomForestAlgorithm,
+    Serving,
+    TrainingData,
+    engine_factory,
+)
+
+__all__ = [
+    "ActualResult",
+    "DataSource",
+    "DataSourceParams",
+    "NaiveBayesAlgorithm",
+    "PredictedResult",
+    "Preparator",
+    "Query",
+    "RandomForestAlgorithm",
+    "Serving",
+    "TrainingData",
+    "engine_factory",
+]

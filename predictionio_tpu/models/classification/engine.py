@@ -1,0 +1,199 @@
+"""Classification engine (DASE components).
+
+Reference parity (behavioral):
+  - DataSource aggregates user entity properties requiring
+    plan/attr0/attr1/attr2; label = plan, features = attrs —
+    ``add-algorithm/src/main/scala/DataSource.scala:36-75``; k-fold readEval.
+  - Query {attr0, attr1, attr2} -> PredictedResult {label} —
+    ``Engine.scala:23-36``.
+  - Algorithms "naive" (MLlib NaiveBayes with lambda smoothing) and
+    "randomforest" (added algo) — ``NaiveBayesAlgorithm.scala``,
+    ``RandomForestAlgorithm.scala``. TPU build: jit-batched multinomial NB
+    (ops.classify) + compact numpy random forest.
+  - Serving returns the first prediction (``Serving.scala``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Engine,
+    JaxAlgorithm,
+    LocalAlgorithm,
+    Params,
+    SanityCheck,
+)
+from predictionio_tpu.e2.cross_validation import k_fold_split
+from predictionio_tpu.ops.classify import (
+    NaiveBayesModel,
+    RandomForestModel,
+    train_naive_bayes,
+    train_random_forest,
+)
+from predictionio_tpu.workflow.context import WorkflowContext
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    attr0: float
+    attr1: float
+    attr2: float
+
+    @staticmethod
+    def from_json_dict(d: dict[str, Any]) -> "Query":
+        return Query(float(d["attr0"]), float(d["attr1"]), float(d["attr2"]))
+
+    def to_array(self) -> np.ndarray:
+        return np.array([self.attr0, self.attr1, self.attr2], np.float64)
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    label: float
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return {"label": self.label}
+
+
+@dataclasses.dataclass(frozen=True)
+class ActualResult:
+    label: float
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    eval_k: int | None = None
+    entity_type: str = "user"
+    label_property: str = "plan"
+    attr_properties: tuple[str, ...] = ("attr0", "attr1", "attr2")
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    labels: np.ndarray  # [N]
+    features: np.ndarray  # [N, F]
+
+    def sanity_check(self) -> None:
+        if len(self.labels) == 0:
+            raise ValueError("no labeled entities found; check app data")
+        if not np.all(np.isfinite(self.features)):
+            raise ValueError("non-finite feature values present")
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+    params: DataSourceParams
+
+    def _read_points(self, ctx: WorkflowContext) -> tuple[np.ndarray, np.ndarray]:
+        store = ctx.p_event_store()
+        props = store.aggregate_properties(
+            app_name=self.params.app_name or ctx.app_name,
+            entity_type=self.params.entity_type,
+            channel_name=ctx.channel_name,
+            required=[self.params.label_property, *self.params.attr_properties],
+        )
+        labels, rows = [], []
+        for _, pm in props.items():
+            labels.append(float(pm.get(self.params.label_property)))
+            rows.append([float(pm.get(a)) for a in self.params.attr_properties])
+        return (
+            np.asarray(labels, np.float64),
+            np.asarray(rows, np.float64).reshape(len(labels), -1),
+        )
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        labels, features = self._read_points(ctx)
+        return TrainingData(labels, features)
+
+    def read_eval(self, ctx: WorkflowContext):
+        if not self.params.eval_k:
+            raise ValueError("DataSourceParams.evalK must not be None")
+        labels, features = self._read_points(ctx)
+        indices = list(range(len(labels)))
+        folds = []
+        for train_idx, test_idx in k_fold_split(indices, self.params.eval_k):
+            td = TrainingData(labels[train_idx], features[train_idx])
+            qa = [
+                (
+                    Query(*[float(x) for x in features[i][:3]]),
+                    ActualResult(float(labels[i])),
+                )
+                for i in test_idx
+            ]
+            folds.append((td, {}, qa))
+        return folds
+
+
+class Preparator(BasePreparator):
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> TrainingData:
+        return td
+
+
+@dataclasses.dataclass(frozen=True)
+class NaiveBayesParams(Params):
+    lambda_: float = 1.0
+
+
+class NaiveBayesAlgorithm(JaxAlgorithm):
+    params_class = NaiveBayesParams
+    params: NaiveBayesParams
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> NaiveBayesModel:
+        return train_naive_bayes(pd.labels, pd.features, self.params.lambda_)
+
+    def predict(self, model: NaiveBayesModel, query: Query) -> PredictedResult:
+        return PredictedResult(model.predict(query.to_array()))
+
+    def batch_predict(self, model, queries):
+        if not queries:
+            return []
+        X = np.stack([q.to_array() for _, q in queries])
+        labels = model.predict_batch(X)
+        return [(i, PredictedResult(float(l))) for (i, _), l in zip(queries, labels)]
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomForestParams(Params):
+    num_trees: int = 10
+    max_depth: int = 4
+    seed: int = 42
+
+
+class RandomForestAlgorithm(LocalAlgorithm):
+    params_class = RandomForestParams
+    params: RandomForestParams
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> RandomForestModel:
+        return train_random_forest(
+            pd.labels,
+            pd.features,
+            num_trees=self.params.num_trees,
+            max_depth=self.params.max_depth,
+            seed=self.params.seed,
+        )
+
+    def predict(self, model: RandomForestModel, query: Query) -> PredictedResult:
+        return PredictedResult(model.predict(query.to_array()))
+
+
+class Serving(BaseServing):
+    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
+        return predictions[0]
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        DataSource,
+        Preparator,
+        {"naive": NaiveBayesAlgorithm, "randomforest": RandomForestAlgorithm},
+        Serving,
+        query_class=Query,
+    )

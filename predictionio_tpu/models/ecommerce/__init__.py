@@ -1,0 +1,36 @@
+"""E-commerce recommendation template.
+
+Reference parity: ``examples/scala-parallel-ecommercerecommendation/
+train-with-rate-event/`` — implicit ALS + popularity fallback + business
+rules, with *live* event-store lookups on the serving hot path: seen-item
+exclusion (``unseenOnly``), the ``unavailableItems`` constraint entity, and
+recent-interaction-based scoring for users without factors.
+"""
+
+from predictionio_tpu.models.ecommerce.engine import (
+    DataSource,
+    ECommAlgorithm,
+    ECommAlgorithmParams,
+    ECommModel,
+    ItemScore,
+    PredictedResult,
+    Preparator,
+    Query,
+    Serving,
+    TrainingData,
+    engine_factory,
+)
+
+__all__ = [
+    "DataSource",
+    "ECommAlgorithm",
+    "ECommAlgorithmParams",
+    "ECommModel",
+    "ItemScore",
+    "PredictedResult",
+    "Preparator",
+    "Query",
+    "Serving",
+    "TrainingData",
+    "engine_factory",
+]

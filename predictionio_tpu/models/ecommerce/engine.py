@@ -1,0 +1,391 @@
+"""E-commerce recommendation engine (DASE components).
+
+Reference parity (behavioral), all from
+``train-with-rate-event/src/main/scala/``:
+  - Query {user, num, categories?, whiteList?, blackList?} ->
+    PredictedResult {itemScores} — ``Engine.scala:23-38``.
+  - ECommAlgorithmParams {appName, unseenOnly, seenEvents, similarEvents,
+    rank, numIterations, lambda, seed} — ``ECommAlgorithm.scala:38-47``.
+  - Train: implicit ALS on rate events (weighted by rating), popularity
+    counts from buy events for the cold fallback — ``ECommAlgorithm.scala:
+    76-158, 211-240``.
+  - Predict (``:243-330``): known user -> dot(userFactor, itemFactors);
+    unknown/cold user -> summed similarity of items to the user's recent
+    ``similarEvents`` (live LEventStore lookup, last 10), falling back to
+    popularity counts when no recent items; ``unseenOnly`` excludes items
+    from the user's live ``seenEvents``; the ``unavailableItems`` constraint
+    entity ($set on entityType "constraint") is re-read per query.
+
+TPU design: factor tables live on device; each query is one jitted
+matvec + masked top-k; the live lookups stay host-side (row-store reads).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Engine,
+    JaxAlgorithm,
+    Params,
+    SanityCheck,
+)
+from predictionio_tpu.ops.als import ALSConfig, als_train
+from predictionio_tpu.workflow.context import WorkflowContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+    categories: frozenset[str] | None = None
+    white_list: frozenset[str] | None = None
+    black_list: frozenset[str] | None = None
+
+    @staticmethod
+    def from_json_dict(d: dict[str, Any]) -> "Query":
+        def fset(key):
+            v = d.get(key)
+            return frozenset(v) if v is not None else None
+
+        return Query(
+            user=str(d["user"]),
+            num=int(d.get("num", 10)),
+            categories=fset("categories"),
+            white_list=fset("whiteList"),
+            black_list=fset("blackList"),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple[ItemScore, ...]
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return {
+            "itemScores": [{"item": s.item, "score": s.score} for s in self.item_scores]
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    user_vocab: list[str]
+    item_vocab: list[str]
+    item_categories: list[frozenset[str] | None]
+    rate_user_idx: np.ndarray
+    rate_item_idx: np.ndarray
+    rate_values: np.ndarray
+    buy_user_idx: np.ndarray
+    buy_item_idx: np.ndarray
+
+    def sanity_check(self) -> None:
+        if len(self.rate_user_idx) == 0:
+            raise ValueError("no rate events found; check app data")
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+    params: DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        store = ctx.p_event_store()
+        app_name = self.params.app_name or ctx.app_name
+        col = store.to_columnar(
+            app_name=app_name,
+            channel_name=ctx.channel_name,
+            event_names=["rate", "buy"],
+            entity_type="user",
+            target_entity_type="item",
+            rating_key="rating",
+        )
+        item_vocab = list(col.target_vocab)
+        item_index = {v: i for i, v in enumerate(item_vocab)}
+        item_props = store.aggregate_properties(
+            app_name=app_name, entity_type="item", channel_name=ctx.channel_name
+        )
+        categories: list[frozenset[str] | None] = [None] * len(item_vocab)
+        for entity_id, pm in item_props.items():
+            idx = item_index.get(entity_id)
+            if idx is None:
+                continue
+            cats = pm.get_opt("categories")
+            if cats is not None:
+                categories[idx] = frozenset(cats)
+        rates = np.asarray([n == "rate" for n in col.event_names], bool)
+        buys = np.asarray([n == "buy" for n in col.event_names], bool)
+        valid = (col.entity_ids >= 0) & (col.target_ids >= 0)
+        rate_mask = rates & valid & np.isfinite(col.ratings)
+        buy_mask = buys & valid
+        return TrainingData(
+            user_vocab=col.entity_vocab,
+            item_vocab=item_vocab,
+            item_categories=categories,
+            rate_user_idx=col.entity_ids[rate_mask],
+            rate_item_idx=col.target_ids[rate_mask],
+            rate_values=col.ratings[rate_mask],
+            buy_user_idx=col.entity_ids[buy_mask],
+            buy_item_idx=col.target_ids[buy_mask],
+        )
+
+
+class Preparator(BasePreparator):
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> TrainingData:
+        return td
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommAlgorithmParams(Params):
+    app_name: str = ""
+    unseen_only: bool = False
+    seen_events: tuple[str, ...] = ("buy", "view")
+    similar_events: tuple[str, ...] = ("view",)
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int | None = 3
+
+
+@dataclasses.dataclass
+class ECommModel(SanityCheck):
+    user_factors: np.ndarray  # [n_users, f]
+    item_factors: np.ndarray  # [n_items, f]
+    popular_counts: np.ndarray  # [n_items] buy counts
+    user_vocab: list[str]
+    item_vocab: list[str]
+    item_categories: list[frozenset[str] | None]
+
+    def __post_init__(self):
+        self._user_index: dict[str, int] | None = None
+        self._item_index: dict[str, int] | None = None
+        self._device_items = None
+
+    def sanity_check(self) -> None:
+        if not (
+            np.all(np.isfinite(self.user_factors))
+            and np.all(np.isfinite(self.item_factors))
+        ):
+            raise ValueError("non-finite ALS factors")
+
+    def user_index(self, user: str) -> int | None:
+        if self._user_index is None:
+            self._user_index = {u: i for i, u in enumerate(self.user_vocab)}
+        return self._user_index.get(user)
+
+    def item_index(self, item: str) -> int | None:
+        if self._item_index is None:
+            self._item_index = {v: i for i, v in enumerate(self.item_vocab)}
+        return self._item_index.get(item)
+
+    def device_items(self):
+        if self._device_items is None:
+            import jax.numpy as jnp
+
+            self._device_items = jnp.asarray(self.item_factors)
+        return self._device_items
+
+    def __getstate__(self):
+        return {
+            "user_factors": self.user_factors,
+            "item_factors": self.item_factors,
+            "popular_counts": self.popular_counts,
+            "user_vocab": self.user_vocab,
+            "item_vocab": self.item_vocab,
+            "item_categories": self.item_categories,
+        }
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._user_index = None
+        self._item_index = None
+        self._device_items = None
+
+
+class ECommAlgorithm(JaxAlgorithm):
+    params_class = ECommAlgorithmParams
+    params: ECommAlgorithmParams
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> ECommModel:
+        cfg = ALSConfig(
+            rank=self.params.rank,
+            iterations=self.params.num_iterations,
+            reg=self.params.lambda_,
+            implicit=True,
+            alpha=self.params.alpha,
+            seed=self.params.seed if self.params.seed is not None else 0,
+        )
+        uf, vf = als_train(
+            pd.rate_user_idx,
+            pd.rate_item_idx,
+            pd.rate_values,
+            len(pd.user_vocab),
+            len(pd.item_vocab),
+            cfg,
+        )
+        popular = np.bincount(
+            pd.buy_item_idx, minlength=len(pd.item_vocab)
+        ).astype(np.float32)
+        return ECommModel(
+            np.asarray(uf),
+            np.asarray(vf),
+            popular,
+            list(pd.user_vocab),
+            list(pd.item_vocab),
+            list(pd.item_categories),
+        )
+
+    # -- live lookups (ref ECommAlgorithm.scala:252-300) ---------------------
+    def _seen_items(self, ctx: WorkflowContext, user: str) -> set[str]:
+        try:
+            events = ctx.l_event_store().find_by_entity(
+                app_name=self.params.app_name or ctx.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.seen_events),
+                limit=None,
+            )
+            return {
+                e.target_entity_id for e in events if e.target_entity_id is not None
+            }
+        except Exception:
+            logger.exception("seen-items lookup failed; serving without filter")
+            return set()
+
+    def _unavailable_items(self, ctx: WorkflowContext) -> set[str]:
+        """$set events on (constraint, unavailableItems), latest wins
+        (ref :268-284)."""
+        try:
+            events = list(
+                ctx.l_event_store().find_by_entity(
+                    app_name=self.params.app_name or ctx.app_name,
+                    entity_type="constraint",
+                    entity_id="unavailableItems",
+                    event_names=["$set"],
+                    limit=1,
+                )
+            )
+            if events:
+                return set(events[0].properties.get_or_else("items", []))
+        except Exception:
+            logger.exception("unavailable-items lookup failed; assuming none")
+        return set()
+
+    def _recent_item_indices(self, ctx: WorkflowContext, model: ECommModel, user: str) -> list[int]:
+        """Last 10 similar-event items (ref :302-320)."""
+        try:
+            events = ctx.l_event_store().find_by_entity(
+                app_name=self.params.app_name or ctx.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.similar_events),
+                limit=10,
+            )
+            out = []
+            for e in events:
+                if e.target_entity_id is not None:
+                    idx = model.item_index(e.target_entity_id)
+                    if idx is not None:
+                        out.append(idx)
+            return out
+        except Exception:
+            logger.exception("recent-items lookup failed")
+            return []
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        return self.predict_with_context(
+            WorkflowContext(mode="serving"), model, query
+        )
+
+    def predict_with_context(
+        self, ctx: WorkflowContext, model: ECommModel, query: Query
+    ) -> PredictedResult:
+        import jax.numpy as jnp
+
+        n = len(model.item_vocab)
+        uidx = model.user_index(query.user)
+        if uidx is not None:
+            scores = np.asarray(
+                model.device_items() @ jnp.asarray(model.user_factors[uidx])
+            )
+        else:
+            recent = self._recent_item_indices(ctx, model, query.user)
+            if recent:
+                q = model.device_items()[jnp.asarray(recent, jnp.int32)]
+                scores = np.asarray(jnp.sum(model.device_items() @ q.T, axis=1))
+            else:
+                scores = model.popular_counts.astype(np.float64)
+
+        mask = np.ones(n, bool)
+        if self.params.unseen_only:
+            for it in self._seen_items(ctx, query.user):
+                idx = model.item_index(it)
+                if idx is not None:
+                    mask[idx] = False
+        for it in self._unavailable_items(ctx):
+            idx = model.item_index(it)
+            if idx is not None:
+                mask[idx] = False
+        if query.white_list is not None:
+            wl = np.zeros(n, bool)
+            for it in query.white_list:
+                idx = model.item_index(it)
+                if idx is not None:
+                    wl[idx] = True
+            mask &= wl
+        if query.black_list is not None:
+            for it in query.black_list:
+                idx = model.item_index(it)
+                if idx is not None:
+                    mask[idx] = False
+        if query.categories is not None:
+            for i in range(n):
+                cats = model.item_categories[i]
+                if cats is None or not (cats & query.categories):
+                    mask[i] = False
+
+        masked = np.where(mask, scores, -np.inf)
+        k = min(query.num, n)
+        idx = np.argpartition(-masked, max(k - 1, 0))[:k]
+        idx = idx[np.argsort(-masked[idx])]
+        return PredictedResult(
+            tuple(
+                ItemScore(model.item_vocab[int(i)], float(masked[i]))
+                for i in idx
+                if np.isfinite(masked[i])
+            )
+        )
+
+
+class Serving(BaseServing):
+    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
+        return predictions[0]
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        DataSource,
+        Preparator,
+        {"ecomm": ECommAlgorithm},
+        Serving,
+        query_class=Query,
+    )

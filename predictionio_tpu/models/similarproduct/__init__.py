@@ -1,0 +1,37 @@
+"""Similar-product template.
+
+Reference parity: ``examples/scala-parallel-similarproduct/`` (the
+multi-events-multi-algos variant, which supersets the base template):
+implicit-ALS item factors scored by cosine similarity against the query
+items, an item-cooccurrence algorithm, and a like-event ALS variant, all
+selectable per engine.json; business filters (categories, category
+blacklist, white/black lists, query-item exclusion) applied at predict time.
+"""
+
+from predictionio_tpu.models.similarproduct.engine import (
+    ALSAlgorithm,
+    CooccurrenceAlgorithm,
+    DataSource,
+    ItemScore,
+    LikeAlgorithm,
+    PredictedResult,
+    Preparator,
+    Query,
+    Serving,
+    TrainingData,
+    engine_factory,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "CooccurrenceAlgorithm",
+    "DataSource",
+    "ItemScore",
+    "LikeAlgorithm",
+    "PredictedResult",
+    "Preparator",
+    "Query",
+    "Serving",
+    "TrainingData",
+    "engine_factory",
+]

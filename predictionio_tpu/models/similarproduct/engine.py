@@ -1,0 +1,403 @@
+"""Similar-product engine (DASE components).
+
+Reference parity (behavioral):
+  - Query {items, num, categories?, categoryBlackList?, whiteList?,
+    blackList?} -> PredictedResult {itemScores} —
+    ``multi-events-multi-algos/src/main/scala/Engine.scala:23-41``.
+  - DataSource reads user/item entities (item ``categories`` property) and
+    view + like events — ``DataSource.scala``.
+  - ALSAlgorithm: implicit ALS on view counts; predict scores every item by
+    cosine similarity to each query item's factor, summed —
+    ``ALSAlgorithm.scala:136-230``.
+  - LikeAlgorithm: same scoring on like events — ``LikeAlgorithm.scala``.
+  - CooccurrenceAlgorithm: top-N ordered-pair counts —
+    ``CooccurrenceAlgorithm.scala:30-90``.
+  - isCandidateItem filters: whitelist, blacklist, query-item exclusion,
+    category overlap, category blacklist — ``ALSAlgorithm.scala:236-260``.
+
+TPU design: cosine scoring is one jitted matmul over the full normalized
+item-factor table; filters are boolean masks fused into the top-k.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Engine,
+    JaxAlgorithm,
+    LocalAlgorithm,
+    Params,
+    SanityCheck,
+)
+from predictionio_tpu.ops.als import ALSConfig, als_train
+from predictionio_tpu.ops.cooccurrence import cooccurrence_top_n, score_by_cooccurrence
+from predictionio_tpu.workflow.context import WorkflowContext
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    items: tuple[str, ...]
+    num: int = 10
+    categories: frozenset[str] | None = None
+    category_black_list: frozenset[str] | None = None
+    white_list: frozenset[str] | None = None
+    black_list: frozenset[str] | None = None
+
+    @staticmethod
+    def from_json_dict(d: dict[str, Any]) -> "Query":
+        def fset(key):
+            v = d.get(key)
+            return frozenset(v) if v is not None else None
+
+        return Query(
+            items=tuple(d["items"]),
+            num=int(d.get("num", 10)),
+            categories=fset("categories"),
+            category_black_list=fset("categoryBlackList"),
+            white_list=fset("whiteList"),
+            black_list=fset("blackList"),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple[ItemScore, ...]
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return {
+            "itemScores": [{"item": s.item, "score": s.score} for s in self.item_scores]
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    user_vocab: list[str]
+    item_vocab: list[str]
+    item_categories: list[frozenset[str] | None]  # aligned with item_vocab
+    view_user_idx: np.ndarray
+    view_item_idx: np.ndarray
+    like_user_idx: np.ndarray
+    like_item_idx: np.ndarray
+
+    def sanity_check(self) -> None:
+        if len(self.view_user_idx) == 0 and len(self.like_user_idx) == 0:
+            raise ValueError("no view/like events found; check app data")
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+    params: DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        store = ctx.p_event_store()
+        app_name = self.params.app_name or ctx.app_name
+        col = store.to_columnar(
+            app_name=app_name,
+            channel_name=ctx.channel_name,
+            event_names=["view", "like"],
+            entity_type="user",
+            target_entity_type="item",
+        )
+        item_vocab = list(col.target_vocab)
+        item_index = {v: i for i, v in enumerate(item_vocab)}
+        # item categories from $set properties of item entities
+        item_props = store.aggregate_properties(
+            app_name=app_name, entity_type="item", channel_name=ctx.channel_name
+        )
+        categories: list[frozenset[str] | None] = [None] * len(item_vocab)
+        for entity_id, pm in item_props.items():
+            idx = item_index.get(entity_id)
+            if idx is None:
+                item_index[entity_id] = len(item_vocab)
+                item_vocab.append(entity_id)
+                categories.append(None)
+                idx = item_index[entity_id]
+            cats = pm.get_opt("categories")
+            if cats is not None:
+                categories[idx] = frozenset(cats)
+        views = np.asarray([n == "view" for n in col.event_names], bool)
+        likes = np.asarray([n == "like" for n in col.event_names], bool)
+        valid = (col.entity_ids >= 0) & (col.target_ids >= 0)
+        return TrainingData(
+            user_vocab=col.entity_vocab,
+            item_vocab=item_vocab,
+            item_categories=categories,
+            view_user_idx=col.entity_ids[views & valid],
+            view_item_idx=col.target_ids[views & valid],
+            like_user_idx=col.entity_ids[likes & valid],
+            like_item_idx=col.target_ids[likes & valid],
+        )
+
+
+class Preparator(BasePreparator):
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> TrainingData:
+        return td
+
+
+# ---------------------------------------------------------------------------
+# Shared model + filtering
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SimilarModel(SanityCheck):
+    item_factors: np.ndarray  # [n_items, f], L2-normalized rows
+    item_vocab: list[str]
+    item_categories: list[frozenset[str] | None]
+
+    def __post_init__(self):
+        self._index: dict[str, int] | None = None
+        self._device_factors = None
+
+    def sanity_check(self) -> None:
+        if not np.all(np.isfinite(self.item_factors)):
+            raise ValueError("non-finite item factors")
+
+    def item_index(self, item: str) -> int | None:
+        if self._index is None:
+            self._index = {v: i for i, v in enumerate(self.item_vocab)}
+        return self._index.get(item)
+
+    def device_factors(self):
+        if self._device_factors is None:
+            import jax.numpy as jnp
+
+            self._device_factors = jnp.asarray(self.item_factors)
+        return self._device_factors
+
+    def __getstate__(self):
+        return {
+            "item_factors": self.item_factors,
+            "item_vocab": self.item_vocab,
+            "item_categories": self.item_categories,
+        }
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._index = None
+        self._device_factors = None
+
+
+def candidate_mask(model: SimilarModel, query: Query, query_idx: list[int]) -> np.ndarray:
+    """ref isCandidateItem (ALSAlgorithm.scala:236-260)."""
+    n = len(model.item_vocab)
+    mask = np.ones(n, bool)
+    mask[query_idx] = False  # exclude query items
+    if query.white_list is not None:
+        wl = np.zeros(n, bool)
+        for it in query.white_list:
+            idx = model.item_index(it)
+            if idx is not None:
+                wl[idx] = True
+        mask &= wl
+    if query.black_list is not None:
+        for it in query.black_list:
+            idx = model.item_index(it)
+            if idx is not None:
+                mask[idx] = False
+    if query.categories is not None:
+        for i in range(n):
+            cats = model.item_categories[i]
+            # items without categories are discarded when filtering by category
+            if cats is None or not (cats & query.categories):
+                mask[i] = False
+    if query.category_black_list is not None:
+        for i in range(n):
+            cats = model.item_categories[i]
+            if cats is not None and (cats & query.category_black_list):
+                mask[i] = False
+    return mask
+
+
+def _topk_filtered(scores: np.ndarray, mask: np.ndarray, k: int) -> list[tuple[int, float]]:
+    scores = np.where(mask, scores, -np.inf)
+    k = min(k, len(scores))
+    if k <= 0:
+        return []
+    idx = np.argpartition(-scores, k - 1)[:k]
+    idx = idx[np.argsort(-scores[idx])]
+    return [(int(i), float(scores[i])) for i in idx if np.isfinite(scores[i])]
+
+
+def _cosine_scores(model: SimilarModel, query_idx: list[int]) -> np.ndarray:
+    import jax.numpy as jnp
+
+    factors = model.device_factors()  # [n, f] normalized
+    q = factors[jnp.asarray(query_idx, jnp.int32)]  # [Q, f]
+    return np.asarray(jnp.sum(factors @ q.T, axis=1))  # summed cosine per item
+
+
+# ---------------------------------------------------------------------------
+# Algorithms
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int | None = 3
+
+
+class _ALSBase(JaxAlgorithm):
+    params_class = ALSAlgorithmParams
+    params: ALSAlgorithmParams
+
+    event_kind = "view"
+
+    def _interactions(self, pd: TrainingData) -> tuple[np.ndarray, np.ndarray]:
+        if self.event_kind == "view":
+            return pd.view_user_idx, pd.view_item_idx
+        return pd.like_user_idx, pd.like_item_idx
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> SimilarModel:
+        users, items = self._interactions(pd)
+        if len(users) == 0:
+            raise ValueError(f"no {self.event_kind} events to train on")
+        # count interactions as implicit ratings (ref trainImplicit on counts)
+        pair, counts = np.unique(
+            np.stack([users, items], 1), axis=0, return_counts=True
+        )
+        cfg = ALSConfig(
+            rank=self.params.rank,
+            iterations=self.params.num_iterations,
+            reg=self.params.lambda_,
+            implicit=True,
+            alpha=self.params.alpha,
+            seed=self.params.seed if self.params.seed is not None else 0,
+        )
+        _, item_factors = als_train(
+            pair[:, 0],
+            pair[:, 1],
+            counts.astype(np.float32),
+            len(pd.user_vocab),
+            len(pd.item_vocab),
+            cfg,
+        )
+        vf = np.asarray(item_factors)
+        norms = np.linalg.norm(vf, axis=1, keepdims=True)
+        vf = vf / np.where(norms == 0, 1.0, norms)  # pre-normalize for cosine
+        return SimilarModel(vf, list(pd.item_vocab), list(pd.item_categories))
+
+    def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
+        query_idx = [
+            i for it in query.items if (i := model.item_index(it)) is not None
+        ]
+        if not query_idx:
+            return PredictedResult(())
+        scores = _cosine_scores(model, query_idx)
+        mask = candidate_mask(model, query, query_idx)
+        top = _topk_filtered(scores, mask, query.num)
+        return PredictedResult(
+            tuple(ItemScore(model.item_vocab[i], s) for i, s in top)
+        )
+
+
+class ALSAlgorithm(_ALSBase):
+    event_kind = "view"
+
+
+class LikeAlgorithm(_ALSBase):
+    """ref LikeAlgorithm.scala — same scoring trained on like events."""
+
+    event_kind = "like"
+
+
+@dataclasses.dataclass(frozen=True)
+class CooccurrenceParams(Params):
+    n: int = 20  # top-N cooccurring items kept per item
+
+
+@dataclasses.dataclass
+class CooccurrenceModel:
+    top_map: dict[int, list[tuple[int, int]]]
+    item_vocab: list[str]
+    item_categories: list[frozenset[str] | None]
+
+    def __post_init__(self):
+        self._index = {v: i for i, v in enumerate(self.item_vocab)}
+
+    def item_index(self, item: str) -> int | None:
+        return self._index.get(item)
+
+    def __getstate__(self):
+        return {
+            "top_map": self.top_map,
+            "item_vocab": self.item_vocab,
+            "item_categories": self.item_categories,
+        }
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._index = {v: i for i, v in enumerate(self.item_vocab)}
+
+
+class CooccurrenceAlgorithm(LocalAlgorithm):
+    params_class = CooccurrenceParams
+    params: CooccurrenceParams
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> CooccurrenceModel:
+        top_map = cooccurrence_top_n(
+            pd.view_user_idx, pd.view_item_idx, len(pd.item_vocab), self.params.n
+        )
+        return CooccurrenceModel(
+            top_map, list(pd.item_vocab), list(pd.item_categories)
+        )
+
+    def predict(self, model: CooccurrenceModel, query: Query) -> PredictedResult:
+        query_idx = [
+            i for it in query.items if (i := model.item_index(it)) is not None
+        ]
+        score_map = score_by_cooccurrence(model.top_map, query_idx)
+        shim = SimilarModel(
+            np.zeros((len(model.item_vocab), 1), np.float32),
+            model.item_vocab,
+            model.item_categories,
+        )
+        mask = candidate_mask(shim, query, query_idx)
+        scores = np.full(len(model.item_vocab), -np.inf)
+        for i, s in score_map.items():
+            scores[i] = s
+        top = _topk_filtered(scores, mask, query.num)
+        return PredictedResult(
+            tuple(ItemScore(model.item_vocab[i], s) for i, s in top)
+        )
+
+
+class Serving(BaseServing):
+    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
+        return predictions[0]
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        DataSource,
+        Preparator,
+        {
+            "als": ALSAlgorithm,
+            "cooccurrence": CooccurrenceAlgorithm,
+            "likealgo": LikeAlgorithm,
+        },
+        Serving,
+        query_class=Query,
+    )

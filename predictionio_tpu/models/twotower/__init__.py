@@ -1,0 +1,40 @@
+"""Two-tower deep retrieval template (flagship model).
+
+The reference has no deep-learning model; BASELINE.json adds "two-tower deep
+retrieval as a JAX P2LAlgorithm (MovieLens-20M, data-parallel on v5e-16)" as
+a target workload. This package provides:
+
+  - ``model.py``   — flax two-tower network, in-batch-softmax training step,
+                     explicit mesh shardings (batch over ``data``, embedding
+                     tables over ``model``), jit-compiled with donation.
+  - ``engine.py``  — the DASE template wrapping it (DataSource over rate/view
+                     events, TwoTowerAlgorithm, top-k retrieval serving).
+"""
+
+from predictionio_tpu.models.twotower.engine import (
+    DataSource,
+    ItemScore,
+    PredictedResult,
+    Preparator,
+    Query,
+    Serving,
+    TrainingData,
+    TwoTowerAlgorithm,
+    TwoTowerAlgorithmParams,
+    TwoTowerModelState,
+    engine_factory,
+)
+
+__all__ = [
+    "DataSource",
+    "ItemScore",
+    "PredictedResult",
+    "Preparator",
+    "Query",
+    "Serving",
+    "TrainingData",
+    "TwoTowerAlgorithm",
+    "TwoTowerAlgorithmParams",
+    "TwoTowerModelState",
+    "engine_factory",
+]

@@ -1,0 +1,235 @@
+"""Two-tower retrieval engine (DASE components).
+
+Wire contract mirrors the recommendation template (Query {user, num} ->
+PredictedResult {itemScores}) so the two are drop-in interchangeable behind
+the same query server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Engine,
+    JaxAlgorithm,
+    Params,
+    SanityCheck,
+)
+from predictionio_tpu.models.twotower.model import (
+    TwoTower,
+    TwoTowerConfig,
+    train_two_tower,
+    user_embedding,
+)
+from predictionio_tpu.workflow.context import WorkflowContext
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+    @staticmethod
+    def from_json_dict(d: dict[str, Any]) -> "Query":
+        return Query(user=str(d["user"]), num=int(d.get("num", 10)))
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple[ItemScore, ...]
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return {
+            "itemScores": [{"item": s.item, "score": s.score} for s in self.item_scores]
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_names: tuple[str, ...] = ("rate", "buy", "view")
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    user_idx: np.ndarray
+    item_idx: np.ndarray
+    user_vocab: list[str]
+    item_vocab: list[str]
+
+    def sanity_check(self) -> None:
+        if len(self.user_idx) == 0:
+            raise ValueError("no interaction events found; check app data")
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+    params: DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        col = ctx.p_event_store().to_columnar(
+            app_name=self.params.app_name or ctx.app_name,
+            channel_name=ctx.channel_name,
+            event_names=list(self.params.event_names),
+            entity_type="user",
+            target_entity_type="item",
+        )
+        valid = (col.entity_ids >= 0) & (col.target_ids >= 0)
+        return TrainingData(
+            col.entity_ids[valid],
+            col.target_ids[valid],
+            col.entity_vocab,
+            col.target_vocab,
+        )
+
+
+class Preparator(BasePreparator):
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> TrainingData:
+        return td
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoTowerAlgorithmParams(Params):
+    embed_dim: int = 64
+    hidden: tuple[int, ...] = (128,)
+    out_dim: int = 32
+    temperature: float = 0.05
+    learning_rate: float = 1e-3
+    batch_size: int = 4096
+    epochs: int = 5
+    seed: int = 0
+    mesh: str = ""  # e.g. "data=-1,model=2"; empty = all devices on data
+
+
+@dataclasses.dataclass
+class TwoTowerModelState(SanityCheck):
+    config: TwoTowerConfig
+    params: Any  # host numpy pytree
+    item_embeddings: np.ndarray
+    user_vocab: list[str]
+    item_vocab: list[str]
+    losses: list[float]
+
+    def __post_init__(self):
+        self._user_index: dict[str, int] | None = None
+        self._device_items = None
+        self._model: TwoTower | None = None
+
+    def sanity_check(self) -> None:
+        if not np.all(np.isfinite(self.item_embeddings)):
+            raise ValueError("two-tower training produced non-finite embeddings")
+
+    def user_index(self, user: str) -> int | None:
+        if self._user_index is None:
+            self._user_index = {u: i for i, u in enumerate(self.user_vocab)}
+        return self._user_index.get(user)
+
+    def model(self) -> TwoTower:
+        if self._model is None:
+            self._model = TwoTower(self.config)
+        return self._model
+
+    def device_items(self):
+        if self._device_items is None:
+            import jax.numpy as jnp
+
+            self._device_items = jnp.asarray(self.item_embeddings)
+        return self._device_items
+
+    def __getstate__(self):
+        return {
+            "config": self.config,
+            "params": self.params,
+            "item_embeddings": self.item_embeddings,
+            "user_vocab": self.user_vocab,
+            "item_vocab": self.item_vocab,
+            "losses": self.losses,
+        }
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._user_index = None
+        self._device_items = None
+        self._model = None
+
+
+class TwoTowerAlgorithm(JaxAlgorithm):
+    params_class = TwoTowerAlgorithmParams
+    params: TwoTowerAlgorithmParams
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> TwoTowerModelState:
+        config = TwoTowerConfig(
+            n_users=max(len(pd.user_vocab), 1),
+            n_items=max(len(pd.item_vocab), 1),
+            embed_dim=self.params.embed_dim,
+            hidden=tuple(self.params.hidden),
+            out_dim=self.params.out_dim,
+            temperature=self.params.temperature,
+            learning_rate=self.params.learning_rate,
+            batch_size=self.params.batch_size,
+            epochs=self.params.epochs,
+            seed=self.params.seed,
+        )
+        mesh = None
+        if self.params.mesh:
+            from predictionio_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(self.params.mesh)
+        result = train_two_tower(pd.user_idx, pd.item_idx, config, mesh=mesh)
+        return TwoTowerModelState(
+            config=config,
+            params=result.params,
+            item_embeddings=result.item_embeddings,
+            user_vocab=pd.user_vocab,
+            item_vocab=pd.item_vocab,
+            losses=result.losses,
+        )
+
+    def predict(self, model: TwoTowerModelState, query: Query) -> PredictedResult:
+        import jax.numpy as jnp
+
+        uidx = model.user_index(query.user)
+        if uidx is None:
+            return PredictedResult(())
+        u = user_embedding(
+            model.model(), model.params, jnp.asarray([uidx], jnp.int32)
+        )[0]
+        from predictionio_tpu.ops.als import top_k_items
+
+        scores, idx = top_k_items(
+            u, model.device_items(), min(query.num, len(model.item_vocab))
+        )
+        return PredictedResult(
+            tuple(
+                ItemScore(model.item_vocab[int(i)], float(s))
+                for s, i in zip(scores, idx)
+                if np.isfinite(s)
+            )
+        )
+
+
+class Serving(BaseServing):
+    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
+        return predictions[0]
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        DataSource,
+        Preparator,
+        {"twotower": TwoTowerAlgorithm},
+        Serving,
+        query_class=Query,
+    )

@@ -1,0 +1,67 @@
+"""Item cooccurrence counting.
+
+Reference parity: ``examples/scala-parallel-similarproduct/
+multi-events-multi-algos/src/main/scala/CooccurrenceAlgorithm.scala:30-90``
+— distinct (user, item) interactions, per-user ordered item pairs, pair
+counts, top-N cooccurring items kept per item. The Spark self-join becomes a
+numpy bincount over pair codes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def cooccurrence_top_n(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    n_items: int,
+    top_n: int,
+) -> dict[int, list[tuple[int, int]]]:
+    """Returns item -> [(other_item, count)] sorted by count desc, len<=top_n."""
+    pairs = np.unique(
+        np.stack([np.asarray(user_idx, np.int64), np.asarray(item_idx, np.int64)], 1),
+        axis=0,
+    )
+    users, items = pairs[:, 0], pairs[:, 1]
+    order = np.argsort(users, kind="stable")
+    users, items = users[order], items[order]
+    # per-user slices
+    boundaries = np.flatnonzero(np.diff(users)) + 1
+    groups = np.split(items, boundaries)
+    codes: list[np.ndarray] = []
+    for g in groups:
+        if len(g) < 2:
+            continue
+        a, b = np.meshgrid(g, g, indexing="ij")
+        mask = a != b
+        codes.append(a[mask] * n_items + b[mask])
+    if not codes:
+        return {}
+    counts = np.bincount(np.concatenate(codes), minlength=0)
+    nz = np.flatnonzero(counts)
+    out: dict[int, list[tuple[int, int]]] = {}
+    lhs = nz // n_items
+    rhs = nz % n_items
+    cnt = counts[nz]
+    order = np.lexsort((-cnt, lhs))
+    for i in order:
+        item = int(lhs[i])
+        bucket = out.setdefault(item, [])
+        if len(bucket) < top_n:
+            bucket.append((int(rhs[i]), int(cnt[i])))
+    return out
+
+
+def score_by_cooccurrence(
+    top_map: dict[int, list[tuple[int, int]]],
+    query_items: Sequence[int],
+) -> dict[int, float]:
+    """Sum cooccurrence counts over the query items (ref predict :70-90)."""
+    scores: dict[int, float] = {}
+    for qi in query_items:
+        for item, count in top_map.get(qi, []):
+            scores[item] = scores.get(item, 0.0) + count
+    return scores

@@ -108,8 +108,17 @@ def load_manifest(
     factory = variant.get("engineFactory")
     if not factory:
         raise EngineLoadError(f"{variant_path} missing engineFactory")
+    # Engine identity: the variant "id" when it is distinctive, else the
+    # absolute engine directory — matching the reference, which registers a
+    # manifest per engine directory at `pio build`. A generic/absent id must
+    # not collide across engines or `deploy` would resolve another engine's
+    # COMPLETED instances and serve the wrong model.
+    variant_id = variant.get("id")
+    engine_id = (
+        variant_id if variant_id and variant_id != "default" else engine_dir
+    )
     return EngineManifest(
-        engine_id=variant.get("id", os.path.basename(engine_dir)),
+        engine_id=engine_id,
         version=variant.get("version", "1"),
         variant=os.path.basename(variant_path),
         engine_factory=factory,

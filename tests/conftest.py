@@ -24,7 +24,11 @@ try:
     from jax._src import xla_bridge as _xb
 
     _jax.config.update("jax_platforms", "cpu")
-    for _name in [n for n in _xb._backend_factories if n != "cpu"]:
+    # Drop only non-standard plugin platforms (e.g. the axon tunnel): their
+    # device init can block, but standard names must stay registered because
+    # libraries register per-platform lowerings for them at import time.
+    _standard = {"cpu", "gpu", "cuda", "rocm", "tpu", "METAL"}
+    for _name in [n for n in _xb._backend_factories if n not in _standard]:
         _xb._backend_factories.pop(_name, None)
 except Exception:
     pass

@@ -1,0 +1,118 @@
+"""e2 algorithm library tests (ref CategoricalNaiveBayesTest,
+MarkovChainTest, BinaryVectorizerTest, CrossValidationTest)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    BinaryVectorizer,
+    LabeledPoint,
+    k_fold_split,
+    train_categorical_naive_bayes,
+    train_markov_chain,
+)
+from predictionio_tpu.ops.classify import train_naive_bayes, train_random_forest
+
+
+class TestCategoricalNaiveBayes:
+    POINTS = [
+        LabeledPoint("spam", ("free", "money")),
+        LabeledPoint("spam", ("free", "offer")),
+        LabeledPoint("ham", ("meeting", "money")),
+        LabeledPoint("ham", ("meeting", "tomorrow")),
+    ]
+
+    def test_priors_and_predict(self):
+        model = train_categorical_naive_bayes(self.POINTS)
+        assert math.isclose(model.priors["spam"], math.log(0.5))
+        assert model.predict(("free", "offer")) == "spam"
+        assert model.predict(("meeting", "tomorrow")) == "ham"
+
+    def test_log_score(self):
+        model = train_categorical_naive_bayes(self.POINTS)
+        s = model.log_score(LabeledPoint("spam", ("free", "money")))
+        # log(1/2) + log(2/2) + log(1/2)
+        assert math.isclose(s, math.log(0.5) + 0.0 + math.log(0.5))
+        assert model.log_score(LabeledPoint("unknown", ("x",))) is None
+        # unseen feature value with -inf default
+        assert model.log_score(LabeledPoint("spam", ("zzz",))) == float("-inf")
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError):
+            train_categorical_naive_bayes([])
+
+
+class TestMarkovChain:
+    def test_top_n_normalized(self):
+        model = train_markov_chain(
+            [(0, 1, 3.0), (0, 2, 1.0), (1, 0, 2.0), (0, 1, 1.0)], 3, top_n=1
+        )
+        assert model.transition_probs(0) == [(1, 0.8)]  # 4/(4+1)
+        assert model.predict(0) == 1
+        assert model.predict(2) is None
+
+    def test_top_n_cap(self):
+        model = train_markov_chain([(0, j, 1.0) for j in range(5)], 6, top_n=3)
+        assert len(model.transition_probs(0)) == 3
+
+
+class TestBinaryVectorizer:
+    def test_fit_transform(self):
+        maps = [{"color": "red", "size": "L"}, {"color": "blue"}]
+        v = BinaryVectorizer.fit(maps)
+        assert v.n_features == 3
+        out = v.transform({"color": "red", "size": "L"})
+        assert out.sum() == 2.0
+        out2 = v.transform({"color": "green"})  # unseen value ignored
+        assert out2.sum() == 0.0
+
+    def test_property_filter(self):
+        v = BinaryVectorizer.fit(
+            [{"a": "1", "b": "2"}], properties=["a"]
+        )
+        assert v.n_features == 1
+
+
+class TestKFold:
+    def test_partitions(self):
+        data = list(range(10))
+        folds = k_fold_split(data, 3)
+        assert len(folds) == 3
+        for train, test in folds:
+            assert sorted(train + test) == data
+        all_test = sorted(sum((test for _, test in folds), []))
+        assert all_test == data  # each element tested exactly once
+
+    def test_invalid_k(self):
+        with pytest.raises(ValueError):
+            k_fold_split([1], 0)
+
+
+class TestNumericNB:
+    def test_separates_classes(self):
+        rng = np.random.default_rng(0)
+        X0 = rng.poisson([1.0, 5.0, 1.0], (50, 3))
+        X1 = rng.poisson([5.0, 1.0, 5.0], (50, 3))
+        X = np.vstack([X0, X1]).astype(float)
+        y = np.array([0.0] * 50 + [1.0] * 50)
+        model = train_naive_bayes(y, X)
+        assert model.predict(np.array([1.0, 6.0, 0.0])) == 0.0
+        assert model.predict(np.array([6.0, 0.0, 6.0])) == 1.0
+        batch = model.predict_batch(np.array([[1, 6, 0], [6, 0, 6]], float))
+        assert list(batch) == [0.0, 1.0]
+
+    def test_negative_features_rejected(self):
+        with pytest.raises(ValueError):
+            train_naive_bayes(np.array([0.0]), np.array([[-1.0]]))
+
+
+class TestRandomForest:
+    def test_learns_threshold(self):
+        rng = np.random.default_rng(1)
+        X = rng.uniform(0, 1, (200, 2))
+        y = (X[:, 0] > 0.5).astype(float)
+        model = train_random_forest(y, X, num_trees=5, max_depth=3)
+        assert model.predict(np.array([0.9, 0.5])) == 1.0
+        assert model.predict(np.array([0.1, 0.5])) == 0.0

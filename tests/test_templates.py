@@ -1,0 +1,431 @@
+"""Template tests: classification, similar-product, e-commerce, two-tower
+(ref per-template engine behaviors in examples/)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.workflow.context import WorkflowContext
+
+APP = "tplapp"
+
+
+def seed_app(storage):
+    app_id = storage.get_meta_data_apps().insert(App(0, APP))
+    return app_id, storage.get_l_events()
+
+
+def ctx(storage):
+    return WorkflowContext(mode="training", _storage=storage, app_name=APP)
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+
+class TestClassification:
+    def seed(self, storage):
+        app_id, levents = seed_app(storage)
+        rng = np.random.default_rng(0)
+        events = []
+        for u in range(60):
+            plan = float(u % 2)
+            # attrs correlate with plan
+            base = np.array([3.0, 0.0, 3.0]) if plan else np.array([0.0, 3.0, 0.0])
+            attrs = rng.poisson(base + 0.3)
+            events.append(
+                Event(
+                    event="$set",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    properties=DataMap(
+                        {
+                            "plan": plan,
+                            "attr0": float(attrs[0]),
+                            "attr1": float(attrs[1]),
+                            "attr2": float(attrs[2]),
+                        }
+                    ),
+                )
+            )
+        levents.insert_batch(events, app_id)
+
+    def variant(self, algos):
+        return {
+            "datasource": {"params": {"appName": APP, "evalK": 3}},
+            "algorithms": algos,
+        }
+
+    def test_train_and_predict_both_algos(self, memory_storage):
+        from predictionio_tpu.models.classification import engine_factory
+        from predictionio_tpu.models.classification.engine import Query
+
+        self.seed(memory_storage)
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(
+            self.variant(
+                [
+                    {"name": "naive", "params": {"lambda": 1.0}},
+                    {"name": "randomforest", "params": {"numTrees": 5}},
+                ]
+            )
+        )
+        c = ctx(memory_storage)
+        models = engine.train(c, ep)
+        assert len(models) == 2
+        _, _, algos, _ = engine.make_components(ep)
+        for algo, model in zip(algos, models):
+            plan1 = algo.predict(model, Query(4.0, 0.0, 4.0))
+            plan0 = algo.predict(model, Query(0.0, 4.0, 0.0))
+            assert plan1.label == 1.0
+            assert plan0.label == 0.0
+
+    def test_eval_precision(self, memory_storage):
+        from predictionio_tpu.eval import AverageMetric, MetricEvaluator
+        from predictionio_tpu.models.classification import engine_factory
+
+        self.seed(memory_storage)
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(
+            self.variant([{"name": "naive", "params": {}}])
+        )
+
+        class Accuracy(AverageMetric):
+            def calculate_score(self, ei, q, p, a):
+                return 1.0 if p.label == a.label else 0.0
+
+        result = MetricEvaluator(Accuracy()).evaluate_base(
+            ctx(memory_storage), engine, [ep]
+        )
+        assert result.best_score > 0.8  # separable synthetic data
+
+
+# ---------------------------------------------------------------------------
+# similar-product
+# ---------------------------------------------------------------------------
+
+
+class TestSimilarProduct:
+    def seed(self, storage):
+        app_id, levents = seed_app(storage)
+        rng = np.random.default_rng(1)
+        events = []
+        # two item clusters: users view within one cluster
+        for u in range(40):
+            cluster = u % 2
+            for _ in range(12):
+                i = int(rng.integers(0, 10)) + cluster * 10
+                events.append(
+                    Event(
+                        event="view",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                    )
+                )
+        # item category properties
+        for i in range(20):
+            events.append(
+                Event(
+                    event="$set",
+                    entity_type="item",
+                    entity_id=f"i{i}",
+                    properties=DataMap(
+                        {"categories": ["even" if i % 2 == 0 else "odd"]}
+                    ),
+                )
+            )
+        levents.insert_batch(events, app_id)
+
+    def variant(self, name="als", params=None):
+        return {
+            "datasource": {"params": {"appName": APP}},
+            "algorithms": [
+                {"name": name, "params": params or {"rank": 8, "numIterations": 8}}
+            ],
+        }
+
+    def make(self, memory_storage, name="als", params=None):
+        from predictionio_tpu.models.similarproduct import engine_factory
+
+        self.seed(memory_storage)
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(self.variant(name, params))
+        c = ctx(memory_storage)
+        models = engine.train(c, ep)
+        _, _, algos, _ = engine.make_components(ep)
+        return engine, algos[0], models[0]
+
+    def test_als_similar_items_same_cluster(self, memory_storage):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        _, algo, model = self.make(memory_storage)
+        result = algo.predict(model, Query(items=("i1",), num=5))
+        assert len(result.item_scores) == 5
+        items = [s.item for s in result.item_scores]
+        assert "i1" not in items  # query item excluded
+        same_cluster = sum(1 for it in items if int(it[1:]) < 10)
+        assert same_cluster >= 4  # mostly same cluster
+
+    def test_filters(self, memory_storage):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        _, algo, model = self.make(memory_storage)
+        r = algo.predict(
+            model, Query(items=("i1",), num=10, white_list=frozenset({"i2", "i3"}))
+        )
+        assert {s.item for s in r.item_scores} <= {"i2", "i3"}
+        r = algo.predict(
+            model, Query(items=("i1",), num=10, black_list=frozenset({"i2"}))
+        )
+        assert "i2" not in {s.item for s in r.item_scores}
+        r = algo.predict(
+            model, Query(items=("i1",), num=10, categories=frozenset({"even"}))
+        )
+        assert all(int(s.item[1:]) % 2 == 0 for s in r.item_scores)
+        r = algo.predict(
+            model,
+            Query(items=("i1",), num=10, category_black_list=frozenset({"even"})),
+        )
+        assert all(int(s.item[1:]) % 2 == 1 for s in r.item_scores)
+
+    def test_unknown_query_items(self, memory_storage):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        _, algo, model = self.make(memory_storage)
+        assert algo.predict(model, Query(items=("ghost",), num=5)).item_scores == ()
+
+    def test_cooccurrence_algorithm(self, memory_storage):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        _, algo, model = self.make(memory_storage, name="cooccurrence", params={"n": 10})
+        result = algo.predict(model, Query(items=("i1",), num=5))
+        assert len(result.item_scores) > 0
+        items = [int(s.item[1:]) for s in result.item_scores]
+        assert all(i < 10 for i in items)  # cooccur within cluster
+        # scores are integer counts summed
+        assert all(s.score >= 1 for s in result.item_scores)
+
+
+# ---------------------------------------------------------------------------
+# e-commerce
+# ---------------------------------------------------------------------------
+
+
+class TestECommerce:
+    def seed(self, storage):
+        app_id, levents = seed_app(storage)
+        rng = np.random.default_rng(2)
+        events = []
+        for u in range(30):
+            cluster = u % 2
+            for _ in range(10):
+                i = int(rng.integers(0, 8)) + cluster * 8
+                events.append(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties=DataMap({"rating": float(rng.integers(3, 6))}),
+                    )
+                )
+        # buys make i0 the most popular
+        for u in range(10):
+            events.append(
+                Event(
+                    event="buy",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id="i0",
+                )
+            )
+        levents.insert_batch(events, app_id)
+        return app_id
+
+    def make(self, memory_storage, **extra):
+        from predictionio_tpu.models.ecommerce import engine_factory
+
+        app_id = self.seed(memory_storage)
+        engine = engine_factory()
+        params = {
+            "appName": APP,
+            "unseenOnly": False,
+            "seenEvents": ["buy", "view"],
+            "similarEvents": ["view"],
+            "rank": 8,
+            "numIterations": 8,
+            **extra,
+        }
+        ep = engine.engine_params_from_variant(
+            {
+                "datasource": {"params": {"appName": APP}},
+                "algorithms": [{"name": "ecomm", "params": params}],
+            }
+        )
+        c = ctx(memory_storage)
+        models = engine.train(c, ep)
+        _, _, algos, _ = engine.make_components(ep)
+        return c, algos[0], models[0], app_id
+
+    def test_known_user(self, memory_storage):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        c, algo, model, _ = self.make(memory_storage)
+        r = algo.predict_with_context(c, model, Query(user="u0", num=4))
+        assert len(r.item_scores) == 4
+
+    def test_cold_user_popularity_fallback(self, memory_storage):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        c, algo, model, _ = self.make(memory_storage)
+        r = algo.predict_with_context(c, model, Query(user="stranger", num=3))
+        assert r.item_scores[0].item == "i0"  # most-bought item first
+
+    def test_cold_user_recent_views(self, memory_storage):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        c, algo, model, app_id = self.make(memory_storage)
+        # new user views items in cluster 1
+        memory_storage.get_l_events().insert_batch(
+            [
+                Event(
+                    event="view",
+                    entity_type="user",
+                    entity_id="newbie",
+                    target_entity_type="item",
+                    target_entity_id=f"i{8 + j}",
+                )
+                for j in range(3)
+            ],
+            app_id,
+        )
+        r = algo.predict_with_context(c, model, Query(user="newbie", num=5))
+        in_cluster = sum(1 for s in r.item_scores if int(s.item[1:]) >= 8)
+        assert in_cluster >= 3
+
+    def test_unseen_only_filters_seen(self, memory_storage):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        c, algo, model, app_id = self.make(memory_storage, unseenOnly=True)
+        # u0 bought i0 (seeded); with unseenOnly the result must omit i0
+        r = algo.predict_with_context(c, model, Query(user="u0", num=16))
+        assert "i0" not in {s.item for s in r.item_scores}
+
+    def test_unavailable_items_constraint(self, memory_storage):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        c, algo, model, app_id = self.make(memory_storage)
+        memory_storage.get_l_events().insert(
+            Event(
+                event="$set",
+                entity_type="constraint",
+                entity_id="unavailableItems",
+                properties=DataMap({"items": ["i1", "i2"]}),
+            ),
+            app_id,
+        )
+        r = algo.predict_with_context(c, model, Query(user="u0", num=16))
+        assert {"i1", "i2"} & {s.item for s in r.item_scores} == set()
+
+
+# ---------------------------------------------------------------------------
+# two-tower
+# ---------------------------------------------------------------------------
+
+
+class TestTwoTower:
+    def seed(self, storage):
+        app_id, levents = seed_app(storage)
+        rng = np.random.default_rng(3)
+        events = []
+        for u in range(24):
+            cluster = u % 2
+            for _ in range(10):
+                i = int(rng.integers(0, 6)) + cluster * 6
+                events.append(
+                    Event(
+                        event="view",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                    )
+                )
+        levents.insert_batch(events, app_id)
+
+    def test_train_and_retrieve(self, memory_storage):
+        from predictionio_tpu.models.twotower import engine_factory
+        from predictionio_tpu.models.twotower.engine import Query
+
+        self.seed(memory_storage)
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(
+            {
+                "datasource": {"params": {"appName": APP}},
+                "algorithms": [
+                    {
+                        "name": "twotower",
+                        "params": {
+                            "embedDim": 16,
+                            "hidden": [32],
+                            "outDim": 8,
+                            "epochs": 30,
+                            "batchSize": 64,
+                            "mesh": "data=4,model=2",
+                        },
+                    }
+                ],
+            }
+        )
+        c = ctx(memory_storage)
+        models = engine.train(c, ep)
+        model = models[0]
+        # loss decreased
+        assert model.losses[-1] < model.losses[0]
+        _, _, algos, _ = engine.make_components(ep)
+        algo = algos[0]
+        r = algo.predict(model, Query(user="u0", num=4))
+        assert len(r.item_scores) == 4
+        # in-cluster retrieval dominates
+        in_cluster = sum(1 for s in r.item_scores if int(s.item[1:]) < 6)
+        assert in_cluster >= 3
+        # unknown user -> empty
+        assert algo.predict(model, Query(user="ghost")).item_scores == ()
+
+    def test_model_checkpoint_roundtrip(self, memory_storage):
+        from predictionio_tpu.controller import model_to_host
+        from predictionio_tpu.models.twotower import engine_factory
+        from predictionio_tpu.models.twotower.engine import Query
+        from predictionio_tpu.workflow import model_io
+
+        self.seed(memory_storage)
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(
+            {
+                "datasource": {"params": {"appName": APP}},
+                "algorithms": [
+                    {
+                        "name": "twotower",
+                        "params": {"embedDim": 8, "hidden": [16], "outDim": 8,
+                                   "epochs": 2, "batchSize": 32},
+                    }
+                ],
+            }
+        )
+        c = ctx(memory_storage)
+        models = engine.train(c, ep)
+        blob = model_io.serialize_models(
+            engine.make_serializable_models(c, ep, models)
+        )
+        (restored,) = model_io.deserialize_models(blob)
+        _, _, algos, _ = engine.make_components(ep)
+        r1 = algos[0].predict(models[0], Query(user="u1", num=3))
+        r2 = algos[0].predict(restored, Query(user="u1", num=3))
+        assert [s.item for s in r1.item_scores] == [s.item for s in r2.item_scores]

@@ -143,3 +143,46 @@ class TestEngineLoader:
     def test_missing_variant(self, tmp_path):
         with pytest.raises(EngineLoadError):
             load_engine(str(tmp_path))
+
+
+class TestEngineIdentity:
+    """Two engine dirs with the template-default id must not share a deploy
+    lineage (regression: deploy once served another engine's model)."""
+
+    def _scaffold(self, tmp_path, name):
+        import json as _json
+
+        d = tmp_path / name
+        d.mkdir()
+        (d / "engine.json").write_text(
+            _json.dumps(
+                {
+                    "id": "default",
+                    "engineFactory": "tests.test_engine.make_engine",
+                    "datasource": {"name": "ds", "params": {"id": 1}},
+                    "preparator": {"name": "prep", "params": {"id": 2}},
+                    "algorithms": [{"name": "a", "params": {"id": 3}}],
+                    "serving": {"name": "s"},
+                }
+            )
+        )
+        return str(d)
+
+    def test_distinct_dirs_distinct_ids(self, tmp_path):
+        from predictionio_tpu.workflow.engine_loader import load_manifest
+
+        m1 = load_manifest(self._scaffold(tmp_path, "rec-a"))
+        m2 = load_manifest(self._scaffold(tmp_path, "rec-b"))
+        assert m1.engine_id != m2.engine_id
+
+    def test_explicit_id_wins(self, tmp_path):
+        import json as _json
+
+        from predictionio_tpu.workflow.engine_loader import load_manifest
+
+        d = tmp_path / "explicit"
+        d.mkdir()
+        (d / "engine.json").write_text(
+            _json.dumps({"id": "my-engine", "engineFactory": "x.y"})
+        )
+        assert load_manifest(str(d)).engine_id == "my-engine"
