@@ -1,0 +1,380 @@
+// Native columnar JSONL event scanner.
+//
+// Role: the bulk-ingest hot path of training feeds — the predictionio_tpu
+// analog of the reference's JVM-side storage scan layer (JdbcRDD /
+// TableInputFormat partitions feeding Spark). Scans an events JSONL file
+// (one wire-format event object per line), filters by event name, and
+// dictionary-encodes entity/target ids into dense int32 columns with a
+// float32 rating column — the exact layout `PEvents.to_columnar` produces —
+// at C++ speed, without materializing Python objects per row.
+//
+// Exposed C ABI (ctypes):
+//   pio_scan_file(path, event_names_csv, rating_key) -> handle
+//   accessor functions to copy out columns / vocabularies
+//   pio_scan_free(handle)
+//
+// The parser is specialized for the event wire format: a flat JSON object
+// whose relevant keys ("event", "entityId", "targetEntityId", "eventTime",
+// "properties") sit at the top level. It handles string escapes and nested
+// objects/arrays inside "properties" correctly by brace matching with
+// string-state tracking.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Columns {
+  std::vector<int32_t> entity_ids;
+  std::vector<int32_t> target_ids;
+  std::vector<int32_t> event_codes;
+  std::vector<double> timestamps;
+  std::vector<float> ratings;
+  std::vector<std::string> entity_vocab;
+  std::vector<std::string> target_vocab;
+  std::vector<std::string> event_vocab;
+  std::vector<std::string> row_ids;  // per-row event id ("" when absent)
+  std::string error;
+};
+
+// Raw parsed row, interned against full (pre-compaction) vocabularies.
+struct RawRow {
+  int32_t entity;
+  int32_t target;  // -1 = absent
+  int32_t event;
+  double ts;
+  float rating;
+  bool passes;  // filter verdict of the LATEST version of this row
+  std::string id;
+};
+
+// --- minimal JSON helpers (specialized, no external deps) -----------------
+
+// Find the value start for "key" at the TOP level of the object starting at
+// `line`. Returns nullptr if absent. `end` bounds the scan (nullptr = until
+// NUL), enabling lookups scoped to a nested object's extent.
+const char* find_top_level_value(const char* line, const char* key,
+                                 const char* end = nullptr) {
+  size_t keylen = strlen(key);
+  int depth = 0;
+  bool in_str = false;
+  const char* p = line;
+  while (*p && (!end || p < end)) {
+    char c = *p;
+    if (in_str) {
+      if (c == '\\' && p[1]) { p += 2; continue; }
+      if (c == '"') in_str = false;
+      ++p;
+      continue;
+    }
+    switch (c) {
+      case '"': {
+        if (depth == 1) {
+          // possible key
+          const char* kstart = p + 1;
+          const char* q = kstart;
+          bool esc = false;
+          while (*q && (esc || *q != '"')) { esc = (!esc && *q == '\\'); ++q; }
+          if (*q == '"') {
+            size_t klen = q - kstart;
+            const char* after = q + 1;
+            while (*after == ' ' || *after == '\t') ++after;
+            if (*after == ':' && klen == keylen && strncmp(kstart, key, keylen) == 0) {
+              ++after;
+              while (*after == ' ' || *after == '\t') ++after;
+              return after;
+            }
+            p = q + 1;
+            continue;
+          }
+        }
+        in_str = true;
+        ++p;
+        continue;
+      }
+      case '{': case '[': ++depth; break;
+      case '}': case ']': --depth; break;
+      default: break;
+    }
+    ++p;
+  }
+  return nullptr;
+}
+
+// Return the pointer one past the matching close of the object/array at `p`
+// (which must point at '{' or '['), or nullptr on malformed input.
+const char* object_end(const char* p) {
+  if (*p != '{' && *p != '[') return nullptr;
+  int depth = 0;
+  bool in_str = false;
+  while (*p) {
+    char c = *p;
+    if (in_str) {
+      if (c == '\\' && p[1]) { p += 2; continue; }
+      if (c == '"') in_str = false;
+    } else if (c == '"') {
+      in_str = true;
+    } else if (c == '{' || c == '[') {
+      ++depth;
+    } else if (c == '}' || c == ']') {
+      --depth;
+      if (depth == 0) return p + 1;
+    }
+    ++p;
+  }
+  return nullptr;
+}
+
+// Parse a JSON string value at `p` into out; returns true on success.
+bool parse_string(const char* p, std::string* out) {
+  if (*p != '"') return false;
+  ++p;
+  out->clear();
+  while (*p && *p != '"') {
+    if (*p == '\\' && p[1]) {
+      ++p;
+      switch (*p) {
+        case 'n': out->push_back('\n'); break;
+        case 't': out->push_back('\t'); break;
+        case 'r': out->push_back('\r'); break;
+        case 'b': out->push_back('\b'); break;
+        case 'f': out->push_back('\f'); break;
+        case 'u': {
+          // keep \uXXXX escapes verbatim (ids are usually ASCII); copying
+          // the raw escape keeps the key stable for dictionary encoding
+          out->push_back('\\'); out->push_back('u');
+          for (int i = 1; i <= 4 && p[i]; ++i) out->push_back(p[i]);
+          p += 4;
+          break;
+        }
+        default: out->push_back(*p); break;
+      }
+      ++p;
+    } else {
+      out->push_back(*p);
+      ++p;
+    }
+  }
+  return *p == '"';
+}
+
+// ISO8601 -> epoch seconds (UTC). Handles "YYYY-MM-DDTHH:MM:SS(.mmm)?(Z|+HH:MM)".
+double parse_iso8601(const std::string& s) {
+  int y, mo, d, h, mi;
+  double sec = 0;
+  if (s.size() < 19) return 0.0;
+  if (sscanf(s.c_str(), "%d-%d-%dT%d:%d:%lf", &y, &mo, &d, &h, &mi, &sec) != 6)
+    return 0.0;
+  // days since epoch (civil algorithm)
+  int yy = y - (mo <= 2);
+  int era = (yy >= 0 ? yy : yy - 399) / 400;
+  unsigned yoe = static_cast<unsigned>(yy - era * 400);
+  unsigned doy = (153 * (mo + (mo > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  long days = era * 146097L + static_cast<long>(doe) - 719468L;
+  double ts = days * 86400.0 + h * 3600.0 + mi * 60.0 + sec;
+  // timezone suffix
+  size_t zpos = s.find_last_of("Z+-");
+  if (zpos != std::string::npos && zpos >= 19 && s[zpos] != 'Z') {
+    int oh = 0, om = 0;
+    if (sscanf(s.c_str() + zpos + 1, "%d:%d", &oh, &om) >= 1) {
+      double off = oh * 3600.0 + om * 60.0;
+      ts += (s[zpos] == '-') ? off : -off;
+    }
+  }
+  return ts;
+}
+
+int32_t encode(const std::string& v,
+               std::unordered_map<std::string, int32_t>* index,
+               std::vector<std::string>* vocab) {
+  auto it = index->find(v);
+  if (it != index->end()) return it->second;
+  int32_t id = static_cast<int32_t>(vocab->size());
+  index->emplace(v, id);
+  vocab->push_back(v);
+  return id;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pio_scan_file(const char* path, const char* event_names_csv,
+                    const char* rating_key, const char* entity_type,
+                    const char* target_entity_type) {
+  auto* cols = new Columns();
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    cols->error = "cannot open file";
+    return cols;
+  }
+  // parse event-name filter
+  std::unordered_map<std::string, bool> allowed;
+  bool filter = event_names_csv && *event_names_csv;
+  if (filter) {
+    std::string csv(event_names_csv), cur;
+    for (char c : csv) {
+      if (c == ',') { if (!cur.empty()) allowed[cur] = true; cur.clear(); }
+      else cur.push_back(c);
+    }
+    if (!cur.empty()) allowed[cur] = true;
+  }
+  // Pass 1: parse EVERY line into raw rows interned against full vocabs;
+  // dedup by event id (later line wins, even if the later version fails the
+  // filter — matching the backend's upsert-then-filter semantics).
+  std::vector<RawRow> rows;
+  std::vector<std::string> full_ent, full_tgt, full_ev;
+  std::unordered_map<std::string, int32_t> ent_index, tgt_index, ev_index;
+  std::unordered_map<std::string, size_t> row_by_id;
+  char* line = nullptr;
+  size_t cap = 0;
+  ssize_t len;
+  std::string sval;
+  while ((len = getline(&line, &cap, f)) != -1) {
+    if (len == 0 || line[0] != '{') continue;
+    const char* ev = find_top_level_value(line, "event");
+    if (!ev || !parse_string(ev, &sval)) continue;
+    std::string event_name = sval;
+    const char* ent = find_top_level_value(line, "entityId");
+    if (!ent || !parse_string(ent, &sval)) continue;
+    std::string entity = sval;
+
+    RawRow row;
+    row.passes = !filter || allowed.find(event_name) != allowed.end();
+    if (row.passes && entity_type && *entity_type) {
+      const char* et = find_top_level_value(line, "entityType");
+      row.passes = et && parse_string(et, &sval) && sval == entity_type;
+    }
+    if (row.passes && target_entity_type && *target_entity_type) {
+      const char* tt = find_top_level_value(line, "targetEntityType");
+      row.passes = tt && parse_string(tt, &sval) && sval == target_entity_type;
+    }
+    std::string target;
+    bool has_target = false;
+    const char* tgt = find_top_level_value(line, "targetEntityId");
+    if (tgt && parse_string(tgt, &sval)) { target = sval; has_target = true; }
+    row.ts = 0.0;
+    const char* t = find_top_level_value(line, "eventTime");
+    if (t && parse_string(t, &sval)) row.ts = parse_iso8601(sval);
+    // rating: top-level key of the properties OBJECT only (bounded scan)
+    row.rating = __builtin_nanf("");
+    const char* props = find_top_level_value(line, "properties");
+    if (props && *props == '{') {
+      const char* pend = object_end(props);
+      const char* rv = pend ? find_top_level_value(
+          props, rating_key ? rating_key : "rating", pend) : nullptr;
+      if (rv) {
+        char* endp = nullptr;
+        double v = strtod(rv, &endp);
+        if (endp != rv) row.rating = static_cast<float>(v);
+      }
+    }
+    const char* eid = find_top_level_value(line, "eventId");
+    row.id = (eid && parse_string(eid, &sval)) ? sval : "";
+
+    row.event = encode(event_name, &ev_index, &full_ev);
+    row.entity = encode(entity, &ent_index, &full_ent);
+    row.target = has_target ? encode(target, &tgt_index, &full_tgt) : -1;
+
+    if (!row.id.empty()) {
+      auto it = row_by_id.find(row.id);
+      if (it != row_by_id.end()) {
+        rows[it->second] = std::move(row);  // upsert in place
+        continue;
+      }
+      row_by_id.emplace(row.id, rows.size());
+    }
+    rows.push_back(std::move(row));
+  }
+  free(line);
+  fclose(f);
+
+  // Pass 2: keep filter-passing rows, stable-sort by eventTime (matching
+  // the python path, which reads via time-ordered find), and re-encode
+  // vocabularies in first-use order of the OUTPUT rows for exact parity.
+  std::vector<size_t> order;
+  order.reserve(rows.size());
+  for (size_t i = 0; i < rows.size(); ++i)
+    if (rows[i].passes) order.push_back(i);
+  std::stable_sort(order.begin(), order.end(), [&](size_t a, size_t b) {
+    return rows[a].ts < rows[b].ts;
+  });
+  std::vector<int32_t> ent_map(full_ent.size(), -1),
+      tgt_map(full_tgt.size(), -1), ev_map(full_ev.size(), -1);
+  cols->entity_ids.reserve(order.size());
+  for (size_t i : order) {
+    const RawRow& r = rows[i];
+    int32_t& em = ent_map[r.entity];
+    if (em < 0) {
+      em = static_cast<int32_t>(cols->entity_vocab.size());
+      cols->entity_vocab.push_back(full_ent[r.entity]);
+    }
+    int32_t tm = -1;
+    if (r.target >= 0) {
+      int32_t& slot = tgt_map[r.target];
+      if (slot < 0) {
+        slot = static_cast<int32_t>(cols->target_vocab.size());
+        cols->target_vocab.push_back(full_tgt[r.target]);
+      }
+      tm = slot;
+    }
+    int32_t& vm = ev_map[r.event];
+    if (vm < 0) {
+      vm = static_cast<int32_t>(cols->event_vocab.size());
+      cols->event_vocab.push_back(full_ev[r.event]);
+    }
+    cols->entity_ids.push_back(em);
+    cols->target_ids.push_back(tm);
+    cols->event_codes.push_back(vm);
+    cols->timestamps.push_back(r.ts);
+    cols->ratings.push_back(r.rating);
+    cols->row_ids.push_back(r.id);
+  }
+  return cols;
+}
+
+int64_t pio_scan_num_rows(void* h) {
+  return static_cast<Columns*>(h)->entity_ids.size();
+}
+const char* pio_scan_error(void* h) {
+  return static_cast<Columns*>(h)->error.c_str();
+}
+void pio_scan_copy_int32(void* h, int which, int32_t* out) {
+  auto* c = static_cast<Columns*>(h);
+  const std::vector<int32_t>* src =
+      which == 0 ? &c->entity_ids : which == 1 ? &c->target_ids : &c->event_codes;
+  memcpy(out, src->data(), src->size() * sizeof(int32_t));
+}
+void pio_scan_copy_f64(void* h, double* out) {
+  auto* c = static_cast<Columns*>(h);
+  memcpy(out, c->timestamps.data(), c->timestamps.size() * sizeof(double));
+}
+void pio_scan_copy_f32(void* h, float* out) {
+  auto* c = static_cast<Columns*>(h);
+  memcpy(out, c->ratings.data(), c->ratings.size() * sizeof(float));
+}
+int64_t pio_scan_vocab_size(void* h, int which) {
+  auto* c = static_cast<Columns*>(h);
+  const std::vector<std::string>* v =
+      which == 0 ? &c->entity_vocab : which == 1 ? &c->target_vocab : &c->event_vocab;
+  return v->size();
+}
+const char* pio_scan_vocab_get(void* h, int which, int64_t i) {
+  auto* c = static_cast<Columns*>(h);
+  const std::vector<std::string>* v =
+      which == 0 ? &c->entity_vocab : which == 1 ? &c->target_vocab : &c->event_vocab;
+  return (*v)[i].c_str();
+}
+const char* pio_scan_row_id(void* h, int64_t i) {
+  return static_cast<Columns*>(h)->row_ids[i].c_str();
+}
+void pio_scan_free(void* h) { delete static_cast<Columns*>(h); }
+
+}  // extern "C"

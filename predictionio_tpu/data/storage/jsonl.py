@@ -218,6 +218,69 @@ class JSONLPEvents(base.PEvents):
     ) -> None:
         self._files.remove_ids(set(event_ids), app_id, channel_id)
 
+    def to_columnar(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        event_names: Sequence[str] | None = None,
+        rating_key: str = "rating",
+        entity_vocab: Sequence[str] | None = None,
+        target_vocab: Sequence[str] | None = None,
+        **find_kwargs,
+    ):
+        """Fast path: native C++ scan of the JSONL file when the filters are
+        expressible natively (event names + entity/target types, no time
+        window, no frozen vocab). Falls back to the generic python path."""
+        # explicit None filters carry "must be absent" semantics the native
+        # scanner does not express; event_names=[] means "match nothing"
+        native_ok = (
+            entity_vocab is None
+            and target_vocab is None
+            and set(find_kwargs) <= {"entity_type", "target_entity_type"}
+            and not ("entity_type" in find_kwargs and find_kwargs["entity_type"] is None)
+            and not (
+                "target_entity_type" in find_kwargs
+                and find_kwargs["target_entity_type"] is None
+            )
+            # event_names=[] means "match nothing" — handled by generic path
+            and not (event_names is not None and len(list(event_names)) == 0)
+        )
+        if native_ok:
+            from predictionio_tpu.utils.native import scan_jsonl_columnar
+
+            raw = scan_jsonl_columnar(
+                self._files.path(app_id, channel_id),
+                event_names=list(event_names) if event_names else None,
+                rating_key=rating_key,
+                entity_type=find_kwargs.get("entity_type"),
+                target_entity_type=find_kwargs.get("target_entity_type"),
+            )
+            if raw is not None:
+                from predictionio_tpu.data.storage.base import ColumnarEvents
+
+                names = [raw["event_vocab"][c] for c in raw["event_codes"]]
+                return ColumnarEvents(
+                    event_ids=raw["event_ids"],
+                    event_names=names,
+                    entity_ids=raw["entity_ids"],
+                    target_ids=raw["target_ids"],
+                    event_codes=raw["event_codes"],
+                    timestamps=raw["timestamps"],
+                    ratings=raw["ratings"],
+                    entity_vocab=raw["entity_vocab"],
+                    target_vocab=raw["target_vocab"],
+                    event_vocab=raw["event_vocab"],
+                )
+        return super().to_columnar(
+            app_id,
+            channel_id,
+            event_names=event_names,
+            rating_key=rating_key,
+            entity_vocab=entity_vocab,
+            target_vocab=target_vocab,
+            **find_kwargs,
+        )
+
 
 class JSONLStorageClient:
     """Backend entry point (type name: ``jsonl``). Config key ``PATH``

@@ -1,0 +1,241 @@
+"""Attention kernels: fused single-chip attention (pallas) and ring
+attention for sequence/context parallelism.
+
+The reference has no sequence models (SURVEY.md section 5 — nearest analog
+is the e2 MarkovChain), but long-context support is first-class in this
+framework: a sequence encoder attached to any engine (see
+``models/twotower``'s history encoder) must scale past single-chip memory.
+
+Design:
+  - ``ring_attention``: Q/K/V sharded over a named mesh axis (``sp``) along
+    the sequence dimension. Each of the P ring steps computes one block of
+    attention with a numerically-stable online softmax (flash-attention
+    accumulation) and rotates the K/V shard to the next device with
+    ``lax.ppermute`` — bandwidth rides ICI neighbor links, compute overlaps
+    the permute under XLA's async scheduling. Supports causal masking with
+    global position offsets.
+  - ``fused_attention``: a pallas TPU kernel for the within-block attention
+    (grid over batch x heads, K/V streamed through VMEM); falls back to the
+    jnp reference path off-TPU. Used by ring_attention for its local block
+    when running on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# ---------------------------------------------------------------------------
+# Reference (jnp) attention + online-softmax block update
+# ---------------------------------------------------------------------------
+
+
+def attention_reference(
+    q: jnp.ndarray,  # [B, H, Lq, D]
+    k: jnp.ndarray,  # [B, H, Lk, D]
+    v: jnp.ndarray,  # [B, H, Lk, D]
+    causal: bool = False,
+    q_offset: int = 0,
+    k_offset: int = 0,
+) -> jnp.ndarray:
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qi = jnp.arange(q.shape[2])[:, None] + q_offset
+        ki = jnp.arange(k.shape[2])[None, :] + k_offset
+        scores = jnp.where(qi >= ki, scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1)
+    # rows with no visible keys produce NaN from softmax(-inf row): zero them
+    weights = jnp.nan_to_num(weights)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+def _online_block(q, k, v, acc, row_max, row_sum, mask):
+    """One flash-attention accumulation step.
+
+    q [B,H,Lq,D]; k,v [B,H,Lk,D]; acc [B,H,Lq,D]; row_max/row_sum [B,H,Lq];
+    mask [Lq, Lk] boolean (True = attend) or None.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    blk_max = jnp.max(scores, axis=-1)  # [B,H,Lq]
+    new_max = jnp.maximum(row_max, blk_max)
+    # guard fully-masked blocks: exp(-inf - -inf) -> use safe max
+    safe_max = jnp.where(jnp.isneginf(new_max), 0.0, new_max)
+    correction = jnp.exp(row_max - safe_max)
+    correction = jnp.where(jnp.isneginf(row_max), 0.0, correction)
+    p = jnp.exp(scores - safe_max[..., None])
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    acc = acc * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    row_sum = row_sum * correction + jnp.sum(p, axis=-1)
+    return acc, new_max, row_sum
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence parallelism over a mesh axis)
+# ---------------------------------------------------------------------------
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, H, L, D] — L is the GLOBAL sequence length
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Full attention over sequences sharded on ``axis``.
+
+    Inputs/outputs are global arrays; under jit the sequence dimension is
+    sharded over the axis and each device runs P ring steps, exchanging K/V
+    shards with its neighbor. Requires L % axis_size == 0.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    axis_size = mesh.shape[axis]
+    L = q.shape[2]
+    if L % axis_size:
+        raise ValueError(f"sequence length {L} not divisible by {axis}={axis_size}")
+    l_local = L // axis_size
+
+    def local_fn(q_blk, k_blk, v_blk):
+        # q_blk etc: [B, H, l_local, D] — this device's shard
+        my_idx = lax.axis_index(axis)
+        q_off = my_idx * l_local
+        B, H, Lq, D = q_blk.shape
+        # initial carries must share the input's varying-axes type under
+        # shard_map's vma checking, so derive them from q_blk
+        zero_rows = jnp.sum(q_blk.astype(jnp.float32) * 0.0, axis=-1)  # [B,H,Lq]
+        acc0 = q_blk.astype(jnp.float32) * 0.0
+        max0 = zero_rows - jnp.inf
+        sum0 = zero_rows
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+        def step(i, carry):
+            k_cur, v_cur, acc, row_max, row_sum = carry
+            # the K/V block currently held came from device (my_idx - i)
+            src = (my_idx - i) % axis_size
+            k_off = src * l_local
+            if causal:
+                qi = jnp.arange(Lq)[:, None] + q_off
+                ki = jnp.arange(Lq)[None, :] + k_off
+                mask = qi >= ki
+            else:
+                mask = None
+            acc, row_max, row_sum = _online_block(
+                q_blk.astype(jnp.float32),
+                k_cur.astype(jnp.float32),
+                v_cur.astype(jnp.float32),
+                acc,
+                row_max,
+                row_sum,
+                mask,
+            )
+            k_nxt = lax.ppermute(k_cur, axis, perm)
+            v_nxt = lax.ppermute(v_cur, axis, perm)
+            return k_nxt, v_nxt, acc, row_max, row_sum
+
+        _, _, acc, row_max, row_sum = lax.fori_loop(
+            0, axis_size, step, (k_blk, v_blk, acc0, max0, sum0)
+        )
+        safe_sum = jnp.where(row_sum == 0.0, 1.0, row_sum)
+        return (acc / safe_sum[..., None]).astype(q_blk.dtype)
+
+    spec = P(None, None, axis, None)
+    sharded = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return sharded(q, k, v)
+
+
+def ring_attention_sharded(
+    q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False
+):
+    """jit-wrapped ring attention with explicit input shardings."""
+    sharding = NamedSharding(mesh, P(None, None, axis, None))
+    fn = jax.jit(
+        functools.partial(ring_attention, mesh=mesh, axis=axis, causal=causal),
+        in_shardings=(sharding, sharding, sharding),
+        out_shardings=sharding,
+    )
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused attention (TPU single-chip hot path)
+# ---------------------------------------------------------------------------
+
+
+def _fused_attention_pallas(q, k, v, causal: bool, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qb = q_ref[0]  # [Lq, D]
+        kb = k_ref[0]
+        vb = v_ref[0]
+        scale = 1.0 / math.sqrt(D)
+        scores = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
+            ki = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
+            scores = jnp.where(qi >= ki, scores, -jnp.inf)
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        p = jnp.exp(scores - m)
+        out = jnp.dot(p, vb, preferred_element_type=jnp.float32)
+        denom = jnp.sum(p, axis=-1, keepdims=True)
+        o_ref[0] = (out / denom).astype(o_ref.dtype)
+
+    grid = (B * H,)
+    qr = q.reshape(B * H, Lq, D)
+    kr = k.reshape(B * H, Lk, D)
+    vr = v.reshape(B * H, Lk, D)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, Lq, D), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, Lk, D), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, Lk, D), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Lq, D), lambda i: (i, 0, 0)),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Lq, D)
+
+
+def fused_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    force_pallas: bool = False,
+) -> jnp.ndarray:
+    """Single-device attention. On TPU: pallas kernel (one (batch, head)
+    block per grid step, softmax fused in VMEM). Elsewhere: the jnp
+    reference path (``force_pallas`` runs the kernel in interpret mode for
+    testing)."""
+    platform = q.devices().pop().platform if hasattr(q, "devices") else "cpu"
+    if platform == "tpu":
+        return _fused_attention_pallas(q, k, v, causal, interpret=False)
+    if force_pallas:
+        return _fused_attention_pallas(q, k, v, causal, interpret=True)
+    return attention_reference(q, k, v, causal=causal)

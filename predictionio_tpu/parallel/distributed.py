@@ -1,0 +1,72 @@
+"""Multi-host distributed runtime.
+
+Replaces the reference's driver/executor split (``Runner.runOnSpark``
+spark-submit + executor fleet, SURVEY.md section 2.1) with JAX's
+single-controller-per-host model: the SAME CLI command runs once per TPU
+host; ``jax.distributed.initialize`` joins them over DCN (coordinator
+rendezvous), after which ``jax.devices()`` spans the slice and every jit
+with sharded inputs runs SPMD with XLA collectives over ICI/DCN.
+
+Environment contract (set by the launcher / scheduler):
+  PIO_COORDINATOR        host:port of process 0 (alias: JAX_COORDINATOR_ADDRESS)
+  PIO_NUM_PROCESSES      total host count
+  PIO_PROCESS_ID         this host's index
+Absent -> single-process mode (no-op), so every code path works unchanged
+on one host.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def maybe_initialize_distributed() -> bool:
+    """Join the multi-host job when the env contract is present. Returns
+    True when running distributed."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = os.environ.get("PIO_COORDINATOR") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if not coordinator:
+        return False
+    num_processes = int(os.environ.get("PIO_NUM_PROCESSES", "1"))
+    process_id = int(os.environ.get("PIO_PROCESS_ID", "0"))
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "joined distributed job: process %d/%d via %s",
+        process_id,
+        num_processes,
+        coordinator,
+    )
+    return True
+
+
+def process_info() -> dict:
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }
+
+
+def is_coordinator() -> bool:
+    import jax
+
+    return jax.process_index() == 0

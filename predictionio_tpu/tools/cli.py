@@ -222,8 +222,11 @@ def cmd_build(args) -> int:
 
 def cmd_train(args) -> int:
     from predictionio_tpu.controller.engine import TrainOptions
+    from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
     from predictionio_tpu.workflow.core_workflow import run_train
     from predictionio_tpu.workflow.engine_loader import load_engine
+
+    maybe_initialize_distributed()
 
     manifest, engine = load_engine(args.engine_dir, args.variant)
     engine_params = engine.engine_params_from_variant(manifest.variant_json)
@@ -269,6 +272,9 @@ def cmd_deploy(args) -> int:
         run_query_server,
     )
 
+    from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
     config = ServerConfig(
         ip=args.ip,
         port=args.port,
@@ -276,6 +282,8 @@ def cmd_deploy(args) -> int:
         feedback=args.feedback,
         event_server_url=args.event_server_url,
         feedback_access_key=args.feedback_access_key,
+        ssl_certfile=args.ssl_certfile,
+        ssl_keyfile=args.ssl_keyfile,
     )
     print(f"Engine server starting on {args.ip}:{args.port} ...")
     run_query_server(args.engine_dir, args.variant, config=config)
@@ -284,12 +292,15 @@ def cmd_deploy(args) -> int:
 
 def cmd_undeploy(args) -> int:
     """POST /stop to a running engine server (ref commands/Engine.scala:244-267)."""
+    import ssl
     import urllib.request
 
-    url = f"http://{args.ip}:{args.port}/stop"
+    scheme = "https" if args.ssl else "http"
+    url = f"{scheme}://{args.ip}:{args.port}/stop"
+    context = ssl._create_unverified_context() if args.ssl else None
     try:
         with urllib.request.urlopen(
-            urllib.request.Request(url, method="POST"), timeout=10
+            urllib.request.Request(url, method="POST"), timeout=10, context=context
         ) as resp:
             print(resp.read().decode())
         return 0
@@ -439,6 +450,69 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_shell(args) -> int:
+    from predictionio_tpu.tools.shell import run_shell
+
+    run_shell()
+    return 0
+
+
+def _pidfile_dir() -> str:
+    base = os.environ.get(
+        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
+    )
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def cmd_start_all(args) -> int:
+    """Start event server + admin server + dashboard as background processes
+    (ref bin/pio-start-all)."""
+    import subprocess
+
+    pidfile = os.path.join(_pidfile_dir(), "pio-services.pid")
+    if os.path.exists(pidfile):
+        return _die(f"{pidfile} exists; run stop-all first")
+    specs = [
+        ("eventserver", ["eventserver", "--port", str(args.eventserver_port)]),
+        ("adminserver", ["adminserver", "--port", str(args.adminserver_port)]),
+        ("dashboard", ["dashboard", "--port", str(args.dashboard_port)]),
+    ]
+    pids = []
+    for name, argv in specs:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.tools.cli", *argv],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        pids.append(f"{name}:{proc.pid}")
+        print(f"started {name} (pid {proc.pid})")
+    with open(pidfile, "w") as f:
+        f.write("\n".join(pids))
+    return 0
+
+
+def cmd_stop_all(args) -> int:
+    """Stop services started by start-all (ref bin/pio-stop-all)."""
+    import signal
+
+    pidfile = os.path.join(_pidfile_dir(), "pio-services.pid")
+    if not os.path.exists(pidfile):
+        return _die("no pio-services.pid; nothing to stop")
+    with open(pidfile) as f:
+        entries = [l.strip() for l in f if l.strip()]
+    for entry in entries:
+        name, _, pid = entry.partition(":")
+        try:
+            os.kill(int(pid), signal.SIGTERM)
+            print(f"stopped {name} (pid {pid})")
+        except ProcessLookupError:
+            print(f"{name} (pid {pid}) already gone")
+    os.remove(pidfile)
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
@@ -454,6 +528,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
     sub.add_parser("status").set_defaults(fn=cmd_status)
+    sub.add_parser("shell").set_defaults(fn=cmd_shell)
+
+    x = sub.add_parser("start-all")
+    x.add_argument("--eventserver-port", type=int, default=7070)
+    x.add_argument("--adminserver-port", type=int, default=7071)
+    x.add_argument("--dashboard-port", type=int, default=9000)
+    x.set_defaults(fn=cmd_start_all)
+    sub.add_parser("stop-all").set_defaults(fn=cmd_stop_all)
 
     # app
     app = sub.add_parser("app").add_subparsers(dest="subcommand", required=True)
@@ -531,11 +613,14 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--feedback", action="store_true")
     x.add_argument("--event-server-url")
     x.add_argument("--feedback-access-key")
+    x.add_argument("--ssl-certfile")
+    x.add_argument("--ssl-keyfile")
     x.set_defaults(fn=cmd_deploy)
 
     x = sub.add_parser("undeploy")
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=8000)
+    x.add_argument("--ssl", action="store_true", help="server was deployed with TLS")
     x.set_defaults(fn=cmd_undeploy)
 
     x = sub.add_parser("batchpredict")

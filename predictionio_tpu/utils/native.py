@@ -1,0 +1,168 @@
+"""Loader for the native C++ scan library.
+
+Compiles ``native/pio_scan.cpp`` with g++ on first use (cached in the
+PIO_FS_BASEDIR), loads it via ctypes, and exposes ``scan_jsonl_columnar``.
+Everything degrades gracefully: no compiler / failed build -> ``None`` and
+callers use the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+
+def _source_path() -> str:
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    return os.path.join(repo_root, "native", "pio_scan.cpp")
+
+
+def _build_dir() -> str:
+    base = os.environ.get(
+        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
+    )
+    d = os.path.join(base, "native")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def get_library() -> ctypes.CDLL | None:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        src = _source_path()
+        if not os.path.exists(src):
+            _lib_failed = True
+            return None
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        so_path = os.path.join(_build_dir(), f"pio_scan_{digest}.so")
+        if not os.path.exists(so_path):
+            try:
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                        "-o", so_path + ".tmp", src,
+                    ],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+                os.replace(so_path + ".tmp", so_path)
+                logger.info("built native scan library: %s", so_path)
+            except (subprocess.SubprocessError, OSError) as exc:
+                logger.warning("native build failed (%s); using python path", exc)
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError as exc:
+            logger.warning("cannot load %s: %s", so_path, exc)
+            _lib_failed = True
+            return None
+        lib.pio_scan_file.restype = ctypes.c_void_p
+        lib.pio_scan_file.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.pio_scan_num_rows.restype = ctypes.c_int64
+        lib.pio_scan_num_rows.argtypes = [ctypes.c_void_p]
+        lib.pio_scan_error.restype = ctypes.c_char_p
+        lib.pio_scan_error.argtypes = [ctypes.c_void_p]
+        lib.pio_scan_copy_int32.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32)]
+        lib.pio_scan_copy_f64.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double)]
+        lib.pio_scan_copy_f32.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+        lib.pio_scan_vocab_size.restype = ctypes.c_int64
+        lib.pio_scan_vocab_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.pio_scan_vocab_get.restype = ctypes.c_char_p
+        lib.pio_scan_vocab_get.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int64]
+        lib.pio_scan_row_id.restype = ctypes.c_char_p
+        lib.pio_scan_row_id.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pio_scan_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def scan_jsonl_columnar(
+    path: str,
+    event_names: list[str] | None = None,
+    rating_key: str = "rating",
+    entity_type: str | None = None,
+    target_entity_type: str | None = None,
+):
+    """Native columnar scan of a JSONL event file. Returns a dict of numpy
+    columns + vocab lists, or None when the native path is unavailable."""
+    lib = get_library()
+    if lib is None or not os.path.exists(path):
+        return None
+    csv = ",".join(event_names) if event_names else ""
+    handle = lib.pio_scan_file(
+        path.encode(),
+        csv.encode(),
+        rating_key.encode(),
+        (entity_type or "").encode(),
+        (target_entity_type or "").encode(),
+    )
+    try:
+        err = lib.pio_scan_error(handle)
+        if err:
+            logger.warning("native scan error: %s", err.decode())
+            return None
+        n = lib.pio_scan_num_rows(handle)
+        entity_ids = np.empty(n, np.int32)
+        target_ids = np.empty(n, np.int32)
+        event_codes = np.empty(n, np.int32)
+        timestamps = np.empty(n, np.float64)
+        ratings = np.empty(n, np.float32)
+        if n:
+            lib.pio_scan_copy_int32(
+                handle, 0, entity_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            lib.pio_scan_copy_int32(
+                handle, 1, target_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            lib.pio_scan_copy_int32(
+                handle, 2, event_codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            lib.pio_scan_copy_f64(
+                handle, timestamps.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+            lib.pio_scan_copy_f32(
+                handle, ratings.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+
+        def vocab(which: int) -> list[str]:
+            size = lib.pio_scan_vocab_size(handle, which)
+            return [
+                lib.pio_scan_vocab_get(handle, which, i).decode()
+                for i in range(size)
+            ]
+
+        return {
+            "entity_ids": entity_ids,
+            "target_ids": target_ids,
+            "event_codes": event_codes,
+            "timestamps": timestamps,
+            "ratings": ratings,
+            "entity_vocab": vocab(0),
+            "target_vocab": vocab(1),
+            "event_vocab": vocab(2),
+            "event_ids": [
+                lib.pio_scan_row_id(handle, i).decode() for i in range(n)
+            ],
+        }
+    finally:
+        lib.pio_scan_free(handle)

@@ -52,6 +52,19 @@ class ServerConfig:
     feedback: bool = False
     event_server_url: str | None = None  # e.g. http://localhost:7070
     feedback_access_key: str | None = None
+    # TLS (ref common/SSLConfiguration.scala): PEM cert + key paths
+    ssl_certfile: str | None = None
+    ssl_keyfile: str | None = None
+    bind_retries: int = 3  # ref MasterActor bind retry x3 (CreateServer.scala:348)
+
+    def ssl_context(self):
+        if not (self.ssl_certfile and self.ssl_keyfile):
+            return None
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
+        return ctx
 
 
 class QueryServer:
@@ -64,13 +77,19 @@ class QueryServer:
         instance_id: str,
         storage: Storage | None = None,
         config: ServerConfig | None = None,
+        plugin_context=None,
     ):
+        from predictionio_tpu.workflow.server_plugins import (
+            EngineServerPluginContext,
+        )
+
         self.engine = engine
         self.engine_params = engine_params
         self.manifest = manifest
         self.instance_id = instance_id
         self.storage = storage or Storage.instance()
         self.config = config or ServerConfig()
+        self.plugin_context = plugin_context or EngineServerPluginContext()
         _, _, self.algorithms, self.serving = engine.make_components(engine_params)
         self.models = models
         self.start_time = _dt.datetime.now(tz=UTC)
@@ -102,7 +121,19 @@ class QueryServer:
                 for algo, model in zip(self.algorithms, self.models)
             ]
             result = self.serving.serve(query, predictions)
+            result = self.plugin_context.apply_output_blockers(
+                self.manifest.variant, query, result
+            )
             body = Engine.encode_result(result)
+            if self.plugin_context.output_sniffers:
+                # asynchronous observers: off the request path, result object
+                asyncio.get_running_loop().run_in_executor(
+                    None,
+                    self.plugin_context.notify_output_sniffers,
+                    self.manifest.variant,
+                    query,
+                    result,
+                )
         except Exception as exc:
             logger.exception("query failed")
             return web.json_response({"message": str(exc)}, status=400)
@@ -196,7 +227,7 @@ class QueryServer:
         return web.json_response({"message": "Stopping."})
 
     async def handle_plugins(self, request: web.Request) -> web.Response:
-        return web.json_response({"plugins": {"outputblockers": {}, "outputsniffers": {}}})
+        return web.json_response(self.plugin_context.to_json_dict())
 
     # ------------------------------------------------------------------- app
     def make_app(self) -> web.Application:
@@ -214,10 +245,38 @@ class QueryServer:
         return app
 
     async def start(self) -> None:
-        self._runner = web.AppRunner(self.make_app())
-        await self._runner.setup()
-        site = web.TCPSite(self._runner, self.config.ip, self.config.port)
-        await site.start()
+        retries = max(1, self.config.bind_retries)
+        last_error: Exception | None = None
+        for attempt in range(retries):
+            # fresh runner+site per attempt: a TCPSite cannot be re-started
+            # after a failed bind (it stays registered with the runner)
+            self._runner = web.AppRunner(self.make_app())
+            await self._runner.setup()
+            site = web.TCPSite(
+                self._runner,
+                self.config.ip,
+                self.config.port,
+                ssl_context=self.config.ssl_context(),
+            )
+            try:
+                await site.start()
+                break
+            except OSError as exc:  # bind retry (ref MasterActor x3)
+                last_error = exc
+                await self._runner.cleanup()
+                self._runner = None
+                logger.warning(
+                    "bind %s:%d failed (attempt %d/%d): %s",
+                    self.config.ip,
+                    self.config.port,
+                    attempt + 1,
+                    retries,
+                    exc,
+                )
+                if attempt + 1 < retries:
+                    await asyncio.sleep(1.0)
+        else:
+            raise last_error  # type: ignore[misc]
         logger.info("engine server on %s:%d", self.config.ip, self.config.port)
 
     async def stop(self) -> None:

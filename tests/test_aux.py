@@ -1,0 +1,175 @@
+"""Aux subsystem tests: self-cleaning data source, engine-server plugins,
+distributed helper, latency histogram."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller.self_cleaning import (
+    EventWindow,
+    SelfCleaningDataSource,
+    clean_events,
+)
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event, now_utc
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.utils.histogram import LatencyHistogram
+from predictionio_tpu.workflow.context import WorkflowContext
+from predictionio_tpu.workflow.server_plugins import (
+    OUTPUT_BLOCKER,
+    EngineServerPlugin,
+    EngineServerPluginContext,
+)
+
+UTC = dt.timezone.utc
+
+
+def ev(name, eid, n_days_ago=0, props=None, target=None):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=now_utc() - dt.timedelta(days=n_days_ago),
+    )
+
+
+class TestEventWindow:
+    def test_parse_duration(self):
+        assert EventWindow.parse_duration("30 days") == dt.timedelta(days=30)
+        assert EventWindow.parse_duration("2 hours") == dt.timedelta(hours=2)
+        assert EventWindow.parse_duration("1 week") == dt.timedelta(weeks=1)
+        with pytest.raises(ValueError):
+            EventWindow.parse_duration("5 fortnights")
+
+
+class TestCleanEvents:
+    def test_window_filters_old(self):
+        events = [ev("buy", "u1", 1), ev("buy", "u2", 40)]
+        out = clean_events(events, EventWindow(duration=dt.timedelta(days=30)))
+        assert [e.entity_id for e in out] == ["u1"]
+
+    def test_dedup(self):
+        e = ev("buy", "u1", 1, target="i1")
+        out = clean_events([e, e, ev("buy", "u2", 1)], EventWindow(remove_duplicates=True))
+        assert len(out) == 2
+
+    def test_compress_set_chain(self):
+        events = [
+            ev("$set", "u1", 3, {"a": 1, "b": 2}),
+            ev("$unset", "u1", 2, {"a": 1}),
+            ev("$set", "u1", 1, {"c": 3}),
+            ev("buy", "u1", 1, target="i1"),
+        ]
+        out = clean_events(events, EventWindow(compress_properties=True))
+        sets = [e for e in out if e.event == "$set"]
+        assert len(sets) == 1
+        assert sets[0].properties.fields == {"b": 2, "c": 3}
+        assert len([e for e in out if e.event == "buy"]) == 1
+
+    def test_deleted_entity_dropped_on_compress(self):
+        events = [
+            ev("$set", "u1", 3, {"a": 1}),
+            ev("$delete", "u1", 1),
+        ]
+        out = clean_events(events, EventWindow(compress_properties=True))
+        assert out == []
+
+
+class TestSelfCleaningDataSource:
+    def test_clean_persisted(self, memory_storage):
+        app_id = memory_storage.get_meta_data_apps().insert(App(0, "cleanapp"))
+        levents = memory_storage.get_l_events()
+        levents.insert_batch(
+            [
+                ev("$set", "u1", 3, {"a": 1}),
+                ev("$set", "u1", 2, {"b": 2}),
+                ev("buy", "u1", 50, target="i1"),  # outside window
+                ev("buy", "u1", 1, target="i2"),
+            ],
+            app_id,
+        )
+
+        class DS(SelfCleaningDataSource):
+            event_window = EventWindow(
+                duration=dt.timedelta(days=30), compress_properties=True
+            )
+
+        ctx = WorkflowContext(_storage=memory_storage, app_name="cleanapp")
+        n = DS().clean_persisted_events(ctx)
+        assert n == 2  # one compressed $set + one recent buy
+        remaining = list(levents.find(app_id))
+        assert len(remaining) == 2
+        sets = [e for e in remaining if e.event == "$set"]
+        assert sets[0].properties.fields == {"a": 1, "b": 2}
+
+
+class TestEngineServerPlugins:
+    def test_output_blocker_rewrites_and_sniffer_observes(self):
+        seen = []
+
+        class Cap(EngineServerPlugin):
+            plugin_name = "cap"
+            plugin_type = OUTPUT_BLOCKER
+
+            def process(self, variant, query, prediction, context):
+                return {"capped": True, **prediction}
+
+        class Spy(EngineServerPlugin):
+            plugin_name = "spy"
+
+            def process(self, variant, query, prediction, context):
+                seen.append((variant, prediction))
+
+        ctx = EngineServerPluginContext([Cap(), Spy()])
+        out = ctx.apply_output_blockers("v1", {"q": 1}, {"score": 2})
+        assert out == {"capped": True, "score": 2}
+        ctx.notify_output_sniffers("v1", {"q": 1}, out)
+        assert seen == [("v1", {"capped": True, "score": 2})]
+        inventory = ctx.to_json_dict()["plugins"]
+        assert "cap" in inventory["outputblockers"]
+        assert "spy" in inventory["outputsniffers"]
+
+    def test_sniffer_errors_swallowed(self):
+        class Bad(EngineServerPlugin):
+            plugin_name = "bad"
+
+            def process(self, variant, query, prediction, context):
+                raise RuntimeError("boom")
+
+        ctx = EngineServerPluginContext([Bad()])
+        ctx.notify_output_sniffers("v", {}, {})  # must not raise
+
+
+class TestDistributedHelper:
+    def test_noop_without_env(self, monkeypatch):
+        from predictionio_tpu.parallel import distributed
+
+        monkeypatch.delenv("PIO_COORDINATOR", raising=False)
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        assert distributed.maybe_initialize_distributed() is False
+
+    def test_process_info_single(self):
+        from predictionio_tpu.parallel.distributed import process_info
+
+        info = process_info()
+        assert info["process_count"] == 1
+        assert info["global_device_count"] == 8
+
+
+class TestLatencyHistogram:
+    def test_percentiles(self):
+        h = LatencyHistogram()
+        for ms in range(1, 101):
+            h.observe(ms / 1000.0)
+        s = h.summary()
+        assert s["count"] == 100
+        assert 40 < s["p50_ms"] < 70
+        assert s["p99_ms"] >= s["p95_ms"] >= s["p50_ms"]
+        assert s["max_ms"] == pytest.approx(100.0, rel=0.01)
+
+    def test_empty(self):
+        assert LatencyHistogram().summary() == {"count": 0}
