@@ -116,22 +116,30 @@ class SelfCleaningDataSource:
 
     def clean_persisted_events(self, ctx: WorkflowContext) -> int:
         """Replace the stored stream with its cleaned form. Returns the
-        number of events after cleaning."""
+        number of events after cleaning.
+
+        Ordering is insert-then-delete: the cleaned events (fresh ids) go in
+        first, and only then are the original ids removed. A crash in between
+        leaves a recoverable superset (temporary duplicates), never a wiped
+        store — unlike drop-table-then-reinsert, which loses the app's whole
+        history if the process dies mid-way.
+        """
         app_name = self._app_name(ctx)
         storage = ctx.storage
         app_id, channel_id = resolve_app(storage, app_name, ctx.channel_name)
         levents = storage.get_l_events()
-        cleaned = clean_events(
-            storage.get_p_events().find(app_id, channel_id), self.event_window
-        )
-        levents.remove(app_id, channel_id)
-        levents.init(app_id, channel_id)
+        originals = list(storage.get_p_events().find(app_id, channel_id))
+        cleaned = clean_events(originals, self.event_window)
         # strip stale event ids so re-insert assigns fresh ones
         import dataclasses as _dc
 
         levents.insert_batch(
             [_dc.replace(e, event_id=None) for e in cleaned], app_id, channel_id
         )
+        old_ids = [e.event_id for e in originals if e.event_id]
+        # batch delete: one pass for file-backed stores, one txn for SQL —
+        # per-id LEvents.delete would rewrite the JSONL file O(N) times
+        storage.get_p_events().delete(old_ids, app_id, channel_id)
         logger.info(
             "self-cleaning: %s now holds %d events", app_name, len(cleaned)
         )

@@ -424,6 +424,14 @@ class PEvents(abc.ABC):
         self, event_ids: Iterable[str], app_id: int, channel_id: int | None = None
     ) -> None: ...
 
+    def version_stamp(self, app_id: int, channel_id: int | None = None) -> str | None:
+        """Cheap content stamp of this app/channel's events, used by the
+        columnar snapshot cache (``data/store/snapshot.py``) for invalidation.
+        Any write must change the stamp. ``None`` (the default) means the
+        backend cannot stamp cheaply and snapshots will not be persisted.
+        """
+        return None
+
     def aggregate_properties(
         self,
         app_id: int,

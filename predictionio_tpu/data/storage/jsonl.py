@@ -218,6 +218,14 @@ class JSONLPEvents(base.PEvents):
     ) -> None:
         self._files.remove_ids(set(event_ids), app_id, channel_id)
 
+    def version_stamp(self, app_id: int, channel_id: int | None = None) -> str | None:
+        path = self._files.path(app_id, channel_id)
+        try:
+            st = os.stat(path)
+        except FileNotFoundError:
+            return "empty"
+        return f"{st.st_size}:{st.st_mtime_ns}"
+
     def to_columnar(
         self,
         app_id: int,
@@ -231,17 +239,17 @@ class JSONLPEvents(base.PEvents):
         """Fast path: native C++ scan of the JSONL file when the filters are
         expressible natively (event names + entity/target types, no time
         window, no frozen vocab). Falls back to the generic python path."""
+        # ``...`` is the find() "don't care" sentinel — same as not passing
+        # the filter at all, so drop it before deciding on the native path
+        native_kwargs = {k: v for k, v in find_kwargs.items() if v is not ...}
         # explicit None filters carry "must be absent" semantics the native
         # scanner does not express; event_names=[] means "match nothing"
         native_ok = (
             entity_vocab is None
             and target_vocab is None
-            and set(find_kwargs) <= {"entity_type", "target_entity_type"}
-            and not ("entity_type" in find_kwargs and find_kwargs["entity_type"] is None)
-            and not (
-                "target_entity_type" in find_kwargs
-                and find_kwargs["target_entity_type"] is None
-            )
+            and set(native_kwargs) <= {"entity_type", "target_entity_type"}
+            and native_kwargs.get("entity_type", "") is not None
+            and native_kwargs.get("target_entity_type", "") is not None
             # event_names=[] means "match nothing" — handled by generic path
             and not (event_names is not None and len(list(event_names)) == 0)
         )
@@ -252,8 +260,8 @@ class JSONLPEvents(base.PEvents):
                 self._files.path(app_id, channel_id),
                 event_names=list(event_names) if event_names else None,
                 rating_key=rating_key,
-                entity_type=find_kwargs.get("entity_type"),
-                target_entity_type=find_kwargs.get("target_entity_type"),
+                entity_type=native_kwargs.get("entity_type"),
+                target_entity_type=native_kwargs.get("target_entity_type"),
             )
             if raw is not None:
                 from predictionio_tpu.data.storage.base import ColumnarEvents

@@ -62,14 +62,25 @@ class MemoryEventStore:
     def __init__(self):
         self._lock = threading.RLock()
         self._tables: dict[tuple[int, int | None], dict[str, Event]] = {}
+        self._versions: dict[tuple[int, int | None], int] = {}
 
     def table(self, app_id: int, channel_id: int | None) -> dict[str, Event]:
         with self._lock:
             return self._tables.setdefault((app_id, channel_id), {})
 
+    def bump(self, app_id: int, channel_id: int | None) -> None:
+        with self._lock:
+            key = (app_id, channel_id)
+            self._versions[key] = self._versions.get(key, 0) + 1
+
+    def version(self, app_id: int, channel_id: int | None) -> int:
+        with self._lock:
+            return self._versions.get((app_id, channel_id), 0)
+
     def drop(self, app_id: int, channel_id: int | None) -> None:
         with self._lock:
             self._tables.pop((app_id, channel_id), None)
+            self.bump(app_id, channel_id)
 
 
 class MemoryLEvents(base.LEvents):
@@ -96,6 +107,7 @@ class MemoryLEvents(base.LEvents):
         )
         with self._store._lock:
             self._store.table(app_id, channel_id)[event_id] = stored
+            self._store.bump(app_id, channel_id)
         return event_id
 
     def get(
@@ -107,9 +119,10 @@ class MemoryLEvents(base.LEvents):
         self, event_id: str, app_id: int, channel_id: int | None = None
     ) -> bool:
         with self._store._lock:
-            return (
-                self._store.table(app_id, channel_id).pop(event_id, None) is not None
-            )
+            removed = self._store.table(app_id, channel_id).pop(event_id, None)
+            if removed is not None:
+                self._store.bump(app_id, channel_id)
+            return removed is not None
 
     def find(
         self,
@@ -166,6 +179,9 @@ class MemoryPEvents(base.PEvents):
     ) -> None:
         for eid in event_ids:
             self._l.delete(eid, app_id, channel_id)
+
+    def version_stamp(self, app_id: int, channel_id: int | None = None) -> str | None:
+        return f"mem:{self._store.version(app_id, channel_id)}"
 
 
 class MemoryApps(base.Apps):

@@ -33,6 +33,10 @@ from predictionio_tpu.data.storage.base import (
 )
 
 _SCHEMA = """
+CREATE TABLE IF NOT EXISTS event_versions (
+  tbl TEXT PRIMARY KEY,
+  version INTEGER NOT NULL DEFAULT 0
+);
 CREATE TABLE IF NOT EXISTS apps (
   id INTEGER PRIMARY KEY AUTOINCREMENT,
   name TEXT NOT NULL UNIQUE,
@@ -171,6 +175,23 @@ class SQLiteStorageClient:
     def close(self) -> None:
         self._conn.close()
 
+    def bump_event_version(self, table: str) -> None:
+        """Monotonic write counter per event table — the snapshot-cache
+        stamp. Rowid/count/max-time are NOT sufficient (sqlite reuses a
+        freed max rowid, so delete+reinsert could leave them unchanged)."""
+        with self._lock, self._conn:
+            self._conn.execute(
+                "INSERT INTO event_versions (tbl, version) VALUES (?, 1) "
+                "ON CONFLICT(tbl) DO UPDATE SET version = version + 1",
+                (table,),
+            )
+
+    def event_version(self, table: str) -> int:
+        rows = self.query(
+            "SELECT version FROM event_versions WHERE tbl = ?", (table,)
+        )
+        return rows[0][0] if rows else 0
+
     # DAO accessors used by registry reflection
     def l_events(self) -> "SQLiteLEvents":
         return SQLiteLEvents(self)
@@ -214,6 +235,7 @@ class SQLiteLEvents(base.LEvents):
         table = _event_table(app_id, channel_id)
         self._c.execute(f"DROP TABLE IF EXISTS {table}")
         self._c._initialized_event_tables.discard(table)
+        self._c.bump_event_version(table)
         return True
 
     def close(self) -> None:
@@ -253,6 +275,7 @@ class SQLiteLEvents(base.LEvents):
                 f"INSERT OR REPLACE INTO {table} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
                 rows,
             )
+        self._c.bump_event_version(table)
         return ids
 
     @staticmethod
@@ -306,6 +329,8 @@ class SQLiteLEvents(base.LEvents):
             if _is_missing_table(exc):
                 return False
             raise
+        if cur.rowcount > 0:
+            self._c.bump_event_version(table)
         return cur.rowcount > 0
 
     def find(
@@ -384,6 +409,18 @@ class SQLitePEvents(base.PEvents):
     ) -> None:
         for eid in event_ids:
             self._l.delete(eid, app_id, channel_id)
+
+    def version_stamp(self, app_id: int, channel_id: int | None = None) -> str | None:
+        table = _event_table(app_id, channel_id)
+        version = self._c.event_version(table)
+        try:
+            rows = self._c.query(f"SELECT COUNT(*) FROM {table}")
+            count = rows[0][0]
+        except sqlite3.OperationalError as exc:
+            if not _is_missing_table(exc):
+                raise
+            count = 0
+        return f"v{version}:{count}"
 
 
 class SQLiteApps(base.Apps):

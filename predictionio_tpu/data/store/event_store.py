@@ -150,3 +150,41 @@ class PEventStore:
         return self._storage.get_p_events().to_columnar(
             app_id=app_id, channel_id=channel_id, **kwargs
         )
+
+    def to_columnar_cached(
+        self,
+        app_name: str,
+        channel_name: str | None = None,
+        snapshot_dir: str | None = None,
+        host_index: int = 0,
+        host_count: int = 1,
+        refresh: bool = False,
+        **kwargs,
+    ) -> ColumnarEvents:
+        """``to_columnar`` through the sharded snapshot cache
+        (``data/store/snapshot.py``) — the replacement for the reference's
+        partitioned storage scans (``JDBCPEvents.scala:91-121``): train runs
+        hit the columnar shards, not the row store, unless events changed.
+
+        ``snapshot_dir`` defaults to ``$PIO_SNAPSHOT_DIR`` or
+        ``~/.pio_store/snapshots``. Multi-host callers pass their
+        ``host_index``/``host_count`` for a deterministic disjoint shard set.
+        """
+        import os
+
+        from predictionio_tpu.data.store.snapshot import SnapshotCache
+
+        snapshot_dir = snapshot_dir or os.environ.get("PIO_SNAPSHOT_DIR") or os.path.join(
+            os.path.expanduser("~"), ".pio_store", "snapshots"
+        )
+        app_id, channel_id = resolve_app(self._storage, app_name, channel_name)
+        cache = SnapshotCache(snapshot_dir)
+        return cache.columnar(
+            self._storage.get_p_events(),
+            app_id,
+            channel_id,
+            host_index=host_index,
+            host_count=host_count,
+            refresh=refresh,
+            **kwargs,
+        )

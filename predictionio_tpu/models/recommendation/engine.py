@@ -33,7 +33,7 @@ from predictionio_tpu.controller import (
     SanityCheck,
 )
 from predictionio_tpu.data.storage.base import ColumnarEvents
-from predictionio_tpu.ops.als import ALSConfig, als_train, top_k_items
+from predictionio_tpu.ops.als import ALSConfig, als_train
 from predictionio_tpu.workflow.context import WorkflowContext
 
 
@@ -217,7 +217,7 @@ class ALSModel(SanityCheck):
 
     def __post_init__(self):
         self._user_index: dict[str, int] | None = None
-        self._device_items = None
+        self._serving_index = None
 
     def sanity_check(self) -> None:
         if not (
@@ -232,13 +232,14 @@ class ALSModel(SanityCheck):
             self._user_index = {u: i for i, u in enumerate(self.user_vocab)}
         return self._user_index.get(user)
 
-    def device_item_factors(self):
-        """Item factor table resident on device for the serving hot path."""
-        if self._device_items is None:
-            import jax.numpy as jnp
+    def serving_index(self):
+        """Both factor tables resident on device; index-addressed top-k
+        with one upload + one fetch per query (ops.als.ServingIndex)."""
+        if self._serving_index is None:
+            from predictionio_tpu.ops.als import ServingIndex
 
-            self._device_items = jnp.asarray(self.item_factors)
-        return self._device_items
+            self._serving_index = ServingIndex(self.user_factors, self.item_factors)
+        return self._serving_index
 
     def __getstate__(self):
         return {
@@ -251,7 +252,7 @@ class ALSModel(SanityCheck):
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._user_index = None
-        self._device_items = None
+        self._serving_index = None
 
 
 class ALSAlgorithm(JaxAlgorithm):
@@ -283,11 +284,8 @@ class ALSAlgorithm(JaxAlgorithm):
         uidx = model.user_index(query.user)
         if uidx is None:
             return PredictedResult(())  # unknown user -> empty result
-        import jax.numpy as jnp
-
-        user_vec = jnp.asarray(model.user_factors[uidx])
-        scores, idx = top_k_items(
-            user_vec, model.device_item_factors(), min(query.num, len(model.item_vocab))
+        scores, idx = model.serving_index().serve(
+            uidx, min(query.num, len(model.item_vocab))
         )
         return PredictedResult(
             tuple(

@@ -215,13 +215,45 @@ def als_train(
 # ---------------------------------------------------------------------------
 # Serving-side scoring
 # ---------------------------------------------------------------------------
+#
+# The hot path (BASELINE's <10ms p50 target) is engineered for minimum
+# host<->device round trips, because on a remote-attached TPU every transfer
+# is a network RTT and on a local one every transfer is a dispatch:
+#   - factor tables stay resident on device (``ServingIndex``),
+#   - the query uploads ONE int32 scalar (the user index); the factor gather
+#     happens on device,
+#   - scores and indices come back in ONE packed float32 fetch (indices ride
+#     as a bitcast, so they are exact for any item count).
+
+
+def _pack(scores, idx):
+    return jnp.stack([scores, lax.bitcast_convert_type(idx, jnp.float32)])
+
+
+def _unpack(packed: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    return packed[0], packed[1].view(np.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
-def _topk_scores(user_vec, item_factors, mask, k: int):
-    scores = item_factors @ user_vec  # [n_items]
+def _serve_by_index(uidx, user_factors, item_factors, mask, k: int):
+    scores = item_factors @ user_factors[uidx]  # [n_items]
     scores = jnp.where(mask, scores, -jnp.inf)
-    return lax.top_k(scores, k)
+    return _pack(*lax.top_k(scores, k))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _serve_by_index_batch(uidxs, user_factors, item_factors, mask, k: int):
+    scores = user_factors[uidxs] @ item_factors.T  # [B, n_items] on the MXU
+    scores = jnp.where(mask[None, :], scores, -jnp.inf)
+    s, i = lax.top_k(scores, k)
+    return jnp.stack([s, lax.bitcast_convert_type(i, jnp.float32)], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_scores_packed(user_vec, item_factors, mask, k: int):
+    scores = item_factors @ user_vec
+    scores = jnp.where(mask, scores, -jnp.inf)
+    return _pack(*lax.top_k(scores, k))
 
 
 def predict_scores(user_vec: jax.Array, item_factors: jax.Array) -> jax.Array:
@@ -234,9 +266,72 @@ def top_k_items(
     k: int,
     mask: jax.Array | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Resident compiled top-k over item factors (serving hot path —
-    BASELINE's <10ms p50 target). ``mask`` False = excluded item."""
+    """One-shot top-k for an explicit user vector; single packed fetch.
+    ``mask`` False = excluded item. Prefer ``ServingIndex`` on the serving
+    path — it also keeps the user table resident."""
     if mask is None:
         mask = jnp.ones((item_factors.shape[0],), bool)
-    scores, idx = _topk_scores(user_vec, item_factors, mask, k)
-    return np.asarray(scores), np.asarray(idx)
+    packed = np.asarray(_topk_scores_packed(user_vec, item_factors, mask, k))
+    return _unpack(packed)
+
+
+class ServingIndex:
+    """Device-resident factor tables with index-addressed top-k serve.
+
+    The TPU replacement for the reference's in-JVM model broadcast
+    (``CreateServer.scala:196-200`` deserializes the kryo model into the
+    server heap; here the model lives in HBM and every query is one compiled
+    kernel). Per-query cost: one int32 upload + one [2,k] float32 fetch.
+    """
+
+    def __init__(self, user_factors, item_factors):
+        self.user_factors = jnp.asarray(user_factors)
+        self.item_factors = jnp.asarray(item_factors)
+        self._full_mask = jnp.ones((self.item_factors.shape[0],), bool)
+
+    @property
+    def n_users(self) -> int:
+        return self.user_factors.shape[0]
+
+    @property
+    def n_items(self) -> int:
+        return self.item_factors.shape[0]
+
+    def warmup(self, k: int) -> None:
+        jax.block_until_ready(
+            _serve_by_index(
+                jnp.int32(0), self.user_factors, self.item_factors, self._full_mask, k
+            )
+        )
+
+    def serve(
+        self, user_index: int, k: int, mask: jax.Array | np.ndarray | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Top-k (scores, item indices) for one user index."""
+        m = self._full_mask if mask is None else jnp.asarray(mask)
+        packed = np.asarray(
+            _serve_by_index(
+                jnp.int32(user_index), self.user_factors, self.item_factors, m, k
+            )
+        )
+        return _unpack(packed)
+
+    def serve_batch(
+        self,
+        user_indices: np.ndarray,
+        k: int,
+        mask: jax.Array | np.ndarray | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Micro-batched serve: [B] indices -> ([B,k] scores, [B,k] items).
+        This is the throughput path an async query server batches into."""
+        m = self._full_mask if mask is None else jnp.asarray(mask)
+        packed = np.asarray(
+            _serve_by_index_batch(
+                jnp.asarray(np.asarray(user_indices, np.int32)),
+                self.user_factors,
+                self.item_factors,
+                m,
+                k,
+            )
+        )
+        return packed[:, 0, :], np.ascontiguousarray(packed[:, 1, :]).view(np.int32)

@@ -102,3 +102,49 @@ class TestTopK:
         mask[4] = False  # blacklist best item
         scores, idx = top_k_items(user, vf, 3, jnp.asarray(mask))
         assert list(idx) == [3, 2, 1]
+
+
+class TestServingIndex:
+    def _index(self):
+        from predictionio_tpu.ops.als import ServingIndex
+
+        uf = np.eye(4, 5, dtype=np.float32)  # user u scores item via vf
+        vf = np.diag(np.arange(1.0, 6.0)).astype(np.float32)[:, :5]
+        return ServingIndex(uf, vf)
+
+    def test_serve_matches_dense_scores(self):
+        idx = self._index()
+        scores, items = idx.serve(2, 3)
+        dense = np.asarray(idx.item_factors) @ np.asarray(idx.user_factors)[2]
+        order = np.argsort(-dense)[:3]
+        assert list(items) == list(order)
+        np.testing.assert_allclose(scores, dense[order], rtol=1e-6)
+
+    def test_serve_mask_blacklist(self):
+        idx = self._index()
+        mask = np.ones(5, bool)
+        _, items = idx.serve(2, 1)
+        mask[int(items[0])] = False
+        _, items2 = idx.serve(2, 1, mask)
+        assert int(items2[0]) != int(items[0])
+
+    def test_serve_batch_consistent_with_single(self):
+        idx = self._index()
+        bs, bi = idx.serve_batch(np.array([0, 1, 2, 3]), 2)
+        for u in range(4):
+            s, i = idx.serve(u, 2)
+            np.testing.assert_array_equal(bi[u], i)
+            np.testing.assert_allclose(bs[u], s, rtol=1e-6)
+
+    def test_index_bitcast_exact_for_large_indices(self):
+        # indices > 2^24 would lose precision as float casts; the packed
+        # path bitcasts, so spot-check determinism on a bigger table
+        from predictionio_tpu.ops.als import ServingIndex
+
+        rng = np.random.default_rng(0)
+        vf = rng.normal(size=(50_000, 8)).astype(np.float32)
+        uf = rng.normal(size=(4, 8)).astype(np.float32)
+        idx = ServingIndex(uf, vf)
+        _, items = idx.serve(1, 5)
+        dense = vf @ uf[1]
+        assert list(items) == list(np.argsort(-dense)[:5])

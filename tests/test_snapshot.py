@@ -1,0 +1,176 @@
+"""Columnar snapshot cache tests (data/store/snapshot.py).
+
+Covers the replacement for the reference's partitioned storage scans
+(``storage/jdbc/.../JDBCPEvents.scala:91-121``): build-once columnar shards,
+stamp-based invalidation on writes, and deterministic host->shard subsets.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.memory import MemoryEventStore, MemoryPEvents
+from predictionio_tpu.data.storage.sqlite import SQLiteStorageClient
+from predictionio_tpu.data.store.snapshot import SnapshotCache, shards_for_host
+
+TS = dt.datetime(2024, 5, 1, tzinfo=dt.timezone.utc)
+
+
+def _rating_events(n):
+    return [
+        Event(
+            event="rate",
+            entity_type="user",
+            entity_id=f"u{i % 7}",
+            target_entity_type="item",
+            target_entity_id=f"i{i % 11}",
+            properties={"rating": float(i % 5 + 1)},
+            event_time=TS + dt.timedelta(seconds=i),
+        )
+        for i in range(n)
+    ]
+
+
+@pytest.fixture
+def sqlite_pevents(tmp_path):
+    client = SQLiteStorageClient({"PATH": str(tmp_path / "ev.db")})
+    p = client.p_events()
+    p.write(_rating_events(100), app_id=1)
+    return p
+
+
+def test_snapshot_roundtrip_matches_direct_scan(tmp_path, sqlite_pevents):
+    cache = SnapshotCache(tmp_path / "snap", n_shards=4)
+    direct = sqlite_pevents.to_columnar(1, event_names=["rate"])
+    cached = cache.columnar(sqlite_pevents, 1, event_names=["rate"])
+    # build pass returns the scan result itself
+    np.testing.assert_array_equal(direct.entity_ids, cached.entity_ids)
+    # second call must hit the shard files and reproduce everything
+    reloaded = cache.columnar(sqlite_pevents, 1, event_names=["rate"])
+    np.testing.assert_array_equal(direct.entity_ids, reloaded.entity_ids)
+    np.testing.assert_array_equal(direct.target_ids, reloaded.target_ids)
+    np.testing.assert_array_equal(direct.event_codes, reloaded.event_codes)
+    np.testing.assert_allclose(direct.ratings, reloaded.ratings)
+    np.testing.assert_allclose(direct.timestamps, reloaded.timestamps)
+    assert direct.entity_vocab == reloaded.entity_vocab
+    assert direct.target_vocab == reloaded.target_vocab
+    assert direct.event_ids == reloaded.event_ids
+    assert direct.event_names == reloaded.event_names
+
+
+def test_snapshot_invalidated_by_write(tmp_path, sqlite_pevents):
+    cache = SnapshotCache(tmp_path / "snap", n_shards=2)
+    first = cache.columnar(sqlite_pevents, 1, event_names=["rate"])
+    assert len(first) == 100
+    sqlite_pevents.write(_rating_events(5), app_id=1)
+    again = cache.columnar(sqlite_pevents, 1, event_names=["rate"])
+    assert len(again) == 105
+
+
+def test_host_shard_assignment_disjoint_and_complete(tmp_path, sqlite_pevents):
+    cache = SnapshotCache(tmp_path / "snap", n_shards=4)
+    cache.columnar(sqlite_pevents, 1, event_names=["rate"])  # build
+    parts = [
+        cache.columnar(
+            sqlite_pevents, 1, event_names=["rate"], host_index=h, host_count=2
+        )
+        for h in range(2)
+    ]
+    ids = [set(p.event_ids) for p in parts]
+    assert ids[0].isdisjoint(ids[1])
+    full = cache.columnar(sqlite_pevents, 1, event_names=["rate"])
+    assert ids[0] | ids[1] == set(full.event_ids)
+
+
+def test_mixed_miss_and_hit_hosts_still_partition_correctly(tmp_path, sqlite_pevents):
+    """A host that builds (cache miss) and a host that reads shards (hit)
+    must still see disjoint, jointly-complete row sets."""
+    miss_side = SnapshotCache(tmp_path / "snap", n_shards=4).columnar(
+        sqlite_pevents, 1, event_names=["rate"], host_index=0, host_count=2
+    )  # built the snapshot while slicing for host 0
+    hit_side = SnapshotCache(tmp_path / "snap", n_shards=4).columnar(
+        sqlite_pevents, 1, event_names=["rate"], host_index=1, host_count=2
+    )  # reads the shard files
+    a, b = set(miss_side.event_ids), set(hit_side.event_ids)
+    full = SnapshotCache(tmp_path / "snap", n_shards=4).columnar(
+        sqlite_pevents, 1, event_names=["rate"]
+    )
+    assert a.isdisjoint(b)
+    assert a | b == set(full.event_ids)
+
+
+def test_sqlite_stamp_changes_on_delete_plus_reinsert(sqlite_pevents):
+    """Delete the newest event and insert a replacement with the same
+    eventTime: sqlite reuses the freed max rowid, so the stamp must come
+    from a monotonic write counter, not (count, max rowid, max time)."""
+    events = sorted(sqlite_pevents.find(1), key=lambda e: e.event_time)
+    newest = events[-1]
+    s0 = sqlite_pevents.version_stamp(1)
+    sqlite_pevents.delete([newest.event_id], app_id=1)
+    import dataclasses
+
+    sqlite_pevents.write(
+        [dataclasses.replace(newest, event_id=None, properties=newest.properties)],
+        app_id=1,
+    )
+    assert sqlite_pevents.version_stamp(1) != s0
+
+
+def test_jsonl_columnar_accepts_ellipsis_sentinel(tmp_path):
+    from predictionio_tpu.data.storage.jsonl import JSONLStorageClient
+
+    client = JSONLStorageClient({"PATH": str(tmp_path / "ev")})
+    p = client.p_events()
+    p.write(_rating_events(6), app_id=1)
+    cols = p.to_columnar(1, target_entity_type=..., entity_type=...)
+    assert len(cols) == 6
+
+
+def test_shards_for_host_round_robin():
+    assert shards_for_host(8, 0, 2) == [0, 2, 4, 6]
+    assert shards_for_host(8, 1, 2) == [1, 3, 5, 7]
+    all_assigned = sorted(
+        s for h in range(3) for s in shards_for_host(7, h, 3)
+    )
+    assert all_assigned == list(range(7))
+
+
+def test_memory_backend_stamp_changes_on_mutation():
+    store = MemoryEventStore()
+    p = MemoryPEvents(store)
+    s0 = p.version_stamp(1)
+    p.write(_rating_events(3), app_id=1)
+    s1 = p.version_stamp(1)
+    assert s0 != s1
+    eid = next(iter(p.find(1))).event_id
+    p.delete([eid], app_id=1)
+    assert p.version_stamp(1) != s1
+
+
+def test_empty_app_snapshot(tmp_path, sqlite_pevents):
+    cache = SnapshotCache(tmp_path / "snap")
+    cols = cache.columnar(sqlite_pevents, 99)
+    assert len(cols) == 0
+    cols2 = cache.columnar(sqlite_pevents, 99)
+    assert len(cols2) == 0
+
+
+def test_event_store_cached_entry_point(tmp_path, memory_storage):
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.store.event_store import PEventStore
+
+    storage = memory_storage
+    storage.get_meta_data_apps().insert(App(id=0, name="snapapp"))
+    app = storage.get_meta_data_apps().get_by_name("snapapp")
+    storage.get_p_events().write(_rating_events(10), app_id=app.id)
+    store = PEventStore(storage)
+    cols = store.to_columnar_cached(
+        "snapapp", snapshot_dir=str(tmp_path / "snap"), event_names=["rate"]
+    )
+    assert len(cols) == 10
+    cols2 = store.to_columnar_cached(
+        "snapapp", snapshot_dir=str(tmp_path / "snap"), event_names=["rate"]
+    )
+    assert len(cols2) == 10
