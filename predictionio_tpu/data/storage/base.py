@@ -432,6 +432,13 @@ class PEvents(abc.ABC):
         """
         return None
 
+    def store_identity(self) -> str | None:
+        """Stable identifier of the underlying store (db path, connection,
+        instance nonce) — part of the snapshot signature so two stores
+        sharing one snapshot root never garbage-collect or alias each
+        other's snapshots. Stable across writes; distinct across stores."""
+        return None
+
     def aggregate_properties(
         self,
         app_id: int,

@@ -226,6 +226,11 @@ class JSONLPEvents(base.PEvents):
             return "empty"
         return f"{st.st_size}:{st.st_mtime_ns}"
 
+    def store_identity(self) -> str | None:
+        # abs path of this app/store root: two jsonl stores sharing one
+        # snapshot root must not alias or GC each other's snapshots
+        return os.path.abspath(self._files.basedir)
+
     def to_columnar(
         self,
         app_id: int,

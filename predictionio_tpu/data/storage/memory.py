@@ -63,6 +63,10 @@ class MemoryEventStore:
         self._lock = threading.RLock()
         self._tables: dict[tuple[int, int | None], dict[str, Event]] = {}
         self._versions: dict[tuple[int, int | None], int] = {}
+        # snapshot-cache stamps must never collide with a *different*
+        # in-memory store (another process, or another instance in this one)
+        # whose counter happens to match — see version_stamp
+        self.nonce = uuid.uuid4().hex[:12]
 
     def table(self, app_id: int, channel_id: int | None) -> dict[str, Event]:
         with self._lock:
@@ -182,6 +186,9 @@ class MemoryPEvents(base.PEvents):
 
     def version_stamp(self, app_id: int, channel_id: int | None = None) -> str | None:
         return f"mem:{self._store.version(app_id, channel_id)}"
+
+    def store_identity(self) -> str | None:
+        return f"mem:{self._store.nonce}"
 
 
 class MemoryApps(base.Apps):

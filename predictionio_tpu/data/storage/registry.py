@@ -33,6 +33,12 @@ _BACKENDS: dict[str, tuple[str, str]] = {
     "sqlite": ("predictionio_tpu.data.storage.sqlite", "SQLiteStorageClient"),
     "localfs": ("predictionio_tpu.data.storage.localfs", "LocalFSStorageClient"),
     "jsonl": ("predictionio_tpu.data.storage.jsonl", "JSONLStorageClient"),
+    # client/server SQL databases over DB-API (ref storage/jdbc driver);
+    # driver modules are imported lazily at connect time and gated with a
+    # clear error if absent (psycopg2/psycopg, pymysql/MySQLdb)
+    "postgres": ("predictionio_tpu.data.storage.sql", "PostgresStorageClient"),
+    "mysql": ("predictionio_tpu.data.storage.sql", "MySQLStorageClient"),
+    "sql": ("predictionio_tpu.data.storage.sql", "SQLStorageClient"),
 }
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")

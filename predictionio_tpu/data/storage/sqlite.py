@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import datetime as _dt
 import json
+import os
 import sqlite3
 import threading
 import uuid
@@ -147,6 +148,13 @@ def _is_missing_table(exc: sqlite3.OperationalError) -> bool:
     return "no such table" in str(exc)
 
 
+# atomic per-table write counter bump, run inside data-write transactions
+_BUMP_SQL = (
+    "INSERT INTO event_versions (tbl, version) VALUES (?, 1) "
+    "ON CONFLICT(tbl) DO UPDATE SET version = version + 1"
+)
+
+
 class SQLiteStorageClient:
     """Backend entry point (type name: ``sqlite``). Config key ``path``
     selects the database file; ``:memory:`` works for tests but is
@@ -155,6 +163,13 @@ class SQLiteStorageClient:
     def __init__(self, config: dict | None = None):
         self.config = config or {}
         self.path = self.config.get("PATH") or self.config.get("path") or ":memory:"
+        # snapshot-cache stamp disambiguator: two databases sharing one
+        # snapshot root must not alias on equal (version, count); an
+        # in-memory db is additionally unique per client instance
+        if self.path == ":memory:":
+            self.store_identity = f"sqlite:{uuid.uuid4().hex[:12]}"
+        else:
+            self.store_identity = f"sqlite:{os.path.abspath(self.path)}"
         self._conn = sqlite3.connect(self.path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA synchronous=NORMAL")
@@ -275,7 +290,9 @@ class SQLiteLEvents(base.LEvents):
                 f"INSERT OR REPLACE INTO {table} VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
                 rows,
             )
-        self._c.bump_event_version(table)
+            # stamp bump in the same transaction: a crash can never commit
+            # data without invalidating cached snapshots
+            self._c._conn.execute(_BUMP_SQL, (table,))
         return ids
 
     @staticmethod
@@ -324,13 +341,16 @@ class SQLiteLEvents(base.LEvents):
     def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
         table = _event_table(app_id, channel_id)
         try:
-            cur = self._c.execute(f"DELETE FROM {table} WHERE id = ?", (event_id,))
+            with self._c._lock, self._c._conn:
+                cur = self._c._conn.execute(
+                    f"DELETE FROM {table} WHERE id = ?", (event_id,)
+                )
+                if cur.rowcount > 0:  # stamp bump rides the delete txn
+                    self._c._conn.execute(_BUMP_SQL, (table,))
         except sqlite3.OperationalError as exc:
             if _is_missing_table(exc):
                 return False
             raise
-        if cur.rowcount > 0:
-            self._c.bump_event_version(table)
         return cur.rowcount > 0
 
     def find(
@@ -407,8 +427,25 @@ class SQLitePEvents(base.PEvents):
     def delete(
         self, event_ids: Iterable[str], app_id: int, channel_id: int | None = None
     ) -> None:
-        for eid in event_ids:
-            self._l.delete(eid, app_id, channel_id)
+        ids = list(event_ids)
+        if not ids:
+            return
+        table = _event_table(app_id, channel_id)
+        # chunked DELETE ... IN + the version bump in one transaction
+        # (not one txn per id, and no data-without-stamp crash window)
+        try:
+            with self._c._lock, self._c._conn:
+                for chunk_start in range(0, len(ids), 500):
+                    chunk = ids[chunk_start : chunk_start + 500]
+                    placeholders = ",".join("?" for _ in chunk)
+                    self._c._conn.execute(
+                        f"DELETE FROM {table} WHERE id IN ({placeholders})", chunk
+                    )
+                self._c._conn.execute(_BUMP_SQL, (table,))
+        except sqlite3.OperationalError as exc:
+            if _is_missing_table(exc):
+                return
+            raise
 
     def version_stamp(self, app_id: int, channel_id: int | None = None) -> str | None:
         table = _event_table(app_id, channel_id)
@@ -421,6 +458,9 @@ class SQLitePEvents(base.PEvents):
                 raise
             count = 0
         return f"v{version}:{count}"
+
+    def store_identity(self) -> str | None:
+        return self._c.store_identity
 
 
 class SQLiteApps(base.Apps):

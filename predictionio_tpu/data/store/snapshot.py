@@ -88,6 +88,9 @@ class SnapshotCache:
             "event_names": sorted(event_names) if event_names else None,
             "rating_key": rating_key,
             "find": {k: str(v) for k, v in sorted(find_kwargs.items())},
+            # distinct stores sharing one snapshot root must neither alias
+            # on equal stamps nor GC each other's generations
+            "store": getattr(p_events, "store_identity", lambda: None)(),
         }
         stamp = p_events.version_stamp(app_id, channel_id)
         key = _key({**signature, "stamp": stamp})

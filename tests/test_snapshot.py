@@ -174,3 +174,39 @@ def test_event_store_cached_entry_point(tmp_path, memory_storage):
         "snapapp", snapshot_dir=str(tmp_path / "snap"), event_names=["rate"]
     )
     assert len(cols2) == 10
+
+
+def test_distinct_stores_share_snapshot_root_without_aliasing(tmp_path):
+    """Two different databases with the same app_id/filters must neither
+    serve each other's cached snapshots nor GC each other's generations."""
+    cache = SnapshotCache(tmp_path / "snap", n_shards=2, keep=1)
+    stores = []
+    for i in range(3):
+        client = SQLiteStorageClient({"PATH": str(tmp_path / f"db{i}.db")})
+        p = client.p_events()
+        p.write(_rating_events(10 + i), app_id=1)
+        stores.append(p)
+    # build all three, then re-read all three: every store sees its own rows
+    for p in stores:
+        cache.columnar(p, 1, event_names=["rate"])
+    for i, p in enumerate(stores):
+        got = cache.columnar(p, 1, event_names=["rate"])
+        assert len(got) == 10 + i
+    # and a cache hit actually occurred (shard dirs for all three survive GC)
+    meta_dirs = [d for d in (tmp_path / "snap").iterdir() if (d / "meta.json").exists()]
+    assert len(meta_dirs) == 3
+
+
+def test_memory_stores_do_not_alias_on_equal_counters(tmp_path):
+    """A fresh in-memory store whose write counter matches another's must
+    not read the other store's persisted snapshot (process-restart case)."""
+    from predictionio_tpu.data.storage.memory import MemoryStorageClient
+
+    cache = SnapshotCache(tmp_path / "snap", n_shards=2)
+    a = MemoryStorageClient().p_events()
+    a.write(_rating_events(5), app_id=1)
+    cache.columnar(a, 1, event_names=["rate"])
+    b = MemoryStorageClient().p_events()  # same counter trajectory as a
+    b.write(_rating_events(7), app_id=1)
+    got = cache.columnar(b, 1, event_names=["rate"])
+    assert len(got) == 7

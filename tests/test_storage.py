@@ -28,19 +28,33 @@ UTC = dt.timezone.utc
 APP = 7
 
 
-@pytest.fixture(params=["memory", "sqlite", "jsonl"])
+def _sql_client(tmp_path):
+    # the generic DB-API driver (ref jdbc) exercised through sqlite3's DB-API
+    # module — same code path postgres/mysql take, minus the server
+    from predictionio_tpu.data.storage.sql import SQLStorageClient
+
+    return SQLStorageClient(
+        {"MODULE": "sqlite3", "CONNECT_ARGS": {"database": str(tmp_path / "s.db")}}
+    )
+
+
+@pytest.fixture(params=["memory", "sqlite", "jsonl", "sql"])
 def client(request, tmp_path):
     if request.param == "memory":
         return MemoryStorageClient()
     if request.param == "sqlite":
         return SQLiteStorageClient({"PATH": str(tmp_path / "t.db")})
+    if request.param == "sql":
+        return _sql_client(tmp_path)
     return JSONLStorageClient({"PATH": str(tmp_path / "events")})
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "sql"])
 def meta_client(request, tmp_path):
     if request.param == "memory":
         return MemoryStorageClient()
+    if request.param == "sql":
+        return _sql_client(tmp_path)
     return SQLiteStorageClient({"PATH": str(tmp_path / "m.db")})
 
 
@@ -394,3 +408,58 @@ class TestRegressions:
         ch = meta_client.channels()
         cid = ch.insert(Channel(0, "first", 1))
         assert ch.insert(Channel(cid, "second", 1)) is None
+
+
+class TestSQLDriver:
+    """Specifics of the DB-API driver (ref storage/jdbc)."""
+
+    def test_paramstyle_rewrite(self):
+        from predictionio_tpu.data.storage.sql import _DIALECTS
+
+        stmt = "SELECT * FROM t WHERE a=? AND b IN (?,?)"
+        assert _DIALECTS["sqlite"].sql(stmt) == stmt
+        assert (
+            _DIALECTS["postgres"].sql(stmt)
+            == "SELECT * FROM t WHERE a=%s AND b IN (%s,%s)"
+        )
+        assert (
+            _DIALECTS["mysql"].sql(stmt)
+            == "SELECT * FROM t WHERE a=%s AND b IN (%s,%s)"
+        )
+
+    def test_missing_driver_module_is_gated(self):
+        from predictionio_tpu.data.storage.sql import SQLStorageClient
+
+        with pytest.raises(StorageError, match="not installed"):
+            SQLStorageClient({"MODULE": "definitely_not_a_dbapi_module"})
+
+    def test_postgres_type_names_missing_dependency(self):
+        for mod in ("psycopg2", "psycopg"):
+            try:
+                __import__(mod)
+                pytest.skip(f"{mod} installed; gate not reachable")
+            except ImportError:
+                pass
+        from predictionio_tpu.data.storage.sql import PostgresStorageClient
+
+        with pytest.raises(StorageError, match="psycopg2"):
+            PostgresStorageClient({})
+
+    def test_registry_wires_sql_type(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_PGSQL_TYPE", "sql")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_PGSQL_MODULE", "sqlite3")
+        monkeypatch.setenv(
+            "PIO_STORAGE_SOURCES_PGSQL_CONNECT_ARGS",
+            '{"database": "%s"}' % (tmp_path / "r.db"),
+        )
+        for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+            monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", "pio")
+            monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "PGSQL")
+        storage = Storage()
+        apps = storage.get_meta_data_apps()
+        app_id = apps.insert(App(0, "sqlapp", None))
+        assert apps.get(app_id).name == "sqlapp"
+        levents = storage.get_l_events()
+        levents.init(app_id)
+        eid = levents.insert(ev(), app_id)
+        assert levents.get(eid, app_id).event == "rate"
