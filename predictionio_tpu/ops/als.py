@@ -222,16 +222,18 @@ def als_train(
 #   - factor tables stay resident on device (``ServingIndex``),
 #   - the query uploads ONE int32 scalar (the user index); the factor gather
 #     happens on device,
-#   - scores and indices come back in ONE packed float32 fetch (indices ride
-#     as a bitcast, so they are exact for any item count).
+#   - scores and indices come back in ONE packed int32 fetch. The scores ride
+#     as a bitcast (float32 bits are preserved exactly in an int32 lane);
+#     packing the *indices* as float32 would be wrong — small indices bitcast
+#     to denormal floats, which XLA flushes to zero.
 
 
 def _pack(scores, idx):
-    return jnp.stack([scores, lax.bitcast_convert_type(idx, jnp.float32)])
+    return jnp.stack([lax.bitcast_convert_type(scores, jnp.int32), idx])
 
 
 def _unpack(packed: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    return packed[0], packed[1].view(np.int32)
+    return packed[0].view(np.float32), packed[1]
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -246,7 +248,7 @@ def _serve_by_index_batch(uidxs, user_factors, item_factors, mask, k: int):
     scores = user_factors[uidxs] @ item_factors.T  # [B, n_items] on the MXU
     scores = jnp.where(mask[None, :], scores, -jnp.inf)
     s, i = lax.top_k(scores, k)
-    return jnp.stack([s, lax.bitcast_convert_type(i, jnp.float32)], axis=1)
+    return jnp.stack([lax.bitcast_convert_type(s, jnp.int32), i], axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -281,7 +283,8 @@ class ServingIndex:
     The TPU replacement for the reference's in-JVM model broadcast
     (``CreateServer.scala:196-200`` deserializes the kryo model into the
     server heap; here the model lives in HBM and every query is one compiled
-    kernel). Per-query cost: one int32 upload + one [2,k] float32 fetch.
+    kernel). Per-query cost: one int32 upload + one [2,k] int32 fetch
+    (row 0 = float32 score bits, row 1 = item indices).
     """
 
     def __init__(self, user_factors, item_factors):
@@ -324,14 +327,34 @@ class ServingIndex:
     ) -> tuple[np.ndarray, np.ndarray]:
         """Micro-batched serve: [B] indices -> ([B,k] scores, [B,k] items).
         This is the throughput path an async query server batches into."""
-        m = self._full_mask if mask is None else jnp.asarray(mask)
-        packed = np.asarray(
-            _serve_by_index_batch(
-                jnp.asarray(np.asarray(user_indices, np.int32)),
-                self.user_factors,
-                self.item_factors,
-                m,
-                k,
-            )
+        return self.unpack_batch(
+            np.asarray(self.serve_batch_async(user_indices, k, mask))
         )
-        return packed[:, 0, :], np.ascontiguousarray(packed[:, 1, :]).view(np.int32)
+
+    def serve_batch_async(
+        self,
+        user_indices: np.ndarray | jax.Array,
+        k: int,
+        mask: jax.Array | np.ndarray | None = None,
+    ) -> jax.Array:
+        """Non-blocking batched serve: dispatches the kernel and returns the
+        packed [B,2,k] int32 device array WITHOUT fetching it. An async query
+        server dispatches batch n+1 while fetching batch n's result, so
+        device work and transport overlap; decode with ``unpack_batch``."""
+        m = self._full_mask if mask is None else jnp.asarray(mask)
+        return _serve_by_index_batch(
+            jnp.asarray(np.asarray(user_indices, np.int32)),
+            self.user_factors,
+            self.item_factors,
+            m,
+            k,
+        )
+
+    @staticmethod
+    def unpack_batch(packed: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Decode a fetched [B,2,k] packed result into ([B,k] float32 scores,
+        [B,k] int32 item indices)."""
+        return (
+            np.ascontiguousarray(packed[:, 0, :]).view(np.float32),
+            packed[:, 1, :],
+        )

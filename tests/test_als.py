@@ -136,6 +136,24 @@ class TestServingIndex:
             np.testing.assert_array_equal(bi[u], i)
             np.testing.assert_allclose(bs[u], s, rtol=1e-6)
 
+    def test_small_indices_survive_packing(self):
+        # regression: packing indices as bitcast *float32* made small indices
+        # denormal floats, which XLA flush-to-zero turned into index 0. The
+        # packed row must be int32 (scores ride as the bitcast instead).
+        from predictionio_tpu.ops.als import ServingIndex
+
+        rng = np.random.default_rng(0)
+        uf = rng.normal(size=(5, 8)).astype(np.float32)
+        vf = rng.normal(size=(50, 8)).astype(np.float32)
+        idx = ServingIndex(uf, vf)
+        scores, items = idx.serve(1, 4)
+        dense = vf @ uf[1]
+        expect = np.argsort(-dense)[:4]
+        assert list(items) == list(expect)
+        np.testing.assert_allclose(scores, dense[expect], rtol=1e-5)
+        _, bi = idx.serve_batch(np.array([1, 3]), 4)
+        assert list(bi[0]) == list(expect)
+
     def test_index_bitcast_exact_for_large_indices(self):
         # indices > 2^24 would lose precision as float casts; the packed
         # path bitcasts, so spot-check determinism on a bigger table
