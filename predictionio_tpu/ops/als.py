@@ -342,12 +342,14 @@ class ServingIndex:
         server dispatches batch n+1 while fetching batch n's result, so
         device work and transport overlap; decode with ``unpack_batch``."""
         m = self._full_mask if mask is None else jnp.asarray(mask)
+        if isinstance(user_indices, jax.Array):
+            # already on device: a np.asarray round-trip would block on a
+            # D2H fetch and defeat the non-blocking contract
+            idxs = user_indices.astype(jnp.int32)
+        else:
+            idxs = jnp.asarray(np.asarray(user_indices, np.int32))
         return _serve_by_index_batch(
-            jnp.asarray(np.asarray(user_indices, np.int32)),
-            self.user_factors,
-            self.item_factors,
-            m,
-            k,
+            idxs, self.user_factors, self.item_factors, m, k
         )
 
     @staticmethod

@@ -284,6 +284,8 @@ def cmd_deploy(args) -> int:
         feedback_access_key=args.feedback_access_key,
         ssl_certfile=args.ssl_certfile,
         ssl_keyfile=args.ssl_keyfile,
+        log_url=args.log_url,
+        log_prefix=args.log_prefix or "",
     )
     print(f"Engine server starting on {args.ip}:{args.port} ...")
     run_query_server(args.engine_dir, args.variant, config=config)
@@ -615,6 +617,8 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--feedback-access-key")
     x.add_argument("--ssl-certfile")
     x.add_argument("--ssl-keyfile")
+    x.add_argument("--log-url", help="POST serving errors to this collector URL")
+    x.add_argument("--log-prefix", help="prefix prepended to each remote log body")
     x.set_defaults(fn=cmd_deploy)
 
     x = sub.add_parser("undeploy")

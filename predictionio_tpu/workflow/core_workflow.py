@@ -142,11 +142,15 @@ def run_evaluation(
     instance.status = EvaluationInstanceStatus.EVALUATING
     instances.update(instance)
     result = evaluation.run(ctx)
-    instance.status = EvaluationInstanceStatus.EVALCOMPLETED
-    instance.end_time = _dt.datetime.now(tz=UTC)
-    instance.evaluator_results = result.one_liner()
-    instance.evaluator_results_json = json.dumps(result.to_json_dict())
-    instance.evaluator_results_html = result.to_html()
-    instances.update(instance)
+    if getattr(result, "no_save", False):
+        # ref CoreWorkflow.scala:140-142 — FakeRun results are not persisted
+        logger.info("evaluation result not inserted into database (no_save)")
+    else:
+        instance.status = EvaluationInstanceStatus.EVALCOMPLETED
+        instance.end_time = _dt.datetime.now(tz=UTC)
+        instance.evaluator_results = result.one_liner()
+        instance.evaluator_results_json = json.dumps(result.to_json_dict())
+        instance.evaluator_results_html = result.to_html()
+        instances.update(instance)
     CleanupFunctions.run()
     return instance_id, result

@@ -56,6 +56,10 @@ class ServerConfig:
     ssl_certfile: str | None = None
     ssl_keyfile: str | None = None
     bind_retries: int = 3  # ref MasterActor bind retry x3 (CreateServer.scala:348)
+    # remote log shipping of serving errors (ref CreateServer.scala:423-434,
+    # 595-611): POST log_prefix + JSON{engineInstance, message} to log_url
+    log_url: str | None = None
+    log_prefix: str = ""
 
     def ssl_context(self):
         if not (self.ssl_certfile and self.ssl_keyfile):
@@ -99,6 +103,8 @@ class QueryServer:
         self.latency = LatencyHistogram()
         self._runner: web.AppRunner | None = None
         self._stop_event = asyncio.Event()
+        # strong refs to fire-and-forget tasks (the loop keeps only weak ones)
+        self._bg_tasks: set[asyncio.Task] = set()
 
     # ---------------------------------------------------------------- routes
     async def handle_queries(self, request: web.Request) -> web.Response:
@@ -136,6 +142,11 @@ class QueryServer:
                 )
         except Exception as exc:
             logger.exception("query failed")
+            if self.config.log_url:
+                import traceback
+
+                msg = f"Query:\n{payload}\n\nStack Trace:\n{traceback.format_exc()}\n\n"
+                self._spawn_bg(self._remote_log(msg))
             return web.json_response({"message": str(exc)}, status=400)
         elapsed = time.perf_counter() - t0
         self.request_count += 1
@@ -143,8 +154,28 @@ class QueryServer:
         self.avg_serving_sec += (elapsed - self.avg_serving_sec) / self.request_count
         self.latency.observe(elapsed)
         if self.config.feedback:
-            asyncio.ensure_future(self._send_feedback(payload, body))
+            self._spawn_bg(self._send_feedback(payload, body))
         return web.json_response(body)
+
+    def _spawn_bg(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
+    async def _remote_log(self, message: str) -> None:
+        """Ship a serving error to the remote collector: POST body is
+        ``log_prefix`` + JSON of {engineInstance, message}
+        (ref ``CreateServer.remoteLog``, CreateServer.scala:423-434)."""
+        import aiohttp
+
+        body = self.config.log_prefix + json.dumps(
+            {"engineInstance": self.instance_id, "message": message}
+        )
+        try:
+            async with aiohttp.ClientSession() as session:
+                await session.post(self.config.log_url, data=body)
+        except Exception:
+            logger.error("Unable to send remote log")
 
     async def _send_feedback(self, query: Any, prediction: Any) -> None:
         """POST a `predict` event back to the event server

@@ -1,0 +1,63 @@
+"""FakeRun — run an arbitrary function under the exact workflow environment.
+
+Reference parity: ``core/src/main/scala/org/apache/predictionio/workflow/
+FakeWorkflow.scala:18-109`` — ``FakeRun`` is an ``Evaluation`` whose
+"evaluator" just calls a user function with the SparkContext, and whose
+result carries ``noSave = true`` so nothing is persisted. It exists so new
+features can be developed with ``pio eval HelloWorld`` and the full env
+(storage config, logging, cleanup hooks) without defining DASE components.
+
+Here the function receives the :class:`WorkflowContext` (the SparkContext
+analogue: mesh + storage + mode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+from predictionio_tpu.workflow.context import WorkflowContext
+
+
+@dataclasses.dataclass
+class FakeEvalResult:
+    """Sentinel result; ``no_save=True`` keeps run_evaluation from writing an
+    EvaluationInstance (ref ``FakeEvalResult.noSave``)."""
+
+    value: Any = None
+    no_save: bool = True
+
+    def one_liner(self) -> str:
+        return "FakeRun (not persisted)"
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return {"fakeRun": True}
+
+    def to_html(self) -> str:
+        return "<p>FakeRun (not persisted)</p>"
+
+
+class FakeRun:
+    """Wraps ``func(ctx) -> Any`` as an Evaluation-shaped object accepted by
+    ``run_evaluation`` and ``pio eval`` (ref ``FakeRun`` trait usage:
+    ``pio eval HelloWorld`` with ``func = f``).
+
+    Subclass and set ``func``, or construct with the function::
+
+        class HelloWorld(FakeRun):
+            @staticmethod
+            def func(ctx):
+                print("hello from", ctx.mode)
+    """
+
+    func: Callable[[WorkflowContext], Any] | None = None
+
+    def __init__(self, func: Callable[[WorkflowContext], Any] | None = None):
+        if func is not None:
+            self.func = func  # type: ignore[assignment]
+
+    def run(self, ctx: WorkflowContext) -> FakeEvalResult:
+        fn = self.func
+        if fn is None:
+            raise ValueError("FakeRun has no func")
+        return FakeEvalResult(value=fn(ctx))

@@ -1,0 +1,194 @@
+"""Batch-view API tests (ref data/view/: DataView, LBatchView EventSeq).
+
+Ref test models: the view code has no dedicated spec in the reference (it is
+deprecated), so these pin the semantics SURVEY.md documents: strict-after
+start-time predicate, ordered per-entity folds, DataView's hash-keyed cache.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import warnings
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import view
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+
+UTC = dt.timezone.utc
+T0 = dt.datetime(2024, 1, 1, tzinfo=UTC)
+
+
+def _ev(name, eid, day, props=None, target=None):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=props or {},
+        event_time=T0 + dt.timedelta(days=day),
+    )
+
+
+def _seq(events):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        return view.EventSeq(events)
+
+
+class TestEventSeq:
+    def test_deprecation_warned(self):
+        with pytest.warns(DeprecationWarning):
+            view.EventSeq([])
+
+    def test_filter_event_and_entity_type(self):
+        es = _seq([_ev("view", "u1", 0), _ev("buy", "u1", 1), _ev("view", "u2", 2)])
+        assert len(es.filter(event="view")) == 2
+        assert len(es.filter(event="buy", entity_type="user")) == 1
+
+    def test_start_time_strictly_after(self):
+        # ref ViewPredicates.getStartTimePredicate: excludes equality
+        es = _seq([_ev("view", "u1", 0), _ev("view", "u1", 1)])
+        assert len(es.filter(start_time=T0)) == 1
+        assert len(es.filter(until_time=T0 + dt.timedelta(days=1))) == 1
+
+    def test_aggregate_by_entity_ordered(self):
+        # out-of-order input must fold in event-time order
+        es = _seq(
+            [
+                _ev("buy", "u1", 2, props={"n": 3}),
+                _ev("buy", "u1", 0, props={"n": 1}),
+                _ev("buy", "u2", 1, props={"n": 5}),
+            ]
+        )
+        folds = es.aggregate_by_entity_ordered(
+            init=[], op=lambda acc, e: acc + [e.properties.get("n")]
+        )
+        assert folds == {"u1": [1, 3], "u2": [5]}
+
+    def test_datamap_aggregator_set_unset_delete(self):
+        agg = view.datamap_aggregator()
+        acc = None
+        acc = agg(acc, _ev("$set", "u1", 0, props={"a": 1, "b": 2}))
+        acc = agg(acc, _ev("$set", "u1", 1, props={"b": 3}))
+        acc = agg(acc, _ev("$unset", "u1", 2, props={"a": 0}))
+        assert isinstance(acc, DataMap) and acc.fields == {"b": 3}
+        assert agg(acc, _ev("$delete", "u1", 3)) is None
+        # non-special events are ignored
+        assert agg(acc, _ev("view", "u1", 4)).fields == {"b": 3}
+
+
+@pytest.fixture
+def app_with_events(memory_storage):
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "viewapp"))
+    lev = memory_storage.get_l_events()
+    for i in range(6):
+        lev.insert(
+            _ev("rate", f"u{i % 2}", i, props={"rating": float(i)}, target=f"i{i}"),
+            app_id,
+        )
+    return memory_storage
+
+
+class TestDataView:
+    def test_create_and_cache(self, app_with_events, tmp_path):
+        calls = []
+
+        def convert(e: Event):
+            calls.append(1)
+            if e.properties.get("rating", 0) < 1:
+                return None  # dropped rows
+            return {
+                "user": e.entity_id,
+                "item": e.target_entity_id,
+                "rating": e.properties["rating"],
+            }
+
+        until = T0 + dt.timedelta(days=30)
+        cols = view.create(
+            "viewapp",
+            convert,
+            until_time=until,
+            name="ratings",
+            version="v1",
+            base_dir=str(tmp_path),
+        )
+        assert set(cols) == {"user", "item", "rating"}
+        assert len(cols["rating"]) == 5  # one dropped by conversion
+        assert cols["rating"].dtype == np.float64
+        n_calls = len(calls)
+
+        # second call: served from cache, conversion not re-run
+        cols2 = view.create(
+            "viewapp",
+            convert,
+            until_time=until,
+            name="ratings",
+            version="v1",
+            base_dir=str(tmp_path),
+        )
+        assert len(calls) == n_calls
+        np.testing.assert_array_equal(cols2["rating"], cols["rating"])
+
+        # version bump invalidates
+        view.create(
+            "viewapp",
+            convert,
+            until_time=until,
+            name="ratings",
+            version="v2",
+            base_dir=str(tmp_path),
+        )
+        assert len(calls) > n_calls
+
+    def test_tuple_and_dataclass_records(self, app_with_events, tmp_path):
+        cols = view.create(
+            "viewapp",
+            lambda e: (e.entity_id, e.properties.get("rating", 0.0)),
+            until_time=T0 + dt.timedelta(days=30),
+            name="tup",
+            base_dir=str(tmp_path),
+        )
+        assert set(cols) == {"c0", "c1"}
+
+    def test_channel_has_own_cache_key(self, app_with_events, tmp_path):
+        # regression: channel_name must be part of the cache key or two
+        # channels of the same app silently share one cached view
+        from predictionio_tpu.data.storage.base import Channel
+
+        st = app_with_events
+        app = st.get_meta_data_apps().get_by_name("viewapp")
+        st.get_meta_data_channels().insert(Channel(0, "mobile", app.id))
+        until = T0 + dt.timedelta(days=30)
+        default_cols = view.create(
+            "viewapp",
+            lambda e: {"u": e.entity_id},
+            until_time=until,
+            name="chan",
+            base_dir=str(tmp_path),
+        )
+        assert len(default_cols["u"]) == 6
+        mobile_cols = view.create(
+            "viewapp",
+            lambda e: {"u": e.entity_id},
+            channel_name="mobile",
+            until_time=until,
+            name="chan",
+            base_dir=str(tmp_path),
+        )
+        assert mobile_cols == {}  # empty channel, not the default's cache
+
+    def test_empty_result(self, app_with_events, tmp_path):
+        cols = view.create(
+            "viewapp",
+            lambda e: None,
+            until_time=T0 + dt.timedelta(days=30),
+            name="none",
+            base_dir=str(tmp_path),
+        )
+        assert cols == {}

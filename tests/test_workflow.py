@@ -186,3 +186,110 @@ class TestEngineIdentity:
             _json.dumps({"id": "my-engine", "engineFactory": "x.y"})
         )
         assert load_manifest(str(d)).engine_id == "my-engine"
+
+
+class TestFakeRun:
+    """Ref FakeWorkflow.scala:18-109 — arbitrary func under the workflow env,
+    result never persisted (noSave)."""
+
+    def test_func_runs_and_nothing_persisted(self, memory_storage):
+        from predictionio_tpu.workflow.core_workflow import run_evaluation
+        from predictionio_tpu.workflow.fake_workflow import FakeRun
+
+        seen = {}
+
+        def f(ctx):
+            seen["mode"] = ctx.mode
+            return 42
+
+        instance_id, result = run_evaluation(
+            FakeRun(f), storage=memory_storage, batch="hello"
+        )
+        assert seen["mode"] == "evaluation"
+        assert result.value == 42 and result.no_save
+        inst = memory_storage.get_meta_data_evaluation_instances().get(instance_id)
+        # instance record exists but results were never written back
+        assert inst is not None
+        assert inst.evaluator_results == ""
+        assert inst.status != "EVALCOMPLETED"
+
+    def test_subclass_style(self, memory_storage):
+        from predictionio_tpu.workflow.core_workflow import run_evaluation
+        from predictionio_tpu.workflow.fake_workflow import FakeRun
+
+        class Hello(FakeRun):
+            @staticmethod
+            def func(ctx):
+                return "hi"
+
+        _, result = run_evaluation(Hello(), storage=memory_storage)
+        assert result.value == "hi"
+
+    def test_no_func_raises(self):
+        from predictionio_tpu.workflow.context import WorkflowContext
+        from predictionio_tpu.workflow.fake_workflow import FakeRun
+
+        with pytest.raises(ValueError):
+            FakeRun().run(WorkflowContext(mode="evaluation"))
+
+
+class TestRemoteLog:
+    """Ref CreateServer.scala:423-434,595-611 — --log-url ships serving
+    errors to an HTTP collector as log_prefix + JSON{engineInstance, message}."""
+
+    def test_query_error_shipped_to_collector(self, memory_storage):
+        import asyncio
+
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from predictionio_tpu.workflow.create_server import QueryServer, ServerConfig
+        from tests.test_engine import make_engine, params
+
+        received = []
+
+        async def collect(request):
+            received.append(await request.text())
+            return web.json_response({})
+
+        engine = make_engine()
+        ep = params()
+
+        async def body():
+            collector = web.Application()
+            collector.router.add_post("/log", collect)
+            cserver = TestServer(collector)
+            await cserver.start_server()
+            try:
+                url = f"http://{cserver.host}:{cserver.port}/log"
+                qs = QueryServer(
+                    engine=engine,
+                    engine_params=ep,
+                    models=[object()],
+                    manifest=manifest(),
+                    instance_id="inst-1",
+                    storage=memory_storage,
+                    config=ServerConfig(log_url=url, log_prefix="PFX"),
+                )
+                client = TestClient(TestServer(qs.make_app()))
+                await client.start_server()
+                try:
+                    resp = await client.post("/queries.json", json={"bogus": 1})
+                    assert resp.status == 400
+                    # the remote log POST is fire-and-forget; let it land
+                    for _ in range(50):
+                        if received:
+                            break
+                        await asyncio.sleep(0.02)
+                finally:
+                    await client.close()
+            finally:
+                await cserver.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(body())
+        assert received, "collector never received the error log"
+        body_text = received[0]
+        assert body_text.startswith("PFX")
+        payload = json.loads(body_text.removeprefix("PFX"))
+        assert payload["engineInstance"] == "inst-1"
+        assert "Stack Trace" in payload["message"]
