@@ -16,6 +16,7 @@ from predictionio_tpu.models.classification.engine import (
     RandomForestAlgorithm,
     Serving,
     TrainingData,
+    custom_properties_engine_factory,
     engine_factory,
 )
 
@@ -30,5 +31,6 @@ __all__ = [
     "RandomForestAlgorithm",
     "Serving",
     "TrainingData",
+    "custom_properties_engine_factory",
     "engine_factory",
 ]

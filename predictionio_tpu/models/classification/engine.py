@@ -123,13 +123,18 @@ class DataSource(BaseDataSource):
             td = TrainingData(labels[train_idx], features[train_idx])
             qa = [
                 (
-                    Query(*[float(x) for x in features[i][:3]]),
+                    self._make_query(features[i]),
                     ActualResult(float(labels[i])),
                 )
                 for i in test_idx
             ]
             folds.append((td, {}, qa))
         return folds
+
+    def _make_query(self, features_row: np.ndarray):
+        """Eval-query constructor; variants with a different Query shape
+        override this so read_eval stays consistent with their features."""
+        return Query(*[float(x) for x in features_row[:3]])
 
 
 class Preparator(BasePreparator):
@@ -196,4 +201,63 @@ def engine_factory() -> Engine:
         {"naive": NaiveBayesAlgorithm, "randomforest": RandomForestAlgorithm},
         Serving,
         query_class=Query,
+    )
+
+
+# ---------------------------------------------------------------------------
+# reading-custom-properties variant (ref examples/scala-parallel-classification/
+# reading-custom-properties/src/main/scala/DataSource.scala:49-66, Engine.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CustomPropertiesQuery:
+    """Four named features instead of attr0-2 (ref variant Engine.scala)."""
+
+    feature_a: float
+    feature_b: float
+    feature_c: float
+    feature_d: float
+
+    @staticmethod
+    def from_json_dict(d: dict[str, Any]) -> "CustomPropertiesQuery":
+        return CustomPropertiesQuery(
+            float(d["featureA"]),
+            float(d["featureB"]),
+            float(d["featureC"]),
+            float(d["featureD"]),
+        )
+
+    def to_array(self) -> np.ndarray:
+        return np.array(
+            [self.feature_a, self.feature_b, self.feature_c, self.feature_d],
+            np.float64,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class CustomPropertiesDataSourceParams(DataSourceParams):
+    label_property: str = "label"
+    attr_properties: tuple[str, ...] = (
+        "featureA",
+        "featureB",
+        "featureC",
+        "featureD",
+    )
+
+
+class CustomPropertiesDataSource(DataSource):
+    params_class = CustomPropertiesDataSourceParams
+
+    def _make_query(self, features_row: np.ndarray):
+        return CustomPropertiesQuery(*[float(x) for x in features_row[:4]])
+
+
+def custom_properties_engine_factory() -> Engine:
+    return Engine(
+        CustomPropertiesDataSource,
+        Preparator,
+        {"naive": NaiveBayesAlgorithm, "randomforest": RandomForestAlgorithm},
+        Serving,
+        query_class=CustomPropertiesQuery,
     )

@@ -164,6 +164,9 @@ class ECommAlgorithmParams(Params):
     lambda_: float = 0.01
     alpha: float = 1.0
     seed: int | None = 3
+    # adjust-score variant: enable the per-request weightedItems constraint
+    # lookup (off by default — it costs one event-store query per predict)
+    adjust_score: bool = False
 
 
 @dataclasses.dataclass
@@ -290,6 +293,39 @@ class ECommAlgorithm(JaxAlgorithm):
             logger.exception("unavailable-items lookup failed; assuming none")
         return set()
 
+    def _item_weights(self, ctx: WorkflowContext, model: ECommModel) -> np.ndarray | None:
+        """adjust-score variant (ref adjust-score/ECommAlgorithm.scala:56-58,
+        256-263,400-430): latest $set on (constraint, weightedItems) carries
+        ``weights``: [{"items": [...], "weight": w}]; scores of listed items
+        are multiplied by w, everything else by 1.0. Returns None when no
+        constraint is set so the multiply can be skipped entirely."""
+        try:
+            events = list(
+                ctx.l_event_store().find_by_entity(
+                    app_name=self.params.app_name or ctx.app_name,
+                    entity_type="constraint",
+                    entity_id="weightedItems",
+                    event_names=["$set"],
+                    limit=1,
+                )
+            )
+        except Exception:
+            logger.exception("weightedItems lookup failed; weights ignored")
+            return None
+        if not events:
+            return None
+        groups = events[0].properties.get_or_else("weights", [])
+        if not groups:
+            return None
+        weights = np.ones(len(model.item_vocab), np.float64)
+        for group in groups:
+            w = float(group.get("weight", 1.0))
+            for it in group.get("items", []):
+                idx = model.item_index(str(it))
+                if idx is not None:
+                    weights[idx] = w
+        return weights
+
     def _recent_item_indices(self, ctx: WorkflowContext, model: ECommModel, user: str) -> list[int]:
         """Last 10 similar-event items (ref :302-320)."""
         try:
@@ -334,6 +370,11 @@ class ECommAlgorithm(JaxAlgorithm):
                 scores = np.asarray(jnp.sum(model.device_items() @ q.T, axis=1))
             else:
                 scores = model.popular_counts.astype(np.float64)
+
+        if self.params.adjust_score:
+            weights = self._item_weights(ctx, model)
+            if weights is not None:
+                scores = scores * weights
 
         mask = np.ones(n, bool)
         if self.params.unseen_only:

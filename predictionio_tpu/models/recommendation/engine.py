@@ -44,12 +44,22 @@ from predictionio_tpu.workflow.context import WorkflowContext
 
 @dataclasses.dataclass(frozen=True)
 class Query:
+    """``blackList`` mirrors the blacklist-items variant
+    (``examples/scala-parallel-recommendation/blacklist-items/src/main/scala/
+    Engine.scala:23-27``); None means no filtering."""
+
     user: str
     num: int = 10
+    black_list: frozenset[str] | None = None
 
     @staticmethod
     def from_json_dict(d: dict[str, Any]) -> "Query":
-        return Query(user=str(d["user"]), num=int(d.get("num", 10)))
+        bl = d.get("blackList")
+        return Query(
+            user=str(d["user"]),
+            num=int(d.get("num", 10)),
+            black_list=frozenset(str(x) for x in bl) if bl is not None else None,
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,9 +105,16 @@ class EvalParams(Params):
 
 @dataclasses.dataclass(frozen=True)
 class DataSourceParams(Params):
+    """``rating_map`` generalises the reading-custom-events variant
+    (``reading-custom-events/src/main/scala/DataSource.scala:50-61``: like->4.0,
+    dislike->1.0) and train-with-view-event (view->1.0 + implicit ALS): each
+    listed event name is assigned a fixed rating value, overriding any
+    per-event "rating" property."""
+
     app_name: str = ""
     event_names: tuple[str, ...] = ("rate", "buy")
     buy_rating: float = 4.0  # ref: map buy event to rating 4
+    rating_map: dict[str, float] | None = None
     eval_params: EvalParams | None = None
 
 
@@ -121,11 +138,18 @@ class TrainingData(SanityCheck):
 
 
 def _columnar_to_ratings(
-    col: ColumnarEvents, buy_rating: float
+    col: ColumnarEvents,
+    buy_rating: float,
+    rating_map: dict[str, float] | None = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     ratings = col.ratings.copy()
-    buys = np.asarray([n == "buy" for n in col.event_names], dtype=bool)
-    ratings[buys] = buy_rating
+    if rating_map:
+        names = np.asarray(col.event_names)
+        for event_name, value in rating_map.items():
+            ratings[names == event_name] = float(value)
+    else:
+        buys = np.asarray([n == "buy" for n in col.event_names], dtype=bool)
+        ratings[buys] = buy_rating
     valid = np.isfinite(ratings) & (col.entity_ids >= 0) & (col.target_ids >= 0)
     return col.entity_ids[valid], col.target_ids[valid], ratings[valid]
 
@@ -147,7 +171,9 @@ class DataSource(BaseDataSource):
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         col = self._read_columnar(ctx)
-        u, i, r = _columnar_to_ratings(col, self.params.buy_rating)
+        u, i, r = _columnar_to_ratings(
+            col, self.params.buy_rating, self.params.rating_map
+        )
         return TrainingData(u, i, r, col.entity_vocab, col.target_vocab)
 
     def read_eval(self, ctx: WorkflowContext):
@@ -156,7 +182,9 @@ class DataSource(BaseDataSource):
             raise ValueError("Must specify evalParams for evaluation")
         ep = self.params.eval_params
         col = self._read_columnar(ctx)
-        u, i, r = _columnar_to_ratings(col, self.params.buy_rating)
+        u, i, r = _columnar_to_ratings(
+            col, self.params.buy_rating, self.params.rating_map
+        )
         idx = np.arange(len(u))
         folds = []
         for fold in range(ep.k_fold):
@@ -193,6 +221,41 @@ class Preparator(BasePreparator):
         return td
 
 
+@dataclasses.dataclass(frozen=True)
+class CustomPreparatorParams(Params):
+    filepath: str
+
+
+class CustomPreparator(BasePreparator):
+    """customize-data-prep variant (ref ``customize-data-prep/src/main/scala/
+    Preparator.scala:29-44``): drop ratings whose item appears in the
+    exclusion file (one item id per line)."""
+
+    params_class = CustomPreparatorParams
+    params: CustomPreparatorParams
+
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> TrainingData:
+        with open(self.params.filepath) as fh:
+            no_train_items = {line.strip() for line in fh if line.strip()}
+        if not no_train_items:
+            return td
+        excluded = np.asarray(
+            [item in no_train_items for item in td.item_vocab], dtype=bool
+        )
+        # drop the items from the vocab too, not just their ratings:
+        # rating-less items would get all-zero factors and could still be
+        # served at score 0.0 (MLlib never materialises factors for them)
+        new_of_old = np.cumsum(~excluded) - 1
+        keep = ~excluded[td.item_idx]
+        return TrainingData(
+            td.user_idx[keep],
+            new_of_old[td.item_idx[keep]].astype(td.item_idx.dtype),
+            td.ratings[keep],
+            td.user_vocab,
+            [it for it, ex in zip(td.item_vocab, excluded) if not ex],
+        )
+
+
 # ---------------------------------------------------------------------------
 # Algorithm
 # ---------------------------------------------------------------------------
@@ -217,6 +280,7 @@ class ALSModel(SanityCheck):
 
     def __post_init__(self):
         self._user_index: dict[str, int] | None = None
+        self._item_index: dict[str, int] | None = None
         self._serving_index = None
 
     def sanity_check(self) -> None:
@@ -231,6 +295,11 @@ class ALSModel(SanityCheck):
         if self._user_index is None:
             self._user_index = {u: i for i, u in enumerate(self.user_vocab)}
         return self._user_index.get(user)
+
+    def item_index(self, item: str) -> int | None:
+        if self._item_index is None:
+            self._item_index = {it: i for i, it in enumerate(self.item_vocab)}
+        return self._item_index.get(item)
 
     def serving_index(self):
         """Both factor tables resident on device; index-addressed top-k
@@ -252,6 +321,7 @@ class ALSModel(SanityCheck):
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._user_index = None
+        self._item_index = None
         self._serving_index = None
 
 
@@ -284,8 +354,18 @@ class ALSAlgorithm(JaxAlgorithm):
         uidx = model.user_index(query.user)
         if uidx is None:
             return PredictedResult(())  # unknown user -> empty result
+        mask = None
+        if query.black_list:
+            # blacklist-items variant (ref blacklist-items/ALSAlgorithm.scala:
+            # 95-111 recommendProductsWithFilter): device-side mask, so
+            # excluded items never reach the top-k
+            mask = np.ones(len(model.item_vocab), dtype=bool)
+            for item in query.black_list:
+                iidx = model.item_index(item)
+                if iidx is not None:
+                    mask[iidx] = False
         scores, idx = model.serving_index().serve(
-            uidx, min(query.num, len(model.item_vocab))
+            uidx, min(query.num, len(model.item_vocab)), mask=mask
         )
         return PredictedResult(
             tuple(
@@ -301,11 +381,37 @@ class Serving(BaseServing):
         return predictions[0]
 
 
+@dataclasses.dataclass(frozen=True)
+class ServingParams(Params):
+    filepath: str
+
+
+class FilterServing(BaseServing):
+    """customize-serving variant (ref ``customize-serving/src/main/scala/
+    Serving.scala:26-43``): re-read the disabled-items file on every request
+    (ops can edit it live, no redeploy) and drop those items from the
+    first algorithm's result."""
+
+    params_class = ServingParams
+    params: ServingParams
+
+    def serve(
+        self, query: Query, predictions: Sequence[PredictedResult]
+    ) -> PredictedResult:
+        with open(self.params.filepath) as fh:
+            disabled = {line.strip() for line in fh if line.strip()}
+        return PredictedResult(
+            tuple(
+                s for s in predictions[0].item_scores if s.item not in disabled
+            )
+        )
+
+
 def engine_factory() -> Engine:
     return Engine(
         DataSource,
-        Preparator,
+        {"": Preparator, "custom": CustomPreparator},
         {"als": ALSAlgorithm},
-        Serving,
+        {"": Serving, "filter": FilterServing},
         query_class=Query,
     )

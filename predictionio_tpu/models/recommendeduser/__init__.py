@@ -1,0 +1,34 @@
+"""Recommended-user template (similar users from follow events).
+
+Reference parity: ``examples/scala-parallel-similarproduct/recommended-user/``
+— follow events user->user, implicit ALS, query {users, num} returns
+similarUserScores.
+"""
+
+from predictionio_tpu.models.recommendeduser.engine import (
+    ALSAlgorithm,
+    DataSource,
+    DataSourceParams,
+    PredictedResult,
+    Preparator,
+    Query,
+    Serving,
+    SimilarUserModel,
+    SimilarUserScore,
+    TrainingData,
+    engine_factory,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "DataSource",
+    "DataSourceParams",
+    "PredictedResult",
+    "Preparator",
+    "Query",
+    "Serving",
+    "SimilarUserModel",
+    "SimilarUserScore",
+    "TrainingData",
+    "engine_factory",
+]

@@ -68,8 +68,20 @@ class Query:
 
 @dataclasses.dataclass(frozen=True)
 class ItemScore:
+    """``properties`` carries returned item attributes for the
+    return-item-properties variant (ref ``return-item-properties/src/main/
+    scala/Engine.scala:38-45`` adds title/date/imdbUrl fields); they are
+    flattened into the wire dict exactly like the reference's named fields."""
+
     item: str
     score: float
+    properties: dict[str, Any] | None = None
+
+    def to_json_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = dict(self.properties or {})
+        out["item"] = self.item
+        out["score"] = self.score
+        return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,14 +89,21 @@ class PredictedResult:
     item_scores: tuple[ItemScore, ...]
 
     def to_json_dict(self) -> dict[str, Any]:
-        return {
-            "itemScores": [{"item": s.item, "score": s.score} for s in self.item_scores]
-        }
+        return {"itemScores": [s.to_json_dict() for s in self.item_scores]}
 
 
 @dataclasses.dataclass(frozen=True)
 class DataSourceParams(Params):
+    """``item_property_names`` enables return-item-properties
+    (ref ``return-item-properties/DataSource.scala:60-75``: collect
+    title/date/imdbUrl per item); ``rate_event`` adds a rated-interaction
+    table for train-with-rate-event (ref ``train-with-rate-event/
+    DataSource.scala``: rate events with a rating property, latest rating
+    per (user,item) wins)."""
+
     app_name: str = ""
+    item_property_names: tuple[str, ...] = ()
+    rate_event: str | None = None
 
 
 @dataclasses.dataclass
@@ -96,10 +115,17 @@ class TrainingData(SanityCheck):
     view_item_idx: np.ndarray
     like_user_idx: np.ndarray
     like_item_idx: np.ndarray
+    # return-item-properties: per-item property dicts aligned with item_vocab
+    item_properties: list[dict[str, Any] | None] | None = None
+    # train-with-rate-event: latest rating per (user, item)
+    rate_user_idx: np.ndarray | None = None
+    rate_item_idx: np.ndarray | None = None
+    rate_values: np.ndarray | None = None
 
     def sanity_check(self) -> None:
-        if len(self.view_user_idx) == 0 and len(self.like_user_idx) == 0:
-            raise ValueError("no view/like events found; check app data")
+        n_rates = 0 if self.rate_user_idx is None else len(self.rate_user_idx)
+        if len(self.view_user_idx) == 0 and len(self.like_user_idx) == 0 and n_rates == 0:
+            raise ValueError("no view/like/rate events found; check app data")
 
 
 class DataSource(BaseDataSource):
@@ -109,33 +135,69 @@ class DataSource(BaseDataSource):
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         store = ctx.p_event_store()
         app_name = self.params.app_name or ctx.app_name
+        event_names = ["view", "like"]
+        if self.params.rate_event:
+            event_names.append(self.params.rate_event)
         col = store.to_columnar(
             app_name=app_name,
             channel_name=ctx.channel_name,
-            event_names=["view", "like"],
+            event_names=event_names,
             entity_type="user",
             target_entity_type="item",
+            rating_key="rating",
         )
         item_vocab = list(col.target_vocab)
         item_index = {v: i for i, v in enumerate(item_vocab)}
-        # item categories from $set properties of item entities
+        # item categories (+ optional returned properties) from $set
+        # properties of item entities
         item_props = store.aggregate_properties(
             app_name=app_name, entity_type="item", channel_name=ctx.channel_name
         )
         categories: list[frozenset[str] | None] = [None] * len(item_vocab)
+        wanted = self.params.item_property_names
+        properties: list[dict[str, Any] | None] | None = (
+            [None] * len(item_vocab) if wanted else None
+        )
         for entity_id, pm in item_props.items():
             idx = item_index.get(entity_id)
             if idx is None:
                 item_index[entity_id] = len(item_vocab)
                 item_vocab.append(entity_id)
                 categories.append(None)
+                if properties is not None:
+                    properties.append(None)
                 idx = item_index[entity_id]
             cats = pm.get_opt("categories")
             if cats is not None:
                 categories[idx] = frozenset(cats)
+            if properties is not None:
+                properties[idx] = {
+                    name: pm.get_opt(name)
+                    for name in wanted
+                    if pm.get_opt(name) is not None
+                }
         views = np.asarray([n == "view" for n in col.event_names], bool)
         likes = np.asarray([n == "like" for n in col.event_names], bool)
         valid = (col.entity_ids >= 0) & (col.target_ids >= 0)
+        rate_u = rate_i = rate_v = None
+        if self.params.rate_event:
+            rates = np.asarray(
+                [n == self.params.rate_event for n in col.event_names], bool
+            )
+            sel = rates & valid & np.isfinite(col.ratings)
+            # latest rating per (user, item) wins (ref train-with-rate-event/
+            # ALSAlgorithm.scala:101-117 reduceByKey on timestamp)
+            order = np.argsort(col.timestamps[sel], kind="stable")
+            u, i, v = (
+                col.entity_ids[sel][order],
+                col.target_ids[sel][order],
+                col.ratings[sel][order],
+            )
+            pairs = np.stack([u, i], 1)
+            # np.unique keeps the FIRST occurrence; reverse so first == latest
+            _, first = np.unique(pairs[::-1], axis=0, return_index=True)
+            keep = len(u) - 1 - first
+            rate_u, rate_i, rate_v = u[keep], i[keep], v[keep].astype(np.float32)
         return TrainingData(
             user_vocab=col.entity_vocab,
             item_vocab=item_vocab,
@@ -144,6 +206,10 @@ class DataSource(BaseDataSource):
             view_item_idx=col.target_ids[views & valid],
             like_user_idx=col.entity_ids[likes & valid],
             like_item_idx=col.target_ids[likes & valid],
+            item_properties=properties,
+            rate_user_idx=rate_u,
+            rate_item_idx=rate_i,
+            rate_values=rate_v,
         )
 
 
@@ -162,10 +228,16 @@ class SimilarModel(SanityCheck):
     item_factors: np.ndarray  # [n_items, f], L2-normalized rows
     item_vocab: list[str]
     item_categories: list[frozenset[str] | None]
+    item_properties: list[dict[str, Any] | None] | None = None
 
     def __post_init__(self):
         self._index: dict[str, int] | None = None
         self._device_factors = None
+
+    def properties_of(self, i: int) -> dict[str, Any] | None:
+        if self.item_properties is None:
+            return None
+        return self.item_properties[i]
 
     def sanity_check(self) -> None:
         if not np.all(np.isfinite(self.item_factors)):
@@ -188,9 +260,11 @@ class SimilarModel(SanityCheck):
             "item_factors": self.item_factors,
             "item_vocab": self.item_vocab,
             "item_categories": self.item_categories,
+            "item_properties": self.item_properties,
         }
 
     def __setstate__(self, state):
+        state.setdefault("item_properties", None)
         self.__dict__.update(state)
         self._index = None
         self._device_factors = None
@@ -270,6 +344,19 @@ class _ALSBase(JaxAlgorithm):
             return pd.view_user_idx, pd.view_item_idx
         return pd.like_user_idx, pd.like_item_idx
 
+    @staticmethod
+    def _build_model(item_factors, pd: TrainingData) -> SimilarModel:
+        """L2-normalise for cosine scoring and package with vocab/metadata."""
+        vf = np.asarray(item_factors)
+        norms = np.linalg.norm(vf, axis=1, keepdims=True)
+        vf = vf / np.where(norms == 0, 1.0, norms)
+        return SimilarModel(
+            vf,
+            list(pd.item_vocab),
+            list(pd.item_categories),
+            pd.item_properties,
+        )
+
     def train(self, ctx: WorkflowContext, pd: TrainingData) -> SimilarModel:
         users, items = self._interactions(pd)
         if len(users) == 0:
@@ -294,10 +381,7 @@ class _ALSBase(JaxAlgorithm):
             len(pd.item_vocab),
             cfg,
         )
-        vf = np.asarray(item_factors)
-        norms = np.linalg.norm(vf, axis=1, keepdims=True)
-        vf = vf / np.where(norms == 0, 1.0, norms)  # pre-normalize for cosine
-        return SimilarModel(vf, list(pd.item_vocab), list(pd.item_categories))
+        return self._build_model(item_factors, pd)
 
     def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
         query_idx = [
@@ -309,12 +393,43 @@ class _ALSBase(JaxAlgorithm):
         mask = candidate_mask(model, query, query_idx)
         top = _topk_filtered(scores, mask, query.num)
         return PredictedResult(
-            tuple(ItemScore(model.item_vocab[i], s) for i, s in top)
+            tuple(
+                ItemScore(model.item_vocab[i], s, model.properties_of(i))
+                for i, s in top
+            )
         )
 
 
 class ALSAlgorithm(_ALSBase):
     event_kind = "view"
+
+
+class RateALSAlgorithm(_ALSBase):
+    """train-with-rate-event variant (ref ``train-with-rate-event/
+    ALSAlgorithm.scala:66-129``): explicit ALS on the latest rating per
+    (user, item) instead of implicit ALS on view counts."""
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> SimilarModel:
+        if pd.rate_user_idx is None or len(pd.rate_user_idx) == 0:
+            raise ValueError(
+                "no rate events to train on; set DataSourceParams.rate_event"
+            )
+        cfg = ALSConfig(
+            rank=self.params.rank,
+            iterations=self.params.num_iterations,
+            reg=self.params.lambda_,
+            implicit=False,
+            seed=self.params.seed if self.params.seed is not None else 0,
+        )
+        _, item_factors = als_train(
+            pd.rate_user_idx,
+            pd.rate_item_idx,
+            pd.rate_values,
+            len(pd.user_vocab),
+            len(pd.item_vocab),
+            cfg,
+        )
+        return self._build_model(item_factors, pd)
 
 
 class LikeAlgorithm(_ALSBase):
@@ -333,6 +448,7 @@ class CooccurrenceModel:
     top_map: dict[int, list[tuple[int, int]]]
     item_vocab: list[str]
     item_categories: list[frozenset[str] | None]
+    item_properties: list[dict[str, Any] | None] | None = None
 
     def __post_init__(self):
         self._index = {v: i for i, v in enumerate(self.item_vocab)}
@@ -340,14 +456,21 @@ class CooccurrenceModel:
     def item_index(self, item: str) -> int | None:
         return self._index.get(item)
 
+    def properties_of(self, i: int) -> dict[str, Any] | None:
+        if self.item_properties is None:
+            return None
+        return self.item_properties[i]
+
     def __getstate__(self):
         return {
             "top_map": self.top_map,
             "item_vocab": self.item_vocab,
             "item_categories": self.item_categories,
+            "item_properties": self.item_properties,
         }
 
     def __setstate__(self, state):
+        state.setdefault("item_properties", None)
         self.__dict__.update(state)
         self._index = {v: i for i, v in enumerate(self.item_vocab)}
 
@@ -361,7 +484,10 @@ class CooccurrenceAlgorithm(LocalAlgorithm):
             pd.view_user_idx, pd.view_item_idx, len(pd.item_vocab), self.params.n
         )
         return CooccurrenceModel(
-            top_map, list(pd.item_vocab), list(pd.item_categories)
+            top_map,
+            list(pd.item_vocab),
+            list(pd.item_categories),
+            pd.item_properties,
         )
 
     def predict(self, model: CooccurrenceModel, query: Query) -> PredictedResult:
@@ -380,7 +506,10 @@ class CooccurrenceAlgorithm(LocalAlgorithm):
             scores[i] = s
         top = _topk_filtered(scores, mask, query.num)
         return PredictedResult(
-            tuple(ItemScore(model.item_vocab[i], s) for i, s in top)
+            tuple(
+                ItemScore(model.item_vocab[i], s, model.properties_of(i))
+                for i, s in top
+            )
         )
 
 
@@ -397,6 +526,7 @@ def engine_factory() -> Engine:
             "als": ALSAlgorithm,
             "cooccurrence": CooccurrenceAlgorithm,
             "likealgo": LikeAlgorithm,
+            "rateals": RateALSAlgorithm,
         },
         Serving,
         query_class=Query,

@@ -429,3 +429,459 @@ class TestTwoTower:
         r1 = algos[0].predict(models[0], Query(user="u1", num=3))
         r2 = algos[0].predict(restored, Query(user="u1", num=3))
         assert [s.item for s in r1.item_scores] == [s.item for s in r2.item_scores]
+
+
+# ---------------------------------------------------------------------------
+# recommendation variants (ref examples/scala-parallel-recommendation/*)
+# ---------------------------------------------------------------------------
+
+
+class TestRecommendationVariants:
+    def seed(self, storage, like_dislike=False, views=False):
+        app_id, levents = seed_app(storage)
+        events = []
+        rng = np.random.default_rng(0)
+        for u in range(20):
+            for i in range(15):
+                if (u + i) % 4 == 0:
+                    continue
+                if like_dislike:
+                    name = "like" if (u + i) % 3 == 0 else "dislike"
+                    props = {}
+                elif views:
+                    name, props = "view", {}
+                else:
+                    name = "rate"
+                    props = {"rating": 5.0 if (u + i) % 3 == 0 else 1.0}
+                events.append(
+                    Event(
+                        event=name,
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties=DataMap(props),
+                    )
+                )
+        levents.insert_batch(events, app_id)
+
+    def make(self, storage, variant):
+        from predictionio_tpu.models.recommendation.engine import engine_factory
+
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(variant)
+        models = engine.train(ctx(storage), ep)
+        _, _, algos, serving = engine.make_components(ep)
+        return engine, algos, models, serving
+
+    def base_variant(self, **extra):
+        v = {
+            "datasource": {"params": {"appName": APP}},
+            "algorithms": [
+                {
+                    "name": "als",
+                    "params": {"rank": 8, "numIterations": 8, "lambda": 0.05, "seed": 1},
+                }
+            ],
+        }
+        v.update(extra)
+        return v
+
+    def test_blacklist_items_excluded(self, memory_storage):
+        from predictionio_tpu.models.recommendation.engine import Query
+
+        self.seed(memory_storage)
+        engine, algos, models, serving = self.make(memory_storage, self.base_variant())
+        full = algos[0].predict(models[0], Query(user="u1", num=5))
+        assert len(full.item_scores) == 5
+        banned = frozenset(s.item for s in full.item_scores[:2])
+        filtered = algos[0].predict(
+            models[0], Query(user="u1", num=5, black_list=banned)
+        )
+        got = {s.item for s in filtered.item_scores}
+        assert not (got & banned)
+        assert len(filtered.item_scores) == 5  # backfilled from next-best
+
+    def test_blacklist_query_decode(self):
+        from predictionio_tpu.models.recommendation.engine import Query
+
+        q = Query.from_json_dict({"user": "u1", "num": 3, "blackList": ["i1", "i2"]})
+        assert q.black_list == frozenset({"i1", "i2"})
+
+    def test_customize_serving_filters_disabled_file(self, memory_storage, tmp_path):
+        from predictionio_tpu.models.recommendation.engine import Query
+
+        self.seed(memory_storage)
+        disabled = tmp_path / "disabled_items.txt"
+        disabled.write_text("")  # nothing disabled yet
+        variant = self.base_variant(
+            serving={"name": "filter", "params": {"filepath": str(disabled)}}
+        )
+        engine, algos, models, serving = self.make(memory_storage, variant)
+        q = Query(user="u2", num=4)
+        preds = [algos[0].predict(models[0], q)]
+        assert len(serving.serve(q, preds).item_scores) == 4
+        # live edit: disable the top item, no retrain/redeploy
+        top = preds[0].item_scores[0].item
+        disabled.write_text(top + "\n")
+        served = serving.serve(q, preds)
+        assert top not in {s.item for s in served.item_scores}
+
+    def test_customize_data_prep_excludes_items(self, memory_storage, tmp_path):
+        self.seed(memory_storage)
+        exclude = tmp_path / "no_train.txt"
+        exclude.write_text("i3\ni4\n")
+        variant = self.base_variant(
+            preparator={"name": "custom", "params": {"filepath": str(exclude)}}
+        )
+        from predictionio_tpu.models.recommendation.engine import engine_factory
+
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(variant)
+        ds, prep, _, _ = engine.make_components(ep)
+        td = ds.read_training(ctx(memory_storage))
+        pd = prep.prepare(ctx(memory_storage), td)
+        # excluded items leave the vocab entirely (no zero-factor rows that
+        # could still be served at score 0.0)
+        assert "i3" not in pd.item_vocab and "i4" not in pd.item_vocab
+        assert len(pd.ratings) < len(td.ratings)
+        # remaining indices still map to the right ids
+        kept = sorted(set(pd.item_idx.tolist()))
+        assert all(0 <= i < len(pd.item_vocab) for i in kept)
+
+    def test_reading_custom_events_rating_map(self, memory_storage):
+        self.seed(memory_storage, like_dislike=True)
+        variant = self.base_variant(
+            datasource={
+                "params": {
+                    "appName": APP,
+                    "eventNames": ["like", "dislike"],
+                    "ratingMap": {"like": 4.0, "dislike": 1.0},
+                }
+            }
+        )
+        from predictionio_tpu.models.recommendation.engine import engine_factory
+
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(variant)
+        ds, _, _, _ = engine.make_components(ep)
+        td = ds.read_training(ctx(memory_storage))
+        assert set(np.unique(td.ratings)) == {1.0, 4.0}
+
+    def test_train_with_view_event_implicit(self, memory_storage):
+        from predictionio_tpu.models.recommendation.engine import Query
+
+        self.seed(memory_storage, views=True)
+        variant = self.base_variant(
+            datasource={
+                "params": {
+                    "appName": APP,
+                    "eventNames": ["view"],
+                    "ratingMap": {"view": 1.0},
+                }
+            },
+            algorithms=[
+                {
+                    "name": "als",
+                    "params": {
+                        "rank": 8,
+                        "numIterations": 8,
+                        "lambda": 0.05,
+                        "seed": 1,
+                        "implicitPrefs": True,
+                        "alpha": 1.0,
+                    },
+                }
+            ],
+        )
+        engine, algos, models, serving = self.make(memory_storage, variant)
+        res = algos[0].predict(models[0], Query(user="u0", num=5))
+        assert len(res.item_scores) == 5
+
+    def test_variant_files_parse(self):
+        import json as _json
+        import os
+
+        from predictionio_tpu.models.recommendation.engine import engine_factory
+
+        engine = engine_factory()
+        vdir = os.path.join(
+            os.path.dirname(
+                __import__(
+                    "predictionio_tpu.models.recommendation", fromlist=["x"]
+                ).__file__
+            ),
+            "variants",
+        )
+        files = sorted(os.listdir(vdir))
+        assert len(files) == 4
+        for f in files:
+            with open(os.path.join(vdir, f)) as fh:
+                engine.engine_params_from_variant(_json.load(fh))
+
+
+# ---------------------------------------------------------------------------
+# similar-product variants (ref examples/scala-parallel-similarproduct/*)
+# ---------------------------------------------------------------------------
+
+
+class TestSimilarProductVariants:
+    def seed(self, storage):
+        app_id, levents = seed_app(storage)
+        events = []
+        # item properties: title/date + categories
+        for i in range(10):
+            events.append(
+                Event(
+                    event="$set",
+                    entity_type="item",
+                    entity_id=f"i{i}",
+                    properties=DataMap(
+                        {
+                            "title": f"Movie {i}",
+                            "date": f"199{i % 10}",
+                            "imdbUrl": f"http://imdb/{i}",
+                            "categories": ["c0" if i < 5 else "c1"],
+                        }
+                    ),
+                )
+            )
+        rng = np.random.default_rng(0)
+        for u in range(16):
+            # two taste clusters over items, views + rates
+            cluster = range(5) if u % 2 == 0 else range(5, 10)
+            for i in cluster:
+                events.append(
+                    Event(
+                        event="view",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                    )
+                )
+                # two rate events for the same pair: later one must win
+                for rating, days in ((2.0, 0), (4.0, 1)):
+                    events.append(
+                        Event(
+                            event="rate",
+                            entity_type="user",
+                            entity_id=f"u{u}",
+                            target_entity_type="item",
+                            target_entity_id=f"i{i}",
+                            properties=DataMap({"rating": rating}),
+                            event_time=__import__("datetime").datetime(
+                                2024, 1, 1 + days,
+                                tzinfo=__import__("datetime").timezone.utc,
+                            ),
+                        )
+                    )
+        levents.insert_batch(events, app_id)
+
+    def make(self, storage, variant):
+        from predictionio_tpu.models.similarproduct.engine import engine_factory
+
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(variant)
+        models = engine.train(ctx(storage), ep)
+        _, _, algos, _ = engine.make_components(ep)
+        return algos, models
+
+    def test_return_item_properties(self, memory_storage):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        self.seed(memory_storage)
+        algos, models = self.make(
+            memory_storage,
+            {
+                "datasource": {
+                    "params": {
+                        "appName": APP,
+                        "itemPropertyNames": ["title", "date", "imdbUrl"],
+                    }
+                },
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {"rank": 6, "numIterations": 6, "lambda": 0.05},
+                    }
+                ],
+            },
+        )
+        res = algos[0].predict(models[0], Query(items=("i0",), num=3))
+        assert res.item_scores
+        wire = res.to_json_dict()["itemScores"][0]
+        # properties are flattened next to item/score like the reference
+        assert set(wire) >= {"item", "score", "title", "date", "imdbUrl"}
+        assert wire["title"].startswith("Movie ")
+
+    def test_train_with_rate_event_latest_wins(self, memory_storage):
+        from predictionio_tpu.models.similarproduct.engine import engine_factory
+
+        self.seed(memory_storage)
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(
+            {
+                "datasource": {"params": {"appName": APP, "rateEvent": "rate"}},
+                "algorithms": [
+                    {
+                        "name": "rateals",
+                        "params": {"rank": 6, "numIterations": 6, "lambda": 0.05},
+                    }
+                ],
+            }
+        )
+        ds, _, algos, _ = engine.make_components(ep)
+        td = ds.read_training(ctx(memory_storage))
+        # dedup kept exactly one rating per (user, item), the later 4.0
+        assert td.rate_values is not None
+        assert np.all(td.rate_values == 4.0)
+        pairs = set(zip(td.rate_user_idx.tolist(), td.rate_item_idx.tolist()))
+        assert len(pairs) == len(td.rate_user_idx)
+        # and the model trains + predicts same-cluster items
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        model = algos[0].train(ctx(memory_storage), td)
+        res = algos[0].predict(model, Query(items=("i1",), num=3))
+        assert len(res.item_scores) == 3
+
+    def test_properties_survive_checkpoint(self, memory_storage):
+        import pickle
+
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        self.seed(memory_storage)
+        algos, models = self.make(
+            memory_storage,
+            {
+                "datasource": {
+                    "params": {"appName": APP, "itemPropertyNames": ["title"]}
+                },
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {"rank": 6, "numIterations": 6, "lambda": 0.05},
+                    }
+                ],
+            },
+        )
+        model = pickle.loads(pickle.dumps(models[0]))
+        res = model and algos[0].predict(model, Query(items=("i0",), num=2))
+        assert res.to_json_dict()["itemScores"][0].get("title")
+
+
+# ---------------------------------------------------------------------------
+# e-commerce adjust-score variant
+# ---------------------------------------------------------------------------
+
+
+class TestECommerceAdjustScore:
+    def test_weighted_items_scale_scores(self, memory_storage):
+        # reuse the e-commerce seed/train helper from TestECommerce
+        helper = TestECommerce()
+        c, algo, model, app_id = helper.make(memory_storage, adjustScore=True)
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        q = Query(user="u0", num=4)
+        base = algo.predict_with_context(c, model, q)
+        assert len(base.item_scores) >= 2
+        # boost the currently-second item via the weightedItems constraint
+        second = base.item_scores[1].item
+        memory_storage.get_l_events().insert(
+            Event(
+                event="$set",
+                entity_type="constraint",
+                entity_id="weightedItems",
+                properties=DataMap(
+                    {"weights": [{"items": [second], "weight": 100.0}]}
+                ),
+            ),
+            app_id,
+        )
+        boosted = algo.predict_with_context(c, model, q)
+        assert boosted.item_scores[0].item == second
+
+
+# ---------------------------------------------------------------------------
+# recommended-user template
+# ---------------------------------------------------------------------------
+
+
+class TestRecommendedUser:
+    def seed(self, storage):
+        app_id, levents = seed_app(storage)
+        events = []
+        # two communities: followers of group A follow a0/a1/a2, B follow b0..b2
+        for g, members in (("a", range(8)), ("b", range(8, 16))):
+            for m in members:
+                for t in range(3):
+                    events.append(
+                        Event(
+                            event="follow",
+                            entity_type="user",
+                            entity_id=f"u{m}",
+                            target_entity_type="user",
+                            target_entity_id=f"{g}{t}",
+                        )
+                    )
+        levents.insert_batch(events, app_id)
+
+    def make(self, storage):
+        from predictionio_tpu.models.recommendeduser.engine import engine_factory
+
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(
+            {
+                "datasource": {"params": {"appName": APP}},
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {"rank": 6, "numIterations": 8, "lambda": 0.05},
+                    }
+                ],
+            }
+        )
+        models = engine.train(ctx(storage), ep)
+        _, _, algos, _ = engine.make_components(ep)
+        return algos, models
+
+    def test_similar_users_same_community(self, memory_storage):
+        from predictionio_tpu.models.recommendeduser.engine import Query
+
+        self.seed(memory_storage)
+        algos, models = self.make(memory_storage)
+        res = algos[0].predict(models[0], Query(users=("a0",), num=2))
+        got = [s.user for s in res.similar_user_scores]
+        assert got and all(u.startswith("a") for u in got)
+        assert "a0" not in got  # query users excluded
+
+    def test_black_and_white_lists(self, memory_storage):
+        from predictionio_tpu.models.recommendeduser.engine import Query
+
+        self.seed(memory_storage)
+        algos, models = self.make(memory_storage)
+        res = algos[0].predict(
+            models[0], Query(users=("a0",), num=3, black_list=frozenset({"a1"}))
+        )
+        assert "a1" not in {s.user for s in res.similar_user_scores}
+        res = algos[0].predict(
+            models[0], Query(users=("a0",), num=3, white_list=frozenset({"b0"}))
+        )
+        assert {s.user for s in res.similar_user_scores} <= {"b0"}
+
+    def test_unknown_users_empty(self, memory_storage):
+        from predictionio_tpu.models.recommendeduser.engine import Query
+
+        self.seed(memory_storage)
+        algos, models = self.make(memory_storage)
+        assert algos[0].predict(models[0], Query(users=("zz",))).similar_user_scores == ()
+
+    def test_wire_format(self, memory_storage):
+        from predictionio_tpu.models.recommendeduser.engine import Query
+
+        self.seed(memory_storage)
+        algos, models = self.make(memory_storage)
+        res = algos[0].predict(models[0], Query(users=("b0", "b1"), num=2))
+        wire = res.to_json_dict()
+        assert "similarUserScores" in wire
+        assert set(wire["similarUserScores"][0]) == {"user", "score"}
