@@ -39,6 +39,11 @@ _BACKENDS: dict[str, tuple[str, str]] = {
     "postgres": ("predictionio_tpu.data.storage.sql", "PostgresStorageClient"),
     "mysql": ("predictionio_tpu.data.storage.sql", "MySQLStorageClient"),
     "sql": ("predictionio_tpu.data.storage.sql", "SQLStorageClient"),
+    # REST driver, no client library needed (ref storage/elasticsearch)
+    "elasticsearch": (
+        "predictionio_tpu.data.storage.elasticsearch",
+        "ESStorageClient",
+    ),
 }
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")

@@ -46,10 +46,10 @@ class Query:
     def from_json_dict(d: dict[str, Any]) -> "Query":
         def fset(key):
             v = d.get(key)
-            return frozenset(v) if v is not None else None
+            return frozenset(str(x) for x in v) if v is not None else None
 
         return Query(
-            users=tuple(d["users"]),
+            users=tuple(str(u) for u in d["users"]),
             num=int(d.get("num", 10)),
             white_list=fset("whiteList"),
             black_list=fset("blackList"),

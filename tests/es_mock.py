@@ -1,0 +1,243 @@
+"""In-process mock Elasticsearch for driver contract tests.
+
+The reference tests its ES driver against a docker-hosted service
+(SURVEY.md section 4); no service exists in this sandbox, so this emulates
+the REST subset the driver speaks: document CRUD, ``_search`` with
+bool/term/terms/range/exists filters + sort + size, ``_count``,
+``_update`` scripted counter upsert, ``_delete_by_query``, index create/
+delete. State is per-server, in-memory.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+
+class _State:
+    def __init__(self):
+        self.indices: dict[str, dict[str, dict]] = {}
+        self.lock = threading.RLock()
+
+
+def _get_field(doc: dict, field: str):
+    return doc.get(field)
+
+
+def _matches(doc: dict, query: dict) -> bool:
+    if not query or "match_all" in query:
+        return True
+    if "term" in query:
+        ((field, value),) = query["term"].items()
+        return _get_field(doc, field) == value
+    if "terms" in query:
+        ((field, values),) = query["terms"].items()
+        return _get_field(doc, field) in values
+    if "range" in query:
+        ((field, spec),) = query["range"].items()
+        v = _get_field(doc, field)
+        if v is None:
+            return False
+        if "gte" in spec and not v >= spec["gte"]:
+            return False
+        if "gt" in spec and not v > spec["gt"]:
+            return False
+        if "lte" in spec and not v <= spec["lte"]:
+            return False
+        if "lt" in spec and not v < spec["lt"]:
+            return False
+        return True
+    if "exists" in query:
+        return _get_field(doc, query["exists"]["field"]) is not None
+    if "bool" in query:
+        b = query["bool"]
+        for f in b.get("filter", []):
+            if not _matches(doc, f):
+                return False
+        for f in b.get("must_not", []):
+            if _matches(doc, f):
+                return False
+        for f in b.get("must", []):
+            if not _matches(doc, f):
+                return False
+        return True
+    raise ValueError(f"mock ES: unsupported query {query}")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: _State  # injected by make_server
+
+    def log_message(self, *args):  # silence
+        pass
+
+    def _reply(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b""
+        return json.loads(raw) if raw else {}
+
+    def _raw_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _route(self):
+        path = self.path.split("?")[0]
+        parts = [p for p in path.split("/") if p]
+        st = self.state
+        with st.lock:
+            # /_bulk — ndjson action/doc pairs ({"index": {"_index", "_id"}})
+            if parts == ["_bulk"] and self.command == "POST":
+                lines = [
+                    json.loads(ln)
+                    for ln in self._raw_body().decode().splitlines()
+                    if ln.strip()
+                ]
+                items = []
+                i = 0
+                while i < len(lines):
+                    action = lines[i]
+                    if "index" not in action:
+                        return self._reply(400, {"error": "unsupported action"})
+                    meta = action["index"]
+                    doc = lines[i + 1]
+                    st.indices.setdefault(meta["_index"], {})[meta["_id"]] = doc
+                    items.append({"index": {"_id": meta["_id"], "status": 201}})
+                    i += 2
+                return self._reply(200, {"errors": False, "items": items})
+            # /{index}/_doc/{id}
+            if len(parts) == 3 and parts[1] == "_doc":
+                index, _, doc_id = parts
+                table = st.indices.setdefault(index, {})
+                if self.command == "PUT":
+                    table[doc_id] = self._body()
+                    return self._reply(200, {"result": "updated", "_id": doc_id})
+                if self.command == "GET":
+                    if doc_id in table:
+                        return self._reply(
+                            200,
+                            {"found": True, "_id": doc_id, "_source": table[doc_id]},
+                        )
+                    return self._reply(404, {"found": False})
+                if self.command == "DELETE":
+                    if doc_id in table:
+                        del table[doc_id]
+                        return self._reply(200, {"result": "deleted"})
+                    return self._reply(404, {"result": "not_found"})
+            # /{index}/_update/{id} — scripted counter upsert
+            if len(parts) == 3 and parts[1] == "_update" and self.command == "POST":
+                index, _, doc_id = parts
+                body = self._body()
+                table = st.indices.setdefault(index, {})
+                if doc_id not in table:
+                    table[doc_id] = dict(body.get("upsert", {}))
+                else:
+                    src = body.get("script", {}).get("source", "")
+                    m = re.match(r"ctx\._source\.(\w+) \+= (\d+)", src)
+                    if not m:
+                        return self._reply(400, {"error": "unsupported script"})
+                    field, delta = m.group(1), int(m.group(2))
+                    table[doc_id][field] = table[doc_id].get(field, 0) + delta
+                return self._reply(
+                    200, {"result": "updated", "get": {"_source": table[doc_id]}}
+                )
+            # /{index}/_search
+            if len(parts) == 2 and parts[1] == "_search" and self.command == "POST":
+                index = parts[0]
+                if index not in st.indices:
+                    return self._reply(404, {"error": "index_not_found"})
+                body = self._body()
+                docs = [
+                    d
+                    for d in st.indices[index].values()
+                    if _matches(d, body.get("query", {}))
+                ]
+                sort_specs = body.get("sort", [])
+                for spec in reversed(sort_specs):
+                    ((field, opts),) = spec.items()
+                    docs.sort(
+                        key=lambda d: (d.get(field) is None, d.get(field)),
+                        reverse=opts.get("order") == "desc",
+                    )
+                cursor = body.get("search_after")
+                if cursor is not None:
+                    # drop docs at-or-before the cursor in sort order
+                    def _past(doc):
+                        for spec, cur in zip(sort_specs, cursor):
+                            ((field, opts),) = spec.items()
+                            v = doc.get(field)
+                            if v == cur:
+                                continue
+                            gt = v is not None and cur is not None and v > cur
+                            return gt != (opts.get("order") == "desc")
+                        return False  # equal tuple: not past the cursor
+
+                    docs = [d for d in docs if _past(d)]
+                docs = docs[: body.get("size", 10)]
+                return self._reply(
+                    200,
+                    {
+                        "hits": {
+                            "total": {"value": len(docs)},
+                            "hits": [{"_source": d} for d in docs],
+                        }
+                    },
+                )
+            # /{index}/_count
+            if len(parts) == 2 and parts[1] == "_count" and self.command == "POST":
+                index = parts[0]
+                if index not in st.indices:
+                    return self._reply(404, {"error": "index_not_found"})
+                return self._reply(200, {"count": len(st.indices[index])})
+            # /{index}/_delete_by_query
+            if (
+                len(parts) == 2
+                and parts[1] == "_delete_by_query"
+                and self.command == "POST"
+            ):
+                index = parts[0]
+                if index not in st.indices:
+                    return self._reply(404, {"error": "index_not_found"})
+                q = self._body().get("query", {})
+                table = st.indices[index]
+                victims = [k for k, d in table.items() if _matches(d, q)]
+                for k in victims:
+                    del table[k]
+                return self._reply(200, {"deleted": len(victims)})
+            # /{index} create / delete
+            if len(parts) == 1:
+                index = parts[0]
+                if self.command == "PUT":
+                    if index in st.indices:
+                        return self._reply(
+                            400, {"error": "resource_already_exists_exception"}
+                        )
+                    st.indices[index] = {}
+                    return self._reply(200, {"acknowledged": True})
+                if self.command == "DELETE":
+                    if index in st.indices:
+                        del st.indices[index]
+                        return self._reply(200, {"acknowledged": True})
+                    return self._reply(404, {"error": "index_not_found"})
+        return self._reply(400, {"error": f"mock ES: no route {self.command} {path}"})
+
+    do_GET = do_PUT = do_POST = do_DELETE = _route
+
+
+def make_server() -> tuple[ThreadingHTTPServer, str]:
+    """Start a mock ES on an ephemeral port; returns (server, base_url)."""
+    state = _State()
+    handler = type("Handler", (_Handler,), {"state": state})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, f"http://127.0.0.1:{server.server_port}"
