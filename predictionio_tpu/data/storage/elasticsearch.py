@@ -313,6 +313,8 @@ class ESAccessKeys(base.AccessKeys):
 
     def insert(self, k: AccessKey) -> str | None:
         key = k.key or base.generate_access_key()
+        if self._docs.get(key) is not None:
+            return None  # never rebind an existing credential
         self._docs.put(
             key, {"key": key, "appid": k.appid, "events": list(k.events)}
         )
@@ -612,7 +614,14 @@ class ESLEvents(base.LEvents):
             lines.append({"index": {"_index": index, "_id": event_id}})
             lines.append(doc)
             ids.append(event_id)
-        self._t.bulk(lines, params={"refresh": "true"})
+        out = self._t.bulk(lines, params={"refresh": "true"})
+        if out.get("errors"):
+            failed = [
+                item["index"]
+                for item in out.get("items", [])
+                if item.get("index", {}).get("error")
+            ]
+            raise ESError(f"_bulk rejected {len(failed)} event(s): {failed[:3]}")
         return ids
 
     def get(
@@ -680,6 +689,8 @@ class ESLEvents(base.LEvents):
         limit: int | None = None,
         reversed: bool = False,
     ) -> Iterator[Event]:
+        if limit is not None and limit < 0:
+            limit = None  # the reference treats limit=-1 as "no cap"
         query = self._query(
             start_time,
             until_time,

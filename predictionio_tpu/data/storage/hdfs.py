@@ -1,0 +1,121 @@
+"""HDFS model blob store over WebHDFS REST (no Hadoop client).
+
+Reference parity: ``storage/hdfs/.../HDFSModels.scala`` (model blobs via the
+Hadoop FileSystem API). The TPU framework talks WebHDFS — Hadoop's standard
+HTTP gateway — with stdlib urllib, including the NameNode -> DataNode
+redirect dance on CREATE/OPEN.
+
+Config keys (``PIO_STORAGE_SOURCES_<NAME>_*``): ``URL`` (e.g.
+``http://namenode:9870``), ``PATH`` (base dir, default ``/pio_models``),
+``USERNAME`` (``user.name`` query param for simple auth).
+"""
+
+from __future__ import annotations
+
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import Model
+
+
+class HDFSError(RuntimeError):
+    pass
+
+
+class WebHDFSModels(base.Models):
+    def __init__(
+        self,
+        url: str,
+        base_path: str = "/pio_models",
+        username: str | None = None,
+        timeout: float = 30.0,
+    ):
+        self._url = url.rstrip("/")
+        self._base = "/" + base_path.strip("/")
+        self._username = username
+        self._timeout = timeout
+
+    def _op_url(self, model_id: str, op: str, **params: str) -> str:
+        safe = urllib.parse.quote(f"pio_model_{model_id}", safe="-_.~")
+        q = {"op": op, **params}
+        if self._username:
+            q["user.name"] = self._username
+        return (
+            f"{self._url}/webhdfs/v1{self._base}/{safe}?"
+            + urllib.parse.urlencode(q)
+        )
+
+    def _request(
+        self,
+        method: str,
+        url: str,
+        payload: bytes | None = None,
+        follow_redirect: bool = True,
+        redirect_payload: bytes | None = None,
+    ) -> tuple[int, bytes]:
+        """One HTTP call; on a NameNode 301/302/307 re-issues against the
+        DataNode ``Location`` with ``redirect_payload`` (the WebHDFS CREATE
+        protocol sends NO body to the NameNode — only the DataNode gets the
+        file bytes)."""
+        req = urllib.request.Request(url, data=payload, method=method)
+        req.add_header("Content-Type", "application/octet-stream")
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as exc:
+            if exc.code in (301, 302, 307) and follow_redirect:
+                location = exc.headers.get("Location")
+                if location:
+                    return self._request(
+                        method, location, redirect_payload, False
+                    )
+            return exc.code, exc.read()
+        except (urllib.error.URLError, OSError) as exc:
+            raise HDFSError(f"{method} {url}: {exc}") from exc
+
+    def insert(self, model: Model) -> None:
+        # two-step write: body-less CREATE against the NameNode, then PUT
+        # the bytes at the DataNode the 307 redirect names
+        status, body = self._request(
+            "PUT",
+            self._op_url(model.id, "CREATE", overwrite="true"),
+            payload=None,
+            redirect_payload=model.models,
+        )
+        if status not in (200, 201):
+            raise HDFSError(f"CREATE {model.id}: HTTP {status}: {body[:200]!r}")
+
+    def get(self, model_id: str) -> Model | None:
+        status, body = self._request("GET", self._op_url(model_id, "OPEN"))
+        if status == 404:
+            return None
+        if status != 200:
+            raise HDFSError(f"OPEN {model_id}: HTTP {status}: {body[:200]!r}")
+        return Model(model_id, body)
+
+    def delete(self, model_id: str) -> None:
+        status, body = self._request("DELETE", self._op_url(model_id, "DELETE"))
+        if status not in (200, 404):
+            raise HDFSError(f"DELETE {model_id}: HTTP {status}: {body[:200]!r}")
+
+
+class HDFSStorageClient:
+    """Backend entry point (type name: ``hdfs``)."""
+
+    def __init__(self, config: dict[str, Any] | None = None):
+        cfg = {k.upper(): v for k, v in (config or {}).items()}
+        url = cfg.get("URL")
+        if not url:
+            raise HDFSError("hdfs storage source needs PIO_STORAGE_SOURCES_<NAME>_URL")
+        self._models = WebHDFSModels(
+            url=url,
+            base_path=cfg.get("PATH", "/pio_models"),
+            username=cfg.get("USERNAME"),
+            timeout=float(cfg.get("TIMEOUT", 30.0)),
+        )
+
+    def models(self) -> WebHDFSModels:
+        return self._models

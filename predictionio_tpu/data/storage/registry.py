@@ -39,11 +39,14 @@ _BACKENDS: dict[str, tuple[str, str]] = {
     "postgres": ("predictionio_tpu.data.storage.sql", "PostgresStorageClient"),
     "mysql": ("predictionio_tpu.data.storage.sql", "MySQLStorageClient"),
     "sql": ("predictionio_tpu.data.storage.sql", "SQLStorageClient"),
-    # REST driver, no client library needed (ref storage/elasticsearch)
+    # REST drivers, no client libraries needed (ref storage/elasticsearch,
+    # storage/s3, storage/hdfs)
     "elasticsearch": (
         "predictionio_tpu.data.storage.elasticsearch",
         "ESStorageClient",
     ),
+    "s3": ("predictionio_tpu.data.storage.s3", "S3StorageClient"),
+    "hdfs": ("predictionio_tpu.data.storage.hdfs", "HDFSStorageClient"),
 }
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
