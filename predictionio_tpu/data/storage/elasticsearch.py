@@ -197,6 +197,18 @@ class _ESDocs:
             params={"refresh": "true"},
         )
 
+    def create(self, doc_id: str, doc: dict) -> bool:
+        """Atomic create-if-absent (``_create`` endpoint); False on 409.
+        The check-then-put alternative races under concurrent writers."""
+        out = self._t.request(
+            "PUT",
+            f"/{self._index}/_create/{urllib.parse.quote(str(doc_id))}",
+            body=doc,
+            params={"refresh": "true"},
+            ok_statuses=(409,),
+        )
+        return out.get("result") == "created"
+
     def get(self, doc_id: str) -> dict | None:
         out = self._t.request(
             "GET",
@@ -313,12 +325,11 @@ class ESAccessKeys(base.AccessKeys):
 
     def insert(self, k: AccessKey) -> str | None:
         key = k.key or base.generate_access_key()
-        if self._docs.get(key) is not None:
-            return None  # never rebind an existing credential
-        self._docs.put(
+        created = self._docs.create(
             key, {"key": key, "appid": k.appid, "events": list(k.events)}
         )
-        return key
+        # atomic create: a concurrent writer can never rebind a credential
+        return key if created else None
 
     def get(self, key: str) -> AccessKey | None:
         d = self._docs.get(key)

@@ -269,6 +269,9 @@ class ALSAlgorithmParams(Params):
     seed: int | None = 3
     implicit_prefs: bool = False
     alpha: float = 1.0
+    # train with the ALX-style mesh-sharded solver (ops/als_sharded.py)
+    # across all visible devices; single-device falls back transparently
+    distributed: bool = False
 
 
 @dataclasses.dataclass
@@ -338,14 +341,26 @@ class ALSAlgorithm(JaxAlgorithm):
             alpha=self.params.alpha,
             seed=self.params.seed if self.params.seed is not None else 0,
         )
-        uf, vf = als_train(
-            pd.user_idx,
-            pd.item_idx,
-            pd.ratings,
-            len(pd.user_vocab),
-            len(pd.item_vocab),
-            cfg,
-        )
+        if self.params.distributed:
+            from predictionio_tpu.ops.als_sharded import als_train_sharded
+
+            uf, vf = als_train_sharded(
+                pd.user_idx,
+                pd.item_idx,
+                pd.ratings,
+                len(pd.user_vocab),
+                len(pd.item_vocab),
+                cfg,
+            )
+        else:
+            uf, vf = als_train(
+                pd.user_idx,
+                pd.item_idx,
+                pd.ratings,
+                len(pd.user_vocab),
+                len(pd.item_vocab),
+                cfg,
+            )
         return ALSModel(
             np.asarray(uf), np.asarray(vf), pd.user_vocab, pd.item_vocab
         )

@@ -114,6 +114,14 @@ class _Handler(BaseHTTPRequestHandler):
                     items.append({"index": {"_id": meta["_id"], "status": 201}})
                     i += 2
                 return self._reply(200, {"errors": False, "items": items})
+            # /{index}/_create/{id} — atomic create-if-absent, 409 on exists
+            if len(parts) == 3 and parts[1] == "_create" and self.command == "PUT":
+                index, _, doc_id = parts
+                table = st.indices.setdefault(index, {})
+                if doc_id in table:
+                    return self._reply(409, {"error": "version_conflict"})
+                table[doc_id] = self._body()
+                return self._reply(201, {"result": "created", "_id": doc_id})
             # /{index}/_doc/{id}
             if len(parts) == 3 and parts[1] == "_doc":
                 index, _, doc_id = parts
