@@ -447,6 +447,28 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_upgrade(args) -> int:
+    """Storage-format migration check (ref Console.scala 'upgrade' — the
+    reference migrates 0.8.x HBase layouts; here every backend is verified
+    and its content stamp reported so operators can confirm compatibility
+    after a framework update)."""
+    storage = _storage()
+    errors = storage.verify_all_data_objects()
+    if errors:
+        for e in errors:
+            print(f"[ERROR] {e}")
+        return 1
+    print("All storage repositories verified; data formats are current.")
+    try:
+        stamp = storage.get_p_events().store_identity()
+        if stamp:
+            print(f"Event store identity: {stamp}")
+    except Exception:
+        pass
+    print("No migration necessary.")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(predictionio_tpu.__version__)
     return 0
@@ -529,6 +551,9 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command", required=True)
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
+    sub.add_parser(
+        "upgrade", help="verify storage formats after a framework update"
+    ).set_defaults(fn=cmd_upgrade)
     sub.add_parser("status").set_defaults(fn=cmd_status)
     sub.add_parser("shell").set_defaults(fn=cmd_shell)
 
