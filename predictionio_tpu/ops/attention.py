@@ -31,6 +31,29 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # stable home since jax 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+import inspect
+
+# the replication/varying checker kwarg was renamed check_rep -> check_vma;
+# pallas_call outputs carry no vma metadata, so the checker must be off for
+# shard_map bodies that invoke pallas kernels
+_NO_CHECK = (
+    {"check_vma": False}
+    if "check_vma" in inspect.signature(shard_map).parameters
+    else {"check_rep": False}
+)
+
+
+def _check_seq_divisible(L: int, axis: str, axis_size: int) -> None:
+    if L % axis_size:
+        raise ValueError(
+            f"sequence length {L} not divisible by {axis}={axis_size}"
+        )
+
 
 # ---------------------------------------------------------------------------
 # Reference (jnp) attention + online-softmax block update
@@ -99,15 +122,9 @@ def ring_attention(
     sharded over the axis and each device runs P ring steps, exchanging K/V
     shards with its neighbor. Requires L % axis_size == 0.
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
-
     axis_size = mesh.shape[axis]
     L = q.shape[2]
-    if L % axis_size:
-        raise ValueError(f"sequence length {L} not divisible by {axis}={axis_size}")
+    _check_seq_divisible(L, axis, axis_size)
     l_local = L // axis_size
 
     def local_fn(q_blk, k_blk, v_blk):
@@ -177,6 +194,59 @@ def ring_attention_sharded(
 
 
 # ---------------------------------------------------------------------------
+# Ulysses attention (all-to-all sequence parallelism)
+# ---------------------------------------------------------------------------
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # [B, H, L, D] — L is the GLOBAL sequence length
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+) -> jnp.ndarray:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme): inputs
+    arrive sequence-sharded on ``axis``; one ``all_to_all`` re-shards them
+    head-wise with the FULL sequence per device, attention runs locally with
+    no inner communication, and a second ``all_to_all`` restores sequence
+    sharding. Communication: 2 all-to-alls of activations total (vs. P-1
+    K/V ``ppermute`` hops for ring attention) — the better schedule when
+    heads are plentiful and the sequence shard still fits one device's
+    memory as [H/P, L]. Requires H % axis_size == 0 and L % axis_size == 0.
+    """
+    axis_size = mesh.shape[axis]
+    _, H, L, _ = q.shape
+    _check_seq_divisible(L, axis, axis_size)
+    if H % axis_size:
+        raise ValueError(f"head count {H} not divisible by {axis}={axis_size}")
+
+    def local_fn(q_blk, k_blk, v_blk):
+        # [B, H, l_local, D] -> [B, H/P, L, D]: split heads, gather sequence
+        def to_heads(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+        def to_seq(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+        q_h, k_h, v_h = to_heads(q_blk), to_heads(k_blk), to_heads(v_blk)
+        # full sequence is present locally: plain causal offsets (0, 0).
+        # fused_attention keeps the local block flash-style (no dense
+        # [L, L] score tensor on TPU) — the point of sequence parallelism
+        out = fused_attention(q_h, k_h, v_h, causal=causal)
+        return to_seq(out)
+
+    spec = P(None, None, axis, None)
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        **_NO_CHECK,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
 # Pallas fused attention (TPU single-chip hot path)
 # ---------------------------------------------------------------------------
 
@@ -192,14 +262,30 @@ def _fused_attention_pallas(q, k, v, causal: bool, interpret: bool):
         kb = k_ref[0]
         vb = v_ref[0]
         scale = 1.0 / math.sqrt(D)
-        scores = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
+        # HIGHEST precision: the TPU default lowers f32 matmuls to bf16
+        # passes (~7e-3 abs error vs float64 at these shapes); full f32
+        # keeps the kernel within ~1e-6 of the dense reference
+        scores = (
+            jnp.dot(
+                qb,
+                kb.T,
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST,
+            )
+            * scale
+        )
         if causal:
             qi = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
             ki = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
             scores = jnp.where(qi >= ki, scores, -jnp.inf)
         m = jnp.max(scores, axis=-1, keepdims=True)
         p = jnp.exp(scores - m)
-        out = jnp.dot(p, vb, preferred_element_type=jnp.float32)
+        out = jnp.dot(
+            p,
+            vb,
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )
         denom = jnp.sum(p, axis=-1, keepdims=True)
         o_ref[0] = (out / denom).astype(o_ref.dtype)
 
@@ -232,9 +318,9 @@ def fused_attention(
     """Single-device attention. On TPU: pallas kernel (one (batch, head)
     block per grid step, softmax fused in VMEM). Elsewhere: the jnp
     reference path (``force_pallas`` runs the kernel in interpret mode for
-    testing)."""
-    platform = q.devices().pop().platform if hasattr(q, "devices") else "cpu"
-    if platform == "tpu":
+    testing). Platform is sniffed via ``jax.default_backend()`` so the
+    choice also works on tracers (e.g. inside shard_map)."""
+    if jax.default_backend() == "tpu":
         return _fused_attention_pallas(q, k, v, causal, interpret=False)
     if force_pallas:
         return _fused_attention_pallas(q, k, v, causal, interpret=True)
