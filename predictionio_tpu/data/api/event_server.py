@@ -56,6 +56,19 @@ class EventServerConfig:
     port: int = 7070
     plugins: str = "plugins"
     stats: bool = False
+    # TLS (ref common/SSLConfiguration.scala — the reference's keystore
+    # config covers the event server too): PEM cert + key paths
+    ssl_certfile: str | None = None
+    ssl_keyfile: str | None = None
+
+    def ssl_context(self):
+        if not (self.ssl_certfile and self.ssl_keyfile):
+            return None
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
+        return ctx
 
 
 class BlockedEvent(Exception):
@@ -404,5 +417,6 @@ def run_event_server(config: EventServerConfig | None = None) -> None:
         server.make_app(),
         host=server.config.ip,
         port=server.config.port,
+        ssl_context=server.config.ssl_context(),
         print=None,
     )

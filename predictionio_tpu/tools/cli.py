@@ -335,7 +335,15 @@ def cmd_eventserver(args) -> int:
     )
 
     print(f"Event server starting on {args.ip}:{args.port} ...")
-    run_event_server(EventServerConfig(ip=args.ip, port=args.port, stats=args.stats))
+    run_event_server(
+        EventServerConfig(
+            ip=args.ip,
+            port=args.port,
+            stats=args.stats,
+            ssl_certfile=args.ssl_certfile,
+            ssl_keyfile=args.ssl_keyfile,
+        )
+    )
     return 0
 
 
@@ -663,6 +671,8 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--ip", default="0.0.0.0")
     x.add_argument("--port", type=int, default=7070)
     x.add_argument("--stats", action="store_true")
+    x.add_argument("--ssl-certfile")
+    x.add_argument("--ssl-keyfile")
     x.set_defaults(fn=cmd_eventserver)
 
     x = sub.add_parser("adminserver")
