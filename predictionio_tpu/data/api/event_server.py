@@ -62,7 +62,12 @@ class EventServerConfig:
     ssl_keyfile: str | None = None
 
     def ssl_context(self):
-        if not (self.ssl_certfile and self.ssl_keyfile):
+        if bool(self.ssl_certfile) != bool(self.ssl_keyfile):
+            # one without the other would silently serve plaintext
+            raise ValueError(
+                "TLS misconfigured: both ssl_certfile and ssl_keyfile are required"
+            )
+        if not self.ssl_certfile:
             return None
         import ssl
 
@@ -392,7 +397,12 @@ class EventServer:
     async def start(self) -> None:
         self._runner = web.AppRunner(self.make_app())
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.config.ip, self.config.port)
+        site = web.TCPSite(
+            self._runner,
+            self.config.ip,
+            self.config.port,
+            ssl_context=self.config.ssl_context(),
+        )
         await site.start()
         logger.info(
             "Event server started on %s:%d", self.config.ip, self.config.port

@@ -20,7 +20,54 @@ def cooccurrence_top_n(
     n_items: int,
     top_n: int,
 ) -> dict[int, list[tuple[int, int]]]:
-    """Returns item -> [(other_item, count)] sorted by count desc, len<=top_n."""
+    """Returns item -> [(other_item, count)] sorted by count desc, len<=top_n.
+
+    The Spark self-join is one sparse matmul: with A the distinct binary
+    user x item interaction matrix, ``A.T @ A`` is the full cooccurrence
+    count matrix (diagonal = item popularity, zeroed out). scipy's CSR
+    product runs this at ML-1M scale in tens of milliseconds where the
+    per-user pair expansion took seconds.
+    """
+    from scipy import sparse
+
+    u = np.asarray(user_idx, np.int64)
+    it = np.asarray(item_idx, np.int64)
+    if len(u) == 0:
+        return {}
+    # distinct (user, item) via 1-D codes — np.unique(axis=0) does a
+    # structured-void sort that is ~50x slower at ML-1M scale
+    codes = np.unique(u * n_items + it)
+    users, items = codes // n_items, codes % n_items
+    n_users = int(users.max()) + 1
+    A = sparse.csr_matrix(
+        (np.ones(len(users), np.int64), (users, items)),
+        shape=(n_users, n_items),
+    )
+    C = (A.T @ A).tocsr()
+    C.setdiag(0)
+    C.eliminate_zeros()
+    out: dict[int, list[tuple[int, int]]] = {}
+    indptr, indices, data = C.indptr, C.indices, C.data
+    for item in range(n_items):
+        lo, hi = indptr[item], indptr[item + 1]
+        if lo == hi:
+            continue
+        row_items = indices[lo:hi]
+        row_counts = data[lo:hi]
+        order = np.lexsort((row_items, -row_counts))[:top_n]
+        out[int(item)] = [
+            (int(row_items[j]), int(row_counts[j])) for j in order
+        ]
+    return out
+
+
+def _cooccurrence_top_n_reference(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    n_items: int,
+    top_n: int,
+) -> dict[int, list[tuple[int, int]]]:
+    """Direct pair-expansion formulation kept as the oracle for tests."""
     pairs = np.unique(
         np.stack([np.asarray(user_idx, np.int64), np.asarray(item_idx, np.int64)], 1),
         axis=0,

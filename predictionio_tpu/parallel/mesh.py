@@ -69,6 +69,12 @@ def _resolve_sizes(axis_sizes: Sequence[int], n_devices: int) -> list[int]:
 def make_mesh(
     spec: MeshSpec | str | None = None, devices: Sequence[jax.Device] | None = None
 ) -> Mesh:
+    """Build a named mesh. ``spec=None`` falls back to ``$PIO_MESH``
+    (e.g. ``data=-1,model=2``), then to all devices on one ``data`` axis."""
+    if spec is None:
+        import os
+
+        spec = os.environ.get("PIO_MESH") or None
     if isinstance(spec, str) or spec is None:
         spec = MeshSpec.parse(spec)
     devs = list(devices) if devices is not None else list(jax.devices())

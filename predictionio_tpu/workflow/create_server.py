@@ -62,7 +62,12 @@ class ServerConfig:
     log_prefix: str = ""
 
     def ssl_context(self):
-        if not (self.ssl_certfile and self.ssl_keyfile):
+        if bool(self.ssl_certfile) != bool(self.ssl_keyfile):
+            # one without the other would silently serve plaintext
+            raise ValueError(
+                "TLS misconfigured: both ssl_certfile and ssl_keyfile are required"
+            )
+        if not self.ssl_certfile:
             return None
         import ssl
 

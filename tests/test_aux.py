@@ -173,3 +173,29 @@ class TestLatencyHistogram:
 
     def test_empty(self):
         assert LatencyHistogram().summary() == {"count": 0}
+
+
+class TestTLSConfigValidation:
+    def test_partial_tls_config_rejected(self):
+        from predictionio_tpu.data.api.event_server import EventServerConfig
+        from predictionio_tpu.workflow.create_server import ServerConfig
+
+        with pytest.raises(ValueError, match="TLS misconfigured"):
+            EventServerConfig(ssl_certfile="/tmp/cert.pem").ssl_context()
+        with pytest.raises(ValueError, match="TLS misconfigured"):
+            ServerConfig(ssl_keyfile="/tmp/key.pem").ssl_context()
+        assert EventServerConfig().ssl_context() is None
+        assert ServerConfig().ssl_context() is None
+
+
+class TestPioMeshEnv:
+    def test_make_mesh_reads_pio_mesh(self, monkeypatch):
+        import jax
+
+        from predictionio_tpu.parallel.mesh import make_mesh
+
+        monkeypatch.setenv("PIO_MESH", "data=-1,model=2")
+        mesh = make_mesh()
+        assert dict(mesh.shape) == {"data": len(jax.devices()) // 2, "model": 2}
+        monkeypatch.delenv("PIO_MESH")
+        assert dict(make_mesh().shape) == {"data": len(jax.devices())}
