@@ -1,0 +1,27 @@
+# Container image for the TPU-native framework (ref reference Dockerfile:
+# the reference bundles Spark + PIO; here the runtime is Python + JAX).
+# For TPU hosts, swap the base image for one with libtpu and run with the
+# TPU device plugin; on CPU this image serves the event/query/admin planes
+# and runs tests.
+FROM python:3.12-slim
+
+RUN apt-get update \
+ && apt-get install -y --no-install-recommends g++ curl \
+ && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/pio
+COPY pyproject.toml README.md ./
+COPY predictionio_tpu ./predictionio_tpu
+COPY native ./native
+COPY conf ./conf
+COPY pio ./pio
+
+RUN pip install --no-cache-dir . flax optax
+
+ENV PIO_FS_BASEDIR=/var/lib/pio
+VOLUME /var/lib/pio
+
+# event server 7070, engine server 8000, admin 7071, dashboard 9000
+EXPOSE 7070 8000 7071 9000
+ENTRYPOINT ["./pio"]
+CMD ["eventserver", "--ip", "0.0.0.0"]

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Cron-able train-and-redeploy loop (ref examples/redeploy-script/redeploy.sh:
+# the reference spark-submits a retrain then curls the engine server; here
+# train runs in-process on the TPU host and /reload hot-swaps the server to
+# the newest COMPLETED engine instance without dropping connections).
+#
+# Crontab example — retrain hourly at :07:
+#   7 * * * * /path/to/repo/examples/redeploy.sh >> /var/log/pio-redeploy.log 2>&1
+set -euo pipefail
+
+# ---- configuration ---------------------------------------------------------
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+ENGINE_DIR="${ENGINE_DIR:-$REPO_DIR/predictionio_tpu/models/recommendation}"
+VARIANT="${VARIANT:-engine.json}"
+HOST="${HOST:-127.0.0.1}"
+# a port other than the default 8000 is recommended so a bare `pio deploy`
+# by mistake cannot shut this server down
+PORT="${PORT:-8001}"
+# ---------------------------------------------------------------------------
+
+echo "[$(date -Is)] training $ENGINE_DIR ($VARIANT)"
+"$REPO_DIR/pio" train --engine-dir "$ENGINE_DIR" --variant "$VARIANT"
+
+echo "[$(date -Is)] reloading server at $HOST:$PORT"
+if curl -fsS -X POST "http://$HOST:$PORT/reload" > /dev/null; then
+  echo "[$(date -Is)] reload OK"
+else
+  echo "[$(date -Is)] reload failed — is the server deployed on $PORT?" >&2
+  exit 1
+fi

@@ -272,6 +272,9 @@ class QueryServer:
             [
                 web.get("/", self.handle_status),
                 web.post("/queries.json", self.handle_queries),
+                # POST is the reference's contract (CreateServer.scala:618-626);
+                # GET kept as a browser convenience
+                web.post("/reload", self.handle_reload),
                 web.get("/reload", self.handle_reload),
                 web.post("/stop", self.handle_stop),
                 web.get("/stop", self.handle_stop),
