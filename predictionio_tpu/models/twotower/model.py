@@ -41,6 +41,12 @@ class TwoTowerConfig:
     batch_size: int = 4096
     epochs: int = 5
     seed: int = 0
+    # mid-training checkpoint/resume (the reference has no step-level
+    # checkpointing, SURVEY.md section 5 — `pio train` is all-or-nothing;
+    # this closes that gap). Directory for epoch checkpoints; None disables.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1  # epochs between checkpoints
+    resume: bool = True  # continue from the newest checkpoint if present
 
 
 class Tower(nn.Module):
@@ -161,11 +167,25 @@ def train_two_tower(
     )
 
     n = len(user_idx)
-    rng_np = np.random.default_rng(config.seed)
     losses: list[float] = []
+    start_epoch = 0
+    if config.checkpoint_dir and config.resume:
+        state = load_train_checkpoint(config.checkpoint_dir)
+        if state is not None:
+            params = jax.device_put(state["params"], p_shardings)
+            # optimizer moments follow their parameter's sharding
+            opt_state = jax.tree_util.tree_map(
+                lambda x: np.asarray(x), state["opt_state"]
+            )
+            opt_state = _shard_opt_state(opt_state, params, p_shardings)
+            start_epoch = int(state["epoch"])
+            losses = list(state["losses"])
+
+    # per-epoch rng derived from (seed, epoch) so a resumed run shuffles
+    # identically to an uninterrupted one
     steps_per_epoch = max(1, n // B)
-    for _ in range(config.epochs):
-        perm = rng_np.permutation(n)
+    for epoch in range(start_epoch, config.epochs):
+        perm = np.random.default_rng((config.seed, epoch)).permutation(n)
         for s in range(steps_per_epoch):
             sel = perm[s * B : (s + 1) * B]
             if len(sel) < B:  # pad by wrapping (static shapes)
@@ -174,6 +194,10 @@ def train_two_tower(
             ib = jax.device_put(item_idx[sel].astype(np.int32), b_sharding)
             params, opt_state, loss = step(params, opt_state, ub, ib)
         losses.append(float(loss))
+        if config.checkpoint_dir and (epoch + 1) % max(1, config.checkpoint_every) == 0:
+            save_train_checkpoint(
+                config.checkpoint_dir, params, opt_state, epoch + 1, losses
+            )
 
     # Precompute the full item-embedding table for serving top-k.
     @jax.jit
@@ -188,3 +212,62 @@ def train_two_tower(
 
 def user_embedding(model: TwoTower, params, user_ids: jnp.ndarray) -> jnp.ndarray:
     return model.apply({"params": params}, user_ids, method=TwoTower.embed_users)
+
+
+# ---------------------------------------------------------------------------
+# Mid-training checkpoint/resume
+# ---------------------------------------------------------------------------
+
+_CKPT_NAME = "twotower_train_ckpt.bin"
+
+
+def save_train_checkpoint(directory, params, opt_state, epoch: int, losses) -> str:
+    """Atomic epoch checkpoint: params + optimizer moments + progress,
+    all pulled to host numpy so the blob is device- and sharding-agnostic
+    (same contract as the model repository, ``workflow/model_io.py``)."""
+    import os
+
+    from predictionio_tpu.workflow.model_io import serialize_models
+
+    host = jax.tree_util.tree_map(lambda x: np.asarray(x), (params, opt_state))
+    blob = serialize_models(
+        [{"params": host[0], "opt_state": host[1], "epoch": epoch, "losses": list(losses)}]
+    )
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, _CKPT_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def load_train_checkpoint(directory) -> dict | None:
+    import os
+
+    from predictionio_tpu.workflow.model_io import deserialize_models
+
+    path = os.path.join(directory, _CKPT_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as fh:
+        return deserialize_models(fh.read())[0]
+
+
+def _shard_opt_state(host_opt_state, params, p_shardings):
+    """Re-land restored optimizer moments with each parameter's sharding
+    (moment pytrees mirror the parameter pytree; scalars stay replicated)."""
+    flat_shard = {
+        jax.tree_util.keystr(k): s
+        for k, s in jax.tree_util.tree_flatten_with_path(p_shardings)[0]
+    }
+
+    def put(path, leaf):
+        key = jax.tree_util.keystr(path[-len(path) + 1 :]) if path else ""
+        # match by parameter-suffix when the moment tree nests the param tree
+        for pk, sharding in flat_shard.items():
+            if key and key.endswith(pk):
+                return jax.device_put(leaf, sharding)
+        return jax.device_put(leaf)
+
+    return jax.tree_util.tree_map_with_path(put, host_opt_state)
