@@ -144,7 +144,9 @@ def generate_access_key() -> str:
 class Apps(abc.ABC):
     @abc.abstractmethod
     def insert(self, app: App) -> int | None:
-        """Insert; auto-generate id when app.id == 0. Returns the id."""
+        """Insert; auto-generate id when app.id == 0. Returns the id, or
+        None when the id or name is already taken (names are unique,
+        ref Apps.scala)."""
 
     @abc.abstractmethod
     def get(self, app_id: int) -> App | None: ...
@@ -156,7 +158,9 @@ class Apps(abc.ABC):
     def get_all(self) -> list[App]: ...
 
     @abc.abstractmethod
-    def update(self, app: App) -> None: ...
+    def update(self, app: App) -> None:
+        """Update in place. Renaming to a name held by a different app is a
+        contract violation: drivers raise (name uniqueness must hold)."""
 
     @abc.abstractmethod
     def delete(self, app_id: int) -> None: ...

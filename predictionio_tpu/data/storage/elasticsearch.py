@@ -84,10 +84,12 @@ class _ESTransport:
                         return json.loads(exc.read() or b"{}")
                     except Exception:
                         return {}
-                last = ESError(
+                # HTTP error from a live node is an application error, not a
+                # transport failure: report it as such (and don't retry other
+                # endpoints — they'd return the same thing)
+                raise ESError(
                     f"{method} {path}: HTTP {exc.code}: {exc.read()[:200]!r}"
-                )
-                break  # HTTP error from a live node: don't retry others
+                ) from exc
             except (urllib.error.URLError, OSError) as exc:
                 last = exc  # node down: try the next endpoint
         raise ESError(f"all elasticsearch endpoints failed: {last}") from last
@@ -108,8 +110,9 @@ class _ESTransport:
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                     return json.loads(resp.read() or b"{}")
             except urllib.error.HTTPError as exc:
-                last = ESError(f"_bulk: HTTP {exc.code}: {exc.read()[:200]!r}")
-                break
+                raise ESError(
+                    f"_bulk: HTTP {exc.code}: {exc.read()[:200]!r}"
+                ) from exc
             except (urllib.error.URLError, OSError) as exc:
                 last = exc
         raise ESError(f"all elasticsearch endpoints failed: {last}") from last
@@ -276,20 +279,35 @@ class _ESDocs:
 
 
 class ESApps(base.Apps):
-    def __init__(self, docs: _ESDocs, seq: ESSequences):
+    def __init__(self, docs: _ESDocs, names: _ESDocs, seq: ESSequences):
         self._docs = docs
         self._seq = seq
+        # Name-uniqueness sentinels live in a sibling index keyed by name and
+        # are created via the atomic ``_create`` endpoint, so two concurrent
+        # inserts with the same name cannot both succeed (a check-then-put on
+        # the search index races; cf. ESAccessKeys which is naturally keyed).
+        # Index creation/memoization is the factory's job (``_meta_docs``).
+        self._names = names
 
     def insert(self, app: App) -> int | None:
+        # search-index guard first: protects names of apps created before the
+        # sentinel index existed (they have no sentinel doc to collide with)
         if self.get_by_name(app.name) is not None:
             return None  # names are unique (ref Apps.scala)
         app_id = app.id or self._seq.gen_next("apps")
-        if self._docs.get(str(app_id)) is not None:
+        if not self._names.create(app.name, {"app_id": app_id}):
             return None
-        self._docs.put(
-            str(app_id),
-            {"id": app_id, "name": app.name, "description": app.description},
-        )
+        try:
+            created = self._docs.create(
+                str(app_id),
+                {"id": app_id, "name": app.name, "description": app.description},
+            )
+        except ESError:
+            self._names.delete(app.name)  # don't orphan the name sentinel
+            raise
+        if not created:
+            self._names.delete(app.name)  # id collision: roll back sentinel
+            return None
         return app_id
 
     def get(self, app_id: int) -> App | None:
@@ -310,12 +328,39 @@ class ESApps(base.Apps):
         ]
 
     def update(self, app: App) -> None:
-        self._docs.put(
-            str(app.id),
-            {"id": app.id, "name": app.name, "description": app.description},
-        )
+        old = self.get(app.id)
+        renaming = old is not None and old.name != app.name
+        if renaming:
+            # claim the new name before touching the doc; refuse the rename
+            # if another app holds it (otherwise two apps would share a name
+            # and the later sentinel cleanup would corrupt uniqueness)
+            other = self.get_by_name(app.name)
+            if other is not None and other.id != app.id:
+                raise ESError(f"app name already in use: {app.name!r}")
+            if not self._names.create(app.name, {"app_id": app.id}):
+                sent = self._names.get(app.name)
+                if not (sent and sent.get("app_id") == app.id):
+                    raise ESError(f"app name already in use: {app.name!r}")
+                # else: our own claim from an interrupted rename — proceed
+        try:
+            self._docs.put(
+                str(app.id),
+                {"id": app.id, "name": app.name, "description": app.description},
+            )
+        except ESError:
+            if renaming:
+                self._names.delete(app.name)  # release the claimed name
+            raise
+        if renaming:
+            self._names.delete(old.name)
 
     def delete(self, app_id: int) -> None:
+        app = self.get(app_id)
+        # sentinel first: if the doc delete then fails, the app is still
+        # findable by name and insert()'s get_by_name guard keeps uniqueness;
+        # the reverse order would orphan the sentinel and block the name
+        if app is not None:
+            self._names.delete(app.name)
         self._docs.delete(str(app_id))
 
 
@@ -832,7 +877,9 @@ class ESStorageClient:
         return ESPEvents(self._transport, self._prefix, self._levents)
 
     def apps(self) -> ESApps:
-        return ESApps(self._meta_docs("apps"), self._seq)
+        return ESApps(
+            self._meta_docs("apps"), self._meta_docs("apps_names"), self._seq
+        )
 
     def access_keys(self) -> ESAccessKeys:
         return ESAccessKeys(self._meta_docs("accesskeys"))

@@ -221,6 +221,11 @@ class MemoryApps(base.Apps):
 
     def update(self, app: App) -> None:
         with self._lock:
+            if any(
+                a.name == app.name and a.id != app.id
+                for a in self._apps.values()
+            ):
+                raise ValueError(f"app name already in use: {app.name!r}")
             self._apps[app.id] = app
 
     def delete(self, app_id: int) -> None:

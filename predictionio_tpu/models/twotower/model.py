@@ -181,11 +181,15 @@ def train_two_tower(
             start_epoch = int(state["epoch"])
             losses = list(state["losses"])
 
-    # per-epoch rng derived from (seed, epoch) so a resumed run shuffles
-    # identically to an uninterrupted one
+    # One sequential rng stream for all epochs; a resumed run replays (and
+    # discards) the permutations of already-completed epochs so it shuffles
+    # identically to an uninterrupted run.
+    shuffle_rng = np.random.default_rng(config.seed)
     steps_per_epoch = max(1, n // B)
-    for epoch in range(start_epoch, config.epochs):
-        perm = np.random.default_rng((config.seed, epoch)).permutation(n)
+    for epoch in range(config.epochs):
+        perm = shuffle_rng.permutation(n)
+        if epoch < start_epoch:
+            continue
         for s in range(steps_per_epoch):
             sel = perm[s * B : (s + 1) * B]
             if len(sel) < B:  # pad by wrapping (static shapes)
@@ -255,19 +259,26 @@ def load_train_checkpoint(directory) -> dict | None:
 
 
 def _shard_opt_state(host_opt_state, params, p_shardings):
-    """Re-land restored optimizer moments with each parameter's sharding
-    (moment pytrees mirror the parameter pytree; scalars stay replicated)."""
-    flat_shard = {
-        jax.tree_util.keystr(k): s
-        for k, s in jax.tree_util.tree_flatten_with_path(p_shardings)[0]
-    }
+    """Re-land restored optimizer moments with each parameter's sharding.
 
-    def put(path, leaf):
-        key = jax.tree_util.keystr(path[-len(path) + 1 :]) if path else ""
-        # match by parameter-suffix when the moment tree nests the param tree
-        for pk, sharding in flat_shard.items():
-            if key and key.endswith(pk):
-                return jax.device_put(leaf, sharding)
-        return jax.device_put(leaf)
+    Optax moment trees (mu/nu) mirror the parameter pytree *structurally*, so
+    any subtree of the optimizer state whose treedef equals the parameter
+    treedef gets the parameter shardings mapped leaf-for-leaf; everything else
+    (scalar ``count`` etc.) is replicated. Structural matching avoids the
+    suffix-collision hazard of name-based matching when one parameter path is
+    a suffix of another.
+    """
+    param_treedef = jax.tree_util.tree_structure(params)
 
-    return jax.tree_util.tree_map_with_path(put, host_opt_state)
+    def mirrors_params(node):
+        try:
+            return jax.tree_util.tree_structure(node) == param_treedef
+        except Exception:
+            return False
+
+    def put(node):
+        if mirrors_params(node):
+            return jax.tree_util.tree_map(jax.device_put, node, p_shardings)
+        return jax.device_put(node)
+
+    return jax.tree_util.tree_map(put, host_opt_state, is_leaf=mirrors_params)

@@ -144,6 +144,7 @@ def als_train_sharded(
         alpha=config.alpha,
         chunk=chunk,
         seed=config.seed,
+        n_items=n_items,
     )
     # [n_dev, b+1, f] -> drop per-block dummy row, concatenate, trim padding
     uf = np.asarray(uf).reshape(n_dev, bu + 1, config.rank)[:, :bu].reshape(-1, config.rank)
@@ -165,6 +166,7 @@ def als_train_sharded(
         "alpha",
         "chunk",
         "seed",
+        "n_items",
     ),
 )
 def _als_sharded_jit(
@@ -186,6 +188,7 @@ def _als_sharded_jit(
     alpha: float,
     chunk: int,
     seed: int,
+    n_items: int,
 ):
     spec = P(axis)
 
@@ -201,6 +204,11 @@ def _als_sharded_jit(
         vf_local = jax.random.normal(key, (bi + 1, rank), jnp.float32) / jnp.sqrt(
             rank
         )
+        # zero padding rows whose global index >= n_items so they don't bias
+        # the implicit-mode gram term in the first user-side solve (they only
+        # self-zero after the first item solve otherwise)
+        global_row = d * bi + jnp.arange(bi + 1)
+        vf_local = jnp.where((global_row < n_items)[:, None], vf_local, 0.0)
         uf_local = jnp.zeros((bu + 1, rank), jnp.float32)
 
         def gather_side(local, block):
