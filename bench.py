@@ -6,17 +6,21 @@ The reference publishes no numbers (BASELINE.json ``published: {}``), so
 ``vs_baseline`` is reported against the north-star serving target of
 10 ms p50 (value < 1.0 means better than target).
 
-Serving latency is reported two ways, both printed:
+Serving is reported three ways, all printed:
+  - ``serving_e2e_*``: concurrent HTTP POSTs from separate load-generator
+    processes through the real ``QueryServer`` (micro-batch dispatcher,
+    batched device kernels) — the number a user of ``pio deploy``
+    experiences under load, and what ``vs_baseline`` uses.
   - ``serving_device_p50_ms``: per-query time of the compiled serve kernel
-    on the TPU, measured by timing a jitted scan of 256 back-to-back serves
-    (one dispatch; amortizes transport). This is what a query server
-    co-located with its chip pays per request and is what ``vs_baseline``
-    uses.
-  - ``serving_e2e_p50_ms``: blocking per-call latency from this process,
-    including host<->device transport. On this harness the TPU is attached
-    through a network tunnel (~20 ms RTT floor, reported as
-    ``transport_rtt_ms``), so this number is transport-bound, not
-    framework-bound.
+    alone (slope method, transport cancels) — the co-located-chip floor.
+  - ``serving_seq_*``: one blocking request at a time — what a *serial*
+    client pays per call, transport included.
+Context for reading the e2e numbers on this harness: the TPU is attached
+through a network tunnel (``transport_rtt_ms``, tens of ms — every batch
+pays one RTT) and the host has ``bench_host_cores`` CPU cores (1 here:
+server + load generators share a core, capping HTTP throughput
+independently of the framework). On co-located multi-core serving hardware
+the same stack is bounded by ``serving_device_p50_ms`` + HTTP overhead.
 
 Scale selection: full ML-20M shape on TPU; a reduced ML-100K shape
 elsewhere (CPU dev boxes) or when PIO_BENCH_SCALE=ml100k.
@@ -143,7 +147,8 @@ def main() -> int:
 
     # end-to-end blocking per-call latency + measured sequential throughput
     # (includes transport; on a tunneled chip this is ~= rtt_ms and says
-    # nothing about the framework)
+    # nothing about the framework). Kept for comparison with the concurrent
+    # server numbers below — this is what a *serial* client experiences.
     latencies = []
     q_users = rng.integers(0, n_users, 30)
     t_all0 = time.perf_counter()
@@ -151,8 +156,8 @@ def main() -> int:
         t0 = time.perf_counter()
         index.serve(int(q), k)
         latencies.append(time.perf_counter() - t0)
-    e2e_qps = len(q_users) / (time.perf_counter() - t_all0)
-    e2e_p50_ms = float(np.percentile(np.array(latencies) * 1000.0, 50))
+    seq_qps = len(q_users) / (time.perf_counter() - t_all0)
+    seq_p50_ms = float(np.percentile(np.array(latencies) * 1000.0, 50))
 
     # micro-batched sustained throughput: dispatch every batch up front (an
     # async query server never blocks per batch), then fetch every result to
@@ -171,6 +176,11 @@ def main() -> int:
     results = [index.unpack_batch(np.asarray(o)) for o in outs]
     batch_qps = 64 * n_batches / (time.perf_counter() - t0)
     assert len(results) == n_batches
+
+    # THE e2e number: concurrent HTTP requests through the real QueryServer
+    # (aiohttp + micro-batch dispatcher coalescing into batched device calls).
+    # This is what a user of `pio deploy` experiences under load.
+    server_stats = _bench_server_e2e(uf, vf, k)
 
     # secondary workloads from the BASELINE matrix, one measurement each
     extra = {}
@@ -192,13 +202,17 @@ def main() -> int:
         **extra,
         "unit": "s",
         "train_compile_s": round(compile_s, 1),
-        # serving device-side p50 vs the 10ms north-star target
-        "vs_baseline": round(device_p50_ms / 10.0, 4),
+        # e2e p50 through the real server under concurrency vs the 10 ms
+        # north-star target — the number a user experiences, not the
+        # device-only kernel time (VERDICT r1 weak #1)
+        "vs_baseline": round(server_stats["serving_e2e_p50_ms"] / 10.0, 4),
         "serving_device_p50_ms": round(device_p50_ms, 4),
-        "serving_e2e_p50_ms": round(e2e_p50_ms, 3),
-        "serving_e2e_qps": round(e2e_qps, 1),
+        **{kk: round(vv, 3) for kk, vv in server_stats.items()},
+        "serving_seq_p50_ms": round(seq_p50_ms, 3),
+        "serving_seq_qps": round(seq_qps, 1),
         "serving_batched_qps": round(batch_qps, 1),
         "transport_rtt_ms": round(rtt_ms, 2),
+        "bench_host_cores": os.cpu_count(),
         "platform": platform,
         "scale": {
             "n_users": n_users,
@@ -210,6 +224,194 @@ def main() -> int:
     }
     print(json.dumps(result))
     return 0
+
+
+def _bench_server_e2e(
+    uf: np.ndarray,
+    vf: np.ndarray,
+    k: int,
+    concurrency: int = 64,
+    n_requests: int = 512,
+) -> dict[str, float]:
+    """Measure the deploy surface end-to-end: the real ``QueryServer``
+    (aiohttp + micro-batch dispatcher) on localhost, hit with
+    ``concurrency``-way concurrent POST /queries.json. Reports p50/p95
+    per-request latency, sustained qps, and the average device batch size
+    the dispatcher achieved."""
+    import asyncio
+
+    from predictionio_tpu.data.storage.memory import MemoryStorageClient  # noqa: F401
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.models.recommendation import engine_factory
+    from predictionio_tpu.models.recommendation.engine import ALSModel
+    from predictionio_tpu.workflow.create_server import QueryServer, ServerConfig
+    from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+    n_users, n_items = uf.shape[0], vf.shape[0]
+    model = ALSModel(
+        np.asarray(uf),
+        np.asarray(vf),
+        [f"u{i}" for i in range(n_users)],
+        [f"i{i}" for i in range(n_items)],
+    )
+    # (QueryServer.start() pre-compiles the pow2 batch buckets via the
+    # algorithm's warmup_serving hook — same as a real deploy)
+    engine = engine_factory()
+    ep = engine.engine_params_from_variant(
+        {"datasource": {"params": {"appName": "bench"}}, "algorithms": [{"name": "als", "params": {}}]}
+    )
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    # the server gets its own event loop + real TCP socket in a background
+    # thread; clients are real threads with persistent HTTP connections.
+    # (sharing one asyncio loop between bench client and server caps the
+    # measurement at the loop's own request-processing rate, not the
+    # framework's)
+    import http.client
+    import queue as _queue
+    import socket
+    import threading
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    loop = asyncio.new_event_loop()
+    server_box: dict = {}
+
+    def serve() -> None:
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            server = QueryServer(
+                engine=engine,
+                engine_params=ep,
+                models=[model],
+                manifest=EngineManifest(
+                    engine_id="bench",
+                    version="1",
+                    variant="engine.json",
+                    engine_factory="predictionio_tpu.models.recommendation.engine_factory",
+                ),
+                instance_id="bench",
+                storage=storage,
+                config=ServerConfig(ip="127.0.0.1", port=port, max_batch_size=32),
+            )
+            await server.start()
+            server_box["server"] = server
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    for _ in range(200):  # wait for bind
+        if "server" in server_box:
+            break
+        time.sleep(0.05)
+    else:
+        raise RuntimeError("bench query server failed to start")
+
+    rng = np.random.default_rng(7)
+    users = [f"u{int(u)}" for u in rng.integers(0, n_users, n_requests)]
+
+    # warm the [B]-shaped programs the dispatcher will hit
+    warm_conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    for u in users[:4]:
+        body = json.dumps({"user": u, "num": k})
+        warm_conn.request(
+            "POST", "/queries.json", body, {"Content-Type": "application/json"}
+        )
+        resp = warm_conn.getresponse()
+        resp.read()
+        if resp.status != 200:
+            raise RuntimeError("serving bench warmup failed")
+    warm_conn.close()
+
+    # load generators are separate *processes* (an in-process client would
+    # share the GIL/event loop with the server and measure itself instead)
+    import subprocess
+
+    client_src = r"""
+import asyncio, json, sys, time
+import aiohttp
+
+port, conc, k = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+users = sys.stdin.read().split()
+
+async def main():
+    lat = []
+    errors = 0
+    async with aiohttp.ClientSession() as s:
+        sem = asyncio.Semaphore(conc)
+        async def one(u):
+            nonlocal errors
+            async with sem:
+                t0 = time.perf_counter()
+                async with s.post(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    json={"user": u, "num": k},
+                ) as r:
+                    await r.read()
+                    if r.status != 200:
+                        errors += 1
+                lat.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(u) for u in users))
+        elapsed = time.perf_counter() - t0
+    print(json.dumps({"elapsed": elapsed, "lat": lat, "errors": errors}))
+
+asyncio.run(main())
+"""
+    n_procs = 2
+    per_proc_conc = max(1, concurrency // n_procs)
+    chunks = [users[i::n_procs] for i in range(n_procs)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", client_src, str(port), str(per_proc_conc), str(k)],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            env={**os.environ, "JAX_PLATFORMS": ""},
+        )
+        for _ in range(n_procs)
+    ]
+    # feed every stdin first so all generators run concurrently; each child
+    # times its own request stream (excluding interpreter startup)
+    for p, chunk in zip(procs, chunks):
+        p.stdin.write(" ".join(chunk).encode())
+        p.stdin.close()
+    outs = [p.stdout.read() for p in procs]
+    for p in procs:
+        p.wait(timeout=300)
+
+    batcher = server_box["server"]._batcher
+    loop.call_soon_threadsafe(loop.stop)
+    latencies: list[float] = []
+    n_errors = 0
+    elapsed = 0.0
+    for out in outs:
+        stats = json.loads(out)
+        latencies.extend(stats["lat"])
+        n_errors += stats["errors"]
+        elapsed = max(elapsed, stats["elapsed"])
+    if n_errors:
+        raise RuntimeError(f"serving bench saw {n_errors} non-200 responses")
+    lat_ms = np.asarray(latencies) * 1000.0
+    return {
+        "serving_e2e_p50_ms": float(np.percentile(lat_ms, 50)),
+        "serving_e2e_p95_ms": float(np.percentile(lat_ms, 95)),
+        "serving_e2e_qps": n_requests / elapsed,
+        "serving_avg_batch": (
+            batcher.queries_dispatched / max(1, batcher.batches_dispatched)
+        ),
+    }
 
 
 def _timed(fn) -> float:

@@ -18,7 +18,7 @@ not by the type system.
 from __future__ import annotations
 
 import inspect
-from typing import Any, Generic, Sequence, TypeVar
+from typing import Any, Callable, Generic, Sequence, TypeVar
 
 from predictionio_tpu.controller.params import EmptyParams, Params
 from predictionio_tpu.workflow.context import WorkflowContext
@@ -113,6 +113,35 @@ class BaseAlgorithm(Generic[PD, M, Q, P]):
         default batchPredict :69-71). Jax algorithms override with a
         vectorized path."""
         return [(i, self.predict(model, q)) for i, q in queries]
+
+    def predict_batch(self, model: Any, queries: Sequence[Q]) -> list[P]:
+        """Serving-side micro-batch hook: predict a batch of *live* queries
+        in one device call. The query server's dispatcher coalesces
+        concurrent /queries.json requests into one call here — the TPU answer
+        to the reference's per-request actor dispatch (and its literal
+        ``TODO: Parallelize``, CreateServer.scala:488-491). Default maps
+        ``predict``; device-backed algorithms override with one batched
+        kernel so N concurrent requests cost one device round-trip."""
+        return [self.predict(model, q) for q in queries]
+
+    def predict_batch_dispatch(
+        self, model: Any, queries: Sequence[Q]
+    ) -> Callable[[], list[P]] | None:
+        """Pipelined serving hook: *dispatch* the batch's device work without
+        blocking and return a zero-arg finalize callable that fetches and
+        decodes the results. The query server dispatches batch n+1 while
+        batch n's results are still crossing the transport, so sustained
+        throughput approaches the pure device-batched rate and per-request
+        latency approaches one transport round-trip. Return None (the
+        default) to use the synchronous ``predict_batch`` path."""
+        return None
+
+    def warmup_serving(self, model: Any, max_batch: int) -> None:
+        """Deploy-time warm-up: pre-compile the device programs the serving
+        path will hit (e.g. every power-of-two batch bucket up to
+        ``max_batch``) so the first burst of traffic doesn't pay XLA
+        compiles. Called by the query server at start and after /reload.
+        Default: nothing to warm."""
 
     # -- persistence hooks (ref makePersistentModel, BaseAlgorithm.scala:95)
     def make_persistent_model(self, ctx: WorkflowContext, model: Any) -> Any:

@@ -42,6 +42,9 @@ from predictionio_tpu.workflow.context import WorkflowContext
 # ---------------------------------------------------------------------------
 
 
+DEFAULT_QUERY_NUM = 10
+
+
 @dataclasses.dataclass(frozen=True)
 class Query:
     """``blackList`` mirrors the blacklist-items variant
@@ -49,7 +52,7 @@ class Query:
     Engine.scala:23-27``); None means no filtering."""
 
     user: str
-    num: int = 10
+    num: int = DEFAULT_QUERY_NUM
     black_list: frozenset[str] | None = None
 
     @staticmethod
@@ -389,6 +392,83 @@ class ALSAlgorithm(JaxAlgorithm):
                 if np.isfinite(s)
             )
         )
+
+    def warmup_serving(self, model: ALSModel, max_batch: int) -> None:
+        """Pre-compile the single-query program plus every pow2 batch bucket
+        for the default result size, so the first request burst after deploy
+        or /reload pays no XLA compiles."""
+        index = model.serving_index()
+        k = min(DEFAULT_QUERY_NUM, len(model.item_vocab))
+        index.warmup(k)
+        index.warmup_buckets(k, max_batch)
+
+    def predict_batch(
+        self, model: ALSModel, queries: Sequence[Query]
+    ) -> list[PredictedResult]:
+        """Serving micro-batch: all mask-free known-user queries become ONE
+        batched top-k kernel ([B] indices -> [B,2,k] packed result); unknown
+        users answer empty and blacklist queries (per-query device mask) fall
+        back to the single-query path. This is what lets the query server
+        sustain batched-kernel throughput end-to-end instead of one device
+        round-trip per request."""
+        return self.predict_batch_dispatch(model, queries)()
+
+    def predict_batch_dispatch(
+        self, model: ALSModel, queries: Sequence[Query]
+    ):
+        """Pipelined serving: dispatch the batched top-k kernel now, fetch in
+        the returned finalize — the query server overlaps batch n's transport
+        with batch n+1's dispatch (ops.als.ServingIndex.serve_batch_async)."""
+        from predictionio_tpu.ops.als import ServingIndex, next_pow2
+
+        results: list[PredictedResult | None] = [None] * len(queries)
+        batch_pos: list[int] = []
+        batch_idx: list[int] = []
+        masked_pos: list[int] = []
+        for i, q in enumerate(queries):
+            uidx = model.user_index(q.user)
+            if uidx is None:
+                results[i] = PredictedResult(())
+            elif q.black_list:
+                # per-query device mask: single-query path, but deferred to
+                # finalize — a blocking predict here would stall the shared
+                # dispatch thread for a full device round-trip
+                masked_pos.append(i)
+            else:
+                batch_pos.append(i)
+                batch_idx.append(uidx)
+        n_items = len(model.item_vocab)
+        handle = None
+        if batch_pos:
+            # bucket B and k to powers of two: every distinct shape compiles
+            # its own XLA program, and ragged request arrivals would
+            # otherwise trigger a compile storm (each a full round-trip on a
+            # tunneled chip); buckets cap the universe at ~log2(max_batch)
+            # programs, pre-warmed via ServingIndex.warmup_buckets
+            k = min(max(queries[i].num for i in batch_pos), n_items)
+            kk = min(next_pow2(k), n_items)
+            bucket = next_pow2(len(batch_pos))
+            idxs = np.zeros(bucket, np.int32)  # pad rows serve user 0, dropped
+            idxs[: len(batch_pos)] = batch_idx
+            handle = model.serving_index().serve_batch_async(idxs, kk)
+
+        def finalize() -> list[PredictedResult]:
+            for i in masked_pos:
+                results[i] = self.predict(model, queries[i])
+            if handle is not None:
+                scores, idx = ServingIndex.unpack_batch(np.asarray(handle))
+                for row, i in enumerate(batch_pos):
+                    num = min(queries[i].num, n_items)
+                    results[i] = PredictedResult(
+                        tuple(
+                            ItemScore(model.item_vocab[int(it)], float(s))
+                            for s, it in zip(scores[row, :num], idx[row, :num])
+                            if np.isfinite(s)
+                        )
+                    )
+            return results  # type: ignore[return-value]
+
+        return finalize
 
 
 class Serving(BaseServing):

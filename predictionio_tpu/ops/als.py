@@ -277,6 +277,13 @@ def top_k_items(
     return _unpack(packed)
 
 
+def next_pow2(n: int) -> int:
+    """Bucket-rounding rule shared by the batched predict path and
+    ``ServingIndex.warmup_buckets`` — they must agree or warmed shapes won't
+    match served shapes and serve-time compiles come back."""
+    return 1 << max(0, n - 1).bit_length()
+
+
 class ServingIndex:
     """Device-resident factor tables with index-addressed top-k serve.
 
@@ -306,6 +313,27 @@ class ServingIndex:
                 jnp.int32(0), self.user_factors, self.item_factors, self._full_mask, k
             )
         )
+
+    def warmup_buckets(self, k: int, max_batch: int) -> None:
+        """Pre-compile every power-of-two batch bucket up to ``max_batch``
+        for top-``k`` (k rounded up to its own bucket). The batched predict
+        path buckets ragged batch sizes to powers of two; compiling them all
+        at deploy time keeps the first ragged burst from paying a compile."""
+        kk = min(next_pow2(k), self.n_items)
+        b = 1
+        handles = []
+        while b <= max_batch:
+            handles.append(
+                _serve_by_index_batch(
+                    jnp.zeros((b,), jnp.int32),
+                    self.user_factors,
+                    self.item_factors,
+                    self._full_mask,
+                    kk,
+                )
+            )
+            b *= 2
+        jax.block_until_ready(handles)
 
     def serve(
         self, user_index: int, k: int, mask: jax.Array | np.ndarray | None = None

@@ -60,6 +60,14 @@ class ServerConfig:
     # 595-611): POST log_prefix + JSON{engineInstance, message} to log_url
     log_url: str | None = None
     log_prefix: str = ""
+    # serving micro-batch dispatch: concurrent /queries.json requests are
+    # coalesced into one algorithm.predict_batch call (the reference predicts
+    # per-request on an actor and carries a literal ``TODO: Parallelize``,
+    # CreateServer.scala:488-491). max_batch_size <= 1 disables coalescing;
+    # batch_window_ms > 0 adds a flush timer (rarely needed: batches form
+    # adaptively while the previous batch is in flight on the worker thread).
+    max_batch_size: int = 128
+    batch_window_ms: float = 0.0
 
     def ssl_context(self):
         if bool(self.ssl_certfile) != bool(self.ssl_keyfile):
@@ -74,6 +82,119 @@ class ServerConfig:
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         ctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
         return ctx
+
+
+class _MicroBatcher:
+    """Coalesces concurrent /queries.json requests into batched predicts.
+
+    Requests enqueue (payload, future) pairs; a single dispatcher pulls
+    everything pending (up to ``max_batch``) and hands the batch to a
+    dedicated worker thread, which runs the full decode -> supplement ->
+    predict_batch -> serve pipeline off the event loop. Batching is
+    *adaptive*: while the worker is busy with batch n, new arrivals
+    accumulate and become batch n+1 — a solo request dispatches immediately
+    (no timer penalty), a concurrent burst converges to one device call per
+    batch. An optional flush window can be configured but is 0 by default.
+    """
+
+    def __init__(
+        self,
+        server: "QueryServer",
+        max_batch: int,
+        window_s: float,
+        max_inflight: int = 4,
+    ):
+        import concurrent.futures
+
+        self._server = server
+        self.max_batch = max(1, max_batch)
+        self.window_s = max(0.0, window_s)
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+        # dispatch runs on one thread (decode + device enqueue, fast);
+        # fetches block on the transport and overlap on their own threads
+        self._dispatch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pio-dispatch"
+        )
+        self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, max_inflight), thread_name_prefix="pio-fetch"
+        )
+        self._inflight = asyncio.Semaphore(max(1, max_inflight))
+        self._finish_tasks: set[asyncio.Task] = set()
+        self.batches_dispatched = 0
+        self.queries_dispatched = 0
+
+    async def submit(self, payload: Any) -> Any:
+        """Enqueue one query payload; returns the encoded result body or
+        raises the per-query error."""
+        fut = asyncio.get_running_loop().create_future()
+        self._queue.put_nowait((payload, fut))
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._run())
+        return await fut
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            item = await self._queue.get()
+            batch = [item]
+            if self.window_s > 0:
+                await asyncio.sleep(self.window_s)
+            while len(batch) < self.max_batch:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            await self._inflight.acquire()  # bound batches in flight
+            try:
+                finalize = await loop.run_in_executor(
+                    self._dispatch_pool,
+                    self._server._dispatch_query_batch,
+                    [payload for payload, _ in batch],
+                )
+            except asyncio.CancelledError:
+                self._inflight.release()
+                raise  # close() must actually terminate the collect loop
+            except BaseException as exc:
+                self._inflight.release()
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                continue
+            self.batches_dispatched += 1
+            self.queries_dispatched += len(batch)
+            # finish asynchronously: the collect loop immediately forms and
+            # dispatches the next batch while this one's fetch is in flight
+            task = asyncio.ensure_future(self._finish(batch, finalize))
+            self._finish_tasks.add(task)
+            task.add_done_callback(self._finish_tasks.discard)
+
+    async def _finish(self, batch: list, finalize) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            outs = await loop.run_in_executor(self._fetch_pool, finalize)
+        except asyncio.CancelledError:
+            raise  # don't convert shutdown into client-visible errors
+        except BaseException as exc:
+            outs = [exc] * len(batch)
+        finally:
+            self._inflight.release()
+        for (_, fut), out in zip(batch, outs):
+            if fut.done():  # client gone / cancelled
+                continue
+            if isinstance(out, BaseException):
+                fut.set_exception(out)
+            else:
+                fut.set_result(out)
+
+    def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        for task in list(self._finish_tasks):
+            task.cancel()
+        self._dispatch_pool.shutdown(wait=False, cancel_futures=True)
+        self._fetch_pool.shutdown(wait=False, cancel_futures=True)
 
 
 class QueryServer:
@@ -110,6 +231,16 @@ class QueryServer:
         self._stop_event = asyncio.Event()
         # strong refs to fire-and-forget tasks (the loop keeps only weak ones)
         self._bg_tasks: set[asyncio.Task] = set()
+        self._batcher = _MicroBatcher(
+            self,
+            max_batch=self.config.max_batch_size,
+            window_s=self.config.batch_window_ms / 1000.0,
+        )
+        import concurrent.futures
+
+        self._sniffer_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pio-sniffer"
+        )
 
     # ---------------------------------------------------------------- routes
     async def handle_queries(self, request: web.Request) -> web.Response:
@@ -125,32 +256,18 @@ class QueryServer:
         except Exception as exc:
             return web.json_response({"message": str(exc)}, status=400)
         try:
-            query = self.engine.decode_query(payload)
-            supplemented = self.serving.supplement(query)
-            predictions = [
-                algo.predict(model, supplemented)
-                for algo, model in zip(self.algorithms, self.models)
-            ]
-            result = self.serving.serve(query, predictions)
-            result = self.plugin_context.apply_output_blockers(
-                self.manifest.variant, query, result
-            )
-            body = Engine.encode_result(result)
-            if self.plugin_context.output_sniffers:
-                # asynchronous observers: off the request path, result object
-                asyncio.get_running_loop().run_in_executor(
-                    None,
-                    self.plugin_context.notify_output_sniffers,
-                    self.manifest.variant,
-                    query,
-                    result,
-                )
+            # the batcher runs decode -> supplement -> predict_batch -> serve
+            # on its worker thread, so the event loop never blocks on device
+            # or storage work and concurrent requests coalesce into one
+            # batched device call
+            body = await self._batcher.submit(payload)
         except Exception as exc:
             logger.exception("query failed")
             if self.config.log_url:
                 import traceback
 
-                msg = f"Query:\n{payload}\n\nStack Trace:\n{traceback.format_exc()}\n\n"
+                tb = "".join(traceback.format_exception(exc))
+                msg = f"Query:\n{payload}\n\nStack Trace:\n{tb}\n\n"
                 self._spawn_bg(self._remote_log(msg))
             return web.json_response({"message": str(exc)}, status=400)
         elapsed = time.perf_counter() - t0
@@ -161,6 +278,108 @@ class QueryServer:
         if self.config.feedback:
             self._spawn_bg(self._send_feedback(payload, body))
         return web.json_response(body)
+
+    def _dispatch_query_batch(self, payloads: list[Any]):
+        """Dispatch-phase of one micro-batch (runs on the dispatch thread):
+        decode and supplement each query, then *dispatch* every algorithm's
+        device work via ``predict_batch_dispatch`` without blocking on
+        results. Returns a finalize callable (run on a fetch thread) that
+        blocks on the transport, serves, and encodes — so the dispatcher can
+        start batch n+1 while batch n's results are in flight.
+
+        Per-query failures are isolated: the failing slot gets its
+        exception, batch mates answer normally. Finalize returns one entry
+        per payload — an encoded result body or an exception."""
+        # capture component refs so an in-flight batch is immune to /reload
+        algorithms, models = self.algorithms, self.models
+        serving = self.serving
+        n = len(payloads)
+        outs: list[Any] = [None] * n
+        queries: list[Any] = [None] * n
+        supplemented: list[Any] = [None] * n
+        valid: list[int] = []
+        for i, payload in enumerate(payloads):
+            try:
+                q = self.engine.decode_query(payload)
+                queries[i] = q
+                supplemented[i] = serving.supplement(q)
+                valid.append(i)
+            except Exception as exc:
+                outs[i] = exc
+        sup = [supplemented[i] for i in valid]
+        finalizers: list[Any] = []
+        if valid:
+            for algo, model in zip(algorithms, models):
+                fin = None
+                try:
+                    fin = algo.predict_batch_dispatch(model, sup)
+                except Exception:
+                    logger.exception(
+                        "predict_batch_dispatch failed; deferring to fetch"
+                    )
+                finalizers.append(fin)
+
+        def finalize() -> list[Any]:
+            if not valid:
+                return outs
+            preds_per_algo: list[list[Any]] = []
+            for fin, (algo, model) in zip(finalizers, zip(algorithms, models)):
+                try:
+                    if fin is not None:
+                        preds = list(fin())
+                    else:
+                        preds = list(algo.predict_batch(model, sup))
+                    if len(preds) != len(sup):
+                        raise RuntimeError(
+                            f"predict_batch returned {len(preds)} results "
+                            f"for {len(sup)} queries"
+                        )
+                except Exception:
+                    # isolate failures: retry each query on the single path
+                    # so one poisonous query can't fail the whole batch
+                    logger.exception(
+                        "batched predict failed; falling back to per-query"
+                    )
+                    preds = []
+                    for s in sup:
+                        try:
+                            preds.append(algo.predict(model, s))
+                        except Exception as exc:
+                            logger.exception("query predict failed")
+                            preds.append(exc)
+                preds_per_algo.append(preds)
+            sniffed: list[tuple[Any, Any]] = []
+            for row, i in enumerate(valid):
+                try:
+                    plist = [preds[row] for preds in preds_per_algo]
+                    for p in plist:
+                        if isinstance(p, BaseException):
+                            raise p
+                    result = serving.serve(queries[i], plist)
+                    result = self.plugin_context.apply_output_blockers(
+                        self.manifest.variant, queries[i], result
+                    )
+                    outs[i] = Engine.encode_result(result)
+                    sniffed.append((queries[i], result))
+                except Exception as exc:
+                    outs[i] = exc
+            if sniffed and self.plugin_context.output_sniffers:
+                # observers are fire-and-forget on their own thread: a slow
+                # or throwing sniffer must neither delay the batch's
+                # responses nor overwrite a successful result
+                self._sniffer_pool.submit(self._notify_sniffers, sniffed)
+            return outs
+
+        return finalize
+
+    def _notify_sniffers(self, sniffed: list) -> None:
+        for query, result in sniffed:
+            try:
+                self.plugin_context.notify_output_sniffers(
+                    self.manifest.variant, query, result
+                )
+            except Exception:
+                logger.exception("output sniffer failed")
 
     def _spawn_bg(self, coro) -> None:
         task = asyncio.ensure_future(coro)
@@ -219,6 +438,14 @@ class QueryServer:
                 "avgServingSec": self.avg_serving_sec,
                 "lastServingSec": self.last_serving_sec,
                 "latency": self.latency.summary(),
+                "batching": {
+                    "batches": self._batcher.batches_dispatched,
+                    "queries": self._batcher.queries_dispatched,
+                    "avgBatchSize": (
+                        self._batcher.queries_dispatched
+                        / max(1, self._batcher.batches_dispatched)
+                    ),
+                },
             }
         )
 
@@ -246,6 +473,7 @@ class QueryServer:
         self.engine_params = engine_params
         self.models = models
         self.instance_id = latest.id
+        await asyncio.get_running_loop().run_in_executor(None, self._warmup)
         logger.info("reloaded engine instance %s", latest.id)
         return web.json_response({"message": "Reload successful", "instanceId": latest.id})
 
@@ -281,9 +509,26 @@ class QueryServer:
                 web.get("/plugins.json", self.handle_plugins),
             ]
         )
+
+        async def _close_batcher(app: web.Application) -> None:
+            # cancel the collect loop while its event loop is still alive
+            # (otherwise the pending task leaks a "loop is closed" warning)
+            self._batcher.close()
+
+        app.on_cleanup.append(_close_batcher)
         return app
 
+    def _warmup(self) -> None:
+        """Pre-compile serving programs (pow2 batch buckets etc.) so the
+        first traffic burst after deploy/reload pays no XLA compiles."""
+        for algo, model in zip(self.algorithms, self.models):
+            try:
+                algo.warmup_serving(model, self.config.max_batch_size)
+            except Exception:
+                logger.exception("serving warmup failed (continuing)")
+
     async def start(self) -> None:
+        await asyncio.get_running_loop().run_in_executor(None, self._warmup)
         retries = max(1, self.config.bind_retries)
         last_error: Exception | None = None
         for attempt in range(retries):
@@ -319,6 +564,8 @@ class QueryServer:
         logger.info("engine server on %s:%d", self.config.ip, self.config.port)
 
     async def stop(self) -> None:
+        self._batcher.close()
+        self._sniffer_pool.shutdown(wait=False, cancel_futures=True)
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

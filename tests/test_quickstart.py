@@ -249,3 +249,104 @@ class TestQuickstart:
             for q, p, a in qpa:
                 assert q.num == 3
                 assert all(r.user == q.user for r in a.ratings)
+
+
+class TestMicroBatchServing:
+    """The serving micro-batch dispatcher (VERDICT round-1 item #1): concurrent
+    /queries.json requests coalesce into one predict_batch device call."""
+
+    def _make_server(self, storage, **cfg):
+        from predictionio_tpu.workflow.core_workflow import load_models_for_instance
+
+        engine, ep, instance_id = train(storage)
+        models = load_models_for_instance(engine, ep, instance_id, storage=storage)
+        return QueryServer(
+            engine=engine,
+            engine_params=ep,
+            models=models,
+            manifest=manifest(),
+            instance_id=instance_id,
+            storage=storage,
+            config=ServerConfig(**cfg),
+        )
+
+    def test_concurrent_queries_coalesce(self, seeded_storage):
+        # a 50 ms flush window makes coalescing deterministic: every request
+        # of the burst lands inside the first batch's window
+        server = self._make_server(seeded_storage, batch_window_ms=50.0)
+
+        async def body():
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                n = 16
+                resps = await asyncio.gather(
+                    *(
+                        client.post("/queries.json", json={"user": f"u{i % N_USERS}", "num": 3})
+                        for i in range(n)
+                    )
+                )
+                for r in resps:
+                    assert r.status == 200
+                    data = await r.json()
+                    assert len(data["itemScores"]) == 3
+                status = await (await client.get("/")).json()
+                assert status["batching"]["queries"] == n
+                # the burst must have coalesced (not one batch per request)
+                assert status["batching"]["batches"] <= 3
+                assert status["batching"]["avgBatchSize"] > 2
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+
+    def test_batch_error_isolation(self, seeded_storage):
+        """One malformed query in a coalesced batch fails alone; its batch
+        mates answer normally."""
+        server = self._make_server(seeded_storage, batch_window_ms=50.0)
+
+        async def body():
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                payloads = [
+                    {"user": "u0", "num": 2},
+                    {"wrong": 1},  # decode error
+                    {"user": "u1", "num": 2},
+                    {"user": "ghost", "num": 2},  # unknown user: empty, not error
+                ]
+                resps = await asyncio.gather(
+                    *(client.post("/queries.json", json=p) for p in payloads)
+                )
+                assert [r.status for r in resps] == [200, 400, 200, 200]
+                assert (await resps[3].json())["itemScores"] == []
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+
+    def test_predict_batch_matches_predict(self, seeded_storage):
+        """ALS predict_batch must agree with the single-query path across
+        known users, unknown users, per-query num, and blacklists."""
+        from predictionio_tpu.models.recommendation.engine import Query
+
+        engine, ep, instance_id = train(seeded_storage)
+        from predictionio_tpu.workflow.core_workflow import load_models_for_instance
+
+        models = load_models_for_instance(engine, ep, instance_id, storage=seeded_storage)
+        _, _, algos, _ = engine.make_components(ep)
+        algo, model = algos[0], models[0]
+        queries = [
+            Query(user="u0", num=3),
+            Query(user="ghost", num=4),
+            Query(user="u1", num=5),
+            Query(user="u2", num=2, black_list=("i0", "i1")),
+            Query(user="u3", num=8),
+        ]
+        batched = algo.predict_batch(model, queries)
+        singles = [algo.predict(model, q) for q in queries]
+        assert len(batched) == len(singles)
+        for b, s in zip(batched, singles):
+            assert [x.item for x in b.item_scores] == [x.item for x in s.item_scores]
+            for xb, xs in zip(b.item_scores, s.item_scores):
+                assert abs(xb.score - xs.score) < 1e-5
