@@ -322,7 +322,10 @@ class ServingIndex:
         kk = min(next_pow2(k), self.n_items)
         b = 1
         handles = []
-        while b <= max_batch:
+        # the dispatch path buckets len(batch) <= max_batch up to
+        # next_pow2(max_batch), so that is the range to warm (warming only
+        # to max_batch would leave e.g. bucket 128 cold for max_batch=100)
+        while b <= next_pow2(max_batch):
             handles.append(
                 _serve_by_index_batch(
                     jnp.zeros((b,), jnp.int32),
