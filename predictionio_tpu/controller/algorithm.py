@@ -27,11 +27,23 @@ from predictionio_tpu.workflow.context import WorkflowContext
 def model_to_host(model: Any) -> Any:
     """Pull every jax array in a model pytree to host numpy — the
     sharding-agnostic checkpoint form (SURVEY.md hard part (f): train on a
-    v5e-16, serve on one host)."""
-    return jax.tree_util.tree_map(
-        lambda x: np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x,
-        model,
-    )
+    v5e-16, serve on one host).
+
+    Arrays sharded across *processes* are not fully addressable from any one
+    host; those are gathered with a cross-host collective first (every
+    process must call this — it happens inside make_serializable_models,
+    which all processes run)."""
+
+    def pull(x):
+        if not isinstance(x, jax.Array):
+            return x
+        if not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            x = multihost_utils.process_allgather(x, tiled=True)
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map(pull, model)
 
 
 class JaxAlgorithm(BaseAlgorithm[PD, M, Q, P], Generic[PD, M, Q, P]):

@@ -147,9 +147,19 @@ def als_train_sharded(
         n_items=n_items,
     )
     # [n_dev, b+1, f] -> drop per-block dummy row, concatenate, trim padding
-    uf = np.asarray(uf).reshape(n_dev, bu + 1, config.rank)[:, :bu].reshape(-1, config.rank)
-    vf = np.asarray(vf).reshape(n_dev, bi + 1, config.rank)[:, :bi].reshape(-1, config.rank)
+    uf = _fetch(uf).reshape(n_dev, bu + 1, config.rank)[:, :bu].reshape(-1, config.rank)
+    vf = _fetch(vf).reshape(n_dev, bi + 1, config.rank)[:, :bi].reshape(-1, config.rank)
     return uf[:n_users], vf[:n_items]
+
+
+def _fetch(a) -> np.ndarray:
+    """Device -> host, gathering across processes when the mesh spans hosts
+    (a multi-host sharded array is not addressable from any single host)."""
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        a = multihost_utils.process_allgather(a, tiled=True)
+    return np.asarray(a)
 
 
 @functools.partial(

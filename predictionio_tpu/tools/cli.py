@@ -220,11 +220,41 @@ def cmd_build(args) -> int:
     return 0
 
 
+def _strip_launcher_flags(argv: list[str]) -> list[str]:
+    """Drop --num-hosts/--hosts (and their values) so workers don't
+    recursively launch fleets."""
+    out: list[str] = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a in ("--num-hosts", "--hosts"):
+            skip = True
+            continue
+        if a.startswith("--num-hosts=") or a.startswith("--hosts="):
+            continue
+        out.append(a)
+    return out
+
+
 def cmd_train(args) -> int:
     from predictionio_tpu.controller.engine import TrainOptions
     from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
     from predictionio_tpu.workflow.core_workflow import run_train
     from predictionio_tpu.workflow.engine_loader import load_engine
+
+    hosts = [h for h in (args.hosts or "").split(",") if h]
+    if (args.num_hosts > 1 or hosts) and "PIO_PROCESS_ID" not in os.environ:
+        # launcher role (ref Runner.runOnSpark, Runner.scala:185-334): spawn
+        # one worker per host running this same train command; workers join
+        # via the PIO_COORDINATOR contract and this process supervises
+        from predictionio_tpu.parallel.launcher import launch_cli_multihost
+
+        worker_args = _strip_launcher_flags(sys.argv[1:])
+        return launch_cli_multihost(
+            worker_args, num_hosts=args.num_hosts, hosts=hosts or None
+        )
 
     maybe_initialize_distributed()
 
@@ -632,6 +662,18 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--skip-sanity-check", action="store_true")
     x.add_argument("--stop-after-read", action="store_true")
     x.add_argument("--stop-after-prepare", action="store_true")
+    x.add_argument(
+        "--num-hosts",
+        type=int,
+        default=1,
+        help="launch N local worker processes joined via jax.distributed "
+        "(ref Runner.runOnSpark)",
+    )
+    x.add_argument(
+        "--hosts",
+        default="",
+        help="comma-separated remote hosts; one ssh-launched worker each",
+    )
     x.set_defaults(fn=cmd_train)
 
     x = sub.add_parser("eval")
@@ -717,6 +759,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from predictionio_tpu.utils.platform import ensure_cpu_if_requested
+
+    ensure_cpu_if_requested()
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

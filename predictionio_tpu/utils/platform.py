@@ -1,0 +1,31 @@
+"""Platform selection guard.
+
+This image (like many TPU dev hosts) registers an out-of-tree PJRT plugin
+whose device init talks to a network tunnel and can hang when the tunnel is
+unreachable. When the user *explicitly* asked for CPU (``JAX_PLATFORMS=cpu``)
+nothing should ever touch the plugin — but a sitecustomize may have imported
+jax before the env var was visible, so the env alone is not enough. Dropping
+the non-standard backend factories and re-pointing the live config makes an
+explicit CPU run hermetic. Mirrors ``tests/conftest.py``.
+"""
+
+from __future__ import annotations
+
+import os
+
+_STANDARD = {"cpu", "gpu", "cuda", "rocm", "tpu", "METAL"}
+
+
+def ensure_cpu_if_requested() -> None:
+    """If JAX_PLATFORMS=cpu, make the CPU backend the only reachable one."""
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return
+    try:
+        import jax
+        from jax._src import xla_bridge as xb
+
+        for name in [n for n in xb._backend_factories if n not in _STANDARD]:
+            xb._backend_factories.pop(name, None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - guard must never break startup
+        pass

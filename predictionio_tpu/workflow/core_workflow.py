@@ -44,9 +44,28 @@ def run_train(
     batch: str = "",
     env: dict[str, str] | None = None,
 ) -> str:
-    """Run training end-to-end; returns the engine-instance id."""
+    """Run training end-to-end; returns the engine-instance id.
+
+    Multi-host: every process runs the same compute (SPMD — non-coordinator
+    hosts must participate in the collectives inside ``engine.train``), but
+    only process 0 touches the metadata/model stores; the others return ""
+    (ref: the Spark driver was the single metadata writer,
+    CoreWorkflow.scala:45-102).
+    """
+    import jax
+
     storage = storage or Storage.instance()
     ctx = ctx or WorkflowContext(mode="training", _storage=storage, batch=batch)
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        models = engine.train(ctx, engine_params, options)
+        if not (options and (options.stop_after_read or options.stop_after_prepare)):
+            # serialization includes the cross-host gather of sharded model
+            # arrays (model_to_host), which is itself a collective — every
+            # process must run it even though only process 0 persists
+            engine.make_serializable_models(ctx, engine_params, models)
+        CleanupFunctions.run()
+        logger.info("process %d finished (coordinator persists)", jax.process_index())
+        return ""
     instances = storage.get_meta_data_engine_instances()
     params_json = Engine.engine_params_to_json(engine_params)
     instance = EngineInstance(
