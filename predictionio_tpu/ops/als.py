@@ -15,7 +15,21 @@ Design notes (TPU):
     opposite-side factors, forms rank-1 Gram contributions via one einsum
     (``cf,cg->cfg``), and scatter-adds into the per-entity ``A``/``b``
     accumulators. No data-dependent shapes anywhere.
-  - Explicit mode solves ``(A_u + reg*I) x = b_u`` per entity.
+  - Explicit mode solves ``(A_u + reg*n_u*I) x = b_u`` per entity, where
+    ``n_u`` is the entity's rating count — the ALS-WR degree-scaled
+    regularization (Zhou et al., "Large-scale Parallel Collaborative
+    Filtering for the Netflix Prize"; the same weighted-λ scheme MLlib's
+    ALS popularized). This is a *numerical requirement* on TPU, not a
+    style choice: under a power-law item popularity (bench triage round 3:
+    the zipf head item carries ~25% of all ratings at ML-20M scale) the
+    hub entity's Gram matrix ``Σ u u^T`` accumulates millions of fp32
+    rank-1 terms, its condition number blows up, Cholesky hits a
+    rounding-induced negative pivot, and the NaNs take the whole model
+    down within two further iterations. Degree-scaled reg keeps the
+    regularizer proportional to the Gram magnitude, so conditioning is
+    degree-invariant. ``ALSConfig.reg_scaling`` selects: ``auto`` (degree
+    for explicit, constant for implicit — implicit's shared ``V^T V``
+    dense term already regularizes hubs), ``degree``, or ``constant``.
     Implicit mode (ref ``ALS.trainImplicit``) uses the classic trick:
     ``A_u = V^T V + Σ_i (c_i - 1) v_i v_i^T + reg*I`` with confidence
     ``c = 1 + alpha * r``, so the dense term is a single f×f matmul shared
@@ -47,6 +61,14 @@ class ALSConfig:
     alpha: float = 1.0  # implicit confidence scale
     seed: int = 3
     chunk: int = 16384  # COO rows per scan step
+    # "auto" | "degree" | "constant" — see module docstring (ALS-WR)
+    reg_scaling: str = "auto"
+
+    @property
+    def degree_scaled_reg(self) -> bool:
+        if self.reg_scaling == "auto":
+            return not self.implicit
+        return self.reg_scaling == "degree"
 
 
 def _pad_coo(
@@ -70,19 +92,23 @@ def _normal_equations(
     chunk: int,
     implicit: bool,
     alpha: float,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Accumulate A [E, f, f] and b [E, f] over fixed-size COO chunks."""
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Accumulate A [E, f, f], b [E, f], and rating counts [E] over
+    fixed-size COO chunks. Counts feed degree-scaled regularization; the
+    dummy padding row accumulates garbage counts, which is harmless (its
+    solution is discarded)."""
     f = opposite.shape[1]
     n_chunks = rows.shape[0] // chunk
     A0 = jnp.zeros((n_entities, f, f), opposite.dtype)
     b0 = jnp.zeros((n_entities, f), opposite.dtype)
+    n0 = jnp.zeros((n_entities,), opposite.dtype)
 
     r_ch = rows.reshape(n_chunks, chunk)
     c_ch = cols.reshape(n_chunks, chunk)
     v_ch = vals.reshape(n_chunks, chunk)
 
     def step(carry, inputs):
-        A, b = carry
+        A, b, n = carry
         r, c, v = inputs
         vecs = opposite[c]  # [chunk, f] gather
         if implicit:
@@ -97,43 +123,75 @@ def _normal_equations(
         outers = jnp.einsum("c,cf,cg->cfg", outer_w, vecs, vecs)
         A = A.at[r].add(outers)
         b = b.at[r].add(b_w[:, None] * vecs)
-        return (A, b), None
+        n = n.at[r].add(jnp.ones_like(v))
+        return (A, b, n), None
 
-    (A, b), _ = lax.scan(step, (A0, b0), (r_ch, c_ch, v_ch))
-    return A, b
+    (A, b, n), _ = lax.scan(step, (A0, b0, n0), (r_ch, c_ch, v_ch))
+    return A, b, n
 
 
 def _solve_side(
-    rows, cols, vals, opposite, n_entities, chunk, reg, implicit, alpha
+    rows,
+    cols,
+    vals,
+    opposite,
+    n_entities,
+    chunk,
+    reg,
+    implicit,
+    alpha,
+    degree_scaled_reg: bool = True,
 ):
     f = opposite.shape[1]
-    A, b = _normal_equations(
+    A, b, counts = _normal_equations(
         rows, cols, vals, opposite, n_entities, chunk, implicit, alpha
     )
     eye = jnp.eye(f, dtype=opposite.dtype)
     if implicit:
         gram = opposite.T @ opposite  # shared dense term, one f x f matmul
         A = A + gram[None, :, :]
-    A = A + reg * eye[None, :, :]
+    if degree_scaled_reg:
+        # ALS-WR: λ·n_e·I — degree-invariant conditioning (module docstring)
+        scale = jnp.maximum(counts, 1.0)
+        A = A + (reg * scale)[:, None, None] * eye[None, :, :]
+    else:
+        A = A + reg * eye[None, :, :]
     # batched SPD solve; Cholesky maps well to the MXU at small f
     factors = jax.scipy.linalg.cho_solve((jnp.linalg.cholesky(A), True), b)
     return factors
 
 
+# One ALS iteration per executable launch — deliberately NOT a fused
+# fori_loop over iterations. Round-3 triage of the round-2 bench crash
+# found two hard reasons:
+#   1. The remote-attach TPU runtime kills any single program execution
+#      running longer than ~60s (surfaces as an opaque UNAVAILABLE device
+#      fault at the next fetch). At ML-20M scale one iteration is seconds
+#      of device time, so a 10-iteration fused loop is guaranteed dead.
+#   2. A fused loop with a static trip count gets unrolled by XLA (compile
+#      time scales with iterations) and with a traced trip count hides
+#      per-iteration progress.
+# Host-looped dispatch costs one dispatch RTT per iteration (negligible
+# against seconds of device work), keeps every launch far under the
+# watchdog, never recompiles when `iterations` changes, and gives the
+# trainer natural mid-train checkpoint/convergence hooks. Factors and the
+# COO tables stay resident on device across launches.
 @functools.partial(
     jax.jit,
     static_argnames=(
         "n_users",
         "n_items",
-        "rank",
-        "iterations",
         "reg",
         "implicit",
         "alpha",
         "chunk",
+        "degree_scaled_reg",
     ),
+    donate_argnums=(0, 1),
 )
-def _als_iterate(
+def _als_step(
+    user_factors,
+    item_factors,
     u_rows,
     i_cols,
     vals_by_u,
@@ -143,35 +201,32 @@ def _als_iterate(
     *,
     n_users: int,
     n_items: int,
-    rank: int,
-    iterations: int,
     reg: float,
     implicit: bool,
     alpha: float,
     chunk: int,
-    seed: int = 0,
+    degree_scaled_reg: bool = True,
 ):
+    user_factors = _solve_side(
+        u_rows, i_cols, vals_by_u, item_factors, n_users + 1, chunk, reg,
+        implicit, alpha, degree_scaled_reg,
+    )
+    item_factors = _solve_side(
+        i_rows, u_cols, vals_by_i, user_factors, n_items + 1, chunk, reg,
+        implicit, alpha, degree_scaled_reg,
+    )
+    return user_factors, item_factors
+
+
+@functools.partial(jax.jit, static_argnames=("n_users", "n_items", "rank", "seed"))
+def _als_init(*, n_users: int, n_items: int, rank: int, seed: int):
     key = jax.random.PRNGKey(seed)
     # +1 dummy row absorbs padding scatters
     item_factors = (
         jax.random.normal(key, (n_items + 1, rank), jnp.float32) / jnp.sqrt(rank)
     )
     user_factors = jnp.zeros((n_users + 1, rank), jnp.float32)
-
-    def body(_, carry):
-        user_f, item_f = carry
-        user_f = _solve_side(
-            u_rows, i_cols, vals_by_u, item_f, n_users + 1, chunk, reg, implicit, alpha
-        )
-        item_f = _solve_side(
-            i_rows, u_cols, vals_by_i, user_f, n_items + 1, chunk, reg, implicit, alpha
-        )
-        return user_f, item_f
-
-    user_factors, item_factors = lax.fori_loop(
-        0, iterations, body, (user_factors, item_factors)
-    )
-    return user_factors[:n_users], item_factors[:n_items]
+    return user_factors, item_factors
 
 
 def als_train(
@@ -193,23 +248,28 @@ def als_train(
 
     u_rows, i_cols, vals_u = _pad_coo(user_idx, item_idx, ratings, chunk, n_users)
     i_rows, u_cols, vals_i = _pad_coo(item_idx, user_idx, ratings, chunk, n_items)
-    return _als_iterate(
-        u_rows,
-        i_cols,
-        vals_u,
-        i_rows,
-        u_cols,
-        vals_i,
-        n_users=n_users,
-        n_items=n_items,
-        rank=config.rank,
-        iterations=config.iterations,
-        reg=config.reg,
-        implicit=config.implicit,
-        alpha=config.alpha,
-        chunk=chunk,
-        seed=config.seed,
+    # COO tables cross host->device ONCE; the per-iteration launches reuse
+    # the same device buffers
+    dev = [
+        jax.device_put(a) for a in (u_rows, i_cols, vals_u, i_rows, u_cols, vals_i)
+    ]
+    user_f, item_f = _als_init(
+        n_users=n_users, n_items=n_items, rank=config.rank, seed=config.seed
     )
+    for _ in range(config.iterations):
+        user_f, item_f = _als_step(
+            user_f,
+            item_f,
+            *dev,
+            n_users=n_users,
+            n_items=n_items,
+            reg=config.reg,
+            implicit=config.implicit,
+            alpha=config.alpha,
+            chunk=chunk,
+            degree_scaled_reg=config.degree_scaled_reg,
+        )
+    return user_f[:n_users], item_f[:n_items]
 
 
 # ---------------------------------------------------------------------------

@@ -19,8 +19,10 @@ laid out for an ICI mesh the way ALX (PAPERS.md) does:
     static-shape chunked scatter-adds, and solves its own block's f-by-f
     systems batched (Cholesky on the MXU).
   - Shapes are identical on every device (blocks and COO shards are padded;
-    padding scatters land in a per-block dummy row), so the whole loop jits
-    once under ``shard_map``.
+    padding scatters land in a per-block dummy row). Each iteration is ONE
+    ``shard_map`` launch (host-looped, like ``ops/als.py:_als_step``): the
+    remote-attach TPU runtime kills single executions past ~60s, and
+    per-iteration dispatch costs one RTT against seconds of device work.
 
 Communication per iteration: 2 all_gathers (U and V). MLlib pays 2 shuffles
 of the *rating* table per iteration, which is strictly larger for any
@@ -126,26 +128,34 @@ def als_train_sharded(
     sharded = NamedSharding(mesh, spec)
     put = lambda x: jax.device_put(x, sharded)
 
-    uf, vf = _als_sharded_jit(
+    statics = dict(
+        mesh=mesh,
+        axis=axis,
+        bu=bu,
+        bi=bi,
+        rank=config.rank,
+        reg=config.reg,
+        implicit=config.implicit,
+        alpha=config.alpha,
+        chunk=chunk,
+        degree_scaled_reg=config.degree_scaled_reg,
+    )
+    dev = (
         put(u_rows),
         put(u_cols),
         put(u_vals),
         put(i_rows),
         put(i_cols),
         put(i_vals),
-        mesh=mesh,
-        axis=axis,
-        bu=bu,
-        bi=bi,
-        rank=config.rank,
-        iterations=config.iterations,
-        reg=config.reg,
-        implicit=config.implicit,
-        alpha=config.alpha,
-        chunk=chunk,
-        seed=config.seed,
-        n_items=n_items,
     )
+    # one iteration per launch — same watchdog/compile rationale as
+    # ops/als.py:_als_step; collectives still ride ICI inside each launch
+    uf, vf = _als_sharded_init(
+        mesh=mesh, axis=axis, bu=bu, bi=bi, rank=config.rank,
+        seed=config.seed, n_items=n_items,
+    )
+    for _ in range(config.iterations):
+        uf, vf = _als_sharded_step(uf, vf, *dev, **statics)
     # [n_dev, b+1, f] -> drop per-block dummy row, concatenate, trim padding
     uf = _fetch(uf).reshape(n_dev, bu + 1, config.rank)[:, :bu].reshape(-1, config.rank)
     vf = _fetch(vf).reshape(n_dev, bi + 1, config.rank)[:, :bi].reshape(-1, config.rank)
@@ -164,22 +174,53 @@ def _fetch(a) -> np.ndarray:
 
 @functools.partial(
     jax.jit,
+    static_argnames=("mesh", "axis", "bu", "bi", "rank", "seed", "n_items"),
+)
+def _als_sharded_init(
+    *, mesh: Mesh, axis: str, bu: int, bi: int, rank: int, seed: int, n_items: int
+):
+    spec = P(axis)
+
+    def device_fn():
+        d = lax.axis_index(axis)
+        # per-device init of the owned item block (+ dummy row)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), d)
+        vf_local = jax.random.normal(key, (bi + 1, rank), jnp.float32) / jnp.sqrt(
+            rank
+        )
+        # zero padding rows whose global index >= n_items so they don't bias
+        # the implicit-mode gram term in the first user-side solve (they only
+        # self-zero after the first item solve otherwise)
+        global_row = d * bi + jnp.arange(bi + 1)
+        vf_local = jnp.where((global_row < n_items)[:, None], vf_local, 0.0)
+        uf_local = jnp.zeros((bu + 1, rank), jnp.float32)
+        # leading device axis for the P(axis) out_spec
+        return uf_local[None], vf_local[None]
+
+    return shard_map(
+        device_fn, mesh=mesh, in_specs=(), out_specs=(spec, spec), **_NO_CHECK
+    )()
+
+
+@functools.partial(
+    jax.jit,
     static_argnames=(
         "mesh",
         "axis",
         "bu",
         "bi",
         "rank",
-        "iterations",
         "reg",
         "implicit",
         "alpha",
         "chunk",
-        "seed",
-        "n_items",
+        "degree_scaled_reg",
     ),
+    donate_argnums=(0, 1),
 )
-def _als_sharded_jit(
+def _als_sharded_step(
+    uf,
+    vf,
     u_rows,
     u_cols,
     u_vals,
@@ -192,34 +233,20 @@ def _als_sharded_jit(
     bu: int,
     bi: int,
     rank: int,
-    iterations: int,
     reg: float,
     implicit: bool,
     alpha: float,
     chunk: int,
-    seed: int,
-    n_items: int,
+    degree_scaled_reg: bool = True,
 ):
     spec = P(axis)
 
-    def device_fn(u_rows, u_cols, u_vals, i_rows, i_cols, i_vals):
-        # shard_map hands each device its [1, L] slice; flatten it
+    def device_fn(uf_l, vf_l, u_rows, u_cols, u_vals, i_rows, i_cols, i_vals):
+        # shard_map hands each device its [1, ...] slice; flatten it
+        uf_l, vf_l = uf_l[0], vf_l[0]
         u_r, u_c, u_v = u_rows[0], u_cols[0], u_vals[0]
         i_r, i_c, i_v = i_rows[0], i_cols[0], i_vals[0]
-        d = lax.axis_index(axis)
         n_dev = lax.psum(1, axis)
-
-        # per-device init of the owned item block (+ dummy row)
-        key = jax.random.fold_in(jax.random.PRNGKey(seed), d)
-        vf_local = jax.random.normal(key, (bi + 1, rank), jnp.float32) / jnp.sqrt(
-            rank
-        )
-        # zero padding rows whose global index >= n_items so they don't bias
-        # the implicit-mode gram term in the first user-side solve (they only
-        # self-zero after the first item solve otherwise)
-        global_row = d * bi + jnp.arange(bi + 1)
-        vf_local = jnp.where((global_row < n_items)[:, None], vf_local, 0.0)
-        uf_local = jnp.zeros((bu + 1, rank), jnp.float32)
 
         def gather_side(local, block):
             # [n_dev, block+1, f] -> drop dummies -> [n_dev*block, f]
@@ -227,29 +254,27 @@ def _als_sharded_jit(
             return full[:, :block].reshape(n_dev * block, rank)
 
         def solve_local(rows, cols, vals, opposite_full, block):
-            A, b = _normal_equations(
+            A, b, counts = _normal_equations(
                 rows, cols, vals, opposite_full, block + 1, chunk, implicit, alpha
             )
             eye = jnp.eye(rank, dtype=jnp.float32)
             if implicit:
                 gram = opposite_full.T @ opposite_full
                 A = A + gram[None]
-            A = A + reg * eye[None]
+            if degree_scaled_reg:
+                # ALS-WR λ·n_e·I (see ops/als.py module docstring): padded
+                # COO rows inflate the dummy row's count only, never a real
+                # entity's — the local-block partition pads with the dummy
+                A = A + (reg * jnp.maximum(counts, 1.0))[:, None, None] * eye[None]
+            else:
+                A = A + reg * eye[None]
             return jax.scipy.linalg.cho_solve((jnp.linalg.cholesky(A), True), b)
 
-        def body(_, carry):
-            uf_l, vf_l = carry
-            v_full = gather_side(vf_l, bi)
-            uf_l = solve_local(u_r, u_c, u_v, v_full, bu)
-            u_full = gather_side(uf_l, bu)
-            vf_l = solve_local(i_r, i_c, i_v, u_full, bi)
-            return uf_l, vf_l
-
-        uf_local, vf_local = lax.fori_loop(
-            0, iterations, body, (uf_local, vf_local)
-        )
-        # re-add the leading device axis for the P(axis) out_spec
-        return uf_local[None], vf_local[None]
+        v_full = gather_side(vf_l, bi)
+        uf_l = solve_local(u_r, u_c, u_v, v_full, bu)
+        u_full = gather_side(uf_l, bu)
+        vf_l = solve_local(i_r, i_c, i_v, u_full, bi)
+        return uf_l[None], vf_l[None]
 
     # checker off: the scan carries inside _normal_equations are initialized
     # unvarying (zeros) and become device-varying on the first write, which
@@ -257,7 +282,7 @@ def _als_sharded_jit(
     return shard_map(
         device_fn,
         mesh=mesh,
-        in_specs=(spec, spec, spec, spec, spec, spec),
+        in_specs=(spec,) * 8,
         out_specs=(spec, spec),
         **_NO_CHECK,
-    )(u_rows, u_cols, u_vals, i_rows, i_cols, i_vals)
+    )(uf, vf, u_rows, u_cols, u_vals, i_rows, i_cols, i_vals)
