@@ -843,7 +843,11 @@ def main() -> int:
     # failed bench even though the JSON (with the gate booleans) still
     # prints for forensics. An entirely empty run is also a failure.
     gates_ok = all(v for k, v in fields.items() if k.endswith("_gate_ok"))
-    shipped = any(k for k in fields if not k.endswith("_error"))
+    # "shipped" means actual measurements — phase metadata (platform, scale,
+    # factor provenance) is written before any timed region and must not
+    # make a fully-crashed run look healthy
+    meta_keys = {"platform", "scale", "serving_factors"}
+    shipped = any(k not in meta_keys for k in fields)
     return 0 if (shipped and gates_ok) else 1
 
 

@@ -39,7 +39,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.ops.als import ALSConfig, _normal_equations
+from predictionio_tpu.ops.als import ALSConfig, _solve_side
 
 try:  # stable home since jax 0.8
     from jax import shard_map  # type: ignore[attr-defined]
@@ -139,6 +139,7 @@ def als_train_sharded(
         alpha=config.alpha,
         chunk=chunk,
         degree_scaled_reg=config.degree_scaled_reg,
+        solver=config.solver,
     )
     dev = (
         put(u_rows),
@@ -215,6 +216,7 @@ def _als_sharded_init(
         "alpha",
         "chunk",
         "degree_scaled_reg",
+        "solver",
     ),
     donate_argnums=(0, 1),
 )
@@ -238,6 +240,7 @@ def _als_sharded_step(
     alpha: float,
     chunk: int,
     degree_scaled_reg: bool = True,
+    solver: str = "cg",
 ):
     spec = P(axis)
 
@@ -253,27 +256,18 @@ def _als_sharded_step(
             full = lax.all_gather(local, axis)  # ICI collective
             return full[:, :block].reshape(n_dev * block, rank)
 
-        def solve_local(rows, cols, vals, opposite_full, block):
-            A, b, counts = _normal_equations(
-                rows, cols, vals, opposite_full, block + 1, chunk, implicit, alpha
-            )
-            eye = jnp.eye(rank, dtype=jnp.float32)
-            if implicit:
-                gram = opposite_full.T @ opposite_full
-                A = A + gram[None]
-            if degree_scaled_reg:
-                # ALS-WR λ·n_e·I (see ops/als.py module docstring): padded
-                # COO rows inflate the dummy row's count only, never a real
-                # entity's — the local-block partition pads with the dummy
-                A = A + (reg * jnp.maximum(counts, 1.0))[:, None, None] * eye[None]
-            else:
-                A = A + reg * eye[None]
-            return jax.scipy.linalg.cho_solve((jnp.linalg.cholesky(A), True), b)
-
+        # per-block-dummy padding means the COO pads inflate only the dummy
+        # row's degree count, so _solve_side's ALS-WR scaling stays exact
         v_full = gather_side(vf_l, bi)
-        uf_l = solve_local(u_r, u_c, u_v, v_full, bu)
+        uf_l = _solve_side(
+            u_r, u_c, u_v, v_full, bu + 1, chunk, reg, implicit, alpha,
+            degree_scaled_reg, solver,
+        )
         u_full = gather_side(uf_l, bu)
-        vf_l = solve_local(i_r, i_c, i_v, u_full, bi)
+        vf_l = _solve_side(
+            i_r, i_c, i_v, u_full, bi + 1, chunk, reg, implicit, alpha,
+            degree_scaled_reg, solver,
+        )
         return uf_l[None], vf_l[None]
 
     # checker off: the scan carries inside _normal_equations are initialized
