@@ -485,12 +485,16 @@ class PEvents(abc.ABC):
         rating_key: str = "rating",
         entity_vocab: Sequence[str] | None = None,
         target_vocab: Sequence[str] | None = None,
+        events: "Iterable[Event] | None" = None,
         **find_kwargs: Any,
     ) -> ColumnarEvents:
         """Scan once and dictionary-encode into dense arrays.
 
         Pass pre-built ``entity_vocab``/``target_vocab`` to encode an eval
         split with the training split's index space (unknown ids get -1).
+        ``events`` overrides the scan source — drivers with a parallel bulk
+        path (ES sliced scroll) feed their merged stream through here so
+        the encoder stays shared.
         """
         ent_index: dict[str, int] = (
             {v: i for i, v in enumerate(entity_vocab)} if entity_vocab else {}
@@ -508,9 +512,14 @@ class PEvents(abc.ABC):
         ev_col: list[int] = []
         ts_col: list[float] = []
         rating_col: list[float] = []
-        for e in self.find(
-            app_id=app_id, channel_id=channel_id, event_names=event_names, **find_kwargs
-        ):
+        if events is None:
+            events = self.find(
+                app_id=app_id,
+                channel_id=channel_id,
+                event_names=event_names,
+                **find_kwargs,
+            )
+        for e in events:
             event_ids.append(e.event_id or "")
             names.append(e.event)
             if frozen_ent:

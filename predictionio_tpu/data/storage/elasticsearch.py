@@ -262,6 +262,61 @@ class _ESDocs:
                 return
             cursor = [page[-1][f] for f in fields]
 
+    def scan_sliced(
+        self,
+        query: dict,
+        slice_id: int,
+        n_slices: int,
+        page_size: int = 5_000,
+    ) -> Iterator[dict]:
+        """One slice of a sliced scroll (the official ES parallel-scan
+        protocol: ``"slice": {"id": i, "max": n}`` on a scroll search).
+        The n slices partition the index disjointly, so n concurrent
+        scanners cover it exactly once — the ES answer to HBase
+        region-split parallel scans (ref ``HBPEvents.scala:63-95``) and
+        what elasticsearch-hadoop does per input split
+        (ref ``ESPEvents.scala:44-100``)."""
+        body: dict[str, Any] = {"query": query, "size": page_size}
+        if n_slices > 1:
+            body["slice"] = {"id": slice_id, "max": n_slices}
+        out = self._t.request(
+            "POST",
+            f"/{self._index}/_search",
+            body=body,
+            params={"scroll": "5m"},
+            ok_statuses=(404,),
+        )
+        scroll_id = out.get("_scroll_id")
+        try:
+            while True:
+                hits = out.get("hits", {}).get("hits", [])
+                if not hits:
+                    return
+                for h in hits:
+                    yield h["_source"]
+                if scroll_id is None:
+                    return
+                out = self._t.request(
+                    "POST",
+                    "/_search/scroll",
+                    body={"scroll": "5m", "scroll_id": scroll_id},
+                )
+                scroll_id = out.get("_scroll_id", scroll_id)
+        finally:
+            if scroll_id is not None:
+                # best-effort release of the server-side scroll context — a
+                # cleanup flake must not turn an already-complete scan into
+                # a failure (the context expires server-side regardless)
+                try:
+                    self._t.request(
+                        "DELETE",
+                        "/_search/scroll",
+                        body={"scroll_id": [scroll_id]},
+                        ok_statuses=(404,),
+                    )
+                except (ESError, OSError):
+                    pass
+
     def delete_by_query(self, query: dict) -> None:
         self._t.request(
             "POST",
@@ -775,15 +830,157 @@ class ESLEvents(base.LEvents):
 class ESPEvents(base.PEvents):
     """Bulk scan over the same indices (the reference reads through
     elasticsearch-hadoop's EsInputFormat, ``ESPEvents.scala:44-100``; the
-    TPU feed path is the shared dictionary-encoder in ``base.PEvents``)."""
+    TPU feed path is the shared dictionary-encoder in ``base.PEvents``).
 
-    def __init__(self, transport: _ESTransport, prefix: str, levents: ESLEvents):
+    This driver is the framework's SCALE-OUT event store (the HBase-class
+    role — see docs/DECISIONS.md): bulk training scans fan out over ES
+    sliced scrolls, one concurrent scanner per slice, the REST analog of
+    the reference's HBase region-split parallel scan
+    (``HBPEvents.scala:63-95``). ``scan_slices`` comes from the storage
+    source config (``PIO_STORAGE_SOURCES_<name>_SCAN_SLICES``, default 4 —
+    the same default as ``JDBCPEvents`` partitions, ``JDBCPEvents.scala:53``).
+    """
+
+    def __init__(
+        self,
+        transport: _ESTransport,
+        prefix: str,
+        levents: ESLEvents,
+        scan_slices: int = 4,
+    ):
         self._t = transport
         self._prefix = prefix
         self._levents = levents
+        self._scan_slices = max(1, int(scan_slices))
 
     def find(self, app_id: int, channel_id: int | None = None, **kw) -> Iterator[Event]:
         return self._levents.find(app_id=app_id, channel_id=channel_id, **kw)
+
+    _SLICE_FILTERS = frozenset(
+        (
+            "start_time",
+            "until_time",
+            "entity_type",
+            "entity_id",
+            "event_names",
+            "target_entity_type",
+            "target_entity_id",
+        )
+    )
+
+    def find_sliced(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        n_slices: int | None = None,
+        **filters: Any,
+    ) -> list[Iterator[Event]]:
+        """Disjoint slice iterators jointly covering the filtered scan.
+        Each iterator is independently consumable (own scroll context), so
+        callers can hand one per worker thread/process."""
+        unknown = set(filters) - self._SLICE_FILTERS
+        if unknown:
+            # silently ignoring a typo'd (or unsliceable, e.g. limit/
+            # reversed) filter would return the wrong row set
+            raise TypeError(f"find_sliced: unsupported filter(s) {sorted(unknown)}")
+        n = n_slices or self._scan_slices
+        query = ESLEvents._query(
+            filters.get("start_time"),
+            filters.get("until_time"),
+            filters.get("entity_type"),
+            filters.get("entity_id"),
+            filters.get("event_names"),
+            filters.get("target_entity_type", ...),
+            filters.get("target_entity_id", ...),
+        )
+        docs = self._levents._docs(app_id, channel_id)
+
+        def one(i: int) -> Iterator[Event]:
+            for d in docs.scan_sliced(query, i, n):
+                yield Event.from_json_dict(d)
+
+        return [one(i) for i in range(n)]
+
+    def find_parallel(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        n_slices: int | None = None,
+        **filters: Any,
+    ) -> Iterator[Event]:
+        """Merge the slices through a bounded queue, one thread per slice.
+        Yields in nondeterministic order (bulk consumers — columnar encode,
+        aggregation — are order-free)."""
+        import queue as _q
+        import threading
+
+        slices = self.find_sliced(app_id, channel_id, n_slices, **filters)
+        if len(slices) == 1:
+            yield from slices[0]
+            return
+        out: _q.Queue = _q.Queue(maxsize=10_000)
+        stop = threading.Event()  # set when the consumer goes away
+        _DONE = object()
+
+        def put_until_stopped(item) -> bool:
+            while not stop.is_set():
+                try:
+                    out.put(item, timeout=0.2)
+                    return True
+                except _q.Full:
+                    continue
+            return False
+
+        def pump(it):
+            try:
+                for e in it:
+                    if not put_until_stopped(e):
+                        break
+            except BaseException as exc:  # surface worker failures to consumer
+                put_until_stopped(exc)
+            finally:
+                # closing the slice generator runs scan_sliced's finally,
+                # releasing its server-side scroll context
+                it.close()
+                put_until_stopped(_DONE)
+
+        threads = [
+            threading.Thread(target=pump, args=(s,), daemon=True) for s in slices
+        ]
+        for t in threads:
+            t.start()
+        live = len(threads)
+        try:
+            while live:
+                item = out.get()
+                if item is _DONE:
+                    live -= 1
+                elif isinstance(item, BaseException):
+                    raise item
+                else:
+                    yield item
+        finally:
+            # consumer finished, broke out early, or a slice failed: unblock
+            # every pump (they exit without putting once stop is set) so no
+            # thread is left parked on a full queue holding Events
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+
+    _COLUMNAR_OWN_KW = frozenset(("rating_key", "entity_vocab", "target_vocab", "events"))
+
+    def to_columnar(self, app_id: int, channel_id: int | None = None, **kw):
+        """Columnar ingest reads through the sliced parallel scan — the
+        training feed overlaps N scroll streams instead of paying one
+        serial deep-pagination walk. Falls back to the serial scan when the
+        call carries find() kwargs slices can't honor (limit, reversed, …)
+        so semantics never silently diverge from the other drivers."""
+        filters = {k: v for k, v in kw.items() if k in self._SLICE_FILTERS}
+        unsliceable = set(kw) - self._SLICE_FILTERS - self._COLUMNAR_OWN_KW
+        if self._scan_slices > 1 and "events" not in kw and not unsliceable:
+            kw = {k: v for k, v in kw.items() if k not in self._SLICE_FILTERS}
+            kw["events"] = self.find_parallel(app_id, channel_id, **filters)
+        return super().to_columnar(app_id, channel_id, **kw)
 
     def write(
         self, events: Iterable[Event], app_id: int, channel_id: int | None = None
@@ -874,7 +1071,12 @@ class ESStorageClient:
         return self._levents
 
     def p_events(self) -> ESPEvents:
-        return ESPEvents(self._transport, self._prefix, self._levents)
+        return ESPEvents(
+            self._transport,
+            self._prefix,
+            self._levents,
+            scan_slices=int(self.config.get("SCAN_SLICES", 4)),
+        )
 
     def apps(self) -> ESApps:
         return ESApps(

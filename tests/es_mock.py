@@ -20,6 +20,8 @@ from typing import Any
 class _State:
     def __init__(self):
         self.indices: dict[str, dict[str, dict]] = {}
+        self.scrolls: dict[str, dict] = {}  # scroll_id -> {docs, pos, size}
+        self.scroll_seq = 0
         self.lock = threading.RLock()
 
 
@@ -157,6 +159,68 @@ class _Handler(BaseHTTPRequestHandler):
                     table[doc_id][field] = table[doc_id].get(field, 0) + delta
                 return self._reply(
                     200, {"result": "updated", "get": {"_source": table[doc_id]}}
+                )
+            # /_search/scroll — scroll continuation
+            if parts == ["_search", "scroll"] and self.command == "POST":
+                sid = self._body().get("scroll_id")
+                ctx = st.scrolls.get(sid)
+                if ctx is None:
+                    return self._reply(404, {"error": "search_context_missing"})
+                page = ctx["docs"][ctx["pos"] : ctx["pos"] + ctx["size"]]
+                ctx["pos"] += len(page)
+                return self._reply(
+                    200,
+                    {
+                        "_scroll_id": sid,
+                        "hits": {
+                            "total": {"value": len(ctx["docs"])},
+                            "hits": [{"_source": d} for d in page],
+                        },
+                    },
+                )
+            if parts == ["_search", "scroll"] and self.command == "DELETE":
+                for sid in self._body().get("scroll_id", []):
+                    st.scrolls.pop(sid, None)
+                return self._reply(200, {"succeeded": True})
+            # /{index}/_search?scroll=... — sliced scroll initiation: the
+            # "slice" clause partitions the index disjointly by doc-id hash
+            # (real ES slices by shard/_id route; semantics match: the n
+            # slices are disjoint and jointly exhaustive)
+            if (
+                len(parts) == 2
+                and parts[1] == "_search"
+                and self.command == "POST"
+                and "scroll=" in (self.path.split("?", 1) + [""])[1]
+            ):
+                import zlib
+
+                index = parts[0]
+                if index not in st.indices:
+                    return self._reply(404, {"error": "index_not_found"})
+                body = self._body()
+                sl = body.get("slice")
+                docs = [
+                    d
+                    for key, d in st.indices[index].items()
+                    if _matches(d, body.get("query", {}))
+                    and (
+                        sl is None
+                        or zlib.crc32(str(key).encode()) % sl["max"] == sl["id"]
+                    )
+                ]
+                size = body.get("size", 10)
+                st.scroll_seq += 1
+                sid = f"scroll{st.scroll_seq}"
+                st.scrolls[sid] = {"docs": docs, "pos": size, "size": size}
+                return self._reply(
+                    200,
+                    {
+                        "_scroll_id": sid,
+                        "hits": {
+                            "total": {"value": len(docs)},
+                            "hits": [{"_source": d} for d in docs[:size]],
+                        },
+                    },
                 )
             # /{index}/_search
             if len(parts) == 2 and parts[1] == "_search" and self.command == "POST":

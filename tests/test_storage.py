@@ -217,12 +217,26 @@ class TestPEvents:
         )
         col = p.to_columnar(APP, event_names=["rate", "view"])
         assert len(col) == 4
-        assert col.entity_vocab == ["u1", "u2"]
-        assert col.target_vocab == ["i1", "i2"]
-        np.testing.assert_array_equal(col.entity_ids, [0, 1, 0, 1])
-        np.testing.assert_array_equal(col.target_ids, [0, 0, 1, 1])
-        assert col.ratings[0] == 4.0 and np.isnan(col.ratings[3])
-        assert col.event_names[3] == "view"
+        # vocab ORDER is driver-dependent (parallel bulk scans — ES sliced
+        # scroll — merge nondeterministically); the contract is the decoded
+        # (entity, target, event, rating) tuples
+        assert sorted(col.entity_vocab) == ["u1", "u2"]
+        assert sorted(col.target_vocab) == ["i1", "i2"]
+        decoded = {
+            (
+                col.entity_vocab[col.entity_ids[i]],
+                col.target_vocab[col.target_ids[i]],
+                col.event_names[i],
+                None if np.isnan(col.ratings[i]) else float(col.ratings[i]),
+            )
+            for i in range(4)
+        }
+        assert decoded == {
+            ("u1", "i1", "rate", 4.0),
+            ("u2", "i1", "rate", 3.0),
+            ("u1", "i2", "rate", 5.0),
+            ("u2", "i2", "view", None),
+        }
 
     def test_to_columnar_frozen_vocab(self, client):
         p = client.p_events()
@@ -715,3 +729,94 @@ class TestRegistryNewDrivers:
 
         with pytest.raises(HDFSError):
             HDFSStorageClient({})
+
+
+class TestESSlicedScan:
+    """Scale-out bulk-scan contract for the promoted ES event store
+    (docs/DECISIONS.md): sliced scrolls must partition the index disjointly
+    and jointly exhaustively, survive multi-page pagination per slice, and
+    feed the columnar training encoder through the parallel merge.
+    Ref parity: HBase region-split scans ``HBPEvents.scala:63-95`` /
+    elasticsearch-hadoop input splits ``ESPEvents.scala:44-100``."""
+
+    N = 137  # not divisible by slice counts or page sizes on purpose
+
+    def _seed(self):
+        c = _es_client()
+        p = c.p_events()
+        events = [
+            ev(
+                name="rate" if i % 3 else "buy",
+                eid=f"u{i % 11}",
+                target=f"i{i % 7}",
+                n=i % 55,
+                props={"rating": float(i % 5 + 1)},
+            )
+            for i in range(self.N)
+        ]
+        p.write(events, APP)
+        return c, p
+
+    def test_slices_disjoint_and_exhaustive(self):
+        c, p = self._seed()
+        try:
+            seen: list[str] = []
+            for it in p.find_sliced(APP, n_slices=4):
+                seen.extend(e.event_id for e in it)
+            assert len(seen) == self.N
+            assert len(set(seen)) == self.N  # disjoint: no doc in two slices
+            serial = {e.event_id for e in p.find(APP)}
+            assert set(seen) == serial  # exhaustive: same cover as serial scan
+        finally:
+            c._mock_server.shutdown()
+
+    def test_multi_page_scroll_per_slice(self):
+        c, p = self._seed()
+        try:
+            docs = p._levents._docs(APP, None)
+            # page_size 7 forces ~5 scroll continuations per slice
+            got = []
+            for i in range(3):
+                got.extend(
+                    d["eventId"]
+                    for d in docs.scan_sliced({"match_all": {}}, i, 3, page_size=7)
+                )
+            assert len(got) == self.N and len(set(got)) == self.N
+        finally:
+            c._mock_server.shutdown()
+
+    def test_filters_apply_within_slices(self):
+        c, p = self._seed()
+        try:
+            par = sorted(
+                e.event_id for e in p.find_parallel(APP, event_names=["buy"])
+            )
+            ser = sorted(
+                e.event_id for e in p.find(APP, event_names=["buy"])
+            )
+            assert par == ser and par  # nonempty and identical
+        finally:
+            c._mock_server.shutdown()
+
+    def test_columnar_through_parallel_scan(self):
+        c, p = self._seed()
+        try:
+            cols = p.to_columnar(APP, event_names=["rate", "buy"], rating_key="rating")
+            assert len(cols.event_ids) == self.N
+            # vocab order is nondeterministic under the parallel merge, but
+            # the (entity, target, rating) triples must match the serial scan
+            serial = {
+                (e.entity_id, e.target_entity_id, e.properties.get_opt("rating"))
+                for e in p.find(APP)
+            }
+            decoded = {
+                (
+                    cols.entity_vocab[cols.entity_ids[i]],
+                    cols.target_vocab[cols.target_ids[i]],
+                    float(cols.ratings[i]),
+                )
+                for i in range(len(cols.event_ids))
+            }
+            assert decoded == serial
+        finally:
+            c._mock_server.shutdown()

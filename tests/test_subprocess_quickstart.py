@@ -1,0 +1,218 @@
+"""Full-stack quickstart over REAL processes and REAL sockets.
+
+Reference parity: ``/root/reference/tests/pio_tests/scenarios/quickstart_test.py:50-120``
+drives the actual binaries — app new, import, build, train, deploy, HTTP
+query — against a live event server. ``tests/test_quickstart.py`` covers the
+same lifecycle in-process (aiohttp TestClient); this module is the missing
+subprocess tier: every step goes through ``./pio`` (the console launcher) as
+its own OS process, the event server and the engine server bind real TCP
+ports, and queries arrive over real HTTP. This is the tier that catches
+launcher/argv/env bugs the in-process test can't (e.g. the round-2 w1.log
+wrong-worker-path failure mode).
+
+Kept CPU-only and small so the whole module runs in well under two minutes.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PIO = os.path.join(REPO, "pio")
+APP = "subprocqs"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http(method: str, port: int, path: str, body: str | None = None) -> tuple[int, str]:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request(method, path, body, {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+def _wait_alive(port: int, proc: subprocess.Popen, timeout_s: float = 90.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            out = proc.stdout.read().decode(errors="replace") if proc.stdout else ""
+            raise AssertionError(
+                f"server process exited rc={proc.returncode} before binding:\n{out[-2000:]}"
+            )
+        try:
+            status, _ = _http("GET", port, "/")
+            if status == 200:
+                return
+        except OSError:
+            time.sleep(0.3)
+    raise AssertionError(f"server on port {port} did not come up in {timeout_s}s")
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    base = tmp_path_factory.mktemp("subproc_store")
+    e = dict(os.environ)
+    e.update(
+        {
+            "PIO_FS_BASEDIR": str(base),
+            "JAX_PLATFORMS": "cpu",
+            # scrub any storage config leaking from the dev environment so
+            # the zero-config sqlite-under-basedir default applies
+            **{k: "" for k in list(e) if k.startswith("PIO_STORAGE_")},
+        }
+    )
+    return e
+
+
+def _pio(env: dict, *args: str, timeout: int = 120) -> subprocess.CompletedProcess:
+    proc = subprocess.run(
+        [PIO, *args], env=env, capture_output=True, timeout=timeout
+    )
+    assert proc.returncode == 0, (
+        f"pio {' '.join(args)} rc={proc.returncode}\n"
+        f"stdout: {proc.stdout.decode(errors='replace')[-1500:]}\n"
+        f"stderr: {proc.stderr.decode(errors='replace')[-1500:]}"
+    )
+    return proc
+
+
+def test_subprocess_quickstart(env, tmp_path):
+    # --- app new (auto-creates an access key) --------------------------------
+    out = _pio(env, "app", "new", APP).stdout.decode()
+    key = next(
+        line.split(":", 1)[1].strip()
+        for line in out.splitlines()
+        if "Access Key" in line
+    )
+    assert key
+
+    # --- event server on a real socket: ingest one event over HTTP ----------
+    es_port = _free_port()
+    es = subprocess.Popen(
+        [PIO, "eventserver", "--ip", "127.0.0.1", "--port", str(es_port)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        _wait_alive(es_port, es)
+        status, body = _http(
+            "POST",
+            es_port,
+            f"/events.json?accessKey={key}",
+            json.dumps(
+                {
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": "u0",
+                    "targetEntityType": "item",
+                    "targetEntityId": "i0",
+                    "properties": {"rating": 5.0},
+                }
+            ),
+        )
+        assert status == 201, body
+        assert "eventId" in json.loads(body)
+    finally:
+        es.send_signal(signal.SIGTERM)
+        es.wait(timeout=15)
+
+    # --- bulk import ---------------------------------------------------------
+    events_file = tmp_path / "events.jsonl"
+    with open(events_file, "w") as f:
+        for u in range(12):
+            for i in range(8):
+                rating = 5.0 if (u + i) % 3 == 0 else 1.0
+                f.write(
+                    json.dumps(
+                        {
+                            "event": "rate",
+                            "entityType": "user",
+                            "entityId": f"u{u}",
+                            "targetEntityType": "item",
+                            "targetEntityId": f"i{i}",
+                            "properties": {"rating": rating},
+                        }
+                    )
+                    + "\n"
+                )
+    out = _pio(env, "import", "--appname", APP, "--input", str(events_file))
+    assert b"96" in out.stdout or b"imported" in out.stdout.lower()
+
+    # --- train via the real CLI (variant points at our app) ------------------
+    engine_dir = os.path.join(REPO, "predictionio_tpu", "models", "recommendation")
+    with open(os.path.join(engine_dir, "engine.json")) as f:
+        variant = json.load(f)
+    variant["datasource"]["params"]["appName"] = APP
+    # few iterations: this is a lifecycle test, not a quality test
+    for algo in variant.get("algorithms", []):
+        algo.setdefault("params", {})["numIterations"] = 3
+    variant_path = tmp_path / "engine.json"
+    variant_path.write_text(json.dumps(variant))
+    out = _pio(env, "train", "--engine-dir", engine_dir, "--variant", str(variant_path))
+    assert b"Training completed" in out.stdout
+
+    # --- status: storage + latest instance visible from a fresh process -----
+    out = _pio(env, "status")
+    assert out.returncode == 0
+
+    # --- deploy on a real socket, query over HTTP, then /stop ----------------
+    port = _free_port()
+    server = subprocess.Popen(
+        [
+            PIO,
+            "deploy",
+            "--engine-dir",
+            engine_dir,
+            "--variant",
+            str(variant_path),
+            "--ip",
+            "127.0.0.1",
+            "--port",
+            str(port),
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        _wait_alive(port, server)
+        status, body = _http(
+            "POST", port, "/queries.json", json.dumps({"user": "u1", "num": 3})
+        )
+        assert status == 200, body
+        scores = json.loads(body)["itemScores"]
+        assert len(scores) == 3
+        assert all("item" in s and "score" in s for s in scores)
+        # status page reflects the served request
+        status, home = _http("GET", port, "/")
+        assert status == 200
+        # graceful stop contract
+        status, _ = _http("POST", port, "/stop")
+        assert status == 200
+        server.wait(timeout=20)
+    finally:
+        if server.poll() is None:
+            server.send_signal(signal.SIGTERM)
+            try:
+                server.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                server.kill()
