@@ -703,6 +703,82 @@ def phase_secondary(ck: _Checkpoint) -> None:
     _jax_setup()
     ck.save(naive_bayes_train_ms=round(_bench_naive_bayes(), 2))
     ck.save(cooccurrence_build_ms=round(_bench_cooccurrence(), 1))
+    cold, warm = _bench_snapshot_ingest()
+    ck.save(
+        snapshot_ingest_cold_s=round(cold, 3),
+        snapshot_ingest_warm_s=round(warm, 3),
+        # the point of the snapshot cache: a second train's ingest reads
+        # columnar shards, not the row store (target: warm < 10% of cold)
+        snapshot_ingest_ratio=round(warm / cold, 4) if cold else None,
+    )
+
+
+def _bench_snapshot_ingest(n_events: int = 200_000) -> tuple[float, float]:
+    """Train-path ingest through the sharded snapshot cache: cold = full
+    row-store scan + dictionary encode + shard write; warm = shard read.
+    This is what every template DataSource pays at the top of `pio train`."""
+    import shutil
+    import tempfile as _tf
+
+    import numpy as np
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.storage.base import AccessKey, App
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.data.store.event_store import PEventStore
+
+    root = _tf.mkdtemp(prefix="pio_bench_snapshot_")
+    try:
+        storage = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+                "PIO_STORAGE_SOURCES_SQL_PATH": os.path.join(root, "ev.db"),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+            }
+        )
+        app_id = storage.get_meta_data_apps().insert(App(0, "snapbench"))
+        storage.get_meta_data_access_keys().insert(AccessKey("k", app_id, ()))
+        rng = np.random.default_rng(0)
+        users = rng.integers(0, 5000, n_events)
+        items = rng.integers(0, 2000, n_events)
+        p = storage.get_p_events()
+        p.write(
+            (
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": float(u % 5 + 1)}),
+                )
+                for u, i in zip(users, items)
+            ),
+            app_id,
+        )
+        store = PEventStore(storage)
+        snap = os.path.join(root, "snapshots")
+        kwargs = dict(
+            app_name="snapbench",
+            snapshot_dir=snap,
+            event_names=["rate"],
+            entity_type="user",
+            target_entity_type="item",
+            rating_key="rating",
+        )
+        t0 = time.perf_counter()
+        cold_cols = store.to_columnar_cached(**kwargs)
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        warm_cols = store.to_columnar_cached(**kwargs)
+        warm = time.perf_counter() - t0
+        assert len(warm_cols) == len(cold_cols) == n_events
+        return cold, warm
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def _bench_naive_bayes(n: int = 200_000, f: int = 64, classes: int = 8) -> float:

@@ -166,16 +166,34 @@ class PEventStore:
         partitioned storage scans (``JDBCPEvents.scala:91-121``): train runs
         hit the columnar shards, not the row store, unless events changed.
 
-        ``snapshot_dir`` defaults to ``$PIO_SNAPSHOT_DIR`` or
-        ``~/.pio_store/snapshots``. Multi-host callers pass their
-        ``host_index``/``host_count`` for a deterministic disjoint shard set.
+        ``snapshot_dir`` defaults to ``$PIO_SNAPSHOT_DIR``, else
+        ``$PIO_FS_BASEDIR/snapshots``, else ``~/.pio_store/snapshots``.
+        Multi-host callers pass their ``host_index``/``host_count`` for a
+        deterministic disjoint shard set. Set ``PIO_SNAPSHOT_DISABLE=1`` to
+        force every train back to the row store.
         """
         import os
 
-        from predictionio_tpu.data.store.snapshot import SnapshotCache
+        from predictionio_tpu.data.store.snapshot import (
+            SnapshotCache,
+            canonical_order,
+            take_host_blocks,
+        )
 
-        snapshot_dir = snapshot_dir or os.environ.get("PIO_SNAPSHOT_DIR") or os.path.join(
-            os.path.expanduser("~"), ".pio_store", "snapshots"
+        if os.environ.get("PIO_SNAPSHOT_DISABLE", "").lower() in ("1", "true", "yes", "on"):
+            cols = self.to_columnar(app_name, channel_name, **kwargs)
+            if host_count > 1:
+                # the bypass must keep the multi-host contract: each host
+                # still gets its disjoint block subset of the SAME canonical
+                # row order, exactly as the cached path computes it
+                cols = take_host_blocks(canonical_order(cols), host_index, host_count)
+            return cols
+        base = os.environ.get("PIO_FS_BASEDIR")
+        snapshot_dir = (
+            snapshot_dir
+            or os.environ.get("PIO_SNAPSHOT_DIR")
+            or (os.path.join(base, "snapshots") if base else None)
+            or os.path.join(os.path.expanduser("~"), ".pio_store", "snapshots")
         )
         app_id, channel_id = resolve_app(self._storage, app_name, channel_name)
         cache = SnapshotCache(snapshot_dir)

@@ -51,6 +51,79 @@ def shards_for_host(n_shards: int, host_index: int, host_count: int) -> list[int
     return [s for s in range(n_shards) if s % host_count == host_index]
 
 
+def canonical_order(cols: ColumnarEvents) -> ColumnarEvents:
+    """Reorder rows to the canonical (timestamp, event_id) order.
+
+    Drivers with parallel bulk scans (ES sliced scroll) yield rows in a
+    nondeterministic merge order; the multi-host block partition below is
+    only disjoint-and-complete across hosts if every host computes the SAME
+    row order. Canonicalizing before sharding makes the invariant driver-
+    independent (and costs one argsort against a full store scan)."""
+    n = len(cols)
+    order = np.lexsort((np.asarray(cols.event_ids), cols.timestamps))
+    if np.array_equal(order, np.arange(n)):
+        return cols
+    take = order.tolist()
+    return ColumnarEvents(
+        event_ids=[cols.event_ids[i] for i in take],
+        event_names=[cols.event_names[i] for i in take],
+        entity_ids=cols.entity_ids[order],
+        target_ids=cols.target_ids[order],
+        event_codes=cols.event_codes[order],
+        timestamps=cols.timestamps[order],
+        ratings=cols.ratings[order],
+        entity_vocab=cols.entity_vocab,
+        target_vocab=cols.target_vocab,
+        event_vocab=cols.event_vocab,
+    )
+
+
+def _shard_count_for(n_rows: int, n_shards: int) -> int:
+    return max(1, min(n_shards, n_rows) if n_rows else 1)
+
+
+def _shard_bounds(n_rows: int, n_shards: int) -> np.ndarray:
+    return np.linspace(0, n_rows, n_shards + 1, dtype=np.int64)
+
+
+def take_blocks(
+    cols: ColumnarEvents, shard_ids: Sequence[int], n_shards: int = 8
+) -> ColumnarEvents:
+    """Select the row blocks that shards ``shard_ids`` of an ``n_shards``-way
+    block partition would contain (same math as the shard files)."""
+    n = len(cols)
+    bounds = _shard_bounds(n, _shard_count_for(n, n_shards))
+    idx = (
+        np.concatenate(
+            [np.arange(bounds[s], bounds[s + 1]) for s in shard_ids]
+        ).astype(np.int64)
+        if shard_ids
+        else np.zeros((0,), np.int64)
+    )
+    take = idx.tolist()
+    return ColumnarEvents(
+        event_ids=[cols.event_ids[i] for i in take],
+        event_names=[cols.event_names[i] for i in take],
+        entity_ids=cols.entity_ids[idx],
+        target_ids=cols.target_ids[idx],
+        event_codes=cols.event_codes[idx],
+        timestamps=cols.timestamps[idx],
+        ratings=cols.ratings[idx],
+        entity_vocab=cols.entity_vocab,
+        target_vocab=cols.target_vocab,
+        event_vocab=cols.event_vocab,
+    )
+
+
+def take_host_blocks(
+    cols: ColumnarEvents, host_index: int, host_count: int, n_shards: int = 8
+) -> ColumnarEvents:
+    """This host's deterministic disjoint block subset (canonicalize first
+    for order-nondeterministic drivers — see ``canonical_order``)."""
+    count = _shard_count_for(len(cols), n_shards)
+    return take_blocks(cols, shards_for_host(count, host_index, host_count), n_shards)
+
+
 @dataclasses.dataclass
 class SnapshotCache:
     """Columnar snapshot store rooted at ``root`` (one subdir per key)."""
@@ -96,12 +169,14 @@ class SnapshotCache:
         key = _key({**signature, "stamp": stamp})
         d = self.root / key
         if refresh or stamp is None or not (d / _META).exists():
-            cols = p_events.to_columnar(
-                app_id,
-                channel_id,
-                event_names=event_names,
-                rating_key=rating_key,
-                **find_kwargs,
+            cols = canonical_order(
+                p_events.to_columnar(
+                    app_id,
+                    channel_id,
+                    event_names=event_names,
+                    rating_key=rating_key,
+                    **find_kwargs,
+                )
             )
             if stamp is not None:
                 self._write(d, cols, signature)
@@ -109,7 +184,9 @@ class SnapshotCache:
             if host_count > 1:
                 # Same block partition as the shard files, so a host that
                 # misses (build pass) and a host that hits (shard read) see
-                # disjoint, jointly-complete row sets.
+                # disjoint, jointly-complete row sets. canonical_order above
+                # makes this hold even for drivers whose scan order is
+                # nondeterministic (ES parallel sliced scroll).
                 shard_ids = shards_for_host(
                     self._shard_count(len(cols)), host_index, host_count
                 )
@@ -124,10 +201,10 @@ class SnapshotCache:
         return json.loads((d / _META).read_text())
 
     def _shard_count(self, n_rows: int) -> int:
-        return max(1, min(self.n_shards, n_rows) if n_rows else 1)
+        return _shard_count_for(n_rows, self.n_shards)
 
     def _bounds(self, n_rows: int, n_shards: int) -> np.ndarray:
-        return np.linspace(0, n_rows, n_shards + 1, dtype=np.int64)
+        return _shard_bounds(n_rows, n_shards)
 
     def _write(self, d: Path, cols: ColumnarEvents, signature: dict) -> None:
         # unique temp dir per writer: concurrent builders on a shared
@@ -196,24 +273,7 @@ class SnapshotCache:
         self, cols: ColumnarEvents, shard_ids: Sequence[int]
     ) -> ColumnarEvents:
         """Select the row blocks that shards ``shard_ids`` would contain."""
-        n = len(cols)
-        bounds = self._bounds(n, self._shard_count(n))
-        idx = np.concatenate(
-            [np.arange(bounds[s], bounds[s + 1]) for s in shard_ids]
-        ).astype(np.int64) if shard_ids else np.zeros((0,), np.int64)
-        take = idx.tolist()
-        return ColumnarEvents(
-            event_ids=[cols.event_ids[i] for i in take],
-            event_names=[cols.event_names[i] for i in take],
-            entity_ids=cols.entity_ids[idx],
-            target_ids=cols.target_ids[idx],
-            event_codes=cols.event_codes[idx],
-            timestamps=cols.timestamps[idx],
-            ratings=cols.ratings[idx],
-            entity_vocab=cols.entity_vocab,
-            target_vocab=cols.target_vocab,
-            event_vocab=cols.event_vocab,
-        )
+        return take_blocks(cols, shard_ids, self.n_shards)
 
     def _gc(self, signature: dict, keep_key: str) -> None:
         """Drop all-but-newest snapshot dirs sharing ``signature``."""

@@ -110,7 +110,7 @@ class DataSource(BaseDataSource):
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         store = ctx.p_event_store()
         app_name = self.params.app_name or ctx.app_name
-        col = store.to_columnar(
+        col = store.to_columnar_cached(
             app_name=app_name,
             channel_name=ctx.channel_name,
             event_names=["rate", "buy"],

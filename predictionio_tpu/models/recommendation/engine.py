@@ -163,7 +163,7 @@ class DataSource(BaseDataSource):
 
     def _read_columnar(self, ctx: WorkflowContext) -> ColumnarEvents:
         store = ctx.p_event_store()
-        return store.to_columnar(
+        return store.to_columnar_cached(
             app_name=self.params.app_name or ctx.app_name,
             channel_name=ctx.channel_name,
             event_names=list(self.params.event_names),

@@ -138,7 +138,7 @@ class DataSource(BaseDataSource):
         event_names = ["view", "like"]
         if self.params.rate_event:
             event_names.append(self.params.rate_event)
-        col = store.to_columnar(
+        col = store.to_columnar_cached(
             app_name=app_name,
             channel_name=ctx.channel_name,
             event_names=event_names,

@@ -33,6 +33,16 @@ try:
 except Exception:
     pass
 
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+# Template DataSources read through the snapshot cache by default; tests
+# must never write shards into the developer's ~/.pio_store
+_snap_dir = tempfile.mkdtemp(prefix="pio_test_snapshots_")
+os.environ["PIO_SNAPSHOT_DIR"] = _snap_dir
+atexit.register(shutil.rmtree, _snap_dir, ignore_errors=True)
+
 import pytest  # noqa: E402
 
 from predictionio_tpu.data.storage.memory import MemoryStorageClient  # noqa: E402

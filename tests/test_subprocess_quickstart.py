@@ -70,15 +70,13 @@ def _wait_alive(port: int, proc: subprocess.Popen, timeout_s: float = 90.0) -> N
 def env(tmp_path_factory):
     base = tmp_path_factory.mktemp("subproc_store")
     e = dict(os.environ)
-    e.update(
-        {
-            "PIO_FS_BASEDIR": str(base),
-            "JAX_PLATFORMS": "cpu",
-            # scrub any storage config leaking from the dev environment so
-            # the zero-config sqlite-under-basedir default applies
-            **{k: "" for k in list(e) if k.startswith("PIO_STORAGE_")},
-        }
-    )
+    # scrub any storage config leaking from the dev environment so the
+    # zero-config sqlite-under-basedir default applies (keys must be
+    # REMOVED: registry parsing treats an empty string as an explicit,
+    # invalid setting, not as unset)
+    for k in [k for k in e if k.startswith("PIO_STORAGE_")]:
+        del e[k]
+    e.update({"PIO_FS_BASEDIR": str(base), "JAX_PLATFORMS": "cpu"})
     return e
 
 
