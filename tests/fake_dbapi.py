@@ -1,0 +1,146 @@
+"""Fake psycopg2 / pymysql DB-API modules backed by sqlite3.
+
+The reference contract-tests every SQL backend against a live service
+(``storage/jdbc/src/test/scala/.../LEventsSpec.scala`` + PEventsSpec run on
+dockerized PostgreSQL). No database server exists in this sandbox, so these
+shims make the GENERIC driver (`data/storage/sql.py`) execute its real
+postgres/mysql code paths — pyformat/format placeholder translation,
+``INSERT .. RETURNING id``, server-side (named) cursors, dialect DDL types —
+against sqlite3 underneath:
+
+- every statement is recorded, and a raw ``?`` placeholder reaching a
+  format/pyformat dialect FAILS IMMEDIATELY (the golden property: the
+  dialect translation must cover 100% of emitted SQL);
+- ``%s`` placeholders are mapped back to ``?`` for execution;
+- dialect-specific DDL types (SERIAL/BYTEA/AUTO_INCREMENT/LONGBLOB) are
+  mapped to sqlite equivalents so the schema actually builds;
+- ``RETURNING id`` executes natively (sqlite >= 3.35);
+- ``connection.cursor(name=...)`` (psycopg2 server-side cursor) is accepted
+  and recorded so streaming scans can assert they used it.
+
+Register with ``install()``; module names are chosen so the driver's
+dialect inference picks postgres/mysql from the name alone.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import sys
+import types
+
+_DDL_MAP = (
+    ("SERIAL PRIMARY KEY", "INTEGER PRIMARY KEY AUTOINCREMENT"),
+    ("INTEGER PRIMARY KEY AUTO_INCREMENT", "INTEGER PRIMARY KEY AUTOINCREMENT"),
+    ("BYTEA", "BLOB"),
+    ("LONGBLOB", "BLOB"),
+)
+
+
+class GoldenLog:
+    """Per-module record of every statement the driver emitted."""
+
+    def __init__(self):
+        self.statements: list[str] = []
+        self.named_cursors: int = 0
+
+    def clear(self):
+        self.statements.clear()
+        self.named_cursors = 0
+
+
+class _Cursor:
+    def __init__(self, sq_conn: sqlite3.Connection, log: GoldenLog, paramstyle: str, name=None):
+        self._cur = sq_conn.cursor()
+        self._log = log
+        self._paramstyle = paramstyle
+        if name is not None:
+            log.named_cursors += 1
+
+    def _translate(self, sql: str) -> str:
+        self._log.statements.append(sql)
+        if self._paramstyle in ("format", "pyformat"):
+            # the golden property: the dialect layer must have translated
+            # every placeholder — a leaked qmark would silently bind wrong
+            # on a real server
+            assert "?" not in sql, f"raw '?' placeholder leaked to {self._paramstyle} driver: {sql}"
+            sql = sql.replace("%s", "?")
+        for src, dst in _DDL_MAP:
+            sql = sql.replace(src, dst)
+        return sql
+
+    def execute(self, sql: str, params=()):
+        self._cur.execute(self._translate(sql), tuple(params))
+        return self
+
+    def executemany(self, sql: str, rows):
+        self._cur.executemany(self._translate(sql), [tuple(r) for r in rows])
+        return self
+
+    def fetchone(self):
+        return self._cur.fetchone()
+
+    def fetchall(self):
+        return self._cur.fetchall()
+
+    def fetchmany(self, n):
+        return self._cur.fetchmany(n)
+
+    def close(self):
+        self._cur.close()
+
+    @property
+    def lastrowid(self):
+        return self._cur.lastrowid
+
+    @property
+    def rowcount(self):
+        return self._cur.rowcount
+
+    @property
+    def description(self):
+        return self._cur.description
+
+
+class _Connection:
+    def __init__(self, sq_conn: sqlite3.Connection, log: GoldenLog, paramstyle: str):
+        self._sq = sq_conn
+        self._log = log
+        self._paramstyle = paramstyle
+
+    def cursor(self, name=None):
+        return _Cursor(self._sq, self._log, self._paramstyle, name=name)
+
+    def commit(self):
+        self._sq.commit()
+
+    def rollback(self):
+        self._sq.rollback()
+
+    def close(self):
+        self._sq.close()
+
+
+def _make_module(name: str, paramstyle: str) -> types.ModuleType:
+    mod = types.ModuleType(name)
+    log = GoldenLog()
+
+    def connect(**kwargs):
+        database = kwargs.get("database") or ":memory:"
+        sq = sqlite3.connect(database, check_same_thread=False)
+        return _Connection(sq, log, paramstyle)
+
+    mod.connect = connect
+    mod.paramstyle = paramstyle
+    mod.IntegrityError = sqlite3.IntegrityError
+    mod.golden_log = log
+    return mod
+
+
+def install() -> tuple[types.ModuleType, types.ModuleType]:
+    """Register fake modules; names chosen so dialect inference fires:
+    'psycopg' substring -> postgres, 'mysql' substring -> mysql."""
+    pg = sys.modules.get("fake_psycopg2") or _make_module("fake_psycopg2", "pyformat")
+    my = sys.modules.get("fake_pymysql") or _make_module("fake_pymysql", "format")
+    sys.modules["fake_psycopg2"] = pg
+    sys.modules["fake_pymysql"] = my
+    return pg, my

@@ -50,6 +50,25 @@ def _es_client():
     return client
 
 
+def _fake_dialect_client(tmp_path, module_name):
+    # the postgres/mysql DIALECT code paths (pyformat/format translation,
+    # RETURNING id, named cursors, dialect DDL) running against the fake
+    # DB-API shims — the sandbox stand-in for the reference's dockerized
+    # LEventsSpec/PEventsSpec per-backend runs
+    from tests.fake_dbapi import install
+
+    install()
+    from predictionio_tpu.data.storage.sql import SQLStorageClient
+
+    return SQLStorageClient(
+        {
+            "MODULE": module_name,
+            "DIALECT": "postgres" if "psycopg" in module_name else "mysql",
+            "CONNECT_ARGS": {"database": str(tmp_path / f"{module_name}.db")},
+        }
+    )
+
+
 def _make_client(param, tmp_path):
     if param == "memory":
         return MemoryStorageClient()
@@ -57,6 +76,10 @@ def _make_client(param, tmp_path):
         return SQLiteStorageClient({"PATH": str(tmp_path / "t.db")})
     if param == "sql":
         return _sql_client(tmp_path)
+    if param == "sql_postgres":
+        return _fake_dialect_client(tmp_path, "fake_psycopg2")
+    if param == "sql_mysql":
+        return _fake_dialect_client(tmp_path, "fake_pymysql")
     if param == "elasticsearch":
         return _es_client()
     if param == "jsonl":
@@ -64,7 +87,15 @@ def _make_client(param, tmp_path):
     raise ValueError(param)
 
 
-@pytest.fixture(params=["memory", "sqlite", "jsonl", "sql", "elasticsearch"])
+_ALL_EVENT_BACKENDS = [
+    "memory", "sqlite", "jsonl", "sql", "sql_postgres", "sql_mysql", "elasticsearch",
+]
+_ALL_META_BACKENDS = [
+    "memory", "sqlite", "sql", "sql_postgres", "sql_mysql", "elasticsearch",
+]
+
+
+@pytest.fixture(params=_ALL_EVENT_BACKENDS)
 def client(request, tmp_path):
     c = _make_client(request.param, tmp_path)
     yield c
@@ -72,7 +103,7 @@ def client(request, tmp_path):
         c._mock_server.shutdown()
 
 
-@pytest.fixture(params=["memory", "sqlite", "sql", "elasticsearch"])
+@pytest.fixture(params=_ALL_META_BACKENDS)
 def meta_client(request, tmp_path):
     c = _make_client(request.param, tmp_path)
     yield c
@@ -820,3 +851,56 @@ class TestESSlicedScan:
             assert decoded == serial
         finally:
             c._mock_server.shutdown()
+
+
+class TestSQLDialectGolden:
+    """Golden assertions on the exact statements the generic SQL driver
+    emits per dialect (ref: per-backend LEventsSpec/PEventsSpec). The fake
+    DB-API shims additionally hard-fail if any raw '?' placeholder reaches
+    a format/pyformat driver, so the whole contract suite above doubles as
+    a translation-coverage test."""
+
+    def _exercise(self, client):
+        from predictionio_tpu.data.storage.base import App, Model
+
+        app_id = client.apps().insert(App(0, "golden"))
+        l = client.l_events()
+        l.init(app_id)
+        eid = l.insert(ev("rate", "u1", target="i1", n=1, props={"rating": 2.0}), app_id)
+        assert l.get(eid, app_id) is not None
+        # streaming bulk scan (query_iter -> postgres named cursor)
+        assert len(list(client.p_events().find(app_id))) == 1
+        client.models().insert(Model("golden-inst", b"blob"))
+        return app_id
+
+    def test_postgres_pyformat_returning_and_named_cursor(self, tmp_path):
+        client = _fake_dialect_client(tmp_path, "fake_psycopg2")
+        log = client._mod.golden_log  # includes construction-time DDL
+        self._exercise(client)
+        stmts = log.statements
+        with_params = [s for s in stmts if "%s" in s]
+        assert with_params, "no pyformat statements recorded"
+        assert all("?" not in s for s in stmts)
+        # serial-PK inserts go through INSERT .. RETURNING id, not lastrowid
+        assert any(s.rstrip().endswith("RETURNING id") for s in stmts), stmts
+        # the bulk event scan used a server-side (named) cursor
+        assert log.named_cursors >= 1
+
+    def test_mysql_format_lastrowid(self, tmp_path):
+        client = _fake_dialect_client(tmp_path, "fake_pymysql")
+        log = client._mod.golden_log  # includes construction-time DDL
+        app_id = self._exercise(client)
+        stmts = log.statements
+        assert app_id >= 1  # came from cursor.lastrowid
+        assert any("%s" in s for s in stmts)
+        assert all("RETURNING" not in s for s in stmts)
+        assert all("?" not in s for s in stmts)
+        # mysql DDL carries its own serial/blob types
+        ddl = [s for s in stmts if s.lstrip().upper().startswith("CREATE TABLE")]
+        assert any("AUTO_INCREMENT" in s for s in ddl)
+        assert any("LONGBLOB" in s for s in ddl)
+
+    def test_sqlite_qmark_untranslated(self, tmp_path):
+        client = _sql_client(tmp_path)
+        # qmark dialect: translation is the identity; smoke the same flow
+        self._exercise(client)
