@@ -359,6 +359,98 @@ def phase_serving(ck: _Checkpoint) -> None:
     server_stats = _bench_server_e2e(uf, vf, k)
     ck.save(**{kk: round(vv, 3) for kk, vv in server_stats.items()})
 
+    ec_p50, ec_reads = _bench_ecommerce_serving()
+    ck.save(
+        ecommerce_p50_ms=round(ec_p50, 3),
+        # storage round trips per warm predict — the TTL cache target is 0
+        ecommerce_storage_reads_per_predict=round(ec_reads, 4),
+    )
+
+
+def _bench_ecommerce_serving(
+    n_users: int = 20_000, n_items: int = 10_000, n_queries: int = 30
+) -> tuple[float, float]:
+    """E-commerce predict path (BASELINE workload 4): device matvec + masked
+    top-k + TTL-cached business-rule lookups (seen/unavailable items).
+    Reports warm p50 and measured storage reads per warm predict."""
+    import numpy as np
+
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.models.ecommerce.engine import (
+        ECommAlgorithm,
+        ECommAlgorithmParams,
+        ECommModel,
+        Query,
+    )
+    from predictionio_tpu.workflow.context import WorkflowContext
+
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    app_id = storage.get_meta_data_apps().insert(App(0, "ecombench"))
+    levents = storage.get_l_events()
+    rng = np.random.default_rng(3)
+    levents.insert_batch(
+        [
+            Event(
+                event="buy",
+                entity_type="user",
+                entity_id="u7",
+                target_entity_type="item",
+                target_entity_id=f"i{int(i)}",
+            )
+            for i in rng.integers(0, n_items, 20)
+        ]
+        + [
+            Event(
+                event="$set",
+                entity_type="constraint",
+                entity_id="unavailableItems",
+                properties=DataMap({"items": [f"i{int(i)}" for i in rng.integers(0, n_items, 50)]}),
+            )
+        ],
+        app_id,
+    )
+    model = ECommModel(
+        rng.normal(size=(n_users, 16)).astype(np.float32),
+        rng.normal(size=(n_items, 16)).astype(np.float32),
+        rng.random(n_items).astype(np.float32),
+        [f"u{i}" for i in range(n_users)],
+        [f"i{i}" for i in range(n_items)],
+        [None] * n_items,
+    )
+    algo = ECommAlgorithm(ECommAlgorithmParams(app_name="ecombench", unseen_only=True))
+    c = WorkflowContext(mode="serving", _storage=storage, app_name="ecombench")
+    store = c.l_event_store()
+    reads = {"n": 0}
+    orig = store.find_by_entity
+
+    def counted(*a, **kw):
+        reads["n"] += 1
+        return orig(*a, **kw)
+
+    store.find_by_entity = counted
+    c.l_event_store = lambda: store
+    algo.predict_with_context(c, model, Query(user="u7", num=10))  # warm + compile
+    reads["n"] = 0
+    lat = []
+    for _ in range(n_queries):
+        t0 = time.perf_counter()
+        algo.predict_with_context(c, model, Query(user="u7", num=10))
+        lat.append(time.perf_counter() - t0)
+    return (
+        float(np.percentile(np.asarray(lat) * 1000.0, 50)),
+        reads["n"] / n_queries,
+    )
+
 
 def _bench_server_e2e(
     uf,

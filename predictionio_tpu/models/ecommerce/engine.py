@@ -167,6 +167,12 @@ class ECommAlgorithmParams(Params):
     # adjust-score variant: enable the per-request weightedItems constraint
     # lookup (off by default — it costs one event-store query per predict)
     adjust_score: bool = False
+    # TTL for serving-time storage lookups (seen/recent items per user,
+    # unavailable-items + weightedItems constraints). With the cache warm,
+    # p50 pays ZERO storage round trips; freshness lags by at most the TTL.
+    # 0 disables caching = the reference's always-live per-query reads
+    # (ECommAlgorithm.scala:252-300).
+    cache_ttl_s: float = 5.0
 
 
 @dataclasses.dataclass
@@ -228,6 +234,18 @@ class ECommAlgorithm(JaxAlgorithm):
     params_class = ECommAlgorithmParams
     params: ECommAlgorithmParams
 
+    @property
+    def _lookup_cache(self):
+        """Lazy per-instance TTL cache for the serving-time storage reads
+        (one shared cache: keys are namespaced tuples)."""
+        cache = getattr(self, "_lookup_cache_obj", None)
+        if cache is None:
+            from predictionio_tpu.utils.ttl_cache import TTLCache
+
+            cache = TTLCache(ttl_s=self.params.cache_ttl_s)
+            self._lookup_cache_obj = cache
+        return cache
+
     def train(self, ctx: WorkflowContext, pd: TrainingData) -> ECommModel:
         cfg = ALSConfig(
             rank=self.params.rank,
@@ -257,61 +275,81 @@ class ECommAlgorithm(JaxAlgorithm):
             list(pd.item_categories),
         )
 
-    # -- live lookups (ref ECommAlgorithm.scala:252-300) ---------------------
+    # -- live lookups (ref ECommAlgorithm.scala:252-300), TTL-cached so the
+    # steady-state predict path does zero storage round trips ---------------
+    # NOTE failure handling: the _live loaders RAISE on storage errors and
+    # the degraded fallback is applied OUTSIDE get_or_load — caching the
+    # fallback would silently disable a business filter for a whole TTL
+    # window after one storage blip; this way only successful reads cache
+    # and the next query retries the store.
     def _seen_items(self, ctx: WorkflowContext, user: str) -> set[str]:
         try:
-            events = ctx.l_event_store().find_by_entity(
-                app_name=self.params.app_name or ctx.app_name,
-                entity_type="user",
-                entity_id=user,
-                event_names=list(self.params.seen_events),
-                limit=None,
+            return self._lookup_cache.get_or_load(
+                ("seen", user), lambda: self._seen_items_live(ctx, user)
             )
-            return {
-                e.target_entity_id for e in events if e.target_entity_id is not None
-            }
         except Exception:
             logger.exception("seen-items lookup failed; serving without filter")
             return set()
 
+    def _seen_items_live(self, ctx: WorkflowContext, user: str) -> set[str]:
+        events = ctx.l_event_store().find_by_entity(
+            app_name=self.params.app_name or ctx.app_name,
+            entity_type="user",
+            entity_id=user,
+            event_names=list(self.params.seen_events),
+            limit=None,
+        )
+        return {e.target_entity_id for e in events if e.target_entity_id is not None}
+
     def _unavailable_items(self, ctx: WorkflowContext) -> set[str]:
-        """$set events on (constraint, unavailableItems), latest wins
-        (ref :268-284)."""
         try:
-            events = list(
-                ctx.l_event_store().find_by_entity(
-                    app_name=self.params.app_name or ctx.app_name,
-                    entity_type="constraint",
-                    entity_id="unavailableItems",
-                    event_names=["$set"],
-                    limit=1,
-                )
+            return self._lookup_cache.get_or_load(
+                ("unavailable",), lambda: self._unavailable_items_live(ctx)
             )
-            if events:
-                return set(events[0].properties.get_or_else("items", []))
         except Exception:
             logger.exception("unavailable-items lookup failed; assuming none")
+            return set()
+
+    def _unavailable_items_live(self, ctx: WorkflowContext) -> set[str]:
+        """$set events on (constraint, unavailableItems), latest wins
+        (ref :268-284)."""
+        events = list(
+            ctx.l_event_store().find_by_entity(
+                app_name=self.params.app_name or ctx.app_name,
+                entity_type="constraint",
+                entity_id="unavailableItems",
+                event_names=["$set"],
+                limit=1,
+            )
+        )
+        if events:
+            return set(events[0].properties.get_or_else("items", []))
         return set()
 
     def _item_weights(self, ctx: WorkflowContext, model: ECommModel) -> np.ndarray | None:
+        try:
+            return self._lookup_cache.get_or_load(
+                ("weights",), lambda: self._item_weights_live(ctx, model)
+            )
+        except Exception:
+            logger.exception("weightedItems lookup failed; weights ignored")
+            return None
+
+    def _item_weights_live(self, ctx: WorkflowContext, model: ECommModel) -> np.ndarray | None:
         """adjust-score variant (ref adjust-score/ECommAlgorithm.scala:56-58,
         256-263,400-430): latest $set on (constraint, weightedItems) carries
         ``weights``: [{"items": [...], "weight": w}]; scores of listed items
         are multiplied by w, everything else by 1.0. Returns None when no
         constraint is set so the multiply can be skipped entirely."""
-        try:
-            events = list(
-                ctx.l_event_store().find_by_entity(
-                    app_name=self.params.app_name or ctx.app_name,
-                    entity_type="constraint",
-                    entity_id="weightedItems",
-                    event_names=["$set"],
-                    limit=1,
-                )
+        events = list(
+            ctx.l_event_store().find_by_entity(
+                app_name=self.params.app_name or ctx.app_name,
+                entity_type="constraint",
+                entity_id="weightedItems",
+                event_names=["$set"],
+                limit=1,
             )
-        except Exception:
-            logger.exception("weightedItems lookup failed; weights ignored")
-            return None
+        )
         if not events:
             return None
         groups = events[0].properties.get_or_else("weights", [])
@@ -327,25 +365,31 @@ class ECommAlgorithm(JaxAlgorithm):
         return weights
 
     def _recent_item_indices(self, ctx: WorkflowContext, model: ECommModel, user: str) -> list[int]:
-        """Last 10 similar-event items (ref :302-320)."""
         try:
-            events = ctx.l_event_store().find_by_entity(
-                app_name=self.params.app_name or ctx.app_name,
-                entity_type="user",
-                entity_id=user,
-                event_names=list(self.params.similar_events),
-                limit=10,
+            return self._lookup_cache.get_or_load(
+                ("recent", user),
+                lambda: self._recent_item_indices_live(ctx, model, user),
             )
-            out = []
-            for e in events:
-                if e.target_entity_id is not None:
-                    idx = model.item_index(e.target_entity_id)
-                    if idx is not None:
-                        out.append(idx)
-            return out
         except Exception:
             logger.exception("recent-items lookup failed")
             return []
+
+    def _recent_item_indices_live(self, ctx: WorkflowContext, model: ECommModel, user: str) -> list[int]:
+        """Last 10 similar-event items (ref :302-320)."""
+        events = ctx.l_event_store().find_by_entity(
+            app_name=self.params.app_name or ctx.app_name,
+            entity_type="user",
+            entity_id=user,
+            event_names=list(self.params.similar_events),
+            limit=10,
+        )
+        out = []
+        for e in events:
+            if e.target_entity_id is not None:
+                idx = model.item_index(e.target_entity_id)
+                if idx is not None:
+                    out.append(idx)
+        return out
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         return self.predict_with_context(

@@ -874,23 +874,37 @@ class TestSQLDialectGolden:
         return app_id
 
     def test_postgres_pyformat_returning_and_named_cursor(self, tmp_path):
+        # the golden log is a module-wide singleton shared with the contract
+        # suite: scope every assertion to THIS client's statements via
+        # markers, or earlier tests could satisfy (or poison) them
+        from tests.fake_dbapi import install
+
+        pg, _ = install()
+        m0 = len(pg.golden_log.statements)
+        cursors0 = pg.golden_log.named_cursors
         client = _fake_dialect_client(tmp_path, "fake_psycopg2")
-        log = client._mod.golden_log  # includes construction-time DDL
         self._exercise(client)
-        stmts = log.statements
+        stmts = pg.golden_log.statements[m0:]
         with_params = [s for s in stmts if "%s" in s]
         assert with_params, "no pyformat statements recorded"
         assert all("?" not in s for s in stmts)
         # serial-PK inserts go through INSERT .. RETURNING id, not lastrowid
         assert any(s.rstrip().endswith("RETURNING id") for s in stmts), stmts
         # the bulk event scan used a server-side (named) cursor
-        assert log.named_cursors >= 1
+        assert pg.golden_log.named_cursors > cursors0
+        # postgres DDL carries its own serial/blob types
+        ddl = [s for s in stmts if s.lstrip().upper().startswith("CREATE TABLE")]
+        assert any("SERIAL PRIMARY KEY" in s for s in ddl)
+        assert any("BYTEA" in s for s in ddl)
 
     def test_mysql_format_lastrowid(self, tmp_path):
+        from tests.fake_dbapi import install
+
+        _, my = install()
+        m0 = len(my.golden_log.statements)
         client = _fake_dialect_client(tmp_path, "fake_pymysql")
-        log = client._mod.golden_log  # includes construction-time DDL
         app_id = self._exercise(client)
-        stmts = log.statements
+        stmts = my.golden_log.statements[m0:]
         assert app_id >= 1  # came from cursor.lastrowid
         assert any("%s" in s for s in stmts)
         assert all("RETURNING" not in s for s in stmts)
