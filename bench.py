@@ -663,6 +663,71 @@ def phase_twotower(ck: _Checkpoint) -> None:
         twotower_recall_at_10=round(recall10, 4),
         twotower_recall_gate_ok=bool(recall10 > 0.05),
     )
+    if platform in ("tpu", "axon"):
+        pallas_ms, ref_ms, err = _bench_attention()
+        ck.save(
+            attention_pallas_ms=round(pallas_ms, 3),
+            attention_ref_ms=round(ref_ms, 3),
+            attention_max_abs_err=float(f"{err:.2e}"),
+            # both sides multiply in bf16 (kernel: explicit bf16 dots with
+            # f32 accumulation; reference: TPU default f32->bf16 passes), so
+            # the gate bounds |pallas - ref| by bf16 rounding at these shapes
+            attention_gate_ok=bool(err < 2e-2),
+        )
+
+
+def _bench_attention(B: int = 4, H: int = 8, L: int = 2048, D: int = 64):
+    """Pallas fused attention vs the jnp reference on TPU: wall-clock of the
+    two-tower history encoder's kernel (ops/attention.py) and their max
+    absolute output difference."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from predictionio_tpu.ops.attention import attention_reference, fused_attention
+
+    from jax import lax
+
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, H, L, D)).astype(np.float32)) for _ in range(3)
+    )
+    pallas_fn = jax.jit(lambda q, k, v: fused_attention(q, k, v, causal=True))
+    ref_fn = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))
+    out_p = np.asarray(pallas_fn(q, k, v))  # compile + warm
+    out_r = np.asarray(ref_fn(q, k, v))
+    err = float(np.max(np.abs(out_p - out_r)))
+
+    def chained(fn, n):
+        # n sequential applications chained through q: one dispatch + one
+        # fetch regardless of n, so the per-iteration slope cancels the
+        # transport RTT (tens of ms on a tunneled chip — larger than the
+        # kernel itself)
+        @jax.jit
+        def run(q, k, v):
+            def body(c, _):
+                return fn(c, k, v), ()
+
+            out, _ = lax.scan(body, q, None, length=n)
+            return out
+
+        return run
+
+    def timed(fn):
+        # wide spread (2 vs 34 iterations) so the slope dwarfs transport
+        # jitter (several ms per fetch on the tunnel)
+        lo, hi = chained(fn, 2), chained(fn, 34)
+        for f in (lo, hi):
+            np.asarray(f(q, k, v)[0, 0, :1])  # compile + warm
+        t_lo = min(
+            _timed(lambda: np.asarray(lo(q, k, v)[0, 0, :1])) for _ in range(4)
+        )
+        t_hi = min(
+            _timed(lambda: np.asarray(hi(q, k, v)[0, 0, :1])) for _ in range(4)
+        )
+        return max(t_hi - t_lo, 1e-9) / 32 * 1000.0
+
+    return timed(pallas_fn), timed(ref_fn), err
 
 
 def _bench_twotower(n_users: int, n_items: int, batch: int = 8192, steps: int = 20) -> float:

@@ -185,9 +185,15 @@ class ECommModel(SanityCheck):
     item_categories: list[frozenset[str] | None]
 
     def __post_init__(self):
+        import uuid
+
         self._user_index: dict[str, int] | None = None
         self._item_index: dict[str, int] | None = None
         self._device_items = None
+        # identity token for serving-side caches: values derived FROM this
+        # model (index arrays, weight vectors) must never be served to a
+        # different (e.g. hot-swapped) model
+        self._cache_token = uuid.uuid4().hex
 
     def sanity_check(self) -> None:
         if not (
@@ -224,10 +230,13 @@ class ECommModel(SanityCheck):
         }
 
     def __setstate__(self, state):
+        import uuid
+
         self.__dict__.update(state)
         self._user_index = None
         self._item_index = None
         self._device_items = None
+        self._cache_token = uuid.uuid4().hex
 
 
 class ECommAlgorithm(JaxAlgorithm):
@@ -328,8 +337,11 @@ class ECommAlgorithm(JaxAlgorithm):
 
     def _item_weights(self, ctx: WorkflowContext, model: ECommModel) -> np.ndarray | None:
         try:
+            # keyed by model identity: the weight vector is sized/indexed
+            # against THIS model's item vocab
             return self._lookup_cache.get_or_load(
-                ("weights",), lambda: self._item_weights_live(ctx, model)
+                ("weights", model._cache_token),
+                lambda: self._item_weights_live(ctx, model),
             )
         except Exception:
             logger.exception("weightedItems lookup failed; weights ignored")
@@ -366,8 +378,10 @@ class ECommAlgorithm(JaxAlgorithm):
 
     def _recent_item_indices(self, ctx: WorkflowContext, model: ECommModel, user: str) -> list[int]:
         try:
+            # keyed by model identity: returns indices INTO this model's
+            # item table
             return self._lookup_cache.get_or_load(
-                ("recent", user),
+                ("recent", model._cache_token, user),
                 lambda: self._recent_item_indices_live(ctx, model, user),
             )
         except Exception:

@@ -68,6 +68,7 @@ class TrainingData(SanityCheck):
     item_idx: np.ndarray
     user_vocab: list[str]
     item_vocab: list[str]
+    timestamps: np.ndarray | None = None  # event times for history ordering
 
     def sanity_check(self) -> None:
         if len(self.user_idx) == 0:
@@ -92,6 +93,7 @@ class DataSource(BaseDataSource):
             col.target_ids[valid],
             col.entity_vocab,
             col.target_vocab,
+            timestamps=col.timestamps[valid],
         )
 
 
@@ -111,6 +113,10 @@ class TwoTowerAlgorithmParams(Params):
     epochs: int = 5
     seed: int = 0
     mesh: str = ""  # e.g. "data=-1,model=2"; empty = all devices on data
+    # sequence encoder over each user's recent item history (consumes the
+    # pallas fused-attention kernel on TPU, ops/attention.py); 0 disables
+    history_len: int = 0
+    n_heads: int = 2
 
 
 @dataclasses.dataclass
@@ -121,6 +127,7 @@ class TwoTowerModelState(SanityCheck):
     user_vocab: list[str]
     item_vocab: list[str]
     losses: list[float]
+    history: np.ndarray | None = None  # [n_users, T] when the encoder is on
 
     def __post_init__(self):
         self._user_index: dict[str, int] | None = None
@@ -156,10 +163,12 @@ class TwoTowerModelState(SanityCheck):
             "user_vocab": self.user_vocab,
             "item_vocab": self.item_vocab,
             "losses": self.losses,
+            "history": self.history,
         }
 
     def __setstate__(self, state):
         self.__dict__.update(state)
+        self.__dict__.setdefault("history", None)  # pre-encoder blobs
         self._user_index = None
         self._device_items = None
         self._model = None
@@ -181,13 +190,28 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             batch_size=self.params.batch_size,
             epochs=self.params.epochs,
             seed=self.params.seed,
+            history_len=self.params.history_len,
+            n_heads=self.params.n_heads,
         )
         mesh = None
         if self.params.mesh:
             from predictionio_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh(self.params.mesh)
-        result = train_two_tower(pd.user_idx, pd.item_idx, config, mesh=mesh)
+        history = None
+        if config.history_len > 0:
+            from predictionio_tpu.models.twotower.model import build_history_matrix
+
+            history = build_history_matrix(
+                pd.user_idx,
+                pd.item_idx,
+                pd.timestamps,
+                config.n_users,
+                config.history_len,
+            )
+        result = train_two_tower(
+            pd.user_idx, pd.item_idx, config, mesh=mesh, history=history
+        )
         return TwoTowerModelState(
             config=config,
             params=result.params,
@@ -195,6 +219,7 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             user_vocab=pd.user_vocab,
             item_vocab=pd.item_vocab,
             losses=result.losses,
+            history=history,
         )
 
     def predict(self, model: TwoTowerModelState, query: Query) -> PredictedResult:
@@ -203,8 +228,13 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         uidx = model.user_index(query.user)
         if uidx is None:
             return PredictedResult(())
+        hist = (
+            jnp.asarray(model.history[uidx : uidx + 1])
+            if model.history is not None
+            else None
+        )
         u = user_embedding(
-            model.model(), model.params, jnp.asarray([uidx], jnp.int32)
+            model.model(), model.params, jnp.asarray([uidx], jnp.int32), hist
         )[0]
         from predictionio_tpu.ops.als import top_k_items
 

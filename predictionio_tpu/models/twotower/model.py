@@ -13,6 +13,13 @@ workload from BASELINE.json):
     XLA/GSPMD inserts the all-gathers for embedding lookups and the psum for
     the data-parallel gradient — no hand-written collectives.
   - The train step is one jitted function with donated optimizer state.
+  - Optional sequence encoder (``history_len > 0``): the user tower fuses a
+    causal self-attention encoding of the user's recent item history into
+    the id embedding. Attention runs through ``ops.attention.fused_attention``
+    — the pallas TPU kernel on TPU, the jnp reference elsewhere. Histories
+    are chronological with -1 padding at the END, so causal masking already
+    keeps pad keys invisible to real positions and pooling masks the rest;
+    no separate key-padding mask is needed.
 """
 
 from __future__ import annotations
@@ -47,6 +54,59 @@ class TwoTowerConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1  # epochs between checkpoints
     resume: bool = True  # continue from the newest checkpoint if present
+    # sequence encoder: 0 disables; > 0 = length of the per-user item
+    # history consumed by causal self-attention in the user tower
+    history_len: int = 0
+    n_heads: int = 2
+
+
+class SeqEncoder(nn.Module):
+    """Causal self-attention encoder over a user's recent item history.
+
+    The consumer of ``ops.attention.fused_attention`` (pallas on TPU).
+    Input: [B, T] item indices, chronological, -1 padding at the END —
+    causal attention means real positions never attend to pads, and the
+    masked mean-pool drops pad positions' outputs.
+    """
+
+    vocab: int
+    embed_dim: int
+    n_heads: int
+    max_len: int
+
+    @nn.compact
+    def __call__(self, hist_ids: jnp.ndarray) -> jnp.ndarray:  # [B, T] -> [B, E]
+        from predictionio_tpu.ops.attention import fused_attention
+
+        valid = hist_ids >= 0  # [B, T]
+        # invalid slots (end pads AND train-time target masking, which can
+        # land mid-sequence) map to a dedicated learned mask token (index
+        # ``vocab``) instead of item 0 — causal followers still see the
+        # key, but it carries "nothing" rather than a phantom item
+        ids = jnp.where(valid, jnp.maximum(hist_ids, 0), self.vocab)
+        x = nn.Embed(self.vocab + 1, self.embed_dim, name="hist_embed")(ids)
+        pos = self.param(
+            "pos",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.embed_dim),
+        )
+        x = x + pos[None, : x.shape[1]]
+        x = nn.LayerNorm(name="ln")(x)
+        B, T, E = x.shape
+        H = self.n_heads
+        Dh = E // H
+
+        def heads(name):
+            y = nn.Dense(E, name=name)(x)
+            return y.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)  # [B,H,T,Dh]
+
+        out = fused_attention(heads("q"), heads("k"), heads("v"), causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, E)
+        out = x + nn.Dense(E, name="proj")(out)  # residual
+        # masked mean-pool over valid (non-pad) positions
+        w = valid.astype(out.dtype)[..., None]
+        denom = jnp.maximum(w.sum(axis=1), 1.0)
+        return (out * w).sum(axis=1) / denom
 
 
 class Tower(nn.Module):
@@ -56,8 +116,10 @@ class Tower(nn.Module):
     out_dim: int
 
     @nn.compact
-    def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, ids: jnp.ndarray, extra: jnp.ndarray | None = None) -> jnp.ndarray:
         x = nn.Embed(self.vocab, self.embed_dim, name="embed")(ids)
+        if extra is not None:
+            x = x + extra  # history encoding fused into the id embedding
         x = x.astype(jnp.bfloat16)
         for i, h in enumerate(self.hidden):
             x = nn.relu(nn.Dense(h, name=f"dense_{i}", dtype=jnp.bfloat16)(x))
@@ -73,12 +135,24 @@ class TwoTower(nn.Module):
         c = self.config
         self.user_tower = Tower(c.n_users, c.embed_dim, c.hidden, c.out_dim)
         self.item_tower = Tower(c.n_items, c.embed_dim, c.hidden, c.out_dim)
+        if c.history_len > 0:
+            self.hist_encoder = SeqEncoder(
+                c.n_items, c.embed_dim, c.n_heads, c.history_len
+            )
 
-    def __call__(self, user_ids, item_ids):
-        return self.user_tower(user_ids), self.item_tower(item_ids)
+    def _user_extra(self, user_hist):
+        if self.config.history_len > 0 and user_hist is not None:
+            return self.hist_encoder(user_hist)
+        return None
 
-    def embed_users(self, user_ids):
-        return self.user_tower(user_ids)
+    def __call__(self, user_ids, item_ids, user_hist=None):
+        return (
+            self.user_tower(user_ids, self._user_extra(user_hist)),
+            self.item_tower(item_ids),
+        )
+
+    def embed_users(self, user_ids, user_hist=None):
+        return self.user_tower(user_ids, self._user_extra(user_hist))
 
     def embed_items(self, item_ids):
         return self.item_tower(item_ids)
@@ -100,8 +174,8 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("data"))
 
 
-def loss_fn(model: TwoTower, params, user_ids, item_ids, temperature: float):
-    u, v = model.apply({"params": params}, user_ids, item_ids)
+def loss_fn(model: TwoTower, params, user_ids, item_ids, temperature: float, user_hist=None):
+    u, v = model.apply({"params": params}, user_ids, item_ids, user_hist)
     logits = (u @ v.T) / temperature  # [B, B]
     labels = jnp.arange(u.shape[0])
     # symmetric in-batch softmax (user->item and item->user)
@@ -110,7 +184,26 @@ def loss_fn(model: TwoTower, params, user_ids, item_ids, temperature: float):
     return 0.5 * (l1 + l2)
 
 
-def make_train_step(model: TwoTower, tx, temperature: float):
+def make_train_step(model: TwoTower, tx, temperature: float, with_history: bool = False):
+    if with_history:
+        # history matrix [n_users, T] rides on device; per-batch rows are
+        # gathered INSIDE the step (one fused gather, no host transfer)
+        def train_step_h(params, opt_state, user_ids, item_ids, hist_matrix):
+            h = hist_matrix[user_ids]
+            # anti-leakage: the training target must not sit in its own
+            # example's history (the encoder would just copy its embedding
+            # and the in-batch softmax would collapse into a shortcut);
+            # masked slots become the learned mask token in SeqEncoder
+            h = jnp.where(h == item_ids[:, None], -1, h)
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(model, p, user_ids, item_ids, temperature, h)
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return train_step_h
+
     def train_step(params, opt_state, user_ids, item_ids):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(model, p, user_ids, item_ids, temperature)
@@ -129,16 +222,42 @@ class TrainResult:
     item_embeddings: np.ndarray  # [n_items, out_dim] precomputed for serving
 
 
+def build_history_matrix(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    timestamps: np.ndarray | None,
+    n_users: int,
+    history_len: int,
+) -> np.ndarray:
+    """Per-user last-``history_len`` item indices, chronological, -1 padded
+    at the END (the layout SeqEncoder requires)."""
+    hist = np.full((n_users, history_len), -1, np.int32)
+    order = (
+        np.lexsort((item_idx, timestamps, user_idx))
+        if timestamps is not None
+        else np.lexsort((item_idx, user_idx))
+    )
+    u_sorted, i_sorted = user_idx[order], item_idx[order]
+    starts = np.searchsorted(u_sorted, np.arange(n_users))
+    ends = np.searchsorted(u_sorted, np.arange(n_users), side="right")
+    for u in range(n_users):
+        items = i_sorted[starts[u] : ends[u]][-history_len:]
+        hist[u, : len(items)] = items
+    return hist
+
+
 def train_two_tower(
     user_idx: np.ndarray,
     item_idx: np.ndarray,
     config: TwoTowerConfig,
     mesh: Mesh | None = None,
+    history: np.ndarray | None = None,
 ) -> TrainResult:
     """Full training loop: shard the interaction list, run jitted steps.
 
     Works on any mesh with axes (data, model) — including 1x1 (single chip)
-    and the 8-device CPU test mesh.
+    and the 8-device CPU test mesh. ``history`` ([n_users, history_len],
+    -1-padded) enables the sequence encoder when config.history_len > 0.
     """
     if mesh is None:
         from predictionio_tpu.parallel.mesh import make_mesh
@@ -153,8 +272,12 @@ def train_two_tower(
     # round batch to a multiple of the data axis (static shapes)
     data_size = mesh.shape["data"]
     B = max(data_size, (B // data_size) * data_size)
+    with_history = config.history_len > 0 and history is not None
     init_u = jnp.zeros((B,), jnp.int32)
-    params = model.init(rng, init_u, init_u)["params"]
+    init_h = (
+        jnp.zeros((B, config.history_len), jnp.int32) if with_history else None
+    )
+    params = model.init(rng, init_u, init_u, init_h)["params"]
     p_shardings = param_sharding_tree(params, mesh)
     params = jax.device_put(params, p_shardings)
     tx = optax.adam(config.learning_rate)
@@ -162,8 +285,15 @@ def train_two_tower(
     b_sharding = batch_sharding(mesh)
 
     step = jax.jit(
-        make_train_step(model, tx, config.temperature),
+        make_train_step(model, tx, config.temperature, with_history=with_history),
         donate_argnums=(0, 1),
+    )
+    hist_dev = (
+        jax.device_put(
+            np.asarray(history, np.int32), NamedSharding(mesh, P())
+        )
+        if with_history
+        else None
     )
 
     n = len(user_idx)
@@ -196,7 +326,10 @@ def train_two_tower(
                 sel = np.concatenate([sel, perm[: B - len(sel)]])
             ub = jax.device_put(user_idx[sel].astype(np.int32), b_sharding)
             ib = jax.device_put(item_idx[sel].astype(np.int32), b_sharding)
-            params, opt_state, loss = step(params, opt_state, ub, ib)
+            if with_history:
+                params, opt_state, loss = step(params, opt_state, ub, ib, hist_dev)
+            else:
+                params, opt_state, loss = step(params, opt_state, ub, ib)
         losses.append(float(loss))
         if config.checkpoint_dir and (epoch + 1) % max(1, config.checkpoint_every) == 0:
             save_train_checkpoint(
@@ -214,8 +347,12 @@ def train_two_tower(
     return TrainResult(host_params, losses, item_emb)
 
 
-def user_embedding(model: TwoTower, params, user_ids: jnp.ndarray) -> jnp.ndarray:
-    return model.apply({"params": params}, user_ids, method=TwoTower.embed_users)
+def user_embedding(
+    model: TwoTower, params, user_ids: jnp.ndarray, user_hist: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    return model.apply(
+        {"params": params}, user_ids, user_hist, method=TwoTower.embed_users
+    )
 
 
 # ---------------------------------------------------------------------------
