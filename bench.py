@@ -142,6 +142,8 @@ def _timed(fn) -> float:
 
 
 def phase_als(ck: _Checkpoint) -> None:
+    import dataclasses
+
     import numpy as np
 
     jax, platform = _jax_setup()
@@ -193,6 +195,23 @@ def phase_als(ck: _Checkpoint) -> None:
     uf, vf = als_train(users_tr, items_tr, vals_tr, n_users, n_items, config)
     _sync(uf, vf)
     train_wall = time.perf_counter() - t0
+    ck.save(als_train_wall_s=round(train_wall, 3))
+
+    # device-only per-iteration time by iteration-count slope: the 1- and
+    # 11-iteration runs pay identical host block-packing + upload costs, so
+    # the difference isolates ten iterations of pure device work
+    cfg1 = dataclasses.replace(config, iterations=1)
+    cfg11 = dataclasses.replace(config, iterations=11)
+    t0 = time.perf_counter()
+    r1 = als_train(users_tr, items_tr, vals_tr, n_users, n_items, cfg1)
+    _sync(*r1)
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r11 = als_train(users_tr, items_tr, vals_tr, n_users, n_items, cfg11)
+    _sync(*r11)
+    t11 = time.perf_counter() - t0
+    device_per_iter = max((t11 - t1) / 10.0, 1e-9)
+    ck.save(als_device_s_per_iter=round(device_per_iter, 3))
 
     # analytic FLOP accounting (VERDICT r2 weak #5): per iteration, both
     # half-solves stream all nnz ratings — each contributes a rank-1 f x f
@@ -207,12 +226,14 @@ def phase_als(ck: _Checkpoint) -> None:
     # peak: TPU v5e ~197 TFLOP/s bf16 / ~98 fp32 (MXU); CPU runs get no MFU
     peak = 98e12 if platform in ("tpu", "axon") else None
     ck.save(
-        als_train_wall_s=round(train_wall, 3),
         als_compile_s=round(max(0.0, cold_wall - train_wall), 1),
         als_flops=float(f"{als_flops:.3e}"),
+        # wall-clock MFU includes host block-packing + H2D upload (what a
+        # user's `pio train` pays); device MFU isolates the compute
         als_tflops_per_s=round(als_flops / train_wall / 1e12, 2),
-        als_mfu=(
-            round(als_flops / train_wall / peak, 4) if peak else None
+        als_mfu=(round(als_flops / train_wall / peak, 4) if peak else None),
+        als_device_mfu=(
+            round(per_iter / device_per_iter / peak, 4) if peak else None
         ),
     )
 

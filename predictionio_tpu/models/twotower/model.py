@@ -59,6 +59,13 @@ class TwoTowerConfig:
     history_len: int = 0
     n_heads: int = 2
 
+    def __post_init__(self):
+        if self.history_len > 0 and self.embed_dim % self.n_heads:
+            raise ValueError(
+                f"embed_dim ({self.embed_dim}) must be divisible by n_heads "
+                f"({self.n_heads}) for the history encoder"
+            )
+
 
 class SeqEncoder(nn.Module):
     """Causal self-attention encoder over a user's recent item history.
@@ -232,11 +239,13 @@ def build_history_matrix(
     """Per-user last-``history_len`` item indices, chronological, -1 padded
     at the END (the layout SeqEncoder requires)."""
     hist = np.full((n_users, history_len), -1, np.int32)
-    order = (
-        np.lexsort((item_idx, timestamps, user_idx))
-        if timestamps is not None
-        else np.lexsort((item_idx, user_idx))
-    )
+    if timestamps is not None:
+        order = np.lexsort((item_idx, timestamps, user_idx))
+    else:
+        # no timestamps: preserve each user's ORIGINAL event order (stable
+        # sort by user only) — sorting by item id would fabricate a
+        # "recency" the encoder then learns from
+        order = np.argsort(user_idx, kind="stable")
     u_sorted, i_sorted = user_idx[order], item_idx[order]
     starts = np.searchsorted(u_sorted, np.arange(n_users))
     ends = np.searchsorted(u_sorted, np.arange(n_users), side="right")

@@ -126,7 +126,7 @@ def _block_coo(
             np.full((nb,), dummy_row, np.int32),
             np.zeros((nb, d), np.int32),
             np.zeros((nb, d), np.float32),
-            np.zeros((nb, d), np.float32),
+            np.zeros((nb, d), np.int8),  # same wire dtype as non-empty path
         )
     order = np.argsort(rows, kind="stable")
     r, c, v = rows[order], cols[order], vals[order]
@@ -142,10 +142,13 @@ def _block_coo(
     dest_slot = p % d
     cols_pad = np.zeros((nb, d), np.int32)
     vals_pad = np.zeros((nb, d), np.float32)
-    w_pad = np.zeros((nb, d), np.float32)
+    # int8 mask: a quarter of the f32 host->device bytes (the block tables
+    # cross the wire once per train; on a remote-attached chip the upload
+    # is a measurable slice of total train wall); cast to f32 on device
+    w_pad = np.zeros((nb, d), np.int8)
     cols_pad[dest_block, dest_slot] = c
     vals_pad[dest_block, dest_slot] = v
-    w_pad[dest_block, dest_slot] = 1.0
+    w_pad[dest_block, dest_slot] = 1
     block_rows = np.full((nb,), dummy_row, np.int32)
     block_rows[:nb_real] = np.repeat(uniq, nblk)
     return block_rows, cols_pad, vals_pad, w_pad
@@ -235,6 +238,7 @@ def _normal_equations_blocked(
     def step(carry, inputs):
         A, b, n = carry
         br, c, v, ww = inputs
+        ww = ww.astype(opposite.dtype)  # int8 wire format -> f32 math
         vecs = opposite[c]  # [CB, D, f] gather
         if implicit:
             ow = ww * (alpha * v)  # (conf - 1), 0 in pad slots

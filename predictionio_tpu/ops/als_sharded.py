@@ -39,7 +39,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.ops.als import ALSConfig, _solve_side
+from predictionio_tpu.ops.als import ALSConfig, _block_coo, _solve_blocked
 
 try:  # stable home since jax 0.8
     from jax import shard_map  # type: ignore[attr-defined]
@@ -56,32 +56,49 @@ _NO_CHECK = (
 )
 
 
-def _block_partition_coo(
+def _block_partition_blocked(
     owner_idx: np.ndarray,
     other_idx: np.ndarray,
     vals: np.ndarray,
     block: int,
-    n_blocks: int,
-    chunk: int,
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Split COO by owning block of ``owner_idx``; localize owner indices to
-    the block; pad every shard to one common chunk-multiple length with
-    scatters into the per-block dummy row (local index ``block``).
+    n_dev: int,
+    d: int,
+    block_chunk: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Split COO by owning device block, localize owner indices, and pack
+    each device's shard into the ALX entity-block layout (``_block_coo`` —
+    the same MXU Gram formulation the single-chip path uses). All devices
+    are padded to one common block count with dummy blocks.
 
-    Returns [n_blocks, L] arrays (owner-local rows, other-global cols, vals).
+    Returns stacked [n_dev, NB], [n_dev, NB, d] x2, [n_dev, NB, d] arrays.
     """
     owners = owner_idx // block
-    per_dev = [np.flatnonzero(owners == d) for d in range(n_blocks)]
-    longest = max((len(ix) for ix in per_dev), default=0)
-    length = max(chunk, ((longest + chunk - 1) // chunk) * chunk)
-    rows = np.full((n_blocks, length), block, np.int32)  # dummy local row
-    cols = np.zeros((n_blocks, length), np.int32)
-    v = np.zeros((n_blocks, length), np.float32)
-    for d, ix in enumerate(per_dev):
-        rows[d, : len(ix)] = (owner_idx[ix] - d * block).astype(np.int32)
-        cols[d, : len(ix)] = other_idx[ix].astype(np.int32)
-        v[d, : len(ix)] = vals[ix].astype(np.float32)
-    return rows, cols, v
+    layouts = []
+    for dev in range(n_dev):
+        ix = np.flatnonzero(owners == dev)
+        layouts.append(
+            _block_coo(
+                (owner_idx[ix] - dev * block).astype(np.int32),
+                other_idx[ix].astype(np.int32),
+                vals[ix].astype(np.float32),
+                d,
+                block_chunk,
+                dummy_row=block,  # local dummy absorbs pad blocks
+            )
+        )
+    nb = max(l[0].shape[0] for l in layouts)
+    nb += (-nb) % block_chunk
+    br = np.full((n_dev, nb), block, np.int32)
+    cols = np.zeros((n_dev, nb, d), np.int32)
+    v = np.zeros((n_dev, nb, d), np.float32)
+    w = np.zeros((n_dev, nb, d), np.int8)
+    for dev, (b_rows, b_cols, b_vals, b_w) in enumerate(layouts):
+        n = b_rows.shape[0]
+        br[dev, :n] = b_rows
+        cols[dev, :n] = b_cols
+        v[dev, :n] = b_vals
+        w[dev, :n] = b_w
+    return br, cols, v, w
 
 
 def als_train_sharded(
@@ -112,16 +129,14 @@ def als_train_sharded(
 
     bu = max(1, -(-n_users // n_dev))  # users per device block
     bi = max(1, -(-n_items // n_dev))
-    chunk = min(
-        config.chunk,
-        max(256, 1 << int(np.ceil(np.log2(max(1, len(ratings) // max(1, n_dev)))))),
-    )
+    d = max(8, min(config.block_d, config.chunk))
+    block_chunk = max(8, config.chunk // d)
 
-    u_rows, u_cols, u_vals = _block_partition_coo(
-        user_idx, item_idx, ratings, bu, n_dev, chunk
+    u_blocks = _block_partition_blocked(
+        user_idx, item_idx, ratings, bu, n_dev, d, block_chunk
     )
-    i_rows, i_cols, i_vals = _block_partition_coo(
-        item_idx, user_idx, ratings, bi, n_dev, chunk
+    i_blocks = _block_partition_blocked(
+        item_idx, user_idx, ratings, bi, n_dev, d, block_chunk
     )
 
     spec = P(axis)
@@ -137,18 +152,11 @@ def als_train_sharded(
         reg=config.reg,
         implicit=config.implicit,
         alpha=config.alpha,
-        chunk=chunk,
+        block_chunk=block_chunk,
         degree_scaled_reg=config.degree_scaled_reg,
         solver=config.solver,
     )
-    dev = (
-        put(u_rows),
-        put(u_cols),
-        put(u_vals),
-        put(i_rows),
-        put(i_cols),
-        put(i_vals),
-    )
+    dev = tuple(put(a) for a in (*u_blocks, *i_blocks))
     # one iteration per launch — same watchdog/compile rationale as
     # ops/als.py:_als_step; collectives still ride ICI inside each launch
     uf, vf = _als_sharded_init(
@@ -214,7 +222,7 @@ def _als_sharded_init(
         "reg",
         "implicit",
         "alpha",
-        "chunk",
+        "block_chunk",
         "degree_scaled_reg",
         "solver",
     ),
@@ -223,12 +231,14 @@ def _als_sharded_init(
 def _als_sharded_step(
     uf,
     vf,
-    u_rows,
+    u_br,
     u_cols,
     u_vals,
-    i_rows,
+    u_w,
+    i_br,
     i_cols,
     i_vals,
+    i_w,
     *,
     mesh: Mesh,
     axis: str,
@@ -238,17 +248,15 @@ def _als_sharded_step(
     reg: float,
     implicit: bool,
     alpha: float,
-    chunk: int,
+    block_chunk: int,
     degree_scaled_reg: bool = True,
     solver: str = "cg",
 ):
     spec = P(axis)
 
-    def device_fn(uf_l, vf_l, u_rows, u_cols, u_vals, i_rows, i_cols, i_vals):
+    def device_fn(uf_l, vf_l, u_br, u_cols, u_vals, u_w, i_br, i_cols, i_vals, i_w):
         # shard_map hands each device its [1, ...] slice; flatten it
         uf_l, vf_l = uf_l[0], vf_l[0]
-        u_r, u_c, u_v = u_rows[0], u_cols[0], u_vals[0]
-        i_r, i_c, i_v = i_rows[0], i_cols[0], i_vals[0]
         n_dev = lax.psum(1, axis)
 
         def gather_side(local, block):
@@ -256,27 +264,29 @@ def _als_sharded_step(
             full = lax.all_gather(local, axis)  # ICI collective
             return full[:, :block].reshape(n_dev * block, rank)
 
-        # per-block-dummy padding means the COO pads inflate only the dummy
-        # row's degree count, so _solve_side's ALS-WR scaling stays exact
+        # per-device dummy-block padding means pads inflate only the local
+        # dummy row's degree count, so ALS-WR scaling stays exact; the local
+        # solve is the same MXU block-Gram path as the single-chip schedule
         v_full = gather_side(vf_l, bi)
-        uf_l = _solve_side(
-            u_r, u_c, u_v, v_full, bu + 1, chunk, reg, implicit, alpha,
-            degree_scaled_reg, solver,
+        uf_l = _solve_blocked(
+            u_br[0], u_cols[0], u_vals[0], u_w[0], v_full, bu + 1,
+            block_chunk, reg, implicit, alpha, degree_scaled_reg, solver,
         )
         u_full = gather_side(uf_l, bu)
-        vf_l = _solve_side(
-            i_r, i_c, i_v, u_full, bi + 1, chunk, reg, implicit, alpha,
-            degree_scaled_reg, solver,
+        vf_l = _solve_blocked(
+            i_br[0], i_cols[0], i_vals[0], i_w[0], u_full, bi + 1,
+            block_chunk, reg, implicit, alpha, degree_scaled_reg, solver,
         )
         return uf_l[None], vf_l[None]
 
-    # checker off: the scan carries inside _normal_equations are initialized
-    # unvarying (zeros) and become device-varying on the first write, which
-    # the varying-manual-axes checker rejects; semantics are unaffected
+    # checker off: the scan carries inside the block-Gram accumulation are
+    # initialized unvarying (zeros) and become device-varying on the first
+    # write, which the varying-manual-axes checker rejects; semantics are
+    # unaffected
     return shard_map(
         device_fn,
         mesh=mesh,
-        in_specs=(spec,) * 8,
+        in_specs=(spec,) * 10,
         out_specs=(spec, spec),
         **_NO_CHECK,
-    )(uf, vf, u_rows, u_cols, u_vals, i_rows, i_cols, i_vals)
+    )(uf, vf, u_br, u_cols, u_vals, u_w, i_br, i_cols, i_vals, i_w)

@@ -422,7 +422,10 @@ def fused_attention(
     Platform is sniffed via ``jax.default_backend()`` so the choice also
     works on tracers (e.g. inside shard_map)."""
     Lq, Lk = q.shape[2], k.shape[2]
-    on_tpu = jax.default_backend() == "tpu"
+    # remote-attach plugins (axon) report backend "tpu" in practice, but
+    # match both spellings so a plugin that surfaces its own name can never
+    # silently route "pallas" benchmarks to the jnp reference
+    on_tpu = jax.default_backend() in ("tpu", "axon")
     if on_tpu or force_pallas:
         interpret = not on_tpu
         # score tile VMEM budget: single-block kernel holds [Lq, Lk] f32

@@ -226,15 +226,23 @@ class TestShardedALS:
         assert rmse < 0.2
 
     def test_block_partition_localizes_and_pads(self):
-        from predictionio_tpu.ops.als_sharded import _block_partition_coo
+        from predictionio_tpu.ops.als_sharded import _block_partition_blocked
 
         owner = np.array([0, 3, 4, 7, 7], np.int32)
         other = np.array([10, 11, 12, 13, 14], np.int32)
         vals = np.arange(5, dtype=np.float32) + 1
-        rows, cols, v = _block_partition_coo(owner, other, vals, block=4, n_blocks=2, chunk=4)
-        assert rows.shape == cols.shape == v.shape == (2, 4)
-        # device 0 owns users 0-3 (two ratings), device 1 owns 4-7 (three)
-        assert rows[0, 0] == 0 and rows[0, 1] == 3
-        assert list(rows[1, :3]) == [0, 3, 3]
-        # padding scatters into the local dummy row (== block)
-        assert rows[0, 2] == 4 and v[0, 2] == 0.0
+        br, cols, v, w = _block_partition_blocked(
+            owner, other, vals, block=4, n_dev=2, d=8, block_chunk=8
+        )
+        nb = br.shape[1]
+        assert br.shape == (2, nb) and cols.shape == v.shape == w.shape == (2, nb, 8)
+        # device 0 owns users 0-3 (local rows 0 and 3); device 1 owns 4-7
+        # (local rows 0 and 3); one block per distinct local entity here
+        assert list(br[0, :2]) == [0, 3]
+        assert list(br[1, :2]) == [0, 3]
+        # pad blocks target the local dummy row (== block)
+        assert (br[:, 2:] == 4).all()
+        # entries land with their values; pad slots carry weight 0
+        assert v[0, 0, 0] == 1.0 and cols[0, 0, 0] == 10
+        assert v[1, 1, 0] == 4.0 and v[1, 1, 1] == 5.0  # user 7's two ratings
+        assert w[1, 1, 0] == 1 and w[1, 1, 2] == 0
