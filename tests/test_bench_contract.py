@@ -1,0 +1,174 @@
+"""Contract tests for the benchmark harness the driver invokes.
+
+The driver runs ``python bench.py`` and records (rc, last stdout line) as
+the round's perf evidence — a wrong exit-code policy or a malformed JSON
+line silently destroys the evidence chain (exactly what happened in round
+2). These tests pin the orchestrator's merge/gate/exit behavior with
+stubbed phases (no device work), plus the TTL cache the serving paths use.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import bench
+
+
+def _run_main(monkeypatch, capsys, phase_results):
+    """Invoke bench.main() orchestrator-mode with _run_phase stubbed;
+    returns (rc, parsed_json_line)."""
+
+    def fake_run(name, timeout_s, retries=1):
+        return phase_results.get(name, ({}, f"{name} stub missing"))
+
+    monkeypatch.setattr(bench, "_run_phase", fake_run)
+    monkeypatch.setattr("sys.argv", ["bench.py"])
+    rc = bench.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    return rc, json.loads(line)
+
+
+def test_healthy_run_merges_all_phases(monkeypatch, capsys):
+    rc, out = _run_main(
+        monkeypatch,
+        capsys,
+        {
+            "als": (
+                {
+                    "scale_name": "ml100k",
+                    "als_train_wall_s": 1.5,
+                    "als_heldout_rmse": 0.35,
+                    "als_rmse_gate_ok": True,
+                },
+                None,
+            ),
+            "serving": ({"serving_e2e_p50_ms": 5.0, "serving_e2e_qps": 100.0}, None),
+            "twotower": ({"twotower_recall_at_10": 0.2, "twotower_recall_gate_ok": True}, None),
+            "secondary": ({"naive_bayes_train_ms": 50.0}, None),
+        },
+    )
+    assert rc == 0
+    assert out["metric"] == "als_ml100k_train_wall_clock"
+    assert out["value"] == 1.5
+    assert out["vs_baseline"] == 0.5  # 5ms p50 / 10ms north star
+    assert out["serving_e2e_qps"] == 100.0
+    assert "als_error" not in out
+
+
+def test_failed_phase_recorded_but_partial_numbers_ship(monkeypatch, capsys):
+    rc, out = _run_main(
+        monkeypatch,
+        capsys,
+        {
+            "als": ({"platform": "tpu", "scale_name": "ml20m"}, "TPU device fault"),
+            "serving": ({"serving_e2e_p50_ms": 8.0}, None),
+            "twotower": ({}, "timeout"),
+            "secondary": ({"cooccurrence_build_ms": 900.0}, None),
+        },
+    )
+    # numbers shipped (serving + secondary) and no gate failed -> healthy,
+    # with the failures visible in the line
+    assert rc == 0
+    assert out["als_error"] == "TPU device fault"
+    assert out["twotower_error"] == "timeout"
+    assert out["value"] is None  # als never produced the headline
+    assert out["vs_baseline"] == 0.8
+
+
+def test_gate_failure_fails_the_run_but_still_prints(monkeypatch, capsys):
+    rc, out = _run_main(
+        monkeypatch,
+        capsys,
+        {
+            "als": (
+                {
+                    "scale_name": "ml100k",
+                    "als_train_wall_s": 0.9,
+                    "als_heldout_rmse": 1.2,
+                    "als_rmse_gate_ok": False,  # junk factors
+                },
+                None,
+            ),
+            "serving": ({"serving_e2e_p50_ms": 5.0}, None),
+            "twotower": ({}, None),
+            "secondary": ({}, None),
+        },
+    )
+    assert rc == 1  # a fast wall-clock over junk factors must not look healthy
+    assert out["als_rmse_gate_ok"] is False
+    assert out["value"] == 0.9  # forensics still printed
+
+
+def test_fully_crashed_run_is_rc1(monkeypatch, capsys):
+    rc, out = _run_main(
+        monkeypatch,
+        capsys,
+        {
+            # metadata-only fields (written before any timed region) must
+            # not count as shipped numbers
+            "als": ({"platform": "tpu", "scale": {}, "scale_name": "ml20m"}, "boom"),
+            "serving": ({"serving_factors": "random_fallback"}, "boom"),
+            "twotower": ({}, "boom"),
+            "secondary": ({}, "boom"),
+        },
+    )
+    assert rc == 1
+    assert out["value"] is None and out["vs_baseline"] is None
+
+
+class TestTTLCache:
+    def test_caches_within_ttl_and_counts(self):
+        from predictionio_tpu.utils.ttl_cache import TTLCache
+
+        c = TTLCache(ttl_s=60)
+        calls = []
+        assert c.get_or_load("k", lambda: calls.append(1) or "v") == "v"
+        assert c.get_or_load("k", lambda: calls.append(1) or "v2") == "v"
+        assert len(calls) == 1 and c.hits == 1 and c.misses == 1
+
+    def test_ttl_zero_bypasses(self):
+        from predictionio_tpu.utils.ttl_cache import TTLCache
+
+        c = TTLCache(ttl_s=0)
+        calls = []
+        c.get_or_load("k", lambda: calls.append(1))
+        c.get_or_load("k", lambda: calls.append(1))
+        assert len(calls) == 2
+
+    def test_expiry(self):
+        import time
+
+        from predictionio_tpu.utils.ttl_cache import TTLCache
+
+        c = TTLCache(ttl_s=0.03)
+        c.get_or_load("k", lambda: "old")
+        time.sleep(0.04)
+        assert c.get_or_load("k", lambda: "new") == "new"
+
+    def test_lru_bound(self):
+        from predictionio_tpu.utils.ttl_cache import TTLCache
+
+        c = TTLCache(ttl_s=60, maxsize=2)
+        for i in range(4):
+            c.get_or_load(i, lambda i=i: i)
+        assert len(c._entries) == 2
+
+    def test_loader_exception_not_cached(self):
+        from predictionio_tpu.utils.ttl_cache import TTLCache
+
+        c = TTLCache(ttl_s=60)
+        with pytest.raises(RuntimeError):
+            c.get_or_load("k", lambda: (_ for _ in ()).throw(RuntimeError("x")))
+        # the failure must not poison the key: next load succeeds and caches
+        assert c.get_or_load("k", lambda: "ok") == "ok"
+        assert c.get_or_load("k", lambda: "other") == "ok"
+
+    def test_invalidate(self):
+        from predictionio_tpu.utils.ttl_cache import TTLCache
+
+        c = TTLCache(ttl_s=60)
+        c.get_or_load("k", lambda: "v1")
+        c.invalidate("k")
+        assert c.get_or_load("k", lambda: "v2") == "v2"
