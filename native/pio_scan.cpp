@@ -377,4 +377,40 @@ const char* pio_scan_row_id(void* h, int64_t i) {
 }
 void pio_scan_free(void* h) { delete static_cast<Columns*>(h); }
 
+// --- COO group-by for the ALS train feed ----------------------------------
+//
+// Stable counting sort of a COO rating list by entity id: the host half of
+// the ALS ingest pipeline (ops/als.py). Replaces numpy's O(n log n)
+// single-threaded argsort + fancy-indexing block packing (measured 12.1s at
+// ML-20M on the bench host) with one O(n) histogram pass + one O(n) scatter
+// pass over native arrays. The device rebuilds everything else (opposite-
+// side ordering, block tables) from this grouped form, so this is the ONLY
+// host-side work in the train ingest.
+//
+// Caller contract: deg_out zeroed, sized n_entities; every rows[j] must be
+// in [0, n_entities) (the Python wrapper validates and falls back to numpy
+// otherwise). Returns 0 on success.
+
+int32_t pio_coo_group(const int32_t* rows, const int32_t* cols,
+                      const float* vals, int64_t n, int32_t n_entities,
+                      int32_t* cols_out, float* vals_out, int32_t* deg_out) {
+  for (int64_t j = 0; j < n; ++j) {
+    int32_t e = rows[j];
+    if (e < 0 || e >= n_entities) return 1;
+    deg_out[e]++;
+  }
+  std::vector<int64_t> cursor(static_cast<size_t>(n_entities));
+  int64_t acc = 0;
+  for (int32_t e = 0; e < n_entities; ++e) {
+    cursor[e] = acc;
+    acc += deg_out[e];
+  }
+  for (int64_t j = 0; j < n; ++j) {
+    int64_t p = cursor[rows[j]]++;
+    cols_out[p] = cols[j];
+    vals_out[p] = vals[j];
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -400,6 +400,82 @@ class ColumnarEvents:
         return len(self.event_ids)
 
 
+def merge_parallel_scans(iterators: Sequence[Iterator[Event]]) -> Iterator[Event]:
+    """Merge N scan iterators through a bounded queue, one thread per
+    iterator. Yields in nondeterministic order (bulk consumers — columnar
+    encode, aggregation — are order-free; the snapshot cache canonicalizes
+    row order AND dictionary encoding afterward). Shared by the drivers with
+    a parallel bulk path: ES sliced scroll, SQL time-range partitions.
+
+    Failure/early-exit contract: a worker exception is re-raised to the
+    consumer; when the consumer goes away every pump thread is unblocked and
+    each source iterator's ``close()`` runs (releasing scroll contexts /
+    database connections)."""
+    import queue as _q
+    import threading
+
+    if len(iterators) == 1:
+        yield from iterators[0]
+        return
+    out: _q.Queue = _q.Queue(maxsize=10_000)
+    stop = threading.Event()  # set when the consumer goes away
+    _DONE = object()
+
+    def put_until_stopped(item) -> bool:
+        while not stop.is_set():
+            try:
+                out.put(item, timeout=0.2)
+                return True
+            except _q.Full:
+                continue
+        return False
+
+    def pump(it):
+        try:
+            try:
+                for e in it:
+                    if not put_until_stopped(e):
+                        break
+            except BaseException as exc:  # surface worker failures to consumer
+                put_until_stopped(exc)
+            # closing the source generator runs its finally blocks, releasing
+            # per-scan resources (scroll context, connection). A close()
+            # failure — or a plain iterator without close() — must neither
+            # kill the thread nor swallow the _DONE handoff below, or the
+            # consumer blocks forever on out.get().
+            try:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
+            except BaseException as exc:
+                put_until_stopped(exc)
+        finally:
+            put_until_stopped(_DONE)
+
+    threads = [
+        threading.Thread(target=pump, args=(s,), daemon=True) for s in iterators
+    ]
+    for t in threads:
+        t.start()
+    live = len(threads)
+    try:
+        while live:
+            item = out.get()
+            if item is _DONE:
+                live -= 1
+            elif isinstance(item, BaseException):
+                raise item
+            else:
+                yield item
+    finally:
+        # consumer finished, broke out early, or a scan failed: unblock
+        # every pump (they exit without putting once stop is set) so no
+        # thread is left parked on a full queue holding Events
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+
+
 class PEvents(abc.ABC):
     """Bulk scan API (ref PEvents.scala:38-189). ``find`` streams events;
     ``to_columnar`` is the TPU feed path."""

@@ -908,64 +908,12 @@ class ESPEvents(base.PEvents):
         n_slices: int | None = None,
         **filters: Any,
     ) -> Iterator[Event]:
-        """Merge the slices through a bounded queue, one thread per slice.
-        Yields in nondeterministic order (bulk consumers — columnar encode,
+        """Merge the slices through a bounded queue, one thread per slice
+        (shared merge: ``base.merge_parallel_scans``). Yields in
+        nondeterministic order (bulk consumers — columnar encode,
         aggregation — are order-free)."""
-        import queue as _q
-        import threading
-
         slices = self.find_sliced(app_id, channel_id, n_slices, **filters)
-        if len(slices) == 1:
-            yield from slices[0]
-            return
-        out: _q.Queue = _q.Queue(maxsize=10_000)
-        stop = threading.Event()  # set when the consumer goes away
-        _DONE = object()
-
-        def put_until_stopped(item) -> bool:
-            while not stop.is_set():
-                try:
-                    out.put(item, timeout=0.2)
-                    return True
-                except _q.Full:
-                    continue
-            return False
-
-        def pump(it):
-            try:
-                for e in it:
-                    if not put_until_stopped(e):
-                        break
-            except BaseException as exc:  # surface worker failures to consumer
-                put_until_stopped(exc)
-            finally:
-                # closing the slice generator runs scan_sliced's finally,
-                # releasing its server-side scroll context
-                it.close()
-                put_until_stopped(_DONE)
-
-        threads = [
-            threading.Thread(target=pump, args=(s,), daemon=True) for s in slices
-        ]
-        for t in threads:
-            t.start()
-        live = len(threads)
-        try:
-            while live:
-                item = out.get()
-                if item is _DONE:
-                    live -= 1
-                elif isinstance(item, BaseException):
-                    raise item
-                else:
-                    yield item
-        finally:
-            # consumer finished, broke out early, or a slice failed: unblock
-            # every pump (they exit without putting once stop is set) so no
-            # thread is left parked on a full queue holding Events
-            stop.set()
-            for t in threads:
-                t.join(timeout=5.0)
+        return base.merge_parallel_scans(slices)
 
     _COLUMNAR_OWN_KW = frozenset(("rating_key", "entity_vocab", "target_vocab", "events"))
 

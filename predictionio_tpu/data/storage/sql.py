@@ -459,6 +459,50 @@ _EVENT_COLS = (
 )
 
 
+def _find_clauses(
+    start_time,
+    until_time,
+    entity_type,
+    entity_id,
+    event_names,
+    target_entity_type=...,
+    target_entity_id=...,
+) -> tuple[list[str], list]:
+    """The 9-filter WHERE builder shared by the row scan and the
+    partitioned bulk scan (``...`` = filter absent for the target fields,
+    None = IS NULL — the reference's Option[Option[String]] semantics)."""
+    clauses, params = [], []
+    if start_time is not None:
+        clauses.append("eventTime >= ?")
+        params.append(_micros(start_time))
+    if until_time is not None:
+        clauses.append("eventTime < ?")
+        params.append(_micros(until_time))
+    if entity_type is not None:
+        clauses.append("entityType = ?")
+        params.append(entity_type)
+    if entity_id is not None:
+        clauses.append("entityId = ?")
+        params.append(entity_id)
+    if event_names is not None:
+        placeholders = ",".join("?" for _ in event_names)
+        clauses.append(f"event IN ({placeholders})")
+        params.extend(event_names)
+    if target_entity_type is not ...:
+        if target_entity_type is None:
+            clauses.append("targetEntityType IS NULL")
+        else:
+            clauses.append("targetEntityType = ?")
+            params.append(target_entity_type)
+    if target_entity_id is not ...:
+        if target_entity_id is None:
+            clauses.append("targetEntityId IS NULL")
+        else:
+            clauses.append("targetEntityId = ?")
+            params.append(target_entity_id)
+    return clauses, params
+
+
 class SQLLEvents(base.LEvents):
     """Row-level event DAO (ref ``JDBCLEvents.scala``)."""
 
@@ -597,35 +641,15 @@ class SQLLEvents(base.LEvents):
     ) -> Iterator[Event]:
         table = _event_table(app_id, channel_id)
         self._c.ensure_event_table(table)
-        clauses, params = [], []
-        if start_time is not None:
-            clauses.append("eventTime >= ?")
-            params.append(_micros(start_time))
-        if until_time is not None:
-            clauses.append("eventTime < ?")
-            params.append(_micros(until_time))
-        if entity_type is not None:
-            clauses.append("entityType = ?")
-            params.append(entity_type)
-        if entity_id is not None:
-            clauses.append("entityId = ?")
-            params.append(entity_id)
-        if event_names is not None:
-            placeholders = ",".join("?" for _ in event_names)
-            clauses.append(f"event IN ({placeholders})")
-            params.extend(event_names)
-        if target_entity_type is not ...:
-            if target_entity_type is None:
-                clauses.append("targetEntityType IS NULL")
-            else:
-                clauses.append("targetEntityType = ?")
-                params.append(target_entity_type)
-        if target_entity_id is not ...:
-            if target_entity_id is None:
-                clauses.append("targetEntityId IS NULL")
-            else:
-                clauses.append("targetEntityId = ?")
-                params.append(target_entity_id)
+        clauses, params = _find_clauses(
+            start_time,
+            until_time,
+            entity_type,
+            entity_id,
+            event_names,
+            target_entity_type,
+            target_entity_id,
+        )
         where = f" WHERE {' AND '.join(clauses)}" if clauses else ""
         order = "DESC" if reversed else "ASC"
         statement = f"SELECT {_EVENT_COLS} FROM {table}{where} ORDER BY eventTime {order}"
@@ -636,16 +660,182 @@ class SQLLEvents(base.LEvents):
 
 
 class SQLPEvents(base.PEvents):
-    """Bulk/columnar event DAO (ref ``JDBCPEvents.scala`` — the JdbcRDD
-    time-partitioned scan; here a single ordered scan feeding the columnar
-    snapshot path)."""
+    """Bulk/columnar event DAO (ref ``JDBCPEvents.scala``).
+
+    The bulk path mirrors the reference's JdbcRDD time-range partitioning
+    (``JDBCPEvents.scala:91-121``; default partition count 4, ``:53-55``):
+    ``find_partitioned`` splits ``[min(eventTime), max(eventTime)]`` into N
+    ranges and scans each on its OWN database connection — the reference
+    opens one JDBC connection per Spark partition the same way. The
+    columnar train feed reads through the threaded merge of those
+    partitions; the snapshot cache canonicalizes row order + encoding
+    afterward, so merge nondeterminism never leaks.
+
+    Partition count: storage-source config ``PARTITIONS`` or env
+    ``PIO_SQL_SCAN_PARTITIONS``, default 4. Single-connection stores that
+    cannot open a second session to the same data (sqlite ``:memory:``)
+    fall back to one partition automatically.
+    """
 
     def __init__(self, client: SQLStorageClient):
         self._c = client
         self._l = SQLLEvents(client)
+        import os
+
+        raw = client.config.get("PARTITIONS") or os.environ.get(
+            "PIO_SQL_SCAN_PARTITIONS", "4"
+        )
+        try:
+            self._partitions = max(1, int(raw))
+        except ValueError:
+            self._partitions = 4
 
     def find(self, app_id: int, channel_id: int | None = None, **kw) -> Iterator[Event]:
         return self._l.find(app_id, channel_id, **kw)
+
+    # -- partitioned bulk scan ---------------------------------------------
+
+    def _can_partition(self, table: str) -> bool:
+        """Partitioned scans need a SECOND connection that sees the same
+        data — true for server databases and file-backed sqlite, false for
+        ``:memory:`` stores where every connect() opens a fresh empty
+        database. Probed once per table (a fresh connection must see the
+        event table) rather than guessed from config."""
+        if self._partitions <= 1:
+            return False
+        cache = getattr(self._c, "_partition_probe", None)
+        if cache is None:
+            cache = self._c._partition_probe = {}
+        if table not in cache:
+            try:
+                conn = self._c._connect()
+                try:
+                    cur = conn.cursor()
+                    # existence probe, O(1) — COUNT(*) would full-scan a 20M
+                    # row table on Postgres just to prove visibility
+                    cur.execute(
+                        self._c.dialect.sql(f"SELECT 1 FROM {table} LIMIT 1")
+                    )
+                    cur.fetchone()
+                    cache[table] = True
+                finally:
+                    conn.close()
+            except Exception:
+                cache[table] = False
+        return cache[table]
+
+    def find_partitioned(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        n_partitions: int | None = None,
+        **filters,
+    ) -> list[Iterator[Event]]:
+        """N iterators over disjoint eventTime ranges whose union is exactly
+        the serial scan's row set (ref ``JDBCPEvents.scala:91-121``)."""
+        table = _event_table(app_id, channel_id)
+        self._c.ensure_event_table(table)
+        n = n_partitions or self._partitions
+        unknown = set(filters) - self._PARTITION_FILTERS
+        if unknown:
+            # a silently-dropped limit/reversed would return a DIFFERENT row
+            # set than the serial scan honoring it — refuse loudly instead
+            raise TypeError(
+                f"find_partitioned cannot honor filters {sorted(unknown)}; "
+                f"supported: {sorted(self._PARTITION_FILTERS)} (use find() for "
+                "limit/reversed)"
+            )
+        clauses, params = _find_clauses(**{
+            "start_time": filters.get("start_time"),
+            "until_time": filters.get("until_time"),
+            "entity_type": filters.get("entity_type"),
+            "entity_id": filters.get("entity_id"),
+            "event_names": filters.get("event_names"),
+            "target_entity_type": filters.get("target_entity_type", ...),
+            "target_entity_id": filters.get("target_entity_id", ...),
+        })
+        where = f" WHERE {' AND '.join(clauses)}" if clauses else ""
+        bounds = self._c.query(
+            f"SELECT MIN(eventTime), MAX(eventTime) FROM {table}{where}", params
+        )[0]
+        if bounds[0] is None or n <= 1 or not self._can_partition(table):
+            return [self._l.find(app_id, channel_id, **filters)]
+        lo, hi = int(bounds[0]), int(bounds[1]) + 1  # [lo, hi) covers all
+        edges = [lo + (hi - lo) * i // n for i in range(n + 1)]
+        sql = self._c.dialect.sql(
+            f"SELECT {_EVENT_COLS} FROM {table}{where}"
+            f"{' AND' if clauses else ' WHERE'} eventTime >= ? AND eventTime < ?"
+            " ORDER BY eventTime ASC"
+        )
+
+        def scan_range(p_lo: int, p_hi: int) -> Iterator[Event]:
+            # fresh connection per partition: concurrent range scans must
+            # not serialize on the client's shared-connection lock
+            conn = self._c._connect()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql, tuple(params) + (p_lo, p_hi))
+                while True:
+                    rows = cur.fetchmany(10_000)
+                    if not rows:
+                        break
+                    for r in rows:
+                        yield SQLLEvents._row_to_event(tuple(r))
+            finally:
+                conn.close()
+
+        return [
+            scan_range(edges[i], edges[i + 1])
+            for i in range(n)
+            if edges[i] < edges[i + 1]
+        ]
+
+    def find_parallel(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        n_partitions: int | None = None,
+        **filters,
+    ) -> Iterator[Event]:
+        """Threaded merge of the time-range partitions (nondeterministic
+        order; bulk consumers are order-free)."""
+        return base.merge_parallel_scans(
+            self.find_partitioned(app_id, channel_id, n_partitions, **filters)
+        )
+
+    _PARTITION_FILTERS = frozenset(
+        (
+            "start_time",
+            "until_time",
+            "entity_type",
+            "entity_id",
+            "event_names",
+            "target_entity_type",
+            "target_entity_id",
+        )
+    )
+    _COLUMNAR_OWN_KW = frozenset(
+        ("rating_key", "entity_vocab", "target_vocab", "events")
+    )
+
+    def to_columnar(self, app_id: int, channel_id: int | None = None, **kw):
+        """Columnar ingest through the partitioned parallel scan when the
+        filters allow it; serial otherwise (limit/reversed can't partition
+        without changing semantics)."""
+        filters = {k: v for k, v in kw.items() if k in self._PARTITION_FILTERS}
+        unpartitionable = set(kw) - self._PARTITION_FILTERS - self._COLUMNAR_OWN_KW
+        table = _event_table(app_id, channel_id)
+        self._c.ensure_event_table(table)
+        # cheap gates first; the second-connection probe involves a real
+        # connect and only matters when partitioning is otherwise possible
+        if (
+            "events" not in kw
+            and not unpartitionable
+            and self._can_partition(table)
+        ):
+            kw = {k: v for k, v in kw.items() if k not in self._PARTITION_FILTERS}
+            kw["events"] = self.find_parallel(app_id, channel_id, **filters)
+        return super().to_columnar(app_id, channel_id, **kw)
 
     def write(
         self, events: Iterable[Event], app_id: int, channel_id: int | None = None

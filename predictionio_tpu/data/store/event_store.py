@@ -185,8 +185,18 @@ class PEventStore:
             if host_count > 1:
                 # the bypass must keep the multi-host contract: each host
                 # still gets its disjoint block subset of the SAME canonical
-                # row order, exactly as the cached path computes it
-                cols = take_host_blocks(canonical_order(cols), host_index, host_count)
+                # row order AND the same canonical dictionary encoding (each
+                # host built its own vocab in scan-encounter order here),
+                # exactly as the cached path computes them
+                cols = take_host_blocks(
+                    canonical_order(
+                        cols,
+                        frozen_entity_vocab="entity_vocab" in kwargs,
+                        frozen_target_vocab="target_vocab" in kwargs,
+                    ),
+                    host_index,
+                    host_count,
+                )
             return cols
         base = os.environ.get("PIO_FS_BASEDIR")
         snapshot_dir = (

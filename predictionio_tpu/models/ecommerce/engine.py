@@ -168,11 +168,13 @@ class ECommAlgorithmParams(Params):
     # lookup (off by default — it costs one event-store query per predict)
     adjust_score: bool = False
     # TTL for serving-time storage lookups (seen/recent items per user,
-    # unavailable-items + weightedItems constraints). With the cache warm,
-    # p50 pays ZERO storage round trips; freshness lags by at most the TTL.
-    # 0 disables caching = the reference's always-live per-query reads
-    # (ECommAlgorithm.scala:252-300).
-    cache_ttl_s: float = 5.0
+    # unavailable-items + weightedItems constraints). The DEFAULT is 0 =
+    # always-live per-query reads, matching the reference's semantics
+    # (ECommAlgorithm.scala:252-300): a `$set` of unavailableItems or a new
+    # seen/buy event affects the very next prediction. Operators opt into a
+    # positive TTL (e.g. 5.0) to trade freshness (lag bounded by the TTL)
+    # for a p50 with ZERO storage round trips once the cache is warm.
+    cache_ttl_s: float = 0.0
 
 
 @dataclasses.dataclass

@@ -220,6 +220,22 @@ def cmd_build(args) -> int:
     return 0
 
 
+def cmd_unregister(args) -> int:
+    """Compatibility verb (ref ``Console.scala:172-177``). In the reference
+    0.12.x the parser still accepts ``unregister`` but the dispatch has no
+    case for it (engine manifests were removed when ``pio build`` stopped
+    registering engines), so it falls through to the help text. Here the
+    verb is accepted explicitly: there is nothing to unregister — engines
+    are plain directories, never registered anywhere — and saying so beats
+    dumping help."""
+    print(
+        "Nothing to unregister: engines are not registered. An engine is "
+        f"just its directory ({args.engine_dir}); remove the directory (or "
+        "its trained instances via the metadata store) instead."
+    )
+    return 0
+
+
 def _strip_launcher_flags(argv: list[str]) -> list[str]:
     """Drop --num-hosts/--hosts (and their values) so workers don't
     recursively launch fleets."""
@@ -655,6 +671,10 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser("build")
     engine_args(x)
     x.set_defaults(fn=cmd_build)
+
+    x = sub.add_parser("unregister")
+    engine_args(x)
+    x.set_defaults(fn=cmd_unregister)
 
     x = sub.add_parser("train")
     engine_args(x)

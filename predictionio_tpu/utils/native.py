@@ -97,8 +97,52 @@ def get_library() -> ctypes.CDLL | None:
         lib.pio_scan_row_id.restype = ctypes.c_char_p
         lib.pio_scan_row_id.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.pio_scan_free.argtypes = [ctypes.c_void_p]
+        lib.pio_coo_group.restype = ctypes.c_int32
+        lib.pio_coo_group.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
         _lib = lib
         return _lib
+
+
+def coo_group(
+    rows: np.ndarray, cols: np.ndarray, vals: np.ndarray, n_entities: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
+    """Stable group-by-entity of a COO rating list at C++ speed: returns
+    ``(cols_sorted, vals_sorted, deg)`` where rows are grouped by ascending
+    entity id (original order preserved within an entity) and ``deg`` is the
+    per-entity rating count. Returns None when the native library is
+    unavailable (callers fall back to numpy argsort)."""
+    lib = get_library()
+    if lib is None:
+        return None
+    rows = np.ascontiguousarray(rows, np.int32)
+    cols = np.ascontiguousarray(cols, np.int32)
+    vals = np.ascontiguousarray(vals, np.float32)
+    n = rows.shape[0]
+    cols_out = np.empty(n, np.int32)
+    vals_out = np.empty(n, np.float32)
+    deg = np.zeros(n_entities, np.int32)
+    rc = lib.pio_coo_group(
+        rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        n_entities,
+        cols_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals_out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        deg.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        return None
+    return cols_out, vals_out, deg
 
 
 def scan_jsonl_columnar(

@@ -85,6 +85,15 @@ class TestStatusVersion:
         assert code == 0
         assert "all data objects verified" in out
 
+    def test_unregister(self, capsys, tmp_path):
+        # ref Console.scala:172-177: the verb is part of the CLI surface
+        # (vestigial there — parsed with no dispatch case); here it is an
+        # explicit, explained no-op
+        code, out, _ = run(capsys, "unregister", "--engine-dir", str(tmp_path))
+        assert code == 0
+        assert "Nothing to unregister" in out
+        assert str(tmp_path) in out
+
 
 class TestImportExport:
     def test_roundtrip(self, memory_storage, capsys, tmp_path):

@@ -41,23 +41,39 @@ def sqlite_pevents(tmp_path):
     return p
 
 
+def _decoded(cols):
+    """Row-wise decoded (entity, target, event) strings — the encoding-
+    independent content of a columnar block. The cache canonicalizes the
+    dictionary encoding (sorted vocabs), so integer codes legitimately
+    differ from a raw scan's scan-encounter codes; the decoded rows and
+    numeric columns must not."""
+    ent = [cols.entity_vocab[i] for i in cols.entity_ids]
+    tgt = [cols.target_vocab[i] if i >= 0 else None for i in cols.target_ids]
+    ev = [cols.event_vocab[i] for i in cols.event_codes]
+    return list(zip(cols.event_ids, ent, tgt, ev))
+
+
 def test_snapshot_roundtrip_matches_direct_scan(tmp_path, sqlite_pevents):
     cache = SnapshotCache(tmp_path / "snap", n_shards=4)
     direct = sqlite_pevents.to_columnar(1, event_names=["rate"])
     cached = cache.columnar(sqlite_pevents, 1, event_names=["rate"])
-    # build pass returns the scan result itself
-    np.testing.assert_array_equal(direct.entity_ids, cached.entity_ids)
+    # build pass returns the canonicalized scan result
+    assert _decoded(direct) == _decoded(cached)
+    # canonical encoding: vocabs sorted so every host derives the same codes
+    assert cached.entity_vocab == sorted(cached.entity_vocab)
+    assert cached.target_vocab == sorted(cached.target_vocab)
     # second call must hit the shard files and reproduce everything
     reloaded = cache.columnar(sqlite_pevents, 1, event_names=["rate"])
-    np.testing.assert_array_equal(direct.entity_ids, reloaded.entity_ids)
-    np.testing.assert_array_equal(direct.target_ids, reloaded.target_ids)
-    np.testing.assert_array_equal(direct.event_codes, reloaded.event_codes)
-    np.testing.assert_allclose(direct.ratings, reloaded.ratings)
-    np.testing.assert_allclose(direct.timestamps, reloaded.timestamps)
-    assert direct.entity_vocab == reloaded.entity_vocab
-    assert direct.target_vocab == reloaded.target_vocab
-    assert direct.event_ids == reloaded.event_ids
-    assert direct.event_names == reloaded.event_names
+    assert _decoded(cached) == _decoded(reloaded)
+    np.testing.assert_array_equal(cached.entity_ids, reloaded.entity_ids)
+    np.testing.assert_array_equal(cached.target_ids, reloaded.target_ids)
+    np.testing.assert_array_equal(cached.event_codes, reloaded.event_codes)
+    np.testing.assert_allclose(cached.ratings, reloaded.ratings)
+    np.testing.assert_allclose(cached.timestamps, reloaded.timestamps)
+    assert cached.entity_vocab == reloaded.entity_vocab
+    assert cached.target_vocab == reloaded.target_vocab
+    assert cached.event_ids == reloaded.event_ids
+    assert cached.event_names == reloaded.event_names
 
 
 def test_snapshot_invalidated_by_write(tmp_path, sqlite_pevents):
@@ -99,6 +115,64 @@ def test_mixed_miss_and_hit_hosts_still_partition_correctly(tmp_path, sqlite_pev
     )
     assert a.isdisjoint(b)
     assert a | b == set(full.event_ids)
+
+
+def test_nondeterministic_scan_order_yields_identical_encoding(sqlite_pevents):
+    """ADVICE r3 (medium): two hosts that both miss the cache and scan the
+    store in DIFFERENT orders (ES sliced scroll merge is nondeterministic)
+    must still derive the same canonical encoding — same vocabs, same
+    integer codes, same row order — or their 'disjoint' blocks live in
+    incompatible index spaces and multi-host training mixes entities."""
+    from predictionio_tpu.data.store.snapshot import canonical_order, take_host_blocks
+
+    events = list(sqlite_pevents.find(1))
+    rng = np.random.default_rng(0)
+    shuffled = [events[i] for i in rng.permutation(len(events))]
+    cols_a = canonical_order(sqlite_pevents.to_columnar(1, events=iter(events)))
+    cols_b = canonical_order(sqlite_pevents.to_columnar(1, events=iter(shuffled)))
+    assert cols_a.entity_vocab == cols_b.entity_vocab
+    assert cols_a.target_vocab == cols_b.target_vocab
+    assert cols_a.event_vocab == cols_b.event_vocab
+    np.testing.assert_array_equal(cols_a.entity_ids, cols_b.entity_ids)
+    np.testing.assert_array_equal(cols_a.target_ids, cols_b.target_ids)
+    np.testing.assert_array_equal(cols_a.event_codes, cols_b.event_codes)
+    assert cols_a.event_ids == cols_b.event_ids
+    # and the per-host blocks each host computes independently compose
+    host0 = take_host_blocks(cols_a, 0, 2)
+    host1 = take_host_blocks(cols_b, 1, 2)
+    assert set(host0.event_ids).isdisjoint(host1.event_ids)
+    assert set(host0.event_ids) | set(host1.event_ids) == set(cols_a.event_ids)
+
+
+def test_partially_frozen_vocab_still_canonicalizes_the_rest(sqlite_pevents):
+    """Freezing entity_vocab must not disable the target/event vocab remap:
+    those are still built in scan-encounter order and must come out
+    canonical (code-review r4 finding on the r3 ADVICE fix)."""
+    from predictionio_tpu.data.store.snapshot import canonical_order
+
+    events = list(sqlite_pevents.find(1))
+    rng = np.random.default_rng(1)
+    shuffled = [events[i] for i in rng.permutation(len(events))]
+    frozen_entities = sorted({e.entity_id for e in events}, reverse=True)
+    a = canonical_order(
+        sqlite_pevents.to_columnar(
+            1, events=iter(events), entity_vocab=frozen_entities
+        ),
+        frozen_entity_vocab=True,
+    )
+    b = canonical_order(
+        sqlite_pevents.to_columnar(
+            1, events=iter(shuffled), entity_vocab=frozen_entities
+        ),
+        frozen_entity_vocab=True,
+    )
+    # frozen space preserved verbatim (even though it is reverse-sorted)
+    assert a.entity_vocab == frozen_entities and b.entity_vocab == frozen_entities
+    np.testing.assert_array_equal(a.entity_ids, b.entity_ids)
+    # non-frozen vocabs canonicalized despite different scan orders
+    assert a.target_vocab == b.target_vocab == sorted(a.target_vocab)
+    np.testing.assert_array_equal(a.target_ids, b.target_ids)
+    np.testing.assert_array_equal(a.event_codes, b.event_codes)
 
 
 def test_sqlite_stamp_changes_on_delete_plus_reinsert(sqlite_pevents):
