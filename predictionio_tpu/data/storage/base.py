@@ -400,6 +400,86 @@ class ColumnarEvents:
         return len(self.event_ids)
 
 
+def _remap_vocab(
+    vocab: list[str], codes: np.ndarray
+) -> tuple[list[str], np.ndarray]:
+    """Sort ``vocab`` lexicographically and rewrite integer ``codes`` into the
+    sorted index space. Codes < 0 (absent target) pass through unchanged."""
+    if not vocab:
+        return vocab, codes
+    order = np.argsort(np.asarray(vocab, dtype=object))
+    inv = np.empty(len(vocab), np.int32)
+    inv[order] = np.arange(len(vocab), dtype=np.int32)
+    sorted_vocab = [vocab[int(i)] for i in order]
+    if np.array_equal(inv, np.arange(len(vocab), dtype=np.int32)):
+        return sorted_vocab, codes
+    new_codes = codes.copy()
+    present = codes >= 0
+    new_codes[present] = inv[codes[present]]
+    return sorted_vocab, new_codes
+
+
+def canonical_order(
+    cols: "ColumnarEvents",
+    frozen_entity_vocab: bool = False,
+    frozen_target_vocab: bool = False,
+) -> "ColumnarEvents":
+    """Reorder rows to the canonical (timestamp, event_id) order AND
+    canonicalize the dictionary encoding (sorted vocabs, remapped codes).
+
+    Drivers with parallel bulk scans (ES sliced scroll, SQL time-range
+    partitions) merge their streams in nondeterministic order, which
+    affects two things consumers depend on: the ROW order (the multi-host
+    block partition must be disjoint and jointly complete across hosts,
+    and exports must be reproducible run-to-run) and the VOCAB order
+    (``to_columnar`` dictionary-encodes in scan-encounter order, so two
+    hosts that each build the columnar independently would otherwise
+    assign different integer codes to the same entity and silently mix
+    entities when their blocks are combined). Canonicalizing both makes
+    the result scan-order-independent. Each frozen flag skips the remap
+    for THAT vocab only — a caller-supplied vocab is already a canonical
+    index space (eval splits encoded with the training split's space must
+    keep it), but the other, scan-encounter-ordered vocabs still need the
+    remap; the event vocab can never be frozen and is always
+    canonicalized."""
+    n = len(cols)
+    ent_vocab, ent_ids = cols.entity_vocab, cols.entity_ids
+    tgt_vocab, tgt_ids = cols.target_vocab, cols.target_ids
+    if not frozen_entity_vocab:
+        ent_vocab, ent_ids = _remap_vocab(ent_vocab, ent_ids)
+    if not frozen_target_vocab:
+        tgt_vocab, tgt_ids = _remap_vocab(tgt_vocab, tgt_ids)
+    ev_vocab, ev_codes = _remap_vocab(cols.event_vocab, cols.event_codes)
+    order = np.lexsort((np.asarray(cols.event_ids), cols.timestamps))
+    if np.array_equal(order, np.arange(n)):
+        if ent_ids is cols.entity_ids and tgt_ids is cols.target_ids and (
+            ev_codes is cols.event_codes
+        ):
+            return cols
+        return dataclasses.replace(
+            cols,
+            entity_ids=ent_ids,
+            target_ids=tgt_ids,
+            event_codes=ev_codes,
+            entity_vocab=ent_vocab,
+            target_vocab=tgt_vocab,
+            event_vocab=ev_vocab,
+        )
+    take = order.tolist()
+    return ColumnarEvents(
+        event_ids=[cols.event_ids[i] for i in take],
+        event_names=[cols.event_names[i] for i in take],
+        entity_ids=ent_ids[order],
+        target_ids=tgt_ids[order],
+        event_codes=ev_codes[order],
+        timestamps=cols.timestamps[order],
+        ratings=cols.ratings[order],
+        entity_vocab=ent_vocab,
+        target_vocab=tgt_vocab,
+        event_vocab=ev_vocab,
+    )
+
+
 def merge_parallel_scans(iterators: Sequence[Iterator[Event]]) -> Iterator[Event]:
     """Merge N scan iterators through a bounded queue, one thread per
     iterator. Yields in nondeterministic order (bulk consumers — columnar

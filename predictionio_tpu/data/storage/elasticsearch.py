@@ -928,6 +928,14 @@ class ESPEvents(base.PEvents):
         if self._scan_slices > 1 and "events" not in kw and not unsliceable:
             kw = {k: v for k, v in kw.items() if k not in self._SLICE_FILTERS}
             kw["events"] = self.find_parallel(app_id, channel_id, **filters)
+            # erase the slice-merge nondeterminism (row order AND the
+            # scan-encounter dictionary encoding) so direct consumers —
+            # exports, multi-host ingest, golden tests — are reproducible
+            return base.canonical_order(
+                super().to_columnar(app_id, channel_id, **kw),
+                frozen_entity_vocab="entity_vocab" in kw,
+                frozen_target_vocab="target_vocab" in kw,
+            )
         return super().to_columnar(app_id, channel_id, **kw)
 
     def write(

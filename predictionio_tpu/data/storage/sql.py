@@ -700,9 +700,10 @@ class SQLPEvents(base.PEvents):
         data — true for server databases and file-backed sqlite, false for
         ``:memory:`` stores where every connect() opens a fresh empty
         database. Probed once per table (a fresh connection must see the
-        event table) rather than guessed from config."""
-        if self._partitions <= 1:
-            return False
+        event table) rather than guessed from config. The configured
+        default partition count is NOT consulted here: an explicit
+        ``n_partitions`` argument must win over the config default, so
+        count gating belongs to the callers."""
         cache = getattr(self._c, "_partition_probe", None)
         if cache is None:
             cache = self._c._partition_probe = {}
@@ -821,7 +822,10 @@ class SQLPEvents(base.PEvents):
     def to_columnar(self, app_id: int, channel_id: int | None = None, **kw):
         """Columnar ingest through the partitioned parallel scan when the
         filters allow it; serial otherwise (limit/reversed can't partition
-        without changing semantics)."""
+        without changing semantics). The merged stream's nondeterministic
+        order is erased by ``canonical_order`` before returning, so every
+        consumer (exports, multi-host ingest, golden tests) sees the same
+        rows, codes, and vocabs run-to-run."""
         filters = {k: v for k, v in kw.items() if k in self._PARTITION_FILTERS}
         unpartitionable = set(kw) - self._PARTITION_FILTERS - self._COLUMNAR_OWN_KW
         table = _event_table(app_id, channel_id)
@@ -831,10 +835,16 @@ class SQLPEvents(base.PEvents):
         if (
             "events" not in kw
             and not unpartitionable
+            and self._partitions > 1
             and self._can_partition(table)
         ):
             kw = {k: v for k, v in kw.items() if k not in self._PARTITION_FILTERS}
             kw["events"] = self.find_parallel(app_id, channel_id, **filters)
+            return base.canonical_order(
+                super().to_columnar(app_id, channel_id, **kw),
+                frozen_entity_vocab="entity_vocab" in kw,
+                frozen_target_vocab="target_vocab" in kw,
+            )
         return super().to_columnar(app_id, channel_id, **kw)
 
     def write(

@@ -58,6 +58,9 @@ class TwoTowerConfig:
     # history consumed by causal self-attention in the user tower
     history_len: int = 0
     n_heads: int = 2
+    # sampled-softmax log-Q debiasing of in-batch negatives (see loss_fn);
+    # uses the training set's empirical item frequency
+    logq_correction: bool = True
 
     def __post_init__(self):
         if self.history_len > 0 and self.embed_dim % self.n_heads:
@@ -181,17 +184,49 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("data"))
 
 
-def loss_fn(model: TwoTower, params, user_ids, item_ids, temperature: float, user_hist=None):
+def loss_fn(
+    model: TwoTower,
+    params,
+    user_ids,
+    item_ids,
+    temperature: float,
+    user_hist=None,
+    item_log_q=None,
+):
     u, v = model.apply({"params": params}, user_ids, item_ids, user_hist)
     logits = (u @ v.T) / temperature  # [B, B]
-    labels = jnp.arange(u.shape[0])
+    B = u.shape[0]
+    labels = jnp.arange(B)
+    # sampled-softmax log-Q correction (Bengio & Senecal; the standard
+    # retrieval-tower debiasing): in-batch negatives are drawn from the
+    # empirical item distribution, so popular items are over-penalized as
+    # negatives unless log Q(item_j) is subtracted from column j. The same
+    # subtraction is a row-constant shift of logits.T, so the item->user
+    # direction's softmax is untouched.
+    if item_log_q is not None:
+        logits = logits - item_log_q[item_ids][None, :]
+    # duplicate-collision masking: when item j' == item j (same catalog item
+    # drawn twice into the batch), position j' is a FALSE negative for
+    # example j — its "wrong" logit is the true item's own score. Masking
+    # the off-diagonal duplicates (symmetric, so it also fixes the
+    # transposed direction) matters exactly when batch size is comparable
+    # to the catalog, where collisions are ubiquitous.
+    same_item = item_ids[None, :] == item_ids[:, None]
+    dup = same_item & ~jnp.eye(B, dtype=bool)
+    logits = jnp.where(dup, jnp.float32(-1e9), logits)
     # symmetric in-batch softmax (user->item and item->user)
     l1 = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
     l2 = optax.softmax_cross_entropy_with_integer_labels(logits.T, labels).mean()
     return 0.5 * (l1 + l2)
 
 
-def make_train_step(model: TwoTower, tx, temperature: float, with_history: bool = False):
+def make_train_step(
+    model: TwoTower,
+    tx,
+    temperature: float,
+    with_history: bool = False,
+    item_log_q=None,
+):
     if with_history:
         # history matrix [n_users, T] rides on device; per-batch rows are
         # gathered INSIDE the step (one fused gather, no host transfer)
@@ -203,7 +238,9 @@ def make_train_step(model: TwoTower, tx, temperature: float, with_history: bool 
             # masked slots become the learned mask token in SeqEncoder
             h = jnp.where(h == item_ids[:, None], -1, h)
             loss, grads = jax.value_and_grad(
-                lambda p: loss_fn(model, p, user_ids, item_ids, temperature, h)
+                lambda p: loss_fn(
+                    model, p, user_ids, item_ids, temperature, h, item_log_q
+                )
             )(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
@@ -213,7 +250,9 @@ def make_train_step(model: TwoTower, tx, temperature: float, with_history: bool 
 
     def train_step(params, opt_state, user_ids, item_ids):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(model, p, user_ids, item_ids, temperature)
+            lambda p: loss_fn(
+                model, p, user_ids, item_ids, temperature, None, item_log_q
+            )
         )(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -293,8 +332,24 @@ def train_two_tower(
     opt_state = tx.init(params)
     b_sharding = batch_sharding(mesh)
 
+    item_log_q = None
+    if config.logq_correction and len(item_idx):
+        freq = np.bincount(
+            np.asarray(item_idx, np.int64), minlength=config.n_items
+        ).astype(np.float64)
+        q = freq / max(1.0, freq.sum())
+        item_log_q = jax.device_put(
+            jnp.asarray(np.log(np.maximum(q, 1e-12)), jnp.float32),
+            NamedSharding(mesh, P()),
+        )
     step = jax.jit(
-        make_train_step(model, tx, config.temperature, with_history=with_history),
+        make_train_step(
+            model,
+            tx,
+            config.temperature,
+            with_history=with_history,
+            item_log_q=item_log_q,
+        ),
         donate_argnums=(0, 1),
     )
     hist_dev = (

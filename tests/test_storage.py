@@ -1018,6 +1018,21 @@ class TestSQLPartitionedScan:
 
         assert decoded(cols) == decoded(serial)
 
+    def test_to_columnar_deterministic_across_runs(self, tmp_path):
+        """The threaded partition merge is scheduling-dependent, but
+        to_columnar must erase that (canonical_order): two runs over the
+        same store return identical rows, codes, and vocabs — exports and
+        golden tests depend on it (code-review r4 finding)."""
+        client, p = self._seed(tmp_path)
+        a = p.to_columnar(1, event_names=["rate"], rating_key="rating")
+        b = p.to_columnar(1, event_names=["rate"], rating_key="rating")
+        assert a.event_ids == b.event_ids
+        assert a.entity_vocab == b.entity_vocab == sorted(a.entity_vocab)
+        assert a.target_vocab == b.target_vocab
+        np.testing.assert_array_equal(a.entity_ids, b.entity_ids)
+        np.testing.assert_array_equal(a.target_ids, b.target_ids)
+        np.testing.assert_array_equal(a.event_codes, b.event_codes)
+
     def test_memory_backed_store_falls_back_to_serial(self, tmp_path):
         from predictionio_tpu.data.storage.sql import SQLStorageClient
 

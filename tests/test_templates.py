@@ -401,6 +401,55 @@ class TestECommerce:
 
 
 class TestTwoTower:
+    def test_loss_masks_duplicate_item_collisions(self):
+        """In-batch softmax correction (round 4): when every batch item is
+        the SAME catalog item, all off-diagonal 'negatives' are the true
+        item itself — masked out, the user->item direction has one effective
+        class and contributes ~zero loss; unmasked it would be ~log(B)."""
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.models.twotower.model import (
+            TwoTower,
+            TwoTowerConfig,
+            loss_fn,
+        )
+
+        cfg = TwoTowerConfig(n_users=16, n_items=4, embed_dim=8, hidden=(8,), out_dim=4)
+        model = TwoTower(cfg)
+        B = 8
+        users = jnp.arange(B, dtype=jnp.int32)
+        items = jnp.zeros((B,), jnp.int32)  # all the same item
+        params = model.init(jax.random.PRNGKey(0), users, items)["params"]
+        loss = float(loss_fn(model, params, users, items, cfg.temperature))
+        # l1 ~ 0 (single unmasked class); l2 (item->user) still a real
+        # B-way softmax, so total = 0.5*(~0 + l2) < 0.5*log(B) + slack,
+        # whereas the uncorrected symmetric loss would be ~log(B) = 2.08
+        assert loss < 0.5 * float(jnp.log(jnp.asarray(float(B)))) + 0.2, loss
+
+    def test_logq_correction_changes_gradient_for_skewed_items(self):
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.models.twotower.model import (
+            TwoTower,
+            TwoTowerConfig,
+            loss_fn,
+        )
+
+        cfg = TwoTowerConfig(n_users=16, n_items=8, embed_dim=8, hidden=(8,), out_dim=4)
+        model = TwoTower(cfg)
+        B = 8
+        users = jnp.arange(B, dtype=jnp.int32)
+        items = jnp.arange(B, dtype=jnp.int32) % 8
+        params = model.init(jax.random.PRNGKey(0), users, items)["params"]
+        logq = jnp.log(jnp.asarray([0.5, 0.1, 0.1, 0.1, 0.05, 0.05, 0.05, 0.05]))
+        base = float(loss_fn(model, params, users, items, cfg.temperature))
+        corrected = float(
+            loss_fn(model, params, users, items, cfg.temperature, None, logq)
+        )
+        assert base != corrected  # the debiasing term is live
+
     def seed(self, storage):
         app_id, levents = seed_app(storage)
         rng = np.random.default_rng(3)
