@@ -859,8 +859,14 @@ class TestESSlicedScan:
         try:
             cols = p.to_columnar(APP, event_names=["rate", "buy"], rating_key="rating")
             assert len(cols.event_ids) == self.N
-            # vocab order is nondeterministic under the parallel merge, but
-            # the (entity, target, rating) triples must match the serial scan
+            # the slice merge is nondeterministic, but to_columnar erases
+            # that (canonical_order): sorted vocabs, deterministic codes,
+            # and the decoded triples must match the serial scan
+            assert cols.entity_vocab == sorted(cols.entity_vocab)
+            assert cols.target_vocab == sorted(cols.target_vocab)
+            again = p.to_columnar(APP, event_names=["rate", "buy"], rating_key="rating")
+            assert again.event_ids == cols.event_ids
+            np.testing.assert_array_equal(again.entity_ids, cols.entity_ids)
             serial = {
                 (e.entity_id, e.target_entity_id, e.properties.get_opt("rating"))
                 for e in p.find(APP)
