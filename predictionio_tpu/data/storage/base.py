@@ -132,8 +132,18 @@ class Model:
 
 
 def generate_access_key() -> str:
-    """64 random bytes, base64 url-safe, no padding (ref AccessKeys.scala:44-49)."""
-    return base64.urlsafe_b64encode(secrets.token_bytes(48)).decode().rstrip("=")
+    """48 random bytes, base64 url-safe, no padding (ref AccessKeys.scala:44-49).
+
+    A key must never START with ``-``: every CLI that takes a key as a
+    positional (``pio accesskey delete <key>``) would parse it as a flag.
+    The url-safe alphabet includes ``-`` (~1.6% of keys would hit it), so
+    regenerate until the first character is safe — a uniformity loss of one
+    character class on one position, not a security-relevant bias.
+    """
+    while True:
+        key = base64.urlsafe_b64encode(secrets.token_bytes(48)).decode().rstrip("=")
+        if not key.startswith("-"):
+            return key
 
 
 # ---------------------------------------------------------------------------

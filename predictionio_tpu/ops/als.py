@@ -70,6 +70,14 @@ class ALSConfig:
     solver: str = "cg"
     # "auto" | "degree" | "constant" — see module docstring (ALS-WR)
     reg_scaling: str = "auto"
+    # "f32" | "bf16": dtype of the FIXED factor table the nnz loop gathers
+    # from. The solver iterations are gather-bound (PERF.md: ~21M row
+    # gathers/iter dwarf the MXU Gram einsum), so halving the row bytes is
+    # the remaining single-chip lever. "bf16" keeps a bf16 COPY of the
+    # opposite side for the gather only — Gram/b accumulation, the shared
+    # implicit gram term, regularization, and the batched solves all stay
+    # f32, so only the gathered operand is rounded (8-bit mantissa).
+    gather_dtype: str = "f32"
     # "auto" | "device" | "host": how the COO list becomes MXU block tables.
     # "device" (= "auto"): host does ONE O(n) stable group-by-user (native
     # C++ counting sort, numpy fallback), uploads the minimal wire form
@@ -96,6 +104,10 @@ class ALSConfig:
             raise ValueError(f"solver must be cg|cholesky, got {self.solver!r}")
         if self.pack not in ("auto", "device", "host"):
             raise ValueError(f"pack must be auto|device|host, got {self.pack!r}")
+        if self.gather_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"gather_dtype must be f32|bf16, got {self.gather_dtype!r}"
+            )
 
     @property
     def degree_scaled_reg(self) -> bool:
@@ -227,6 +239,7 @@ def _normal_equations_blocked(
     block_chunk: int,
     implicit: bool,
     alpha: float,
+    gather_dtype: str = "f32",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Block-Gram accumulation: the MXU path for the nnz loop.
 
@@ -238,13 +251,23 @@ def _normal_equations_blocked(
     (``bdf,bdg->bfg`` — contraction depth D rides the MXU) and only the
     per-BLOCK [f,f] results are scattered: D times fewer scatter elements
     and the FLOPs move from the VPU to the MXU.
+
+    ``gather_dtype="bf16"`` gathers from a bf16 copy of ``opposite``
+    (half the row bytes on the gather-bound path); accumulation and the
+    returned A/b/counts are always at least f32 (callers may pass an
+    ``opposite`` that is ALREADY bf16 — e.g. the sharded path's bf16
+    all_gather — without the accumulators degrading to bf16).
     """
     f = opposite.shape[1]
+    acc_dtype = jnp.promote_types(opposite.dtype, jnp.float32)
+    gathered = (
+        opposite.astype(jnp.bfloat16) if gather_dtype == "bf16" else opposite
+    )
     nb = block_rows.shape[0]
     n_chunks = nb // block_chunk
-    A0 = jnp.zeros((n_entities, f, f), opposite.dtype)
-    b0 = jnp.zeros((n_entities, f), opposite.dtype)
-    n0 = jnp.zeros((n_entities,), opposite.dtype)
+    A0 = jnp.zeros((n_entities, f, f), acc_dtype)
+    b0 = jnp.zeros((n_entities, f), acc_dtype)
+    n0 = jnp.zeros((n_entities,), acc_dtype)
 
     br_ch = block_rows.reshape(n_chunks, block_chunk)
     c_ch = cols.reshape(n_chunks, block_chunk, -1)
@@ -254,16 +277,27 @@ def _normal_equations_blocked(
     def step(carry, inputs):
         A, b, n = carry
         br, c, v, ww = inputs
-        ww = ww.astype(opposite.dtype)  # int8 wire format -> f32 math
-        vecs = opposite[c]  # [CB, D, f] gather
+        ww = ww.astype(acc_dtype)  # int8 wire format -> f32 math
+        vecs = gathered[c]  # [CB, D, f] gather (bf16 rows when opted in)
         if implicit:
             ow = ww * (alpha * v)  # (conf - 1), 0 in pad slots
             bw = ww * (1.0 + alpha * v)
         else:
             ow = ww
             bw = ww * v
-        A_blk = jnp.einsum("bdf,bdg->bfg", ow[..., None] * vecs, vecs)
-        b_blk = jnp.einsum("bd,bdf->bf", bw, vecs)
+        # weights stay f32 on every mode (the f32*bf16 product promotes, so
+        # ONLY the gathered rows are rounded — the documented contract; the
+        # multiply precision was never the bottleneck, the gather bytes are)
+        # and the einsums accumulate in acc_dtype
+        A_blk = jnp.einsum(
+            "bdf,bdg->bfg",
+            ow[..., None] * vecs,
+            vecs,
+            preferred_element_type=acc_dtype,
+        ).astype(acc_dtype)
+        b_blk = jnp.einsum(
+            "bd,bdf->bf", bw, vecs, preferred_element_type=acc_dtype
+        ).astype(acc_dtype)
         n_blk = ww.sum(axis=-1)
         A = A.at[br].add(A_blk, indices_are_sorted=True)
         b = b.at[br].add(b_blk, indices_are_sorted=True)
@@ -321,14 +355,20 @@ def _solve_blocked(
     alpha,
     degree_scaled_reg: bool,
     solver: str = "cg",
+    gather_dtype: str = "f32",
 ):
     f = opposite.shape[1]
     A, b, counts = _normal_equations_blocked(
-        block_rows, cols, vals, w, opposite, n_entities, block_chunk, implicit, alpha
+        block_rows, cols, vals, w, opposite, n_entities, block_chunk, implicit, alpha,
+        gather_dtype,
     )
-    eye = jnp.eye(f, dtype=opposite.dtype)
+    eye = jnp.eye(f, dtype=A.dtype)
     if implicit:
-        gram = opposite.T @ opposite
+        # shared dense term accumulates at the (>= f32) accumulator dtype
+        # even if ``opposite`` arrived bf16 from a caller
+        gram = jnp.einsum(
+            "df,dg->fg", opposite, opposite, preferred_element_type=A.dtype
+        )
         A = A + gram[None, :, :]
     if degree_scaled_reg:
         A = A + (reg * jnp.maximum(counts, 1.0))[:, None, None] * eye[None, :, :]
@@ -393,6 +433,7 @@ def _solve_side(
         "block_chunk",
         "degree_scaled_reg",
         "solver",
+        "gather_dtype",
     ),
     donate_argnums=(0, 1),
 )
@@ -416,14 +457,15 @@ def _als_step(
     block_chunk: int,
     degree_scaled_reg: bool = True,
     solver: str = "cg",
+    gather_dtype: str = "f32",
 ):
     user_factors = _solve_blocked(
         u_br, u_cols, u_vals, u_w, item_factors, n_users + 1, block_chunk,
-        reg, implicit, alpha, degree_scaled_reg, solver,
+        reg, implicit, alpha, degree_scaled_reg, solver, gather_dtype,
     )
     item_factors = _solve_blocked(
         i_br, i_cols, i_vals, i_w, user_factors, n_items + 1, block_chunk,
-        reg, implicit, alpha, degree_scaled_reg, solver,
+        reg, implicit, alpha, degree_scaled_reg, solver, gather_dtype,
     )
     return user_factors, item_factors
 
@@ -666,6 +708,7 @@ def als_train(
             block_chunk=block_chunk,
             degree_scaled_reg=config.degree_scaled_reg,
             solver=config.solver,
+            gather_dtype=config.gather_dtype,
         )
     if timings is not None:
         fetch_barrier(user_f, item_f)

@@ -155,6 +155,7 @@ def als_train_sharded(
         block_chunk=block_chunk,
         degree_scaled_reg=config.degree_scaled_reg,
         solver=config.solver,
+        gather_dtype=config.gather_dtype,
     )
     dev = tuple(put(a) for a in (*u_blocks, *i_blocks))
     # one iteration per launch — same watchdog/compile rationale as
@@ -225,6 +226,7 @@ def _als_sharded_init(
         "block_chunk",
         "degree_scaled_reg",
         "solver",
+        "gather_dtype",
     ),
     donate_argnums=(0, 1),
 )
@@ -251,6 +253,7 @@ def _als_sharded_step(
     block_chunk: int,
     degree_scaled_reg: bool = True,
     solver: str = "cg",
+    gather_dtype: str = "f32",
 ):
     spec = P(axis)
 
@@ -259,9 +262,19 @@ def _als_sharded_step(
         uf_l, vf_l = uf_l[0], vf_l[0]
         n_dev = lax.psum(1, axis)
 
+        # bf16 across the ICI only in EXPLICIT mode: it halves the
+        # collective bytes and hands _solve_blocked the same bf16 rows the
+        # single-chip path gathers (its accumulators stay f32 — see
+        # _normal_equations_blocked). Implicit mode gathers f32 so the
+        # shared V^T V gram term is computed from full-precision factors,
+        # exactly like the single-chip bf16 path (which rounds ONLY the
+        # per-row gathers, never the gram input).
+        wire_bf16 = gather_dtype == "bf16" and not implicit
+
         def gather_side(local, block):
             # [n_dev, block+1, f] -> drop dummies -> [n_dev*block, f]
-            full = lax.all_gather(local, axis)  # ICI collective
+            send = local.astype(jnp.bfloat16) if wire_bf16 else local
+            full = lax.all_gather(send, axis)  # ICI collective
             return full[:, :block].reshape(n_dev * block, rank)
 
         # per-device dummy-block padding means pads inflate only the local
@@ -271,11 +284,13 @@ def _als_sharded_step(
         uf_l = _solve_blocked(
             u_br[0], u_cols[0], u_vals[0], u_w[0], v_full, bu + 1,
             block_chunk, reg, implicit, alpha, degree_scaled_reg, solver,
+            gather_dtype,
         )
         u_full = gather_side(uf_l, bu)
         vf_l = _solve_blocked(
             i_br[0], i_cols[0], i_vals[0], i_w[0], u_full, bi + 1,
             block_chunk, reg, implicit, alpha, degree_scaled_reg, solver,
+            gather_dtype,
         )
         return uf_l[None], vf_l[None]
 

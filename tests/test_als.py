@@ -52,6 +52,27 @@ class TestExplicitALS:
         uf, vf = als_train(users, items, vals, 3, 3, ALSConfig(rank=2, iterations=2))
         assert np.all(np.isfinite(np.asarray(uf)))
 
+    def test_bf16_gather_quality_parity(self):
+        # gather_dtype="bf16" rounds only the gathered operand of the Gram
+        # accumulation (accumulators/solves stay f32): quality must stay
+        # within bf16 rounding of the f32 path, not just "finite"
+        users, items, vals = synthetic_ratings(density=0.7, seed=2)
+
+        def rmse(dt):
+            uf, vf = als_train(
+                users, items, vals, 30, 20,
+                ALSConfig(rank=6, iterations=8, reg=0.05, gather_dtype=dt),
+            )
+            pred = np.sum(np.asarray(uf)[users] * np.asarray(vf)[items], axis=1)
+            return float(np.sqrt(np.mean((pred - vals) ** 2)))
+
+        r32, r16 = rmse("f32"), rmse("bf16")
+        assert abs(r16 - r32) < 0.02, (r32, r16)
+
+    def test_gather_dtype_validated(self):
+        with pytest.raises(ValueError):
+            ALSConfig(gather_dtype="f64")
+
     def test_cold_entities_zero_safe(self):
         # user 2 and item 2 have no ratings; solve must stay finite
         users = np.array([0, 1], np.int32)
@@ -200,12 +221,38 @@ class TestShardedALS:
         assert rmse_multi < 0.15
         assert rmse_multi < max(5 * abs(rmse_single), 0.15)
 
-    def test_implicit_mode(self):
+    def test_bf16_gather_quality_parity_sharded(self):
+        # the sharded path must honor gather_dtype too (bf16 factors across
+        # the ICI all_gather + bf16 HBM row gathers), with quality within
+        # bf16 rounding of the sharded f32 run
         from predictionio_tpu.ops.als import ALSConfig
         from predictionio_tpu.ops.als_sharded import als_train_sharded
 
         u, i, r, n_u, n_i = self._problem()
-        cfg = ALSConfig(rank=8, iterations=6, reg=0.05, implicit=True, alpha=2.0, chunk=512)
+
+        def rmse(dt):
+            cfg = ALSConfig(
+                rank=8, iterations=10, reg=0.05, chunk=512, gather_dtype=dt
+            )
+            uf, vf = als_train_sharded(u, i, r, n_u, n_i, cfg)
+            return float(np.sqrt(np.mean(((uf @ vf.T)[u, i] - r) ** 2)))
+
+        r32, r16 = rmse("f32"), rmse("bf16")
+        assert r16 < 0.2 and abs(r16 - r32) < 0.05, (r32, r16)
+
+    @pytest.mark.parametrize("gather_dtype", ["f32", "bf16"])
+    def test_implicit_mode(self, gather_dtype):
+        # bf16 variant: the implicit path must keep its shared V^T V gram
+        # term at full precision (f32 all_gather) while still ranking
+        # correctly — the contract the explicit path's wire-bf16 skips
+        from predictionio_tpu.ops.als import ALSConfig
+        from predictionio_tpu.ops.als_sharded import als_train_sharded
+
+        u, i, r, n_u, n_i = self._problem()
+        cfg = ALSConfig(
+            rank=8, iterations=6, reg=0.05, implicit=True, alpha=2.0, chunk=512,
+            gather_dtype=gather_dtype,
+        )
         uf, vf = als_train_sharded(u, i, np.abs(r), n_u, n_i, cfg)
         assert np.all(np.isfinite(uf)) and np.all(np.isfinite(vf))
         # observed pairs should score above unobserved on average

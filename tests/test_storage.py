@@ -336,6 +336,15 @@ class TestMetadata:
         keys.delete(k)
         assert keys.get(k) is None
 
+    def test_generated_key_is_cli_safe(self):
+        # a key starting with "-" would be parsed as a flag by every CLI
+        # that takes it as a positional (pio accesskey delete <key>); the
+        # generator must never emit one (flaked ~1.6% of runs before)
+        from predictionio_tpu.data.storage.base import generate_access_key
+
+        for _ in range(300):
+            assert not generate_access_key().startswith("-")
+
     def test_channels(self, meta_client):
         ch = meta_client.channels()
         cid = ch.insert(Channel(0, "mobile", 1))
