@@ -117,6 +117,13 @@ class TwoTowerAlgorithmParams(Params):
     # pallas fused-attention kernel on TPU, ops/attention.py); 0 disables
     history_len: int = 0
     n_heads: int = 2
+    # sequence/context parallelism for the encoder: shard the history axis
+    # over the mesh's `model` axis (ring or ulysses attention over ICI,
+    # composed with `data`-axis batch sharding). Requires history_len > 0,
+    # history_len % model-axis == 0, and a mesh with model > 1; serving is
+    # unaffected (attention has no parameters, models load mesh-less).
+    context_parallel: bool = False
+    sp_impl: str = "ring"  # "ring" | "ulysses"
 
 
 @dataclasses.dataclass
@@ -192,6 +199,8 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             seed=self.params.seed,
             history_len=self.params.history_len,
             n_heads=self.params.n_heads,
+            context_parallel=self.params.context_parallel,
+            sp_impl=self.params.sp_impl,
         )
         mesh = None
         if self.params.mesh:

@@ -58,6 +58,15 @@ class TwoTowerConfig:
     # history consumed by causal self-attention in the user tower
     history_len: int = 0
     n_heads: int = 2
+    # sequence/context parallelism for the history encoder: when True and a
+    # mesh is passed to ``train_two_tower``, the encoder's attention shards
+    # the history sequence over the mesh's ``model`` axis (ring attention's
+    # K/V ppermute or Ulysses' all_to_alls over ICI) composed with the
+    # batch's ``data``-axis sharding — dp x sp on one 2-D mesh. This is how
+    # histories longer than one device's memory train; at short
+    # history_len it is a correctness-exercised path, not a win.
+    context_parallel: bool = False
+    sp_impl: str = "ring"  # "ring" | "ulysses"
     # sampled-softmax log-Q debiasing of in-batch negatives (see loss_fn);
     # uses the training set's empirical item frequency
     logq_correction: bool = True
@@ -67,6 +76,12 @@ class TwoTowerConfig:
             raise ValueError(
                 f"embed_dim ({self.embed_dim}) must be divisible by n_heads "
                 f"({self.n_heads}) for the history encoder"
+            )
+        if self.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl must be ring|ulysses, got {self.sp_impl!r}")
+        if self.context_parallel and self.history_len <= 0:
+            raise ValueError(
+                "context_parallel requires a history encoder (history_len > 0)"
             )
 
 
@@ -83,10 +98,50 @@ class SeqEncoder(nn.Module):
     embed_dim: int
     n_heads: int
     max_len: int
+    # sequence parallelism: a mesh makes attention shard T over ``sp_axis``
+    # (ring or ulysses over ICI), composed with the batch's ``dp_axis``
+    # sharding. None = single-device fused_attention.
+    sp_mesh: Mesh | None = None
+    sp_axis: str = "model"
+    dp_axis: str = "data"
+    sp_impl: str = "ring"
+
+    def _attend(self, q, k, v):  # [B, H, T, Dh] each
+        from predictionio_tpu.ops.attention import (
+            fused_attention,
+            ring_attention,
+            ulysses_attention,
+        )
+
+        mesh = self.sp_mesh
+        sp_n = dict(mesh.shape).get(self.sp_axis, 1) if mesh is not None else 1
+        if mesh is None or sp_n <= 1:
+            return fused_attention(q, k, v, causal=True)
+        T, H = q.shape[2], q.shape[1]
+        # fail loud: a silent fallback here would turn the configured
+        # sequence parallelism into a no-op nobody notices
+        if T % sp_n:
+            raise ValueError(
+                f"history_len {T} not divisible by mesh axis "
+                f"{self.sp_axis}={sp_n}"
+            )
+        batch_axis = self.dp_axis if self.dp_axis in mesh.shape else None
+        if self.sp_impl == "ulysses":
+            if H % sp_n:
+                raise ValueError(
+                    f"n_heads {H} not divisible by mesh axis "
+                    f"{self.sp_axis}={sp_n} (ulysses splits heads)"
+                )
+            return ulysses_attention(
+                q, k, v, mesh, axis=self.sp_axis, causal=True,
+                batch_axis=batch_axis,
+            )
+        return ring_attention(
+            q, k, v, mesh, axis=self.sp_axis, causal=True, batch_axis=batch_axis
+        )
 
     @nn.compact
     def __call__(self, hist_ids: jnp.ndarray) -> jnp.ndarray:  # [B, T] -> [B, E]
-        from predictionio_tpu.ops.attention import fused_attention
 
         valid = hist_ids >= 0  # [B, T]
         # invalid slots (end pads AND train-time target masking, which can
@@ -110,7 +165,7 @@ class SeqEncoder(nn.Module):
             y = nn.Dense(E, name=name)(x)
             return y.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)  # [B,H,T,Dh]
 
-        out = fused_attention(heads("q"), heads("k"), heads("v"), causal=True)
+        out = self._attend(heads("q"), heads("k"), heads("v"))
         out = out.transpose(0, 2, 1, 3).reshape(B, T, E)
         out = x + nn.Dense(E, name="proj")(out)  # residual
         # masked mean-pool over valid (non-pad) positions
@@ -140,6 +195,10 @@ class Tower(nn.Module):
 
 class TwoTower(nn.Module):
     config: TwoTowerConfig
+    # mesh for the history encoder's sequence parallelism (None = off);
+    # attention carries no parameters, so checkpoints from a
+    # context-parallel train load into a mesh-less serving model unchanged
+    sp_mesh: Mesh | None = None
 
     def setup(self):
         c = self.config
@@ -147,7 +206,9 @@ class TwoTower(nn.Module):
         self.item_tower = Tower(c.n_items, c.embed_dim, c.hidden, c.out_dim)
         if c.history_len > 0:
             self.hist_encoder = SeqEncoder(
-                c.n_items, c.embed_dim, c.n_heads, c.history_len
+                c.n_items, c.embed_dim, c.n_heads, c.history_len,
+                sp_mesh=self.sp_mesh if c.context_parallel else None,
+                sp_impl=c.sp_impl,
             )
 
     def _user_extra(self, user_hist):
@@ -314,7 +375,7 @@ def train_two_tower(
             mesh = make_mesh("data=-1,model=1")
         except ValueError:
             mesh = make_mesh("data=1,model=1")
-    model = TwoTower(config)
+    model = TwoTower(config, sp_mesh=mesh if config.context_parallel else None)
     rng = jax.random.PRNGKey(config.seed)
     B = min(config.batch_size, max(len(user_idx), 8))
     # round batch to a multiple of the data axis (static shapes)

@@ -115,12 +115,18 @@ def ring_attention(
     mesh: Mesh,
     axis: str = "sp",
     causal: bool = False,
+    batch_axis: str | None = None,
 ) -> jnp.ndarray:
     """Full attention over sequences sharded on ``axis``.
 
     Inputs/outputs are global arrays; under jit the sequence dimension is
     sharded over the axis and each device runs P ring steps, exchanging K/V
     shards with its neighbor. Requires L % axis_size == 0.
+
+    ``batch_axis`` composes sequence parallelism with data parallelism:
+    the batch dimension shards over that mesh axis (dp x sp over one 2-D
+    mesh), so a dp-sharded caller (e.g. a sharded train step) does not
+    force GSPMD to all-gather the batch around the shard_map boundary.
     """
     axis_size = mesh.shape[axis]
     L = q.shape[2]
@@ -170,7 +176,7 @@ def ring_attention(
         safe_sum = jnp.where(row_sum == 0.0, 1.0, row_sum)
         return (acc / safe_sum[..., None]).astype(q_blk.dtype)
 
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, None, axis, None)
     sharded = shard_map(
         local_fn,
         mesh=mesh,
@@ -181,12 +187,21 @@ def ring_attention(
 
 
 def ring_attention_sharded(
-    q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    batch_axis: str | None = None,
 ):
     """jit-wrapped ring attention with explicit input shardings."""
-    sharding = NamedSharding(mesh, P(None, None, axis, None))
+    sharding = NamedSharding(mesh, P(batch_axis, None, axis, None))
     fn = jax.jit(
-        functools.partial(ring_attention, mesh=mesh, axis=axis, causal=causal),
+        functools.partial(
+            ring_attention, mesh=mesh, axis=axis, causal=causal,
+            batch_axis=batch_axis,
+        ),
         in_shardings=(sharding, sharding, sharding),
         out_shardings=sharding,
     )
@@ -205,6 +220,7 @@ def ulysses_attention(
     mesh: Mesh,
     axis: str = "sp",
     causal: bool = False,
+    batch_axis: str | None = None,
 ) -> jnp.ndarray:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme): inputs
     arrive sequence-sharded on ``axis``; one ``all_to_all`` re-shards them
@@ -236,7 +252,8 @@ def ulysses_attention(
         out = fused_attention(q_h, k_h, v_h, causal=causal)
         return to_seq(out)
 
-    spec = P(None, None, axis, None)
+    # batch_axis: dp x sp composition — see ring_attention
+    spec = P(batch_axis, None, axis, None)
     return shard_map(
         local_fn,
         mesh=mesh,

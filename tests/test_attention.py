@@ -37,6 +37,18 @@ class TestRingAttention:
         got = ring_attention_sharded(q, k, v, mesh, axis="sp", causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)
 
+    def test_dp_sp_composed(self):
+        # batch sharded over `data` AND sequence over `sp` in ONE shard_map
+        # (dp x sp): the composition the two-tower context-parallel encoder
+        # relies on — without batch_axis, GSPMD must all-gather the batch
+        mesh = make_mesh("data=2,sp=4")
+        q, k, v = qkv(B=4, L=16)
+        expected = attention_reference(q, k, v, causal=True)
+        got = ring_attention_sharded(
+            q, k, v, mesh, axis="sp", causal=True, batch_axis="data"
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)
+
     def test_bad_length_rejected(self):
         mesh = make_mesh("sp=8")
         q, k, v = qkv(L=30)  # not divisible by 8
@@ -115,3 +127,13 @@ class TestUlyssesAttention:
         q, k, v = qkv(H=6)  # 6 heads on 8 devices
         with pytest.raises(ValueError, match="head count"):
             ulysses_attention(q, k, v, make_mesh("sp=8"))
+
+    def test_dp_sp_composed(self):
+        # dp x sp on one 2-D mesh (see TestRingAttention.test_dp_sp_composed)
+        mesh = make_mesh("data=2,sp=4")
+        q, k, v = qkv(B=4, H=4, L=16, D=16, seed=3)
+        out = ulysses_attention(
+            q, k, v, mesh, axis="sp", causal=True, batch_axis="data"
+        )
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
