@@ -275,6 +275,10 @@ class ALSAlgorithmParams(Params):
     # train with the ALX-style mesh-sharded solver (ops/als_sharded.py)
     # across all visible devices; single-device falls back transparently
     distributed: bool = False
+    # "f32" | "bf16": gather the fixed factor side in bf16 during the
+    # solver's Gram accumulation (halves the gather-bound loop's row bytes;
+    # accumulators and solves stay f32 — see ops/als.ALSConfig.gather_dtype)
+    gather_dtype: str = "f32"
 
 
 @dataclasses.dataclass
@@ -343,6 +347,7 @@ class ALSAlgorithm(JaxAlgorithm):
             implicit=self.params.implicit_prefs,
             alpha=self.params.alpha,
             seed=self.params.seed if self.params.seed is not None else 0,
+            gather_dtype=self.params.gather_dtype,
         )
         if self.params.distributed:
             from predictionio_tpu.ops.als_sharded import als_train_sharded

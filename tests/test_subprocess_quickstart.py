@@ -84,10 +84,13 @@ def _pio(env: dict, *args: str, timeout: int = 120) -> subprocess.CompletedProce
     proc = subprocess.run(
         [PIO, *args], env=env, capture_output=True, timeout=timeout
     )
+    # keep a wide stderr tail: multi-host failures put the interesting
+    # per-worker "[host N] ..." lines BEFORE the launcher's final error
+    # lines, and a short tail shows only the latter (round-4 forensics)
     assert proc.returncode == 0, (
         f"pio {' '.join(args)} rc={proc.returncode}\n"
         f"stdout: {proc.stdout.decode(errors='replace')[-1500:]}\n"
-        f"stderr: {proc.stderr.decode(errors='replace')[-1500:]}"
+        f"stderr: {proc.stderr.decode(errors='replace')[-6000:]}"
     )
     return proc
 

@@ -780,6 +780,21 @@ class TestRecommendationVariants:
         v.update(extra)
         return v
 
+    def test_gather_dtype_param_reaches_solver(self, memory_storage):
+        """gatherDtype in engine.json flows through to ALSConfig: bf16
+        trains to usable factors, a bad value fails at param parse/train."""
+        from predictionio_tpu.models.recommendation.engine import Query
+
+        self.seed(memory_storage)
+        v = self.base_variant()
+        v["algorithms"][0]["params"]["gatherDtype"] = "bf16"
+        engine, algos, models, serving = self.make(memory_storage, v)
+        r = algos[0].predict(models[0], Query(user="u1", num=5))
+        assert len(r.item_scores) == 5
+        v["algorithms"][0]["params"]["gatherDtype"] = "f64"
+        with pytest.raises(ValueError, match="gather_dtype"):
+            self.make(memory_storage, v)
+
     def test_blacklist_items_excluded(self, memory_storage):
         from predictionio_tpu.models.recommendation.engine import Query
 
