@@ -339,6 +339,9 @@ def build_history_matrix(
     """Per-user last-``history_len`` item indices, chronological, -1 padded
     at the END (the layout SeqEncoder requires)."""
     hist = np.full((n_users, history_len), -1, np.int32)
+    n = len(user_idx)
+    if n == 0:
+        return hist
     if timestamps is not None:
         order = np.lexsort((item_idx, timestamps, user_idx))
     else:
@@ -347,11 +350,16 @@ def build_history_matrix(
         # "recency" the encoder then learns from
         order = np.argsort(user_idx, kind="stable")
     u_sorted, i_sorted = user_idx[order], item_idx[order]
+    # vectorized last-K per user: each row's position within its user's
+    # run -> keep only the last K rows of each run -> scatter into the K
+    # slots. O(n) after the sort, no per-user python loop (the loop was
+    # ~proportional to n_users; the sort dominates either way)
     starts = np.searchsorted(u_sorted, np.arange(n_users))
-    ends = np.searchsorted(u_sorted, np.arange(n_users), side="right")
-    for u in range(n_users):
-        items = i_sorted[starts[u] : ends[u]][-history_len:]
-        hist[u, : len(items)] = items
+    deg = np.searchsorted(u_sorted, np.arange(n_users), side="right") - starts
+    pos = np.arange(n) - starts[u_sorted]
+    drop = np.maximum(deg - history_len, 0)[u_sorted]  # rows trimmed from front
+    keep = pos >= drop
+    hist[u_sorted[keep], (pos - drop)[keep]] = i_sorted[keep]
     return hist
 
 

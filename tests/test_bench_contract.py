@@ -21,6 +21,8 @@ def _run_main(monkeypatch, capsys, phase_results):
     returns (rc, parsed_json_line)."""
 
     def fake_run(name, timeout_s, retries=1):
+        if name == "probe" and name not in phase_results:
+            return {"probe_platform": "stub"}, None  # healthy device default
         return phase_results.get(name, ({}, f"{name} stub missing"))
 
     monkeypatch.setattr(bench, "_run_phase", fake_run)
@@ -116,6 +118,32 @@ def test_fully_crashed_run_is_rc1(monkeypatch, capsys):
     )
     assert rc == 1
     assert out["value"] is None and out["vs_baseline"] is None
+
+
+def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
+    """A dead device (hung TPU tunnel, observed mid-round-4) must degrade
+    the run in minutes, not burn 2x timeout in every device phase: the
+    probe fails once, device phases are skipped with explicit errors, the
+    CPU loopback serving numbers still ship, and rc is nonzero."""
+    calls = []
+
+    def fake_run(name, timeout_s, retries=1):
+        calls.append(name)
+        if name == "probe":
+            return {}, "phase timed out after 180s"
+        if name == "serving_local":
+            return {"serving_local_e2e_p50_ms": 6.0}, None
+        raise AssertionError(f"device phase {name} must not run")
+
+    monkeypatch.setattr(bench, "_run_phase", fake_run)
+    monkeypatch.setattr("sys.argv", ["bench.py"])
+    rc = bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert calls == ["probe", "serving_local"]
+    assert rc == 1  # headline phases never ran -> degraded
+    assert out["preflight_error"]
+    assert out["als_error"] == "skipped: device preflight failed"
+    assert out["serving_local_e2e_p50_ms"] == 6.0
 
 
 class TestTTLCache:
