@@ -10,9 +10,11 @@ startTime/endTime implicitly — SURVEY.md section 6 calls this out as a gap).
 
 from __future__ import annotations
 
+import contextlib
 import datetime as _dt
 import json
 import logging
+import os
 import time
 from typing import Any
 
@@ -32,6 +34,32 @@ from predictionio_tpu.workflow.engine_loader import EngineManifest
 
 logger = logging.getLogger(__name__)
 UTC = _dt.timezone.utc
+
+
+@contextlib.contextmanager
+def _maybe_profile():
+    """XLA profiler trace around training, gated by ``PIO_PROFILE_DIR``.
+
+    The reference has no training profiler at all (SURVEY.md §5: "none
+    beyond logging and Spark's own UI"); on TPU the XLA trace is the
+    ground truth for where a train step's device time goes (gather vs
+    scatter vs MXU), viewable in TensorBoard/XProf or with
+    ``jax.profiler``'s trace viewer. Off by default: tracing buffers
+    device events in memory and writes multi-MB artifacts.
+    """
+    trace_dir = os.environ.get("PIO_PROFILE_DIR")
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(trace_dir, exist_ok=True)
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("XLA profiler trace written to %s", trace_dir)
 
 
 def run_train(
@@ -57,7 +85,8 @@ def run_train(
     storage = storage or Storage.instance()
     ctx = ctx or WorkflowContext(mode="training", _storage=storage, batch=batch)
     if jax.process_count() > 1 and jax.process_index() != 0:
-        models = engine.train(ctx, engine_params, options)
+        with _maybe_profile():
+            models = engine.train(ctx, engine_params, options)
         if not (options and (options.stop_after_read or options.stop_after_prepare)):
             # serialization includes the cross-host gather of sharded model
             # arrays (model_to_host), which is itself a collective — every
@@ -87,7 +116,8 @@ def run_train(
     try:
         instance.status = EngineInstanceStatus.TRAINING
         instances.update(instance)
-        models = engine.train(ctx, engine_params, options)
+        with _maybe_profile():
+            models = engine.train(ctx, engine_params, options)
         if options and (options.stop_after_read or options.stop_after_prepare):
             instance.status = EngineInstanceStatus.COMPLETED
             instance.end_time = _dt.datetime.now(tz=UTC)

@@ -44,6 +44,27 @@ class TestRunTrain:
         models = model_io.deserialize_models(blob.models)
         assert models == [Model0(3, 1, 2)]
 
+    def test_profile_dir_writes_xla_trace(self, memory_storage, tmp_path, monkeypatch):
+        """PIO_PROFILE_DIR wraps engine.train in a jax profiler trace (the
+        perf-attribution tool the reference lacks, SURVEY.md §5); the
+        trace artifacts must land in the directory and training still
+        completes normally."""
+        import os
+
+        trace_dir = tmp_path / "trace"
+        monkeypatch.setenv("PIO_PROFILE_DIR", str(trace_dir))
+        instance_id = run_train(
+            make_engine(), manifest(), params(), storage=memory_storage
+        )
+        inst = memory_storage.get_meta_data_engine_instances().get(instance_id)
+        assert inst.status == EngineInstanceStatus.COMPLETED
+        produced = [
+            os.path.join(root, f)
+            for root, _, files in os.walk(trace_dir)
+            for f in files
+        ]
+        assert produced, "no trace artifacts written"
+
     def test_get_latest_completed_finds_it(self, memory_storage):
         run_train(make_engine(), manifest(), params(), storage=memory_storage)
         iid2 = run_train(make_engine(), manifest(), params(), storage=memory_storage)
