@@ -39,7 +39,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.ops.als import ALSConfig, _block_coo, _solve_blocked
+from predictionio_tpu.ops.als import ALSConfig, _host_group_by, _solve_blocked
 
 try:  # stable home since jax 0.8
     from jax import shard_map  # type: ignore[attr-defined]
@@ -66,38 +66,50 @@ def _block_partition_blocked(
     block_chunk: int,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Split COO by owning device block, localize owner indices, and pack
-    each device's shard into the ALX entity-block layout (``_block_coo`` —
-    the same MXU Gram formulation the single-chip path uses). All devices
-    are padded to one common block count with dummy blocks.
+    each device's shard into the ALX entity-block layout (the same MXU
+    Gram formulation the single-chip path uses). All devices are padded to
+    one common block count with dummy blocks (local dummy row = ``block``).
+
+    One global O(n) group-by (native C++ counting sort — device blocks are
+    contiguous entity ranges, so grouping by entity also groups by device)
+    replaces the per-device stable argsorts this used to run: at ML-20M on
+    8 devices that was 16 argsorts over the full rating list per train.
+    The within-entity order (original event order) and the emitted layout
+    are identical to the old packer's.
 
     Returns stacked [n_dev, NB], [n_dev, NB, d] x2, [n_dev, NB, d] arrays.
     """
-    owners = owner_idx // block
-    layouts = []
-    for dev in range(n_dev):
-        ix = np.flatnonzero(owners == dev)
-        layouts.append(
-            _block_coo(
-                (owner_idx[ix] - dev * block).astype(np.int32),
-                other_idx[ix].astype(np.int32),
-                vals[ix].astype(np.float32),
-                d,
-                block_chunk,
-                dummy_row=block,  # local dummy absorbs pad blocks
-            )
-        )
-    nb = max(l[0].shape[0] for l in layouts)
-    nb += (-nb) % block_chunk
+    n_ent = n_dev * block
+    cols_g, vals_g, deg = _host_group_by(
+        owner_idx.astype(np.int32),
+        other_idx.astype(np.int32),
+        vals.astype(np.float32),
+        n_ent,
+    )
+    start = np.concatenate([[0], np.cumsum(deg)])
+    nblk = -(-deg // d)  # blocks per entity (0 for unrated entities)
+    per_dev_blocks = nblk.reshape(n_dev, block).sum(axis=1)
+    nb_real_max = int(per_dev_blocks.max())
+    nb = max(nb_real_max + (-nb_real_max) % block_chunk, block_chunk)
     br = np.full((n_dev, nb), block, np.int32)
     cols = np.zeros((n_dev, nb, d), np.int32)
     v = np.zeros((n_dev, nb, d), np.float32)
     w = np.zeros((n_dev, nb, d), np.int8)
-    for dev, (b_rows, b_cols, b_vals, b_w) in enumerate(layouts):
-        n = b_rows.shape[0]
-        br[dev, :n] = b_rows
-        cols[dev, :n] = b_cols
-        v[dev, :n] = b_vals
-        w[dev, :n] = b_w
+    for dev in range(n_dev):
+        e0, e1 = dev * block, (dev + 1) * block
+        deg_l = deg[e0:e1]
+        r0, r1 = int(start[e0]), int(start[e1])
+        if r1 == r0:
+            continue  # no ratings for this device's entities
+        nblk_l = nblk[e0:e1]
+        block_base = np.concatenate([[0], np.cumsum(nblk_l)])
+        # position of each grouped row within its entity -> (block, slot)
+        p = np.arange(r1 - r0) - np.repeat(start[e0:e1] - r0, deg_l)
+        eidx = np.repeat(np.arange(block), deg_l)
+        cols[dev, block_base[eidx] + p // d, p % d] = cols_g[r0:r1]
+        v[dev, block_base[eidx] + p // d, p % d] = vals_g[r0:r1]
+        w[dev, block_base[eidx] + p // d, p % d] = 1
+        br[dev, : int(block_base[-1])] = np.repeat(np.arange(block), nblk_l)
     return br, cols, v, w
 
 

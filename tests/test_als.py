@@ -294,6 +294,51 @@ class TestShardedALS:
         assert v[1, 1, 0] == 4.0 and v[1, 1, 1] == 5.0  # user 7's two ratings
         assert w[1, 1, 0] == 1 and w[1, 1, 2] == 0
 
+    def test_block_partition_matches_per_device_block_coo(self):
+        """The one-pass global group-by packer must emit bit-identical
+        tables to its predecessor (per-device stable-argsort _block_coo),
+        including within-entity event order, dummy padding, and the
+        common-nb padding rule."""
+        from predictionio_tpu.ops.als import _block_coo
+        from predictionio_tpu.ops.als_sharded import _block_partition_blocked
+
+        rng = np.random.default_rng(11)
+        for trial, (n_ent, n_dev, d, bc, nnz) in enumerate(
+            [(16, 4, 8, 8, 500), (7, 3, 8, 16, 0), (40, 8, 16, 8, 3000), (5, 2, 8, 8, 37)]
+        ):
+            block = -(-n_ent // n_dev)
+            owner = rng.integers(0, n_ent, nnz).astype(np.int32)
+            other = rng.integers(0, 50, nnz).astype(np.int32)
+            vals = rng.random(nnz).astype(np.float32)
+            got = _block_partition_blocked(owner, other, vals, block, n_dev, d, bc)
+            # predecessor: per-device localized _block_coo, padded to max nb
+            owners = owner // block
+            layouts = [
+                _block_coo(
+                    (owner[owners == dev] - dev * block).astype(np.int32),
+                    other[owners == dev],
+                    vals[owners == dev],
+                    d,
+                    bc,
+                    dummy_row=block,
+                )
+                for dev in range(n_dev)
+            ]
+            nb = max(l[0].shape[0] for l in layouts)
+            nb += (-nb) % bc
+            want = (
+                np.full((n_dev, nb), block, np.int32),
+                np.zeros((n_dev, nb, d), np.int32),
+                np.zeros((n_dev, nb, d), np.float32),
+                np.zeros((n_dev, nb, d), np.int8),
+            )
+            for dev, tables in enumerate(layouts):
+                n = tables[0].shape[0]
+                for w_arr, t in zip(want, tables):
+                    w_arr[dev, :n] = t
+            for g, w_arr, name in zip(got, want, ("br", "cols", "vals", "w")):
+                assert np.array_equal(g, w_arr), (trial, name)
+
 
 class TestDevicePack:
     """The device-side block-building pipeline (round-4 perf work): host does
