@@ -257,6 +257,39 @@ def phase_als(ck: _Checkpoint) -> None:
         ),
     )
 
+    # extra datapoint (not the headline): the bf16-gather solver variant
+    # (ALSConfig.gather_dtype — halves the gather-bound loop's row bytes).
+    # Guarded so a failure here can never taint the headline numbers; its
+    # own RMSE is recorded so a quality cost would be visible.
+    if platform in ("tpu", "axon"):
+        try:
+            t_bf16: dict = {}
+            cfg16 = ALSConfig(
+                rank=rank, iterations=iterations, reg=0.05, chunk=65536,
+                gather_dtype="bf16",
+            )
+            t0 = time.perf_counter()
+            uf16, vf16 = als_train(
+                users_tr, items_tr, vals_tr, n_users, n_items, cfg16,
+                timings=t_bf16,
+            )
+            bf16_wall = time.perf_counter() - t0
+            uf16_h, vf16_h = np.asarray(uf16), np.asarray(vf16)
+            pred16 = np.sum(
+                uf16_h[users[test_mask]] * vf16_h[items[test_mask]], axis=1
+            )
+            ck.save(
+                # wall includes this variant's own compile (shapes differ
+                # from the f32 program); device_s is the comparable number
+                als_bf16_wall_s=round(bf16_wall, 3),
+                als_bf16_device_s=round(t_bf16["device_s"], 3),
+                als_bf16_heldout_rmse=round(
+                    float(np.sqrt(np.mean((pred16 - vals[test_mask]) ** 2))), 4
+                ),
+            )
+        except Exception as exc:  # noqa: BLE001 - extra datapoint only
+            ck.save(als_bf16_error=str(exc)[:200])
+
     # held-out quality gate (device -> host readback is the round-2 crash
     # site; the wall-clock above is already checkpointed if this faults)
     uf_host, vf_host = np.asarray(uf), np.asarray(vf)
