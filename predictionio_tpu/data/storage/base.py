@@ -429,6 +429,25 @@ def _remap_vocab(
     return sorted_vocab, new_codes
 
 
+def _rows_canonical(event_ids: list[str], timestamps: np.ndarray) -> bool:
+    """True iff rows are already in (timestamp, event_id) lexsort order.
+
+    O(n) timestamp diff; event-id string comparisons only at timestamp
+    ties (vectorized when bulk imports make ties pervasive)."""
+    if len(timestamps) < 2:
+        return True
+    d = np.diff(timestamps)
+    if np.any(d < 0):
+        return False
+    ties = np.flatnonzero(d == 0)
+    if len(ties) == 0:
+        return True
+    if len(ties) > 1024:  # one object-array build beats a python loop
+        ev = np.asarray(event_ids, dtype=object)
+        return bool(np.all(ev[ties] <= ev[ties + 1]))
+    return all(event_ids[int(i)] <= event_ids[int(i) + 1] for i in ties)
+
+
 def canonical_order(
     cols: "ColumnarEvents",
     frozen_entity_vocab: bool = False,
@@ -460,8 +479,12 @@ def canonical_order(
     if not frozen_target_vocab:
         tgt_vocab, tgt_ids = _remap_vocab(tgt_vocab, tgt_ids)
     ev_vocab, ev_codes = _remap_vocab(cols.event_vocab, cols.event_codes)
-    order = np.lexsort((np.asarray(cols.event_ids), cols.timestamps))
-    if np.array_equal(order, np.arange(n)):
+    # O(n) already-sorted precheck before the O(n log n) lexsort: the
+    # common consumer chain canonicalizes twice (driver to_columnar, then
+    # the snapshot cache on the same result), and the second pass must be
+    # cheap. Rows are canonical iff timestamps are nondecreasing and
+    # event_ids are nondecreasing within equal timestamps.
+    if _rows_canonical(cols.event_ids, cols.timestamps):
         if ent_ids is cols.entity_ids and tgt_ids is cols.target_ids and (
             ev_codes is cols.event_codes
         ):
@@ -475,6 +498,7 @@ def canonical_order(
             target_vocab=tgt_vocab,
             event_vocab=ev_vocab,
         )
+    order = np.lexsort((np.asarray(cols.event_ids), cols.timestamps))
     take = order.tolist()
     return ColumnarEvents(
         event_ids=[cols.event_ids[i] for i in take],

@@ -933,8 +933,8 @@ class ESPEvents(base.PEvents):
             # exports, multi-host ingest, golden tests — are reproducible
             return base.canonical_order(
                 super().to_columnar(app_id, channel_id, **kw),
-                frozen_entity_vocab="entity_vocab" in kw,
-                frozen_target_vocab="target_vocab" in kw,
+                frozen_entity_vocab=kw.get("entity_vocab") is not None,
+                frozen_target_vocab=kw.get("target_vocab") is not None,
             )
         return super().to_columnar(app_id, channel_id, **kw)
 

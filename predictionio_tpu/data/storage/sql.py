@@ -27,6 +27,7 @@ import dataclasses
 import datetime as _dt
 import importlib
 import json
+import logging
 import threading
 import uuid
 from typing import Iterable, Iterator, Sequence
@@ -49,6 +50,8 @@ from predictionio_tpu.data.storage.sqlite import (
     _micros,
     _offset_of,
 )
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -721,8 +724,16 @@ class SQLPEvents(base.PEvents):
                     cache[table] = True
                 finally:
                     conn.close()
-            except Exception:
-                cache[table] = False
+            except Exception as exc:
+                # do NOT cache: a transient failure (server blip, connection
+                # limit) must not silently downgrade every later bulk scan
+                # of this table to serial for the process lifetime — the
+                # next scan re-probes. Only a successful probe is sticky.
+                logger.warning(
+                    "partition probe for %s failed (scanning serial this "
+                    "time): %s", table, exc
+                )
+                return False
         return cache[table]
 
     def find_partitioned(
@@ -774,7 +785,19 @@ class SQLPEvents(base.PEvents):
             # not serialize on the client's shared-connection lock
             conn = self._c._connect()
             try:
-                cur = conn.cursor()
+                # server-side (named) cursor where the dialect has one
+                # (postgres): a client-side cursor materializes the WHOLE
+                # partition at execute() — at ML-20M / 4 partitions that is
+                # ~5M rows held per partition, the exact OOM query_iter's
+                # streaming exists to avoid (same rationale, :233-240)
+                cur = None
+                if self._c.dialect.use_returning:
+                    try:
+                        cur = conn.cursor(name=f"pio_part_{uuid.uuid4().hex[:8]}")
+                    except TypeError:
+                        cur = None
+                if cur is None:
+                    cur = conn.cursor()
                 cur.execute(sql, tuple(params) + (p_lo, p_hi))
                 while True:
                     rows = cur.fetchmany(10_000)
@@ -842,8 +865,8 @@ class SQLPEvents(base.PEvents):
             kw["events"] = self.find_parallel(app_id, channel_id, **filters)
             return base.canonical_order(
                 super().to_columnar(app_id, channel_id, **kw),
-                frozen_entity_vocab="entity_vocab" in kw,
-                frozen_target_vocab="target_vocab" in kw,
+                frozen_entity_vocab=kw.get("entity_vocab") is not None,
+                frozen_target_vocab=kw.get("target_vocab") is not None,
             )
         return super().to_columnar(app_id, channel_id, **kw)
 

@@ -191,8 +191,8 @@ class PEventStore:
                 cols = take_host_blocks(
                     canonical_order(
                         cols,
-                        frozen_entity_vocab="entity_vocab" in kwargs,
-                        frozen_target_vocab="target_vocab" in kwargs,
+                        frozen_entity_vocab=kwargs.get("entity_vocab") is not None,
+                        frozen_target_vocab=kwargs.get("target_vocab") is not None,
                     ),
                     host_index,
                     host_count,

@@ -158,8 +158,8 @@ class SnapshotCache:
                     rating_key=rating_key,
                     **find_kwargs,
                 ),
-                frozen_entity_vocab="entity_vocab" in find_kwargs,
-                frozen_target_vocab="target_vocab" in find_kwargs,
+                frozen_entity_vocab=find_kwargs.get("entity_vocab") is not None,
+                frozen_target_vocab=find_kwargs.get("target_vocab") is not None,
             )
             if stamp is not None:
                 self._write(d, cols, signature)

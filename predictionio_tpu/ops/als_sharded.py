@@ -39,7 +39,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.ops.als import ALSConfig, _host_group_by, _solve_blocked
+from predictionio_tpu.ops.als import (
+    ALSConfig,
+    _host_group_by,
+    _pad_blocks,
+    _solve_blocked,
+)
 
 try:  # stable home since jax 0.8
     from jax import shard_map  # type: ignore[attr-defined]
@@ -89,8 +94,7 @@ def _block_partition_blocked(
     start = np.concatenate([[0], np.cumsum(deg)])
     nblk = -(-deg // d)  # blocks per entity (0 for unrated entities)
     per_dev_blocks = nblk.reshape(n_dev, block).sum(axis=1)
-    nb_real_max = int(per_dev_blocks.max())
-    nb = max(nb_real_max + (-nb_real_max) % block_chunk, block_chunk)
+    nb = _pad_blocks(int(per_dev_blocks.max()), block_chunk)
     br = np.full((n_dev, nb), block, np.int32)
     cols = np.zeros((n_dev, nb, d), np.int32)
     v = np.zeros((n_dev, nb, d), np.float32)

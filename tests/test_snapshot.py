@@ -175,6 +175,57 @@ def test_partially_frozen_vocab_still_canonicalizes_the_rest(sqlite_pevents):
     np.testing.assert_array_equal(a.event_codes, b.event_codes)
 
 
+def test_explicit_none_vocab_is_not_frozen(sqlite_pevents):
+    """Passing entity_vocab=None explicitly (a natural way to thread an
+    optional vocab) must be treated as NOT frozen: the presence-keyed
+    check used to skip the canonical remap exactly on the
+    nondeterministic-scan path it exists for (code-review r4 #2)."""
+    events = list(sqlite_pevents.find(1))
+    rng = np.random.default_rng(2)
+    shuffled = [events[i] for i in rng.permutation(len(events))]
+    a = sqlite_pevents.to_columnar(
+        1, events=iter(events), entity_vocab=None, target_vocab=None
+    )
+    b = sqlite_pevents.to_columnar(
+        1, events=iter(shuffled), entity_vocab=None, target_vocab=None
+    )
+    # sqlite's to_columnar path canonicalizes only through the snapshot
+    # cache; emulate the driver-level call the parallel-scan drivers make
+    from predictionio_tpu.data.store.snapshot import canonical_order
+
+    def canon(cols, kw):
+        return canonical_order(
+            cols,
+            frozen_entity_vocab=kw.get("entity_vocab") is not None,
+            frozen_target_vocab=kw.get("target_vocab") is not None,
+        )
+
+    kw = {"entity_vocab": None, "target_vocab": None}
+    a, b = canon(a, kw), canon(b, kw)
+    assert a.entity_vocab == b.entity_vocab == sorted(a.entity_vocab)
+    np.testing.assert_array_equal(a.entity_ids, b.entity_ids)
+    np.testing.assert_array_equal(a.target_ids, b.target_ids)
+
+
+def test_rows_canonical_precheck():
+    """The O(n) precheck must agree with the lexsort on sortedness,
+    including timestamp ties decided by event_id order."""
+    from predictionio_tpu.data.storage.base import _rows_canonical
+
+    assert _rows_canonical([], np.asarray([], np.int64))
+    assert _rows_canonical(["a"], np.asarray([5], np.int64))
+    assert _rows_canonical(["a", "b"], np.asarray([1, 2], np.int64))
+    assert _rows_canonical(["a", "b"], np.asarray([1, 1], np.int64))
+    assert not _rows_canonical(["b", "a"], np.asarray([1, 1], np.int64))
+    assert not _rows_canonical(["a", "b"], np.asarray([2, 1], np.int64))
+    # vectorized tie path (> 1024 ties)
+    n = 3000
+    ids = [f"e{i:06d}" for i in range(n)]
+    ts = np.zeros(n, np.int64)
+    assert _rows_canonical(ids, ts)
+    assert not _rows_canonical(list(reversed(ids)), ts)
+
+
 def test_sqlite_stamp_changes_on_delete_plus_reinsert(sqlite_pevents):
     """Delete the newest event and insert a replacement with the same
     eventTime: sqlite reuses the freed max rowid, so the stamp must come
