@@ -1024,6 +1024,110 @@ def phase_secondary(ck: _Checkpoint) -> None:
         # columnar shards, not the row store (target: warm < 10% of cold)
         snapshot_ingest_ratio=round(warm / cold, 4) if cold else None,
     )
+    eps, p50 = _bench_event_ingest()
+    ck.save(
+        # ingestion surface (the reference's other hot path): batched POSTs
+        # of 50 events/request (the contract cap) through the real aiohttp
+        # event server over loopback, auth + validation + storage included
+        event_ingest_eps=round(eps, 1),
+        event_ingest_batch_p50_ms=round(p50, 3),
+    )
+
+
+def _bench_event_ingest(
+    n_batches: int = 40, batch_size: int = 50
+) -> tuple[float, float]:
+    """Event-server ingest throughput: real HTTP batch POSTs (50/request,
+    the reference's hard cap, EventServer.scala:70) against the in-memory
+    store over loopback. Returns (events/s, per-batch p50 ms)."""
+    import asyncio
+    import http.client
+    import socket
+    import threading
+
+    import numpy as np
+
+    from predictionio_tpu.data.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.data.storage.base import AccessKey, App
+    from predictionio_tpu.data.storage.registry import Storage
+
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    app_id = storage.get_meta_data_apps().insert(App(0, "ingestbench"))
+    storage.get_meta_data_access_keys().insert(AccessKey("ingestkey", app_id, ()))
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    loop = asyncio.new_event_loop()
+    ready = threading.Event()
+
+    def serve() -> None:
+        asyncio.set_event_loop(loop)
+        server = EventServer(
+            storage=storage, config=EventServerConfig(ip="127.0.0.1", port=port)
+        )
+        loop.run_until_complete(server.start())
+        ready.set()
+        loop.run_forever()
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    if not ready.wait(timeout=30):
+        raise RuntimeError("event server failed to start for the ingest bench")
+
+    rng = np.random.default_rng(9)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    path = "/batch/events.json?accessKey=ingestkey"
+
+    def post_batch() -> None:
+        body = json.dumps(
+            [
+                {
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": f"u{int(u)}",
+                    "targetEntityType": "item",
+                    "targetEntityId": f"i{int(i)}",
+                    "properties": {"rating": float(i % 5 + 1)},
+                }
+                for u, i in zip(
+                    rng.integers(0, 5000, batch_size),
+                    rng.integers(0, 2000, batch_size),
+                )
+            ]
+        )
+        conn.request("POST", path, body, {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        payload = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(f"ingest bench batch failed: {resp.status} {payload[:200]}")
+
+    post_batch()  # warm (routes, json codecs, first insert)
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        t1 = time.perf_counter()
+        post_batch()
+        lat.append(time.perf_counter() - t1)
+    elapsed = time.perf_counter() - t0
+    loop.call_soon_threadsafe(loop.stop)
+    thread.join(timeout=10)
+    conn.close()
+    return (
+        n_batches * batch_size / elapsed,
+        float(np.percentile(np.asarray(lat) * 1000.0, 50)),
+    )
 
 
 def _bench_snapshot_ingest(n_events: int = 200_000) -> tuple[float, float]:
