@@ -1072,12 +1072,15 @@ def _bench_event_ingest(
     loop = asyncio.new_event_loop()
     ready = threading.Event()
 
+    server_box: dict = {}
+
     def serve() -> None:
         asyncio.set_event_loop(loop)
         server = EventServer(
             storage=storage, config=EventServerConfig(ip="127.0.0.1", port=port)
         )
         loop.run_until_complete(server.start())
+        server_box["server"] = server
         ready.set()
         loop.run_forever()
 
@@ -1121,9 +1124,16 @@ def _bench_event_ingest(
         post_batch()
         lat.append(time.perf_counter() - t1)
     elapsed = time.perf_counter() - t0
+    conn.close()
+    # graceful aiohttp runner cleanup ON its loop, then stop it (a bare
+    # loop.stop leaves the keep-alive handler task pending and noisy)
+    stop_fut = asyncio.run_coroutine_threadsafe(server_box["server"].stop(), loop)
+    try:
+        stop_fut.result(timeout=10)
+    except Exception:
+        pass
     loop.call_soon_threadsafe(loop.stop)
     thread.join(timeout=10)
-    conn.close()
     return (
         n_batches * batch_size / elapsed,
         float(np.percentile(np.asarray(lat) * 1000.0, 50)),

@@ -264,7 +264,13 @@ class EventServer:
                 "Batch request must have less than or equal to "
                 f"{MAX_EVENTS_PER_BATCH_REQUEST} events",
             )
-        results: list[dict[str, Any]] = []
+        # decode + allowed-event checks inline (cheap, no storage); then ONE
+        # executor hop processes every insert — the per-event loop used to
+        # pay 50 run_in_executor round-trips per batch request. Per-event
+        # semantics are unchanged: same status array order, per-event error
+        # isolation, blockers/sniffers per event, bookkeeping on 201 only.
+        results: list[dict[str, Any] | None] = []
+        to_insert: list[tuple[int, Event]] = []  # (result slot, event)
         for item in payload:
             try:
                 event = Event.from_json_dict(item)
@@ -276,14 +282,26 @@ class EventServer:
                     {"status": 403, "message": f"{event.event} events are not allowed"}
                 )
                 continue
-            try:
-                status, body = await self._run(self._insert_one, auth, event)
-                results.append({"status": status, **body})
-                self._bookkeep(auth.app_id, status, event)
-            except BlockedEvent as exc:
-                results.append({"status": 403, "message": str(exc)})
-            except Exception as exc:
-                results.append({"status": 500, "message": str(exc)})
+            results.append(None)
+            to_insert.append((len(results) - 1, event))
+
+        def insert_all() -> list[tuple[int, Event, int, dict[str, Any]]]:
+            out = []
+            for slot, event in to_insert:
+                try:
+                    status, body = self._insert_one(auth, event)
+                except BlockedEvent as exc:
+                    status, body = 403, {"message": str(exc)}
+                except Exception as exc:
+                    status, body = 500, {"message": str(exc)}
+                out.append((slot, event, status, body))
+            return out
+
+        if to_insert:
+            for slot, event, status, body in await self._run(insert_all):
+                results[slot] = {"status": status, **body}
+                if status == 201:
+                    self._bookkeep(auth.app_id, status, event)
         return web.json_response(results)
 
     async def handle_stats(self, request: web.Request) -> web.Response:
