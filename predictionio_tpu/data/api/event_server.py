@@ -62,18 +62,9 @@ class EventServerConfig:
     ssl_keyfile: str | None = None
 
     def ssl_context(self):
-        if bool(self.ssl_certfile) != bool(self.ssl_keyfile):
-            # one without the other would silently serve plaintext
-            raise ValueError(
-                "TLS misconfigured: both ssl_certfile and ssl_keyfile are required"
-            )
-        if not self.ssl_certfile:
-            return None
-        import ssl
+        from predictionio_tpu.utils.tls import server_ssl_context
 
-        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        ctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
-        return ctx
+        return server_ssl_context(self.ssl_certfile, self.ssl_keyfile)
 
 
 class BlockedEvent(Exception):

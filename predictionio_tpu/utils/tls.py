@@ -1,0 +1,27 @@
+"""Shared server-side TLS setup (ref ``common/.../SSLConfiguration.scala:33``
+— one keystore config served both the event server and the engine server).
+
+Both aiohttp servers (event server, query server) build their SSLContext
+here so TLS policy changes (minimum version, cert reload) happen once.
+"""
+
+from __future__ import annotations
+
+
+def server_ssl_context(certfile: str | None, keyfile: str | None):
+    """SSLContext from a cert/key pair; None when TLS is off.
+
+    Raises when exactly one of the pair is set — that misconfiguration
+    would otherwise silently serve plaintext.
+    """
+    if bool(certfile) != bool(keyfile):
+        raise ValueError(
+            "TLS misconfigured: both ssl_certfile and ssl_keyfile are required"
+        )
+    if not certfile:
+        return None
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile)
+    return ctx

@@ -80,21 +80,39 @@ def run_train(
     (ref: the Spark driver was the single metadata writer,
     CoreWorkflow.scala:45-102).
     """
-    import jax
-
     storage = storage or Storage.instance()
     ctx = ctx or WorkflowContext(mode="training", _storage=storage, batch=batch)
-    if jax.process_count() > 1 and jax.process_index() != 0:
-        with _maybe_profile():
-            models = engine.train(ctx, engine_params, options)
-        if not (options and (options.stop_after_read or options.stop_after_prepare)):
-            # serialization includes the cross-host gather of sharded model
-            # arrays (model_to_host), which is itself a collective — every
-            # process must run it even though only process 0 persists
-            engine.make_serializable_models(ctx, engine_params, models)
-        CleanupFunctions.run()
-        logger.info("process %d finished (coordinator persists)", jax.process_index())
-        return ""
+    # multi-host detection via the launcher's env contract, NOT
+    # jax.process_count(): calling into jax here would initialize the XLA
+    # backend for every train — including pure-host LocalAlgorithm engines
+    # that never touch jax — contending for the accelerator with any
+    # already-deployed server on the same machine
+    if os.environ.get("PIO_COORDINATOR") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    ):
+        import jax
+
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            try:
+                with _maybe_profile():
+                    models = engine.train(ctx, engine_params, options)
+                if not (
+                    options
+                    and (options.stop_after_read or options.stop_after_prepare)
+                ):
+                    # serialization includes the cross-host gather of sharded
+                    # model arrays (model_to_host), which is itself a
+                    # collective — every process must run it even though only
+                    # process 0 persists
+                    engine.make_serializable_models(ctx, engine_params, models)
+            finally:
+                # same contract as the coordinator path's finally: cleanup
+                # hooks run even when a worker's collective aborts
+                CleanupFunctions.run()
+            logger.info(
+                "process %d finished (coordinator persists)", jax.process_index()
+            )
+            return ""
     instances = storage.get_meta_data_engine_instances()
     params_json = Engine.engine_params_to_json(engine_params)
     instance = EngineInstance(

@@ -70,18 +70,9 @@ class ServerConfig:
     batch_window_ms: float = 0.0
 
     def ssl_context(self):
-        if bool(self.ssl_certfile) != bool(self.ssl_keyfile):
-            # one without the other would silently serve plaintext
-            raise ValueError(
-                "TLS misconfigured: both ssl_certfile and ssl_keyfile are required"
-            )
-        if not self.ssl_certfile:
-            return None
-        import ssl
+        from predictionio_tpu.utils.tls import server_ssl_context
 
-        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        ctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
-        return ctx
+        return server_ssl_context(self.ssl_certfile, self.ssl_keyfile)
 
 
 class _MicroBatcher:
@@ -133,19 +124,31 @@ class _MicroBatcher:
             self._task = asyncio.ensure_future(self._run())
         return await fut
 
+    @staticmethod
+    def _fail_batch(batch: list, exc: BaseException) -> None:
+        for _, fut in batch:
+            if not fut.done():
+                fut.set_exception(exc)
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
             item = await self._queue.get()
             batch = [item]
-            if self.window_s > 0:
-                await asyncio.sleep(self.window_s)
-            while len(batch) < self.max_batch:
-                try:
-                    batch.append(self._queue.get_nowait())
-                except asyncio.QueueEmpty:
-                    break
-            await self._inflight.acquire()  # bound batches in flight
+            try:
+                if self.window_s > 0:
+                    await asyncio.sleep(self.window_s)
+                while len(batch) < self.max_batch:
+                    try:
+                        batch.append(self._queue.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                await self._inflight.acquire()  # bound batches in flight
+            except asyncio.CancelledError:
+                # shutdown while holding a collected-but-undispatched batch:
+                # its clients must get a response, not an eternal await
+                self._fail_batch(batch, RuntimeError("query server is shutting down"))
+                raise
             try:
                 finalize = await loop.run_in_executor(
                     self._dispatch_pool,
@@ -174,7 +177,10 @@ class _MicroBatcher:
         try:
             outs = await loop.run_in_executor(self._fetch_pool, finalize)
         except asyncio.CancelledError:
-            raise  # don't convert shutdown into client-visible errors
+            # shutdown: resolve the batch's futures (handlers awaiting them
+            # would otherwise hang for aiohttp's whole shutdown timeout)
+            self._fail_batch(batch, RuntimeError("query server is shutting down"))
+            raise
         except BaseException as exc:
             outs = [exc] * len(batch)
         finally:
@@ -193,6 +199,17 @@ class _MicroBatcher:
             self._task = None
         for task in list(self._finish_tasks):
             task.cancel()
+        # fail everything still queued: enqueued-but-never-collected items
+        # have handlers awaiting their futures (collected/dispatched batches
+        # are resolved by the _run/_finish cancellation paths)
+        exc = RuntimeError("query server is shutting down")
+        while True:
+            try:
+                _, fut = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if not fut.done():
+                fut.set_exception(exc)
         self._dispatch_pool.shutdown(wait=False, cancel_futures=True)
         self._fetch_pool.shutdown(wait=False, cancel_futures=True)
 
@@ -220,8 +237,16 @@ class QueryServer:
         self.storage = storage or Storage.instance()
         self.config = config or ServerConfig()
         self.plugin_context = plugin_context or EngineServerPluginContext()
-        _, _, self.algorithms, self.serving = engine.make_components(engine_params)
-        self.models = models
+        _, _, algorithms, serving = engine.make_components(engine_params)
+        # (algorithms, serving, models) live in ONE tuple swapped atomically:
+        # the dispatch thread snapshots it in a single attribute read, so a
+        # concurrent /reload can never pair new algorithms with old models
+        # (attribute-by-attribute assignment allowed exactly that interleave)
+        self._active: tuple[list[Any], Any, list[Any]] = (
+            algorithms,
+            serving,
+            models,
+        )
         self.start_time = _dt.datetime.now(tz=UTC)
         self.request_count = 0
         self.avg_serving_sec = 0.0
@@ -290,9 +315,9 @@ class QueryServer:
         Per-query failures are isolated: the failing slot gets its
         exception, batch mates answer normally. Finalize returns one entry
         per payload — an encoded result body or an exception."""
-        # capture component refs so an in-flight batch is immune to /reload
-        algorithms, models = self.algorithms, self.models
-        serving = self.serving
+        # ONE read of the atomic tuple: an in-flight batch is immune to
+        # /reload and always sees a consistent (algorithms, serving, models)
+        algorithms, serving, models = self._active
         n = len(payloads)
         outs: list[Any] = [None] * n
         queries: list[Any] = [None] * n
@@ -467,11 +492,9 @@ class QueryServer:
         except Exception as exc:
             logger.exception("reload failed")
             return web.json_response({"message": str(exc)}, status=500)
-        _, _, self.algorithms, self.serving = self.engine.make_components(
-            engine_params
-        )
+        _, _, algorithms, serving = self.engine.make_components(engine_params)
         self.engine_params = engine_params
-        self.models = models
+        self._active = (algorithms, serving, models)  # atomic swap
         self.instance_id = latest.id
         await asyncio.get_running_loop().run_in_executor(None, self._warmup)
         logger.info("reloaded engine instance %s", latest.id)
@@ -518,10 +541,23 @@ class QueryServer:
         app.on_cleanup.append(_close_batcher)
         return app
 
+    @property
+    def algorithms(self) -> list[Any]:
+        return self._active[0]
+
+    @property
+    def serving(self) -> Any:
+        return self._active[1]
+
+    @property
+    def models(self) -> list[Any]:
+        return self._active[2]
+
     def _warmup(self) -> None:
         """Pre-compile serving programs (pow2 batch buckets etc.) so the
         first traffic burst after deploy/reload pays no XLA compiles."""
-        for algo, model in zip(self.algorithms, self.models):
+        algorithms, _, models = self._active
+        for algo, model in zip(algorithms, models):
             try:
                 algo.warmup_serving(model, self.config.max_batch_size)
             except Exception:

@@ -42,7 +42,8 @@ class FakeRun:
     ``run_evaluation`` and ``pio eval`` (ref ``FakeRun`` trait usage:
     ``pio eval HelloWorld`` with ``func = f``).
 
-    Subclass and set ``func``, or construct with the function::
+    Subclass and set ``func`` (plain function, ``@staticmethod``, or a
+    lambda — all three spellings work), or construct with the function::
 
         class HelloWorld(FakeRun):
             @staticmethod
@@ -57,7 +58,19 @@ class FakeRun:
             self.func = func  # type: ignore[assignment]
 
     def run(self, ctx: WorkflowContext) -> FakeEvalResult:
-        fn = self.func
+        # instance attribute first (set by __init__ — a plain function there
+        # never binds); then the CLASS DICT, bypassing descriptor binding: a
+        # plain function assigned as `func = my_fn` (the natural spelling,
+        # @staticmethod omitted) would otherwise arrive as a bound method
+        # and receive the FakeRun instance in place of the context
+        fn = self.__dict__.get("func")
+        if fn is None:
+            for klass in type(self).__mro__:
+                if "func" in klass.__dict__:
+                    fn = klass.__dict__["func"]
+                    break
+        if isinstance(fn, (staticmethod, classmethod)):
+            fn = fn.__get__(None, type(self))
         if fn is None:
             raise ValueError("FakeRun has no func")
         return FakeEvalResult(value=fn(ctx))

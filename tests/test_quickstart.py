@@ -325,6 +325,32 @@ class TestMicroBatchServing:
 
         asyncio.run(body())
 
+    def test_shutdown_resolves_pending_queries(self, seeded_storage):
+        """Closing the batcher mid-flight must RESOLVE every pending future
+        (shutdown error), not abandon it: an awaiting handler would
+        otherwise hang for aiohttp's whole shutdown timeout
+        (code-review r4 #2)."""
+        server = self._make_server(seeded_storage, batch_window_ms=5000.0)
+
+        async def body():
+            # a huge flush window guarantees the requests are queued (not
+            # yet dispatched) when close() lands
+            tasks = [
+                asyncio.ensure_future(
+                    server._batcher.submit({"user": "u0", "num": 2})
+                )
+                for _ in range(4)
+            ]
+            await asyncio.sleep(0.05)  # let the collect task pick up item 1
+            server._batcher.close()
+            results = await asyncio.wait_for(
+                asyncio.gather(*tasks, return_exceptions=True), timeout=5.0
+            )
+            assert all(isinstance(r, Exception) for r in results), results
+            assert any("shutting down" in str(r) for r in results)
+
+        asyncio.run(body())
+
     def test_predict_batch_matches_predict(self, seeded_storage):
         """ALS predict_batch must agree with the single-query path across
         known users, unknown users, per-query num, and blacklists."""

@@ -253,6 +253,31 @@ class TestFakeRun:
         with pytest.raises(ValueError):
             FakeRun().run(WorkflowContext(mode="evaluation"))
 
+    def test_plain_function_class_attribute(self, memory_storage):
+        """`func = my_fn` without @staticmethod (the natural spelling) must
+        receive the WorkflowContext, not a bound FakeRun instance
+        (code-review r4: descriptor binding turned it into a method)."""
+        from predictionio_tpu.workflow.context import WorkflowContext
+        from predictionio_tpu.workflow.fake_workflow import FakeRun
+
+        def my_fn(ctx):
+            return ctx.mode
+
+        class Hello(FakeRun):
+            func = my_fn
+
+        result = Hello().run(WorkflowContext(mode="evaluation"))
+        assert result.value == "evaluation"
+
+    def test_lambda_class_attribute(self):
+        from predictionio_tpu.workflow.context import WorkflowContext
+        from predictionio_tpu.workflow.fake_workflow import FakeRun
+
+        class Hello(FakeRun):
+            func = lambda ctx: ctx.mode  # noqa: E731
+
+        assert Hello().run(WorkflowContext(mode="evaluation")).value == "evaluation"
+
 
 class TestRemoteLog:
     """Ref CreateServer.scala:423-434,595-611 — --log-url ships serving
