@@ -91,6 +91,17 @@ class _ESTransport:
                     f"{method} {path}: HTTP {exc.code}: {exc.read()[:200]!r}"
                 ) from exc
             except (urllib.error.URLError, OSError) as exc:
+                if not _retry_safe(method, path, exc):
+                    # the request may have been APPLIED before the connection
+                    # died; replaying a non-idempotent op on another endpoint
+                    # double-executes it (_update double-increments a
+                    # sequence; a replayed _create 409s and orphans its
+                    # sentinel). Surface the ambiguity instead.
+                    raise ESError(
+                        f"{method} {path}: connection failed after send and "
+                        f"the operation is not idempotent — not retried on "
+                        f"another endpoint: {exc}"
+                    ) from exc
                 last = exc  # node down: try the next endpoint
         raise ESError(f"all elasticsearch endpoints failed: {last}") from last
 
@@ -114,8 +125,32 @@ class _ESTransport:
                     f"_bulk: HTTP {exc.code}: {exc.read()[:200]!r}"
                 ) from exc
             except (urllib.error.URLError, OSError) as exc:
+                # bulk bodies here carry only explicit-_id index/delete
+                # actions (idempotent overwrite/delete), so cross-endpoint
+                # replay after an ambiguous failure is safe
                 last = exc
         raise ESError(f"all elasticsearch endpoints failed: {last}") from last
+
+
+def _retry_safe(method: str, path: str, exc: Exception) -> bool:
+    """May this failed request be replayed on another endpoint?
+
+    Always when the connection was refused (nothing reached the server).
+    Otherwise only for idempotent operations: GET/HEAD, and PUT/DELETE of
+    addressed documents — but NOT ``_update`` scripts (replay re-applies
+    the script) or ``_create`` (replay 409s and the caller misreads it as
+    "already taken").
+    """
+    reason = getattr(exc, "reason", exc)
+    if isinstance(reason, ConnectionRefusedError):
+        return True
+    if method in ("GET", "HEAD"):
+        return True
+    if method in ("PUT", "DELETE") and "/_update/" not in path and (
+        "/_create/" not in path
+    ):
+        return True
+    return False
 
 
 def _iso(ts: _dt.datetime | None) -> str | None:
@@ -129,7 +164,12 @@ def _parse_iso(s: str | None) -> _dt.datetime | None:
 # Dynamic mapping would analyze strings as text, so term queries on values
 # like "$set" or "MyApp1" would match nothing on a real server (the mock does
 # exact equality and can't catch this). Every index is created with string
-# fields mapped to keyword and *Time fields to date.
+# fields mapped to keyword and *Time fields to date. The explicit
+# ``properties`` exist because dynamic templates only materialize mappings
+# as documents arrive: a sorted query against an EMPTY index 400s on a real
+# server ("No mapping found for [eventTime] in order to sort on") unless the
+# sorted fields are mapped at creation — which broke every fresh-app read,
+# version stamp, and first-deploy instance lookup (code-review r4).
 _INDEX_MAPPINGS = {
     "mappings": {
         "dynamic_templates": [
@@ -145,15 +185,35 @@ _INDEX_MAPPINGS = {
                     "mapping": {"type": "keyword"},
                 }
             },
-        ]
+        ],
+        # every field any DAO sorts on, shared across index types (an
+        # unused mapping is harmless; an unmapped sort field is a 400)
+        "properties": {
+            "eventTime": {"type": "date"},
+            "creationTime": {"type": "date"},
+            "eventId": {"type": "keyword"},
+            "startTime": {"type": "date"},
+            "endTime": {"type": "date"},
+        },
     }
 }
 
 
 def _ensure_index(transport: _ESTransport, index: str) -> None:
-    transport.request(
+    out = transport.request(
         "PUT", f"/{index}", body=_INDEX_MAPPINGS, ok_statuses=(400,)
     )
+    err = out.get("error")
+    if err is None:
+        return
+    # only "already exists" may be swallowed: any other 400 (invalid index
+    # name, rejected mapping body) would otherwise let the first write
+    # auto-create the index with analyzed-text dynamic mappings, where every
+    # term query silently matches nothing. Real ES wraps the type in a dict;
+    # the protocol mock reports it as a bare string.
+    etype = err.get("type") if isinstance(err, dict) else err
+    if "resource_already_exists" not in str(etype):
+        raise ESError(f"index create {index} failed: {err}")
 
 
 # ---------------------------------------------------------------------------
@@ -558,7 +618,7 @@ class ESEngineInstances(base.EngineInstances):
                     ]
                 }
             },
-            sort=[{"startTime": {"order": "desc"}}],
+            sort=[{"startTime": {"order": "desc", "unmapped_type": "date"}}],
         )
         return [_doc_to_instance(d) for d in hits]
 
@@ -629,7 +689,7 @@ class ESEvaluationInstances(base.EvaluationInstances):
     def get_completed(self) -> list[EvaluationInstance]:
         hits = self._docs.search(
             {"term": {"status": "EVALCOMPLETED"}},
-            sort=[{"startTime": {"order": "desc"}}],
+            sort=[{"startTime": {"order": "desc", "unmapped_type": "date"}}],
         )
         return [_doc_to_eval(d) for d in hits]
 
@@ -712,7 +772,15 @@ class ESLEvents(base.LEvents):
     ) -> list[str]:
         """One ``_bulk`` request + one refresh for the whole batch (a
         per-event loop would pay an HTTP round trip and an index refresh
-        per document)."""
+        per document).
+
+        Partial-failure contract: ``_bulk`` is non-atomic, so on rejection
+        the raised :class:`ESError` carries ``indexed_ids`` (the documents
+        that DID land, in batch order). All documents are written with
+        explicit ``_id``s, so retrying with the same event ids (pass events
+        whose ``event_id`` is already set, e.g. from the error's
+        ``attempted_ids``) is an idempotent overwrite, never a duplicate.
+        """
         if not events:
             return []
         index = self._docs(app_id, channel_id)._index  # ensures mappings
@@ -727,12 +795,24 @@ class ESLEvents(base.LEvents):
             ids.append(event_id)
         out = self._t.bulk(lines, params={"refresh": "true"})
         if out.get("errors"):
+            items = out.get("items", [])
             failed = [
-                item["index"]
-                for item in out.get("items", [])
-                if item.get("index", {}).get("error")
+                item["index"] for item in items if item.get("index", {}).get("error")
             ]
-            raise ESError(f"_bulk rejected {len(failed)} event(s): {failed[:3]}")
+            indexed = [
+                item["index"]["_id"]
+                for item in items
+                if not item.get("index", {}).get("error")
+                and item.get("index", {}).get("_id")
+            ]
+            exc = ESError(
+                f"_bulk rejected {len(failed)} of {len(ids)} event(s) "
+                f"({len(indexed)} were indexed; retry with the same ids to "
+                f"overwrite, not duplicate): {failed[:3]}"
+            )
+            exc.indexed_ids = indexed
+            exc.attempted_ids = ids
+            raise exc
         return ids
 
     def get(
@@ -813,7 +893,10 @@ class ESLEvents(base.LEvents):
         )
         order = "desc" if reversed else "asc"
         # eventId tiebreak makes the search_after cursor total-ordered
-        sort = [{"eventTime": {"order": order}}, {"eventId": {"order": order}}]
+        sort = [
+            {"eventTime": {"order": order, "unmapped_type": "date"}},
+            {"eventId": {"order": order, "unmapped_type": "keyword"}},
+        ]
         docs = self._docs(app_id, channel_id)
         if limit is not None and limit <= 10_000:
             hits: Iterable[dict] = docs.search(query, size=limit, sort=sort)
@@ -953,8 +1036,18 @@ class ESPEvents(base.PEvents):
     def delete(
         self, event_ids: Iterable[str], app_id: int, channel_id: int | None = None
     ) -> None:
+        # _bulk delete actions: the per-event loop paid one HTTP round trip
+        # AND one forced index refresh per document (minutes for a 100k-event
+        # self-cleaning pass); one refresh per 1000-doc chunk instead
+        index = self._levents._index(app_id, channel_id)
+        chunk: list[dict] = []
         for event_id in event_ids:
-            self._levents.delete(event_id, app_id, channel_id)
+            chunk.append({"delete": {"_index": index, "_id": event_id}})
+            if len(chunk) >= 1_000:
+                self._t.bulk(chunk, params={"refresh": "true"})
+                chunk = []
+        if chunk:
+            self._t.bulk(chunk, params={"refresh": "true"})
 
     def version_stamp(self, app_id: int, channel_id: int | None = None) -> str | None:
         index = self._levents._index(app_id, channel_id)
@@ -966,7 +1059,9 @@ class ESPEvents(base.PEvents):
             return None
         # count alone misses delete+insert pairs; include the max eventTime
         hits = _ESDocs(self._t, index).search(
-            {"match_all": {}}, size=1, sort=[{"eventTime": {"order": "desc"}}]
+            {"match_all": {}},
+            size=1,
+            sort=[{"eventTime": {"order": "desc", "unmapped_type": "date"}}],
         )
         latest = hits[0].get("eventTime", "") if hits else ""
         return f"{count}:{latest}"

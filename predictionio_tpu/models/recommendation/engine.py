@@ -60,7 +60,7 @@ class Query:
         bl = d.get("blackList")
         return Query(
             user=str(d["user"]),
-            num=int(d.get("num", 10)),
+            num=int(d.get("num", DEFAULT_QUERY_NUM)),
             black_list=frozenset(str(x) for x in bl) if bl is not None else None,
         )
 

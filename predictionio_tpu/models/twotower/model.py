@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 from typing import Any
 
 import jax
@@ -34,6 +35,8 @@ import numpy as np
 import optax
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -432,8 +435,21 @@ def train_two_tower(
     n = len(user_idx)
     losses: list[float] = []
     start_epoch = 0
+    # signature guards resume against a DIFFERENT run reusing the dir: a
+    # changed config (e.g. the catalog grew, so restored embedding tables
+    # would be silently too small — XLA clamps out-of-range gathers) or
+    # changed training data must not resume, and a COMPLETED run's
+    # checkpoint is deleted below so a scheduled retrain can never skip all
+    # its epochs and return the stale parameters (code-review r4)
+    run_signature = _train_signature(config, user_idx, item_idx)
     if config.checkpoint_dir and config.resume:
         state = load_train_checkpoint(config.checkpoint_dir)
+        if state is not None and state.get("signature") != run_signature:
+            logger.warning(
+                "ignoring checkpoint in %s: it belongs to a different "
+                "config/dataset", config.checkpoint_dir
+            )
+            state = None
         if state is not None:
             params = jax.device_put(state["params"], p_shardings)
             # optimizer moments follow their parameter's sharding
@@ -466,8 +482,13 @@ def train_two_tower(
         losses.append(float(loss))
         if config.checkpoint_dir and (epoch + 1) % max(1, config.checkpoint_every) == 0:
             save_train_checkpoint(
-                config.checkpoint_dir, params, opt_state, epoch + 1, losses
+                config.checkpoint_dir, params, opt_state, epoch + 1, losses,
+                signature=run_signature,
             )
+    if config.checkpoint_dir:
+        # the checkpoint exists for crash-resume of THIS run; once complete
+        # it must not survive to turn the next train into a silent no-op
+        clear_train_checkpoint(config.checkpoint_dir)
 
     # Precompute the full item-embedding table for serving top-k.
     @jax.jit
@@ -495,7 +516,32 @@ def user_embedding(
 _CKPT_NAME = "twotower_train_ckpt.bin"
 
 
-def save_train_checkpoint(directory, params, opt_state, epoch: int, losses) -> str:
+def _train_signature(
+    config: TwoTowerConfig, user_idx: np.ndarray, item_idx: np.ndarray
+) -> str:
+    """Identity of one training run: the model-shaping config fields plus a
+    cheap fingerprint of the interaction data. A checkpoint from a run with
+    a different signature must never be resumed — restored embedding
+    tables of the wrong vocab size gather out-of-bounds SILENTLY (XLA
+    clamps), and a different dataset makes 'resume' meaningless."""
+    import hashlib
+
+    u = np.asarray(user_idx, np.int64)
+    i = np.asarray(item_idx, np.int64)
+    h = hashlib.sha1()
+    for a in (u[:4096], u[-4096:], i[:4096], i[-4096:]):
+        h.update(np.ascontiguousarray(a).tobytes())
+    key = (
+        config.n_users, config.n_items, config.embed_dim, tuple(config.hidden),
+        config.out_dim, config.history_len, config.n_heads, config.seed,
+        config.batch_size, len(u), h.hexdigest(),
+    )
+    return hashlib.sha1(repr(key).encode()).hexdigest()
+
+
+def save_train_checkpoint(
+    directory, params, opt_state, epoch: int, losses, signature: str = ""
+) -> str:
     """Atomic epoch checkpoint: params + optimizer moments + progress,
     all pulled to host numpy so the blob is device- and sharding-agnostic
     (same contract as the model repository, ``workflow/model_io.py``)."""
@@ -505,7 +551,15 @@ def save_train_checkpoint(directory, params, opt_state, epoch: int, losses) -> s
 
     host = jax.tree_util.tree_map(lambda x: np.asarray(x), (params, opt_state))
     blob = serialize_models(
-        [{"params": host[0], "opt_state": host[1], "epoch": epoch, "losses": list(losses)}]
+        [
+            {
+                "params": host[0],
+                "opt_state": host[1],
+                "epoch": epoch,
+                "losses": list(losses),
+                "signature": signature,
+            }
+        ]
     )
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, _CKPT_NAME)
@@ -514,6 +568,15 @@ def save_train_checkpoint(directory, params, opt_state, epoch: int, losses) -> s
         fh.write(blob)
     os.replace(tmp, path)
     return path
+
+
+def clear_train_checkpoint(directory) -> None:
+    """Remove a run's checkpoint (called when training completes)."""
+    import contextlib
+    import os
+
+    with contextlib.suppress(FileNotFoundError):
+        os.unlink(os.path.join(directory, _CKPT_NAME))
 
 
 def load_train_checkpoint(directory) -> dict | None:

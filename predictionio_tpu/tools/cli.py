@@ -267,7 +267,13 @@ def cmd_train(args) -> int:
         # via the PIO_COORDINATOR contract and this process supervises
         from predictionio_tpu.parallel.launcher import launch_cli_multihost
 
-        worker_args = _strip_launcher_flags(sys.argv[1:])
+        # the argv main() actually PARSED, not the process's sys.argv: a
+        # programmatic main(["train", ...]) call (test harness, wrapper)
+        # must not spawn workers executing the wrapper's own command line
+        invocation = getattr(args, "_invocation_argv", None)
+        worker_args = _strip_launcher_flags(
+            invocation if invocation is not None else sys.argv[1:]
+        )
         return launch_cli_multihost(
             worker_args, num_hosts=args.num_hosts, hosts=hosts or None
         )
@@ -783,6 +789,9 @@ def main(argv: list[str] | None = None) -> int:
 
     ensure_cpu_if_requested()
     args = build_parser().parse_args(argv)
+    # remember the EXACT argv this invocation parsed (None = process argv);
+    # the multi-host launcher re-execs it in the workers
+    args._invocation_argv = list(argv) if argv is not None else None
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="[%(levelname)s] [%(name)s] %(message)s",

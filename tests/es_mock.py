@@ -20,9 +20,18 @@ from typing import Any
 class _State:
     def __init__(self):
         self.indices: dict[str, dict[str, dict]] = {}
+        # per-index mapped field names: explicit properties from the index-
+        # creation body plus dynamic mappings added as documents arrive —
+        # real ES 400s a sort on an UNMAPPED field (e.g. any sorted query
+        # against a fresh empty index) unless the sort spec carries
+        # ``unmapped_type``, and the mock must reproduce that to catch it
+        self.mappings: dict[str, set[str]] = {}
         self.scrolls: dict[str, dict] = {}  # scroll_id -> {docs, pos, size}
         self.scroll_seq = 0
         self.lock = threading.RLock()
+
+    def note_doc_fields(self, index: str, doc: dict) -> None:
+        self.mappings.setdefault(index, set()).update(doc.keys())
 
 
 def _get_field(doc: dict, field: str):
@@ -108,13 +117,28 @@ class _Handler(BaseHTTPRequestHandler):
                 i = 0
                 while i < len(lines):
                     action = lines[i]
-                    if "index" not in action:
+                    if "index" in action:
+                        meta = action["index"]
+                        doc = lines[i + 1]
+                        st.indices.setdefault(meta["_index"], {})[meta["_id"]] = doc
+                        st.note_doc_fields(meta["_index"], doc)
+                        items.append({"index": {"_id": meta["_id"], "status": 201}})
+                        i += 2
+                    elif "delete" in action:  # no source line follows
+                        meta = action["delete"]
+                        table = st.indices.setdefault(meta["_index"], {})
+                        existed = table.pop(meta["_id"], None) is not None
+                        items.append(
+                            {
+                                "delete": {
+                                    "_id": meta["_id"],
+                                    "status": 200 if existed else 404,
+                                }
+                            }
+                        )
+                        i += 1
+                    else:
                         return self._reply(400, {"error": "unsupported action"})
-                    meta = action["index"]
-                    doc = lines[i + 1]
-                    st.indices.setdefault(meta["_index"], {})[meta["_id"]] = doc
-                    items.append({"index": {"_id": meta["_id"], "status": 201}})
-                    i += 2
                 return self._reply(200, {"errors": False, "items": items})
             # /{index}/_create/{id} — atomic create-if-absent, 409 on exists
             if len(parts) == 3 and parts[1] == "_create" and self.command == "PUT":
@@ -123,6 +147,7 @@ class _Handler(BaseHTTPRequestHandler):
                 if doc_id in table:
                     return self._reply(409, {"error": "version_conflict"})
                 table[doc_id] = self._body()
+                st.note_doc_fields(index, table[doc_id])
                 return self._reply(201, {"result": "created", "_id": doc_id})
             # /{index}/_doc/{id}
             if len(parts) == 3 and parts[1] == "_doc":
@@ -130,6 +155,7 @@ class _Handler(BaseHTTPRequestHandler):
                 table = st.indices.setdefault(index, {})
                 if self.command == "PUT":
                     table[doc_id] = self._body()
+                    st.note_doc_fields(index, table[doc_id])
                     return self._reply(200, {"result": "updated", "_id": doc_id})
                 if self.command == "GET":
                     if doc_id in table:
@@ -234,6 +260,23 @@ class _Handler(BaseHTTPRequestHandler):
                     if _matches(d, body.get("query", {}))
                 ]
                 sort_specs = body.get("sort", [])
+                mapped = st.mappings.get(index, set())
+                for spec in sort_specs:
+                    ((field, opts),) = spec.items()
+                    # real-ES behavior: sorting on a field with no mapping
+                    # (fresh empty index, or field never seen) is HTTP 400
+                    # unless the spec carries unmapped_type
+                    if field not in mapped and "unmapped_type" not in opts:
+                        return self._reply(
+                            400,
+                            {
+                                "error": {
+                                    "type": "search_phase_execution_exception",
+                                    "reason": f"No mapping found for [{field}] "
+                                    "in order to sort on",
+                                }
+                            },
+                        )
                 for spec in reversed(sort_specs):
                     ((field, opts),) = spec.items()
                     docs.sort(
@@ -294,6 +337,13 @@ class _Handler(BaseHTTPRequestHandler):
                             400, {"error": "resource_already_exists_exception"}
                         )
                     st.indices[index] = {}
+                    # explicit mappings from the creation body ARE mapped
+                    # even while the index is empty (dynamic-template rules
+                    # are not — they materialize per arriving document)
+                    props = (
+                        self._body().get("mappings", {}).get("properties", {})
+                    )
+                    st.mappings.setdefault(index, set()).update(props.keys())
                     return self._reply(200, {"acknowledged": True})
                 if self.command == "DELETE":
                     if index in st.indices:

@@ -598,6 +598,65 @@ class TestESDriverSpecifics:
         finally:
             _cleanup_client(c)
 
+    def test_fresh_empty_index_sorted_reads_succeed(self):
+        """Real ES 400s a sort on an unmapped field, and a FRESH index has
+        no mappings for fields that dynamic templates would only create as
+        documents arrive: every sorted read against an empty app (find,
+        version_stamp, get_latest_completed) must still work — via the
+        explicit creation-time properties — not ESError (code-review r4,
+        top finding; the mock now reproduces the 400)."""
+        c = _es_client()
+        try:
+            l = c.l_events()
+            l.init(APP)  # creates the empty event index
+            assert list(l.find(APP)) == []
+            assert list(l.find(APP, reversed=True, limit=5)) == []
+            # version stamp on the empty index (crashed the snapshot cache)
+            stamp = c.p_events().version_stamp(APP)
+            assert stamp is not None
+            # metadata DAO sorted lookups on fresh indices
+            assert (
+                c.engine_instances().get_latest_completed("e", "1", "v") is None
+            )
+            assert c.evaluation_instances().get_completed() == []
+        finally:
+            _cleanup_client(c)
+
+    def test_mock_rejects_sort_on_unmapped_field(self):
+        """Pin the mock's real-ES strictness: sorting on a field no mapping
+        covers (empty index, no unmapped_type) is an error — so a driver
+        regression that drops the explicit properties or unmapped_type
+        fails the suite instead of passing against a lenient mock."""
+        from predictionio_tpu.data.storage.elasticsearch import ESError
+
+        c = _es_client()
+        try:
+            l = c.l_events()
+            l.init(APP)
+            docs = l._docs(APP, None)
+            with pytest.raises(ESError, match="No mapping found"):
+                docs.search(
+                    {"match_all": {}},
+                    size=1,
+                    sort=[{"neverMappedField": {"order": "asc"}}],
+                )
+        finally:
+            _cleanup_client(c)
+
+    def test_batch_delete_via_bulk(self):
+        """PEvents.delete uses _bulk delete actions (one refresh per chunk,
+        not one HTTP round trip + refresh per document)."""
+        c = _es_client()
+        try:
+            l = c.l_events()
+            ids = l.insert_batch([ev(eid=f"d{n}", n=n % 60) for n in range(10)], APP)
+            p = c.p_events()
+            p.delete(ids[:6], APP)
+            remaining = {e.event_id for e in p.find(app_id=APP)}
+            assert remaining == set(ids[6:])
+        finally:
+            _cleanup_client(c)
+
 
 class TestS3Models:
     """S3 driver against an in-process mock that checks SigV4 headers

@@ -680,6 +680,103 @@ class TestTwoTower:
         r = algo.predict(pickle.loads(pickle.dumps(model)), Query(user="u0", num=4))
         assert len(r.item_scores) == 4
 
+    def _ckpt_problem(self):
+        rng = np.random.default_rng(7)
+        u = rng.integers(0, 20, 400).astype(np.int32)
+        i = ((u % 4) * 3 + rng.integers(0, 3, 400)).astype(np.int32)
+        return u, i
+
+    def _ckpt_config(self, tmp_path, **over):
+        from predictionio_tpu.models.twotower.model import TwoTowerConfig
+
+        base = dict(
+            n_users=20, n_items=12, embed_dim=8, hidden=(8,), out_dim=4,
+            batch_size=64, epochs=4, checkpoint_dir=str(tmp_path / "ckpt"),
+        )
+        base.update(over)
+        return TwoTowerConfig(**base)
+
+    def test_completed_run_clears_its_checkpoint(self, tmp_path):
+        """A finished run's checkpoint must not survive: resume=True would
+        otherwise turn the next scheduled retrain into a silent no-op that
+        returns the stale parameters (code-review r4, top finding)."""
+        import os
+
+        from predictionio_tpu.models.twotower.model import (
+            _CKPT_NAME,
+            train_two_tower,
+        )
+
+        u, i = self._ckpt_problem()
+        cfg = self._ckpt_config(tmp_path)
+        r1 = train_two_tower(u, i, cfg)
+        assert len(r1.losses) == 4
+        assert not os.path.exists(os.path.join(cfg.checkpoint_dir, _CKPT_NAME))
+        # the second train actually trains (4 fresh epochs, not a resume)
+        r2 = train_two_tower(u, i, cfg)
+        assert len(r2.losses) == 4
+
+    def test_resume_continues_from_checkpoint(self, tmp_path):
+        """An interrupted run's checkpoint resumes: prior losses are kept
+        and only the remaining epochs run."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np_
+        import optax
+
+        from predictionio_tpu.models.twotower.model import (
+            TwoTower,
+            _train_signature,
+            save_train_checkpoint,
+            train_two_tower,
+        )
+
+        u, i = self._ckpt_problem()
+        cfg = self._ckpt_config(tmp_path)
+        # fabricate epoch-2 state exactly as an interrupted run leaves it
+        model = TwoTower(cfg)
+        z = jnp.zeros((8,), jnp.int32)
+        params = model.init(jax.random.PRNGKey(cfg.seed), z, z)["params"]
+        opt_state = optax.adam(cfg.learning_rate).init(params)
+        host = jax.tree_util.tree_map(np_.asarray, (params, opt_state))
+        save_train_checkpoint(
+            cfg.checkpoint_dir, host[0], host[1], 2, [9.0, 8.5],
+            signature=_train_signature(cfg, u, i),
+        )
+        res = train_two_tower(u, i, cfg)
+        assert res.losses[:2] == [9.0, 8.5]  # carried over
+        assert len(res.losses) == 4  # only epochs 3-4 ran fresh
+
+    def test_stale_checkpoint_from_other_config_ignored(self, tmp_path):
+        """A checkpoint whose signature doesn't match (different dataset or
+        vocab sizes) must be ignored — restoring wrong-shape embedding
+        tables would corrupt silently (XLA clamps OOB gathers)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np_
+        import optax
+
+        from predictionio_tpu.models.twotower.model import (
+            TwoTower,
+            save_train_checkpoint,
+            train_two_tower,
+        )
+
+        u, i = self._ckpt_problem()
+        cfg = self._ckpt_config(tmp_path)
+        model = TwoTower(cfg)
+        z = jnp.zeros((8,), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), z, z)["params"]
+        opt_state = optax.adam(cfg.learning_rate).init(params)
+        host = jax.tree_util.tree_map(np_.asarray, (params, opt_state))
+        save_train_checkpoint(
+            cfg.checkpoint_dir, host[0], host[1], 4, [1.0] * 4,
+            signature="someone-elses-run",
+        )
+        res = train_two_tower(u, i, cfg)
+        # trained from scratch: 4 fresh losses, fabricated ones discarded
+        assert len(res.losses) == 4 and res.losses[:2] != [1.0, 1.0]
+
     def test_build_history_matrix_chronological_pad_end(self):
         from predictionio_tpu.models.twotower.model import build_history_matrix
 
