@@ -199,10 +199,14 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
         )
         results: list[tuple[EI, list[tuple[Q, P, A]]]] = []
         for fold_idx, (td, ei, qa_pairs) in enumerate(data_source.read_eval(ctx)):
-            logger.info("eval fold %d: %d queries", fold_idx, len(list(qa_pairs)))
+            # materialize ONCE before anything reads it: a data source may
+            # yield a generator here (nothing enforces Sequence), and the
+            # old len(list(...)) log line consumed it — the fold would then
+            # evaluate zero queries and the metric silently averaged nothing
+            qa_list = list(qa_pairs)
+            logger.info("eval fold %d: %d queries", fold_idx, len(qa_list))
             pd = preparator.prepare(ctx, td)
             models = [algo.train(ctx, pd) for algo in algorithms]
-            qa_list = list(qa_pairs)
             supplemented = [
                 (i, serving.supplement(q)) for i, (q, _) in enumerate(qa_list)
             ]
@@ -233,22 +237,33 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
              "serving": {"params": {...}}}
         """
 
+        def extract(cls: type, raw: dict, role: str) -> Params | None:
+            params_cls = getattr(cls, "params_class", None)
+            if params_cls is not None:
+                return params_from_dict(params_cls, raw)
+            if raw:
+                # silently training with defaults while the user's
+                # hyperparameters sit in engine.json is the typo-hiding
+                # behavior the strict params_from_dict exists to prevent
+                raise ValueError(
+                    f"{role} component {cls.__name__} declares no "
+                    f"params_class but the variant supplies params "
+                    f"{sorted(raw)}; they would be ignored"
+                )
+            return None
+
         def one(role: str, classes: dict[str, type]) -> tuple[str, Params]:
             node = variant.get(role) or {}
             name = node.get("name", "")
             cls = self._pick(classes, name, role)
-            params_cls = getattr(cls, "params_class", None)
-            raw = node.get("params") or {}
-            params = params_from_dict(params_cls, raw) if params_cls else None
+            params = extract(cls, node.get("params") or {}, role)
             return name, params  # type: ignore[return-value]
 
         algorithms: list[tuple[str, Params]] = []
         for node in variant.get("algorithms") or []:
             name = node.get("name", "")
             cls = self._pick(self.algorithm_classes, name, "algorithm")
-            params_cls = getattr(cls, "params_class", None)
-            raw = node.get("params") or {}
-            params = params_from_dict(params_cls, raw) if params_cls else None
+            params = extract(cls, node.get("params") or {}, "algorithm")
             algorithms.append((name, params))  # type: ignore[arg-type]
         return EngineParams(
             data_source=one("datasource", self.data_source_classes),

@@ -52,7 +52,10 @@ class EventServerPluginContext:
     def __init__(self, plugins: list[EventServerPlugin] | None = None):
         self.input_blockers: dict[str, EventServerPlugin] = {}
         self.input_sniffers: dict[str, EventServerPlugin] = {}
-        for p in plugins or list(_REGISTRY):
+        # None = global registry; an EXPLICIT empty list means a
+        # plugin-free server (a falsy-list fallback would let globally
+        # registered blockers reject events the caller opted out of)
+        for p in list(_REGISTRY) if plugins is None else plugins:
             if p.plugin_type == INPUT_BLOCKER:
                 self.input_blockers[p.plugin_name] = p
             else:

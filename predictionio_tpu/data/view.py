@@ -185,33 +185,44 @@ def create(
         "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
     )
     begin = start_time or _dt.datetime(1970, 1, 1, tzinfo=UTC)
-    # like the reference, fix "now" at call time so the key is stable
-    end = until_time or _dt.datetime.now(tz=UTC)
+
+    from predictionio_tpu.data.store.event_store import PEventStore, resolve_app
+
+    store = PEventStore(storage)
+    if until_time is None:
+        # "everything so far": key on the store's VERSION STAMP, not
+        # wall-clock "now" — a now-keyed digest can never hit, so every
+        # call rescanned the row store and left another npz behind
+        app_id, channel_id = resolve_app(
+            store._storage, app_name, channel_name
+        )
+        stamp = store._storage.get_p_events().version_stamp(app_id, channel_id)
+        end_key = f"stamp:{stamp}"
+    else:
+        end_key = str(until_time)
 
     fn_uid = getattr(conversion_function, "__module__", "") + "." + getattr(
         conversion_function, "__qualname__", repr(conversion_function)
     )
     key_blob = json.dumps(
-        [str(begin), str(end), version, fn_uid, channel_name], sort_keys=True
+        [str(begin), end_key, version, fn_uid, channel_name], sort_keys=True
     ).encode()
     digest = hashlib.sha1(key_blob).hexdigest()[:16]
     view_dir = os.path.join(base, "view")
     os.makedirs(view_dir, exist_ok=True)
-    path = os.path.join(view_dir, f"{name or 'view'}-{app_name}-{digest}.npz")
+    prefix = f"{name or 'view'}-{app_name}-"
+    path = os.path.join(view_dir, f"{prefix}{digest}.npz")
 
     if os.path.exists(path):
         with np.load(path, allow_pickle=False) as z:
             return {k: z[k] for k in z.files}
 
-    from predictionio_tpu.data.store.event_store import PEventStore
-
-    store = PEventStore(storage)
     converted = []
     for e in store.find(
         app_name,
         channel_name=channel_name,
         start_time=start_time,
-        until_time=end,
+        until_time=until_time,
     ):
         rec = conversion_function(e)
         if rec is not None:
@@ -221,4 +232,20 @@ def create(
     tmp = path + ".tmp.npz"
     np.savez(tmp[:-4], **cols)
     os.replace(tmp, path)
+    # bound the cache: stamp-keyed digests go stale as events arrive; keep
+    # the newest few per (name, app) and drop the rest
+    stale = sorted(
+        (
+            os.path.join(view_dir, f)
+            for f in os.listdir(view_dir)
+            if f.startswith(prefix) and f.endswith(".npz")
+        ),
+        key=os.path.getmtime,
+        reverse=True,
+    )[4:]
+    for old in stale:
+        try:
+            os.unlink(old)
+        except OSError:
+            pass
     return cols

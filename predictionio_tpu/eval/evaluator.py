@@ -117,7 +117,16 @@ class MetricEvaluator:
             others = [m.calculate(eval_data) for m in self.other_metrics]
             logger.info("  %s = %s", self.metric.header(), score)
             scores.append(MetricScores(ep, score, others))
-            if self.metric.compare(score, scores[best_idx].score) > 0:
+            # NaN guard: compare() uses ordering operators, for which NaN
+            # answers False both ways — a NaN score in slot 0 (e.g. a grid
+            # point whose folds produced no valid queries) could never be
+            # displaced and would be persisted as "best". Any finite score
+            # beats NaN; NaN never beats anything.
+            best_is_nan = scores[best_idx].score != scores[best_idx].score
+            score_is_nan = score != score
+            if score_is_nan:
+                continue
+            if best_is_nan or self.metric.compare(score, scores[best_idx].score) > 0:
                 best_idx = i
         result = MetricEvaluatorResult(
             best_score=scores[best_idx].score,

@@ -35,28 +35,65 @@ def shard_columns(
     *,
     axis: str = "data",
     pad_values: dict[str, Any] | None = None,
+    mask_name: str | None = None,
 ) -> tuple[dict[str, jax.Array], int]:
     """Shard equal-length host columns over the mesh's data axis.
 
-    Rows are padded to a multiple of the axis size; callers mask with the
-    returned original length. In multi-process mode each process passes its
-    local rows and the result is a globally-sharded array
-    (``make_array_from_process_local_data``); single-process mode uses a
-    plain sharded device_put.
+    Single-process: rows are padded to a multiple of the axis size (pads at
+    the TAIL, so masking by the returned original length works).
+
+    Multi-process: each process passes its LOCAL rows; the processes
+    coordinate one common per-process padded length (an allgather of local
+    counts — uneven counts would otherwise make every process infer a
+    different global shape and corrupt the first collective), and the
+    result is a globally-sharded array via
+    ``make_array_from_process_local_data`` with an explicit global shape.
+    Pad rows then sit at the tail of each process's REGION — the middle of
+    the global array — so masking by length is wrong there: pass
+    ``mask_name`` to get a boolean validity column (sharded identically)
+    under that key, which is correct in both modes.
+
+    Returns ``(arrays, local_row_count)``.
     """
     pad_values = pad_values or {}
     axis_size = mesh.shape[axis]
     sharding = NamedSharding(mesh, PartitionSpec(axis))
+    lengths = {col.shape[0] for col in columns.values()}
+    if len(lengths) > 1:
+        raise ValueError("all columns must have the same length")
+    n_local = lengths.pop() if lengths else 0
+
+    multi = jax.process_count() > 1
+    if multi:
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(
+            multihost_utils.process_allgather(np.asarray(n_local, np.int64))
+        ).reshape(-1)
+        per_len = int(-(-int(counts.max()) // axis_size) * axis_size)
+        per_len = max(per_len, axis_size)
+        global_rows = per_len * jax.process_count()
+    else:
+        per_len = n_local + ((-n_local) % axis_size)
+        per_len = max(per_len, axis_size) if n_local else axis_size
+        global_rows = per_len
+
+    def put(local: np.ndarray) -> jax.Array:
+        if multi:
+            return jax.make_array_from_process_local_data(
+                sharding, local, (global_rows, *local.shape[1:])
+            )
+        return jax.device_put(local, sharding)
+
     out: dict[str, jax.Array] = {}
-    n_rows = None
     for name, col in columns.items():
-        padded, n = pad_to_multiple(col, axis_size, pad_values.get(name, 0))
-        if n_rows is None:
-            n_rows = n
-        elif n != n_rows:
-            raise ValueError("all columns must have the same length")
-        if jax.process_count() > 1:
-            out[name] = jax.make_array_from_process_local_data(sharding, padded)
-        else:
-            out[name] = jax.device_put(padded, sharding)
-    return out, int(n_rows or 0)
+        pad = per_len - col.shape[0]
+        pad_width = [(0, pad)] + [(0, 0)] * (col.ndim - 1)
+        out[name] = put(
+            np.pad(col, pad_width, constant_values=pad_values.get(name, 0))
+        )
+    if mask_name is not None:
+        mask = np.zeros((per_len,), bool)
+        mask[:n_local] = True
+        out[mask_name] = put(mask)
+    return out, int(n_local)

@@ -82,11 +82,18 @@ class MultiHostLauncher:
     def n_processes(self) -> int:
         return len(self.hosts) if self.hosts else self.num_hosts
 
+    @property
+    def _stdout_target(self) -> int:
+        # stream_logs=False must NOT leave a PIPE nobody drains: a worker
+        # writing past the ~64KB OS pipe buffer would block in write() and
+        # the fleet would hang forever in _supervise
+        return subprocess.PIPE if self.stream_logs else subprocess.DEVNULL
+
     def _spawn_local(self, process_id: int, coordinator: str) -> subprocess.Popen:
         return subprocess.Popen(
             self.command,
             env=self._worker_env(process_id, coordinator),
-            stdout=subprocess.PIPE,
+            stdout=self._stdout_target,
             stderr=subprocess.STDOUT,
             start_new_session=True,  # isolate signals: we terminate explicitly
         )
@@ -110,7 +117,7 @@ class MultiHostLauncher:
         )
         return subprocess.Popen(
             ["ssh", "-o", "BatchMode=yes", host, remote],
-            stdout=subprocess.PIPE,
+            stdout=self._stdout_target,
             stderr=subprocess.STDOUT,
             start_new_session=True,
         )
@@ -131,6 +138,13 @@ class MultiHostLauncher:
         host = self.coordinator_host or (
             self.hosts[0] if self.hosts else "127.0.0.1"
         )
+        # hosts entries are ssh targets and may carry a user prefix
+        # ("ubuntu@10.0.0.1") — the JAX coordinator address must be a bare
+        # host:port or every worker's rendezvous fails on the malformed URL
+        host = host.rsplit("@", 1)[-1]
+        # NOTE: with a port chosen here, remote mode assumes the port is
+        # also free on the coordinator HOST (we can only probe locally);
+        # pass coordinator_port explicitly to pin a known-free one
         port = self.coordinator_port or pick_free_port()
         coordinator = f"{host}:{port}"
         logger.info("launching %d workers; coordinator %s", n, coordinator)

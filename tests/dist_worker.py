@@ -59,8 +59,31 @@ def main() -> int:
     )
     out = float(step(garr))
     assert out == expected, f"sharded step: {out} != {expected}"
+
+    # shard_columns with UNEVEN per-process row counts: processes must
+    # coordinate one global shape (an uncoordinated build inferred a
+    # different global shape per process), and the mask column must select
+    # exactly the real rows even though pads sit mid-global-array
+    from predictionio_tpu.parallel.ingest import shard_columns
+
+    rank = jax.process_index()
+    local_rows = 3 if rank == 0 else 5
+    vals = np.full((local_rows,), float(rank + 1), np.float32)
+    cols, n_local = shard_columns(
+        mesh, {"v": vals}, axis="data", mask_name="ok"
+    )
+    assert n_local == local_rows
+
+    @jax.jit
+    def masked_sum(v, ok):
+        return (v * ok.astype(v.dtype)).sum()
+
+    got = float(masked_sum(cols["v"], cols["ok"]))
+    want = float(sum((3 if p == 0 else 5) * (p + 1) for p in range(n_proc)))
+    assert got == want, f"masked shard_columns sum: {got} != {want}"
     print(
-        f"rank {jax.process_index()}/{n_proc}: sharded step ok ({out})",
+        f"rank {jax.process_index()}/{n_proc}: sharded step ok ({out}), "
+        f"uneven shard_columns ok ({got})",
         flush=True,
     )
     return 0

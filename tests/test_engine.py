@@ -144,6 +144,33 @@ class TestVariantExtraction:
         with pytest.raises(ParamsError):
             make_engine().engine_params_from_variant(variant)
 
+    def test_params_without_params_class_rejected(self):
+        """A component with no params_class must REFUSE variant params, not
+        silently train with defaults while the user's hyperparameters sit
+        ignored in engine.json (code-review r4)."""
+
+        class NoParamsAlgo:
+            def __init__(self, params=None):
+                pass
+
+        from predictionio_tpu.controller import Engine
+        from tests.sample_engine import DataSource0, Preparator0, Serving0
+
+        engine = Engine(
+            {"ds": DataSource0},
+            {"prep": Preparator0},
+            {"np": NoParamsAlgo},
+            {"s": Serving0},
+        )
+        variant = {
+            "datasource": {"name": "ds"},
+            "preparator": {"name": "prep"},
+            "algorithms": [{"name": "np", "params": {"rank": 32}}],
+            "serving": {"name": "s"},
+        }
+        with pytest.raises(ValueError, match="would be ignored"):
+            engine.engine_params_from_variant(variant)
+
     def test_params_to_json_roundtrip(self):
         ep = params(algos=((3,),))
         flat = Engine.engine_params_to_json(ep)

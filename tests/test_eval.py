@@ -125,6 +125,22 @@ class TestMetricEvaluator:
         with pytest.raises(ValueError):
             MetricEvaluator(QidMetric()).evaluate_base(CTX, make_engine(), [])
 
+    def test_nan_score_never_wins(self):
+        """A NaN score in slot 0 must be displaced by any finite score:
+        compare() uses ordering operators, which NaN answers False both
+        ways, so NaN used to be unbeatable and landed in best.json
+        (code-review r4)."""
+
+        class NanFirstMetric(AverageMetric):
+            def calculate_score(self, ei, q, p, a) -> float:
+                return float("nan") if p.algo_id == 3 else float(p.algo_id)
+
+        result = MetricEvaluator(NanFirstMetric()).evaluate_base(
+            CTX, make_engine(), [params(3), params(9), params(5)]
+        )
+        assert result.best_index == 1
+        assert result.best_score == 9.0
+
 
 class TestGridSearch:
     def test_cartesian(self):

@@ -325,3 +325,30 @@ def test_plugins_json():
         assert "inputblockers" in data["plugins"]
 
     with_client(body)
+
+
+def test_explicit_empty_plugin_list_disables_registry():
+    """EventServerPluginContext(plugins=[]) means a plugin-FREE server: the
+    old falsy-list fallback silently loaded globally registered blockers
+    the caller opted out of (code-review r4)."""
+    from predictionio_tpu.data.api.plugins import (
+        INPUT_BLOCKER,
+        EventServerPlugin,
+        EventServerPluginContext,
+        _REGISTRY,
+    )
+
+    class Blocker(EventServerPlugin):
+        plugin_name = "global-blocker"
+        plugin_type = INPUT_BLOCKER
+
+        def process(self, event_info, context):
+            raise RuntimeError("blocked")
+
+    b = Blocker()
+    _REGISTRY.append(b)
+    try:
+        assert EventServerPluginContext(plugins=[]).input_blockers == {}
+        assert "global-blocker" in EventServerPluginContext().input_blockers
+    finally:
+        _REGISTRY.remove(b)

@@ -183,6 +183,36 @@ class TestDataView:
         )
         assert mobile_cols == {}  # empty channel, not the default's cache
 
+    def test_no_until_time_caches_on_version_stamp(self, app_with_events, tmp_path):
+        """until_time=None must key on the store's version stamp, not
+        wall-clock 'now' (which can never hit and leaves an npz per call):
+        unchanged store -> cache hit; new event -> fresh scan; the view dir
+        stays bounded (code-review r4)."""
+        import os
+
+        calls = []
+
+        def convert(e: Event):
+            calls.append(1)
+            return {"u": e.entity_id}
+
+        kw = dict(name="nowless", base_dir=str(tmp_path))
+        cols = view.create("viewapp", convert, **kw)
+        assert len(cols["u"]) == 6
+        n1 = len(calls)
+        cols2 = view.create("viewapp", convert, **kw)  # unchanged -> HIT
+        assert len(calls) == n1
+        assert len(cols2["u"]) == 6
+        # a new event changes the stamp -> fresh scan sees 7 rows
+        st = app_with_events
+        app = st.get_meta_data_apps().get_by_name("viewapp")
+        st.get_l_events().insert(_ev("rate", "u9", 9, target="i9"), app.id)
+        cols3 = view.create("viewapp", convert, **kw)
+        assert len(cols3["u"]) == 7 and len(calls) > n1
+        # the directory is bounded, not one file per call
+        files = [f for f in os.listdir(tmp_path / "view") if f.startswith("nowless-")]
+        assert len(files) <= 4
+
     def test_empty_result(self, app_with_events, tmp_path):
         cols = view.create(
             "viewapp",
