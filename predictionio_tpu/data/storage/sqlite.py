@@ -19,6 +19,8 @@ import os
 import sqlite3
 import threading
 import uuid
+
+import numpy as np
 from typing import Iterable, Iterator, Sequence
 
 from predictionio_tpu.data.datamap import DataMap
@@ -233,6 +235,50 @@ class SQLiteStorageClient:
         return SQLiteModels(self)
 
 
+def _event_where(
+    *,
+    start_time=None,
+    until_time=None,
+    entity_type=None,
+    entity_id=None,
+    event_names=None,
+    target_entity_type=...,
+    target_entity_id=...,
+) -> tuple[str, list]:
+    """WHERE clause + params for the 9-filter event contract (shared by
+    ``find`` and the raw-column columnar scan)."""
+    clauses, params = [], []
+    if start_time is not None:
+        clauses.append("eventTime >= ?")
+        params.append(_micros(start_time))
+    if until_time is not None:
+        clauses.append("eventTime < ?")
+        params.append(_micros(until_time))
+    if entity_type is not None:
+        clauses.append("entityType = ?")
+        params.append(entity_type)
+    if entity_id is not None:
+        clauses.append("entityId = ?")
+        params.append(entity_id)
+    if event_names is not None:
+        placeholders = ",".join("?" for _ in event_names)
+        clauses.append(f"event IN ({placeholders})")
+        params.extend(event_names)
+    if target_entity_type is not ...:
+        if target_entity_type is None:
+            clauses.append("targetEntityType IS NULL")
+        else:
+            clauses.append("targetEntityType = ?")
+            params.append(target_entity_type)
+    if target_entity_id is not ...:
+        if target_entity_id is None:
+            clauses.append("targetEntityId IS NULL")
+        else:
+            clauses.append("targetEntityId = ?")
+            params.append(target_entity_id)
+    return (f" WHERE {' AND '.join(clauses)}" if clauses else ""), params
+
+
 class SQLiteLEvents(base.LEvents):
     def __init__(self, client: SQLiteStorageClient):
         self._c = client
@@ -368,36 +414,15 @@ class SQLiteLEvents(base.LEvents):
         reversed: bool = False,
     ) -> Iterator[Event]:
         table = _event_table(app_id, channel_id)
-        clauses, params = [], []
-        if start_time is not None:
-            clauses.append("eventTime >= ?")
-            params.append(_micros(start_time))
-        if until_time is not None:
-            clauses.append("eventTime < ?")
-            params.append(_micros(until_time))
-        if entity_type is not None:
-            clauses.append("entityType = ?")
-            params.append(entity_type)
-        if entity_id is not None:
-            clauses.append("entityId = ?")
-            params.append(entity_id)
-        if event_names is not None:
-            placeholders = ",".join("?" for _ in event_names)
-            clauses.append(f"event IN ({placeholders})")
-            params.extend(event_names)
-        if target_entity_type is not ...:
-            if target_entity_type is None:
-                clauses.append("targetEntityType IS NULL")
-            else:
-                clauses.append("targetEntityType = ?")
-                params.append(target_entity_type)
-        if target_entity_id is not ...:
-            if target_entity_id is None:
-                clauses.append("targetEntityId IS NULL")
-            else:
-                clauses.append("targetEntityId = ?")
-                params.append(target_entity_id)
-        where = f" WHERE {' AND '.join(clauses)}" if clauses else ""
+        where, params = _event_where(
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+        )
         order = "DESC" if reversed else "ASC"
         sql = f"SELECT * FROM {table}{where} ORDER BY eventTime {order}"
         if limit is not None and limit >= 0:
@@ -418,6 +443,106 @@ class SQLitePEvents(base.PEvents):
 
     def find(self, app_id: int, channel_id: int | None = None, **kw) -> Iterator[Event]:
         return self._l.find(app_id, channel_id, **kw)
+
+    _COLUMNAR_FAST_KW = frozenset(
+        (
+            "event_names", "rating_key", "entity_vocab", "target_vocab",
+            "start_time", "until_time", "entity_type", "entity_id",
+            "target_entity_type", "target_entity_id",
+        )
+    )
+
+    def to_columnar(self, app_id: int, channel_id: int | None = None, **kw):
+        """Raw-column columnar scan: selects only the five encoded columns
+        and lets sqlite's ``json_extract`` pull the rating out of the
+        properties JSON in C. The generic path builds an Event + DataMap +
+        two tz-aware datetimes per row just to throw them away — measured
+        ~5x slower at the snapshot-ingest bench's 200k rows. Output is
+        identical (same vocab encounter order, same codes/timestamps);
+        unsupported kwargs fall back to the generic encoder."""
+        rating_key = kw.get("rating_key", "rating")
+        if (
+            "events" in kw
+            or set(kw) - self._COLUMNAR_FAST_KW
+            # JSON-path metacharacters would need escaping; rare keys take
+            # the generic path instead of risking a wrong path expression
+            or not rating_key.replace("_", "").isalnum()
+        ):
+            return super().to_columnar(app_id, channel_id, **kw)
+        table = _event_table(app_id, channel_id)
+        where, params = _event_where(
+            start_time=kw.get("start_time"),
+            until_time=kw.get("until_time"),
+            entity_type=kw.get("entity_type"),
+            entity_id=kw.get("entity_id"),
+            event_names=kw.get("event_names"),
+            target_entity_type=kw.get("target_entity_type", ...),
+            target_entity_id=kw.get("target_entity_id", ...),
+        )
+        sql = (
+            f"SELECT id, event, entityId, targetEntityId, eventTime, "
+            f"json_extract(properties, ?) FROM {table}{where} "
+            f"ORDER BY eventTime ASC"
+        )
+        try:
+            rows = self._c.query(sql, [f"$.{rating_key}", *params])
+        except sqlite3.OperationalError as exc:
+            if _is_missing_table(exc):
+                rows = []
+            else:
+                raise
+        entity_vocab = kw.get("entity_vocab")
+        target_vocab = kw.get("target_vocab")
+        ent_index: dict[str, int] = (
+            {v: i for i, v in enumerate(entity_vocab)} if entity_vocab else {}
+        )
+        tgt_index: dict[str, int] = (
+            {v: i for i, v in enumerate(target_vocab)} if target_vocab else {}
+        )
+        frozen_ent = entity_vocab is not None
+        frozen_tgt = target_vocab is not None
+        ev_index: dict[str, int] = {}
+        n = len(rows)
+        event_ids: list[str] = [""] * n
+        names: list[str] = [""] * n
+        ent_col = np.empty(n, np.int32)
+        tgt_col = np.empty(n, np.int32)
+        ev_col = np.empty(n, np.int32)
+        ts_col = np.empty(n, np.float64)
+        rating_col = np.empty(n, np.float32)
+        for i, (eid, name, ent, tgt, micros, rating) in enumerate(rows):
+            event_ids[i] = eid or ""
+            names[i] = name
+            if frozen_ent:
+                ent_col[i] = ent_index.get(ent, -1)
+            else:
+                ent_col[i] = ent_index.setdefault(ent, len(ent_index))
+            if tgt is None:
+                tgt_col[i] = -1
+            elif frozen_tgt:
+                tgt_col[i] = tgt_index.get(tgt, -1)
+            else:
+                tgt_col[i] = tgt_index.setdefault(tgt, len(tgt_index))
+            ev_col[i] = ev_index.setdefault(name, len(ev_index))
+            # micros/1e6 == Event.event_time.timestamp() (tz-independent)
+            ts_col[i] = micros / 1e6
+            # json_extract: numbers arrive as int/float (bool as 0/1, like
+            # DataMap's isinstance(int) rule); TEXT/NULL/objects -> NaN
+            rating_col[i] = (
+                float(rating) if isinstance(rating, (int, float)) else float("nan")
+            )
+        return base.ColumnarEvents(
+            event_ids=event_ids,
+            event_names=names,
+            entity_ids=ent_col,
+            target_ids=tgt_col,
+            event_codes=ev_col,
+            timestamps=ts_col,
+            ratings=rating_col,
+            entity_vocab=list(entity_vocab) if frozen_ent else list(ent_index),
+            target_vocab=list(target_vocab) if frozen_tgt else list(tgt_index),
+            event_vocab=list(ev_index),
+        )
 
     def write(
         self, events: Iterable[Event], app_id: int, channel_id: int | None = None

@@ -226,6 +226,68 @@ def test_rows_canonical_precheck():
     assert not _rows_canonical(list(reversed(ids)), ts)
 
 
+def test_sqlite_fast_columnar_matches_generic(sqlite_pevents):
+    """The raw-column sqlite to_columnar (json_extract rating, no Event
+    construction) must emit byte-identical output to the generic
+    Event-stream encoder across the tricky cases: numeric/string/bool/
+    missing/nested ratings, absent targets, filters, frozen vocabs."""
+    import dataclasses as _dc
+
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+
+    extra = [
+        Event(
+            event="rate", entity_type="user", entity_id="uX",
+            target_entity_type="item", target_entity_id="iX",
+            properties=DataMap({"rating": "five"}),  # string -> NaN
+        ),
+        Event(
+            event="rate", entity_type="user", entity_id="uY",
+            target_entity_type="item", target_entity_id="iY",
+            properties=DataMap({"rating": True}),  # bool -> 1.0
+        ),
+        Event(
+            event="rate", entity_type="user", entity_id="uZ",
+            target_entity_type="item", target_entity_id="iZ",
+            properties=DataMap({"rating": {"nested": 1}}),  # object -> NaN
+        ),
+        Event(
+            event="view", entity_type="user", entity_id="uX",
+            properties=DataMap({}),  # no target, no rating
+        ),
+    ]
+    sqlite_pevents.write(extra, app_id=1)
+
+    def generic(**kw):
+        # route through the base encoder by feeding the found events
+        return type(sqlite_pevents).__mro__[1].to_columnar(
+            sqlite_pevents, 1, **kw
+        )
+
+    for kw in (
+        {},
+        {"event_names": ["rate"]},
+        {"entity_type": "user", "rating_key": "rating"},
+        {"entity_vocab": ["uZ", "uX"], "target_vocab": ["iX"]},
+    ):
+        fast = sqlite_pevents.to_columnar(1, **kw)
+        slow = generic(**kw)
+        assert fast.event_ids == slow.event_ids, kw
+        assert fast.event_names == slow.event_names, kw
+        assert fast.entity_vocab == slow.entity_vocab, kw
+        assert fast.target_vocab == slow.target_vocab, kw
+        assert fast.event_vocab == slow.event_vocab, kw
+        np.testing.assert_array_equal(fast.entity_ids, slow.entity_ids)
+        np.testing.assert_array_equal(fast.target_ids, slow.target_ids)
+        np.testing.assert_array_equal(fast.event_codes, slow.event_codes)
+        np.testing.assert_array_equal(fast.timestamps, slow.timestamps)
+        np.testing.assert_array_equal(fast.ratings, slow.ratings)
+    # unsupported kwargs take the generic path, not a wrong answer
+    lim = sqlite_pevents.to_columnar(1, limit=2)
+    assert len(lim) == 2
+
+
 def test_sqlite_stamp_changes_on_delete_plus_reinsert(sqlite_pevents):
     """Delete the newest event and insert a replacement with the same
     eventTime: sqlite reuses the freed max rowid, so the stamp must come
