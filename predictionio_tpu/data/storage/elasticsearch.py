@@ -135,22 +135,18 @@ class _ESTransport:
 def _retry_safe(method: str, path: str, exc: Exception) -> bool:
     """May this failed request be replayed on another endpoint?
 
-    Always when the connection was refused (nothing reached the server).
-    Otherwise only for idempotent operations: GET/HEAD, and PUT/DELETE of
-    addressed documents — but NOT ``_update`` scripts (replay re-applies
-    the script) or ``_create`` (replay 409s and the caller misreads it as
-    "already taken").
+    Everything except the two genuinely non-idempotent operations:
+    ``_update`` scripts (a replay re-applies the script — a sequence
+    counter would double-increment) and ``_create`` (a replay 409s and
+    the caller misreads "already taken"). POST reads (_search, _count,
+    scroll) and addressed-document PUT/DELETE writes are idempotent and
+    keep the multi-endpoint failover a dead node depends on. A refused
+    connection never reached the server and is always safe.
     """
     reason = getattr(exc, "reason", exc)
     if isinstance(reason, ConnectionRefusedError):
         return True
-    if method in ("GET", "HEAD"):
-        return True
-    if method in ("PUT", "DELETE") and "/_update/" not in path and (
-        "/_create/" not in path
-    ):
-        return True
-    return False
+    return "/_update/" not in path and "/_create/" not in path
 
 
 def _iso(ts: _dt.datetime | None) -> str | None:

@@ -233,17 +233,19 @@ def create(
     np.savez(tmp[:-4], **cols)
     os.replace(tmp, path)
     # bound the cache: stamp-keyed digests go stale as events arrive; keep
-    # the newest few per (name, app) and drop the rest
-    stale = sorted(
-        (
-            os.path.join(view_dir, f)
-            for f in os.listdir(view_dir)
-            if f.startswith(prefix) and f.endswith(".npz")
-        ),
-        key=os.path.getmtime,
-        reverse=True,
-    )[4:]
-    for old in stale:
+    # the newest few per (name, app) and drop the rest. Stat per-file under
+    # try: a concurrent create() (multi-host workers share the dir) may
+    # unlink an entry between listdir and the stat — that must not fail a
+    # build whose own output was already written successfully.
+    aged: list[tuple[float, str]] = []
+    for f in os.listdir(view_dir):
+        if f.startswith(prefix) and f.endswith(".npz"):
+            p = os.path.join(view_dir, f)
+            try:
+                aged.append((os.path.getmtime(p), p))
+            except OSError:
+                continue  # already gone
+    for _, old in sorted(aged, reverse=True)[4:]:
         try:
             os.unlink(old)
         except OSError:

@@ -157,6 +157,9 @@ class _MicroBatcher:
                 )
             except asyncio.CancelledError:
                 self._inflight.release()
+                # shutdown mid-dispatch: this batch's clients must get a
+                # response too (close()'s drain only covers queued items)
+                self._fail_batch(batch, RuntimeError("query server is shutting down"))
                 raise  # close() must actually terminate the collect loop
             except BaseException as exc:
                 self._inflight.release()

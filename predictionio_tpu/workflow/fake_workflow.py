@@ -60,17 +60,39 @@ class FakeRun:
     def run(self, ctx: WorkflowContext) -> FakeEvalResult:
         # instance attribute first (set by __init__ — a plain function there
         # never binds); then the CLASS DICT, bypassing descriptor binding: a
-        # plain function assigned as `func = my_fn` (the natural spelling,
-        # @staticmethod omitted) would otherwise arrive as a bound method
-        # and receive the FakeRun instance in place of the context
+        # plain ONE-ARGUMENT function assigned as `func = my_fn` (the
+        # natural spelling, @staticmethod omitted) would otherwise arrive
+        # as a bound method and receive the FakeRun instance in place of
+        # the context. A conventional method spelling (def func(self, ctx))
+        # still binds: arity decides.
+        import inspect
+
         fn = self.__dict__.get("func")
         if fn is None:
             for klass in type(self).__mro__:
                 if "func" in klass.__dict__:
-                    fn = klass.__dict__["func"]
+                    raw = klass.__dict__["func"]
+                    if isinstance(raw, (staticmethod, classmethod)):
+                        fn = raw.__get__(None, type(self))
+                    elif callable(raw):
+                        try:
+                            n_pos = sum(
+                                1
+                                for p in inspect.signature(
+                                    raw
+                                ).parameters.values()
+                                if p.kind
+                                in (
+                                    p.POSITIONAL_ONLY,
+                                    p.POSITIONAL_OR_KEYWORD,
+                                )
+                            )
+                        except (TypeError, ValueError):
+                            n_pos = 1
+                        fn = raw.__get__(self, type(self)) if n_pos >= 2 else raw
+                    else:
+                        fn = raw
                     break
-        if isinstance(fn, (staticmethod, classmethod)):
-            fn = fn.__get__(None, type(self))
         if fn is None:
             raise ValueError("FakeRun has no func")
         return FakeEvalResult(value=fn(ctx))

@@ -643,6 +643,25 @@ class TestESDriverSpecifics:
         finally:
             _cleanup_client(c)
 
+    def test_failover_to_second_endpoint_for_reads_and_doc_writes(self):
+        """A dead first endpoint (connection refused / unreachable) must
+        not break POST reads (search/_count) or addressed-doc writes —
+        only _update/_create replays are refused (code-review r4 on r4:
+        the first version of the idempotency guard keyed on method and
+        lost read failover)."""
+        from predictionio_tpu.data.storage.elasticsearch import _retry_safe
+
+        timeout = TimeoutError("timed out mid-flight")  # ambiguous failure
+        assert _retry_safe("POST", "/idx/_search", timeout)
+        assert _retry_safe("POST", "/idx/_count", timeout)
+        assert _retry_safe("PUT", "/idx/_doc/42", timeout)
+        assert _retry_safe("DELETE", "/idx/_doc/42", timeout)
+        assert not _retry_safe("POST", "/idx/_update/seq", timeout)
+        assert not _retry_safe("PUT", "/idx/_create/name", timeout)
+        # nothing reached the server: always safe, even for _update
+        refused = ConnectionRefusedError()
+        assert _retry_safe("POST", "/idx/_update/seq", refused)
+
     def test_batch_delete_via_bulk(self):
         """PEvents.delete uses _bulk delete actions (one refresh per chunk,
         not one HTTP round trip + refresh per document)."""

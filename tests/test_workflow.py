@@ -278,6 +278,20 @@ class TestFakeRun:
 
         assert Hello().run(WorkflowContext(mode="evaluation")).value == "evaluation"
 
+    def test_conventional_method_spelling(self):
+        """def func(self, ctx) — the ordinary method spelling — must still
+        bind and receive both self and the context (arity decides)."""
+        from predictionio_tpu.workflow.context import WorkflowContext
+        from predictionio_tpu.workflow.fake_workflow import FakeRun
+
+        class Hello(FakeRun):
+            tag = "m"
+
+            def func(self, ctx):
+                return f"{self.tag}:{ctx.mode}"
+
+        assert Hello().run(WorkflowContext(mode="evaluation")).value == "m:evaluation"
+
 
 class TestRemoteLog:
     """Ref CreateServer.scala:423-434,595-611 — --log-url ships serving
