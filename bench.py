@@ -145,6 +145,16 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
+def _free_port() -> int:
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
 # ---------------------------------------------------------------------------
 # Phase: als — headline train wall-clock + held-out RMSE + FLOP/MFU accounting
 # ---------------------------------------------------------------------------
@@ -626,14 +636,9 @@ def _bench_server_e2e(
     # measurement at the loop's own request-processing rate, not the
     # framework's)
     import http.client
-    import socket
     import threading
 
-    sock = socket.socket()
-    sock.bind(("127.0.0.1", 0))
-    port = sock.getsockname()[1]
-    sock.close()
-
+    port = _free_port()
     loop = asyncio.new_event_loop()
     server_box: dict = {}
 
@@ -1042,7 +1047,6 @@ def _bench_event_ingest(
     store over loopback. Returns (events/s, per-batch p50 ms)."""
     import asyncio
     import http.client
-    import socket
     import threading
 
     import numpy as np
@@ -1065,10 +1069,7 @@ def _bench_event_ingest(
     app_id = storage.get_meta_data_apps().insert(App(0, "ingestbench"))
     storage.get_meta_data_access_keys().insert(AccessKey("ingestkey", app_id, ()))
 
-    sock = socket.socket()
-    sock.bind(("127.0.0.1", 0))
-    port = sock.getsockname()[1]
-    sock.close()
+    port = _free_port()
     loop = asyncio.new_event_loop()
     ready = threading.Event()
 

@@ -43,7 +43,15 @@ UTC = _dt.timezone.utc
 
 
 class ESError(RuntimeError):
-    pass
+    """Transport/application error from the ES driver.
+
+    Bulk-insert partial failures attach ``indexed_ids`` (documents that DID
+    land) and ``attempted_ids`` (the full batch's ids, in order) — see
+    ``ESLEvents.insert_batch`` for the retry contract.
+    """
+
+    indexed_ids: list[str] = []
+    attempted_ids: list[str] = []
 
 
 class _ESTransport:

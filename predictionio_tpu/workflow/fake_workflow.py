@@ -14,6 +14,7 @@ analogue: mesh + storage + mode).
 from __future__ import annotations
 
 import dataclasses
+import inspect
 from typing import Any, Callable
 
 from predictionio_tpu.workflow.context import WorkflowContext
@@ -65,8 +66,6 @@ class FakeRun:
         # as a bound method and receive the FakeRun instance in place of
         # the context. A conventional method spelling (def func(self, ctx))
         # still binds: arity decides.
-        import inspect
-
         fn = self.__dict__.get("func")
         if fn is None:
             for klass in type(self).__mro__:
