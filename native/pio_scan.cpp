@@ -131,7 +131,48 @@ const char* object_end(const char* p) {
   return nullptr;
 }
 
+// 4 hex digits at p -> value, or -1 when invalid/truncated (also the
+// bounds check: a NUL inside the window fails the digit test, so a line
+// ending mid-escape can never walk the cursor past the buffer).
+int hex4(const char* p) {
+  int v = 0;
+  for (int i = 0; i < 4; ++i) {
+    char c = p[i];
+    int d;
+    if (c >= '0' && c <= '9') d = c - '0';
+    else if (c >= 'a' && c <= 'f') d = c - 'a' + 10;
+    else if (c >= 'A' && c <= 'F') d = c - 'A' + 10;
+    else return -1;
+    v = (v << 4) | d;
+  }
+  return v;
+}
+
+void append_utf8(std::string* out, uint32_t cp) {
+  if (cp < 0x80) {
+    out->push_back(static_cast<char>(cp));
+  } else if (cp < 0x800) {
+    out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else {
+    out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  }
+}
+
 // Parse a JSON string value at `p` into out; returns true on success.
+// \uXXXX escapes are DECODED to UTF-8 (incl. surrogate pairs): the JSONL
+// writer uses json.dumps' default ensure_ascii=True, so every non-ASCII id
+// is stored escaped, and the python read path (json.loads) decodes it —
+// keeping the escape verbatim made the two scan paths intern different
+// vocab strings for the same id. Lone surrogates fail the parse (treated
+// as a malformed value, like any truncated escape).
 bool parse_string(const char* p, std::string* out) {
   if (*p != '"') return false;
   ++p;
@@ -146,11 +187,22 @@ bool parse_string(const char* p, std::string* out) {
         case 'b': out->push_back('\b'); break;
         case 'f': out->push_back('\f'); break;
         case 'u': {
-          // keep \uXXXX escapes verbatim (ids are usually ASCII); copying
-          // the raw escape keeps the key stable for dictionary encoding
-          out->push_back('\\'); out->push_back('u');
-          for (int i = 1; i <= 4 && p[i]; ++i) out->push_back(p[i]);
-          p += 4;
+          int v = hex4(p + 1);
+          if (v < 0) return false;
+          uint32_t cp = static_cast<uint32_t>(v);
+          p += 4;  // at the last hex digit; the trailing ++p advances past
+          if (cp >= 0xD800 && cp <= 0xDBFF) {
+            // high surrogate: a \uXXXX low surrogate must follow
+            if (p[1] != '\\' || p[2] != 'u') return false;
+            int lo = hex4(p + 3);
+            if (lo < 0xDC00 || lo > 0xDFFF) return false;
+            cp = 0x10000 + ((cp - 0xD800) << 10) +
+                 (static_cast<uint32_t>(lo) - 0xDC00);
+            p += 6;
+          } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+            return false;  // lone low surrogate
+          }
+          append_utf8(out, cp);
           break;
         }
         default: out->push_back(*p); break;
@@ -179,14 +231,22 @@ double parse_iso8601(const std::string& s) {
   unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
   long days = era * 146097L + static_cast<long>(doe) - 719468L;
   double ts = days * 86400.0 + h * 3600.0 + mi * 60.0 + sec;
-  // timezone suffix
+  // timezone suffix: "+HH:MM", compact "+HHMM", or bare "+HH" — python's
+  // fromisoformat accepts all three, so the native parse must agree (the
+  // %d:%d sscanf read "+0530" as 530 HOURS)
   size_t zpos = s.find_last_of("Z+-");
   if (zpos != std::string::npos && zpos >= 19 && s[zpos] != 'Z') {
+    const char* z = s.c_str() + zpos + 1;
     int oh = 0, om = 0;
-    if (sscanf(s.c_str() + zpos + 1, "%d:%d", &oh, &om) >= 1) {
-      double off = oh * 3600.0 + om * 60.0;
-      ts += (s[zpos] == '-') ? off : -off;
+    if (strchr(z, ':')) {
+      sscanf(z, "%d:%d", &oh, &om);
+    } else {
+      int v = atoi(z);
+      if (strlen(z) >= 4) { oh = v / 100; om = v % 100; }
+      else oh = v;
     }
+    double off = oh * 3600.0 + om * 60.0;
+    ts += (s[zpos] == '-') ? off : -off;
   }
   return ts;
 }
@@ -283,14 +343,15 @@ void* pio_scan_file(const char* path, const char* event_names_csv,
     row.entity = encode(entity, &ent_index, &full_ent);
     row.target = has_target ? encode(target, &tgt_index, &full_tgt) : -1;
 
-    if (!row.id.empty()) {
-      auto it = row_by_id.find(row.id);
-      if (it != row_by_id.end()) {
-        rows[it->second] = std::move(row);  // upsert in place
-        continue;
-      }
-      row_by_id.emplace(row.id, rows.size());
+    // id-less rows share the "" key on purpose: the backend's dedup map is
+    // keyed on `event_id or ""`, so every id-less line collapses into one
+    // last-wins record there — the native path must produce the same row set
+    auto it = row_by_id.find(row.id);
+    if (it != row_by_id.end()) {
+      rows[it->second] = std::move(row);  // upsert in place
+      continue;
     }
+    row_by_id.emplace(row.id, rows.size());
     rows.push_back(std::move(row));
   }
   free(line);
@@ -374,6 +435,26 @@ const char* pio_scan_vocab_get(void* h, int which, int64_t i) {
 }
 const char* pio_scan_row_id(void* h, int64_t i) {
   return static_cast<Columns*>(h)->row_ids[i].c_str();
+}
+// Batched row-id export: one FFI call for lengths, one for the concatenated
+// bytes (a pio_scan_row_id call + decode PER ROW was a 20M-iteration python
+// loop that rivaled the whole C++ scan). Length-prefixing is separator-free,
+// so ids may contain any byte.
+int64_t pio_scan_ids_total_bytes(void* h) {
+  auto* c = static_cast<Columns*>(h);
+  int64_t total = 0;
+  for (const auto& s : c->row_ids) total += static_cast<int64_t>(s.size());
+  return total;
+}
+void pio_scan_copy_ids(void* h, int32_t* lengths, char* buf) {
+  auto* c = static_cast<Columns*>(h);
+  char* out = buf;
+  for (size_t i = 0; i < c->row_ids.size(); ++i) {
+    const std::string& s = c->row_ids[i];
+    lengths[i] = static_cast<int32_t>(s.size());
+    memcpy(out, s.data(), s.size());
+    out += s.size();
+  }
 }
 void pio_scan_free(void* h) { delete static_cast<Columns*>(h); }
 

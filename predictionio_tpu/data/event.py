@@ -100,6 +100,13 @@ class Event:
         for field in ("event", "entityType", "entityId"):
             if field not in d or not isinstance(d[field], str):
                 raise ValueError(f"field {field} is required and must be a string")
+        # optional string fields must still BE strings: a numeric
+        # targetEntityId would be accepted (bool(7) passes validate), then
+        # persisted as a JSON number that the two scan paths decode
+        # differently (python interns the int, the native scanner drops it)
+        for field in ("targetEntityType", "targetEntityId", "eventId", "prId"):
+            if d.get(field) is not None and not isinstance(d[field], str):
+                raise ValueError(f"field {field} must be a string")
         props = d.get("properties")
         if props is None:
             props = {}

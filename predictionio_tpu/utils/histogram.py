@@ -43,28 +43,37 @@ class LatencyHistogram:
     def _bucket_upper(self, i: int) -> float:
         return self.MIN_SEC * (self.FACTOR ** i)
 
+    def _percentile_of(
+        self, buckets: list[int], count: int, mx: float, q: float
+    ) -> float:
+        if count == 0:
+            return 0.0
+        target = q * count
+        acc = 0
+        for i, c in enumerate(buckets):
+            acc += c
+            if acc >= target:
+                return self._bucket_upper(i)
+        return mx
+
     def percentile(self, q: float) -> float:
         with self._lock:
-            if self._count == 0:
-                return 0.0
-            target = q * self._count
-            acc = 0
-            for i, c in enumerate(self._buckets):
-                acc += c
-                if acc >= target:
-                    return self._bucket_upper(i)
-            return self._max
+            return self._percentile_of(self._buckets, self._count, self._max, q)
 
     def summary(self) -> dict:
+        # ONE snapshot under the lock: re-reading live state per percentile
+        # could report a p99 above the reported max when observe() lands
+        # between the reads
         with self._lock:
             count, total, mx = self._count, self._sum, self._max
+            buckets = list(self._buckets)
         if count == 0:
             return {"count": 0}
         return {
             "count": count,
             "mean_ms": 1000.0 * total / count,
-            "p50_ms": 1000.0 * self.percentile(0.50),
-            "p95_ms": 1000.0 * self.percentile(0.95),
-            "p99_ms": 1000.0 * self.percentile(0.99),
+            "p50_ms": 1000.0 * self._percentile_of(buckets, count, mx, 0.50),
+            "p95_ms": 1000.0 * self._percentile_of(buckets, count, mx, 0.95),
+            "p99_ms": 1000.0 * self._percentile_of(buckets, count, mx, 0.99),
             "max_ms": 1000.0 * mx,
         }

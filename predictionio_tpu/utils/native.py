@@ -53,17 +53,21 @@ def get_library() -> ctypes.CDLL | None:
             digest = hashlib.sha256(f.read()).hexdigest()[:16]
         so_path = os.path.join(_build_dir(), f"pio_scan_{digest}.so")
         if not os.path.exists(so_path):
+            # per-process tmp name: multi-host workers share PIO_FS_BASEDIR
+            # and compile concurrently — a shared ".tmp" let one process
+            # install another's half-written ELF under the digest name
+            tmp = f"{so_path}.{os.getpid()}.tmp"
             try:
                 subprocess.run(
                     [
                         "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                        "-o", so_path + ".tmp", src,
+                        "-o", tmp, src,
                     ],
                     check=True,
                     capture_output=True,
                     timeout=120,
                 )
-                os.replace(so_path + ".tmp", so_path)
+                os.replace(tmp, so_path)
                 logger.info("built native scan library: %s", so_path)
             except (subprocess.SubprocessError, OSError) as exc:
                 logger.warning("native build failed (%s); using python path", exc)
@@ -96,6 +100,13 @@ def get_library() -> ctypes.CDLL | None:
         lib.pio_scan_vocab_get.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int64]
         lib.pio_scan_row_id.restype = ctypes.c_char_p
         lib.pio_scan_row_id.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pio_scan_ids_total_bytes.restype = ctypes.c_int64
+        lib.pio_scan_ids_total_bytes.argtypes = [ctypes.c_void_p]
+        lib.pio_scan_copy_ids.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p,
+        ]
         lib.pio_scan_free.argtypes = [ctypes.c_void_p]
         lib.pio_coo_group.restype = ctypes.c_int32
         lib.pio_coo_group.argtypes = [
@@ -195,6 +206,26 @@ def scan_jsonl_columnar(
                 for i in range(size)
             ]
 
+        # row ids in TWO ffi calls (lengths + one concatenated buffer):
+        # a pio_scan_row_id call + decode per row was a python loop that
+        # rivaled the whole C++ scan at 20M rows
+        event_ids: list[str] = []
+        if n:
+            lengths = np.empty(n, np.int32)
+            buf = ctypes.create_string_buffer(
+                max(1, int(lib.pio_scan_ids_total_bytes(handle)))
+            )
+            lib.pio_scan_copy_ids(
+                handle,
+                lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                buf,
+            )
+            raw = buf.raw
+            pos = 0
+            for ln in lengths.tolist():
+                event_ids.append(raw[pos : pos + ln].decode())
+                pos += ln
+
         return {
             "entity_ids": entity_ids,
             "target_ids": target_ids,
@@ -204,9 +235,7 @@ def scan_jsonl_columnar(
             "entity_vocab": vocab(0),
             "target_vocab": vocab(1),
             "event_vocab": vocab(2),
-            "event_ids": [
-                lib.pio_scan_row_id(handle, i).decode() for i in range(n)
-            ],
+            "event_ids": event_ids,
         }
     finally:
         lib.pio_scan_free(handle)

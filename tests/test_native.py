@@ -77,13 +77,16 @@ class TestNativeScan:
 
     def test_handles_escapes_and_missing_fields(self, lib, tmp_path):
         path = tmp_path / "weird.jsonl"
+        # eventIds matter: id-less rows share the upsert key "" and
+        # collapse to one, on BOTH scan paths (jsonl.py by_id dedup)
         rows = [
             {"event": "rate", "entityType": "user", "entityId": 'u"quoted"',
              "targetEntityType": "item", "targetEntityId": "i\\slash",
              "properties": {"rating": 2.5, "nested": {"rating": 99}},
-             "eventTime": "2024-06-01T12:30:00.000+02:00"},
+             "eventTime": "2024-06-01T12:30:00.000+02:00", "eventId": "a"},
             {"event": "view", "entityType": "user", "entityId": "u2",
-             "properties": {}, "eventTime": "2024-06-01T10:30:00.000Z"},
+             "properties": {}, "eventTime": "2024-06-01T10:30:00.000Z",
+             "eventId": "b"},
         ]
         with open(path, "w") as f:
             for r in rows:
@@ -166,6 +169,75 @@ class TestNativeEdgeSemantics:
         # latest version is "view"; filtering for "rate" must NOT resurrect it
         assert len(client.p_events().to_columnar(APP, event_names=["rate"])) == 0
         assert len(client.p_events().to_columnar(APP, event_names=["view"])) == 1
+
+    def test_unicode_ids_match_python_path(self, lib, tmp_path):
+        """json.dumps(ensure_ascii=True) stores non-ASCII ids as \\uXXXX
+        escapes; the native scan must DECODE them (incl. a surrogate pair)
+        so both scan paths intern identical vocab strings
+        (code-review r4: it kept the escape text verbatim)."""
+        client = JSONLStorageClient({"PATH": str(tmp_path / "uni")})
+        l = client.l_events()
+        for ent, tgt in (("müller", "商品1"), ("πθ", "🎬movie")):  # incl. astral
+            l.insert(
+                Event(
+                    event="rate", entity_type="user", entity_id=ent,
+                    target_entity_type="item", target_entity_id=tgt,
+                    properties=DataMap({"rating": 3.0}),
+                ),
+                APP,
+            )
+        p = client.p_events()
+        native = p.to_columnar(APP)
+        from predictionio_tpu.data.storage.base import PEvents
+
+        python = PEvents.to_columnar(p, APP)
+        assert native.entity_vocab == python.entity_vocab == ["müller", "πθ"]
+        assert native.target_vocab == python.target_vocab == ["商品1", "🎬movie"]
+
+    def test_truncated_escape_does_not_crash(self, lib, tmp_path):
+        """A crash-truncated file ending mid-\\u escape must not read past
+        the line buffer (code-review r4: the cursor advanced 4 bytes
+        unconditionally); the malformed row is dropped, prior rows scan."""
+        path = tmp_path / "trunc.jsonl"
+        good = {"event": "rate", "entityType": "u", "entityId": "ok",
+                "properties": {"rating": 1.0}}
+        with open(path, "w") as f:
+            f.write(json.dumps(good) + "\n")
+            f.write('{"event": "rate", "entityType": "u", "entityId": "a\\u00')
+        out = scan_jsonl_columnar(str(path))
+        assert out is not None
+        assert out["entity_vocab"] == ["ok"]
+
+    def test_compact_timezone_offset(self, lib, tmp_path):
+        """+HHMM (no colon) must parse as hours+minutes, matching
+        fromisoformat — the sscanf read +0530 as 530 hours."""
+        path = tmp_path / "tz.jsonl"
+        rows = [
+            {"event": "a", "entityType": "u", "entityId": "x",
+             "eventTime": "2026-07-30T12:00:00+0530", "eventId": "a"},
+            {"event": "a", "entityType": "u", "entityId": "y",
+             "eventTime": "2026-07-30T06:30:00Z", "eventId": "b"},  # same instant
+        ]
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        out = scan_jsonl_columnar(str(path))
+        assert out["timestamps"][0] == out["timestamps"][1]
+
+    def test_idless_rows_collapse_like_python_path(self, lib, tmp_path):
+        """Rows without an eventId all share the backend dedup key \"\"
+        (last wins); the native path used to keep every one of them."""
+        path = tmp_path / "noid.jsonl"
+        with open(path, "w") as f:
+            for n in range(3):
+                f.write(json.dumps({
+                    "event": "rate", "entityType": "u", "entityId": f"e{n}",
+                    "properties": {"rating": float(n)},
+                }) + "\n")
+        out = scan_jsonl_columnar(str(path))
+        assert len(out["entity_ids"]) == 1
+        assert out["entity_vocab"] == ["e2"]  # last id-less row wins
+        assert out["ratings"][0] == 2.0
 
     def test_time_sorted_with_real_ids(self, lib, tmp_path):
         import datetime as dt
