@@ -20,6 +20,7 @@
 // string-state tracking.
 
 #include <algorithm>
+#include <cctype>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -237,15 +238,24 @@ double parse_iso8601(const std::string& s) {
   size_t zpos = s.find_last_of("Z+-");
   if (zpos != std::string::npos && zpos >= 19 && s[zpos] != 'Z') {
     const char* z = s.c_str() + zpos + 1;
-    int oh = 0, om = 0;
+    int oh = 0, om = 0, osec = 0;
     if (strchr(z, ':')) {
-      sscanf(z, "%d:%d", &oh, &om);
+      sscanf(z, "%d:%d:%d", &oh, &om, &osec);  // ":SS" optional
     } else {
-      int v = atoi(z);
-      if (strlen(z) >= 4) { oh = v / 100; om = v % 100; }
+      // compact form must be exactly HH, HHMM or HHMMSS, all digits —
+      // python's fromisoformat accepts those three and rejects e.g.
+      // "+530", which atoi would otherwise read as 530 HOURS; agree with
+      // the python path by treating anything else as a malformed row
+      size_t zlen = strlen(z);
+      if (zlen != 2 && zlen != 4 && zlen != 6) return 0.0;
+      for (size_t i = 0; i < zlen; ++i)
+        if (!isdigit(static_cast<unsigned char>(z[i]))) return 0.0;
+      long v = atol(z);
+      if (zlen == 6) { oh = v / 10000; om = (v / 100) % 100; osec = v % 100; }
+      else if (zlen == 4) { oh = v / 100; om = v % 100; }
       else oh = v;
     }
-    double off = oh * 3600.0 + om * 60.0;
+    double off = oh * 3600.0 + om * 60.0 + osec;
     ts += (s[zpos] == '-') ? off : -off;
   }
   return ts;

@@ -189,7 +189,9 @@ def create(
     from predictionio_tpu.data.store.event_store import PEventStore, resolve_app
 
     store = PEventStore(storage)
-    if until_time is None:
+    stamp_keyed = until_time is None
+    cacheable = True
+    if stamp_keyed:
         # "everything so far": key on the store's VERSION STAMP, not
         # wall-clock "now" — a now-keyed digest can never hit, so every
         # call rescanned the row store and left another npz behind
@@ -197,6 +199,11 @@ def create(
             store._storage, app_name, channel_name
         )
         stamp = store._storage.get_p_events().version_stamp(app_id, channel_id)
+        # a backend that cannot stamp cheaply returns None (base-class
+        # default); keying on the constant 'stamp:None' would serve the
+        # first npz forever while events accumulate — mirror snapshot.py
+        # and bypass the cache instead
+        cacheable = stamp is not None
         end_key = f"stamp:{stamp}"
     else:
         end_key = str(until_time)
@@ -211,9 +218,16 @@ def create(
     view_dir = os.path.join(base, "view")
     os.makedirs(view_dir, exist_ok=True)
     prefix = f"{name or 'view'}-{app_name}-"
-    path = os.path.join(view_dir, f"{prefix}{digest}.npz")
+    # stamp-keyed entries carry a marker so the prune below can tell them
+    # apart from explicit-until_time entries ("t-"), which are immutable
+    # and valid forever (pruning those thrashed workloads alternating >4
+    # windows); marking BOTH kinds lets pre-marker legacy files — which
+    # can never be hit again under this naming — be swept instead of
+    # orphaned
+    marker = "stamp-" if stamp_keyed else "t-"
+    path = os.path.join(view_dir, f"{prefix}{marker}{digest}.npz")
 
-    if os.path.exists(path):
+    if cacheable and os.path.exists(path):
         with np.load(path, allow_pickle=False) as z:
             return {k: z[k] for k in z.files}
 
@@ -229,22 +243,35 @@ def create(
             converted.append(_record_to_dict(rec))
 
     cols = _columnarise(converted)
+    if not cacheable:
+        return cols
     tmp = path + ".tmp.npz"
     np.savez(tmp[:-4], **cols)
     os.replace(tmp, path)
-    # bound the cache: stamp-keyed digests go stale as events arrive; keep
-    # the newest few per (name, app) and drop the rest. Stat per-file under
-    # try: a concurrent create() (multi-host workers share the dir) may
-    # unlink an entry between listdir and the stat — that must not fail a
-    # build whose own output was already written successfully.
+    # bound the cache: only STAMP-keyed digests go stale as events arrive;
+    # keep the newest few per (name, app) and drop the rest.
+    # Explicit-until_time entries (no marker) are immutable and stay. Stat
+    # per-file under try: a concurrent create() (multi-host workers share
+    # the dir) may unlink an entry between listdir and the stat — that must
+    # not fail a build whose own output was already written successfully.
     aged: list[tuple[float, str]] = []
     for f in os.listdir(view_dir):
-        if f.startswith(prefix) and f.endswith(".npz"):
-            p = os.path.join(view_dir, f)
+        if not (f.startswith(prefix) and f.endswith(".npz")):
+            continue
+        rest = f[len(prefix):]
+        p = os.path.join(view_dir, f)
+        if rest.startswith("stamp-"):
             try:
                 aged.append((os.path.getmtime(p), p))
             except OSError:
                 continue  # already gone
+        elif not rest.startswith("t-"):
+            # pre-marker legacy entry: unreachable under the marker naming
+            # (never hit again), so delete rather than orphan
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
     for _, old in sorted(aged, reverse=True)[4:]:
         try:
             os.unlink(old)

@@ -15,6 +15,7 @@ import datetime as _dt
 import json
 import logging
 import os
+import sys
 import time
 from typing import Any
 
@@ -82,14 +83,29 @@ def run_train(
     """
     storage = storage or Storage.instance()
     ctx = ctx or WorkflowContext(mode="training", _storage=storage, batch=batch)
-    # multi-host detection via the launcher's env contract, NOT
-    # jax.process_count(): calling into jax here would initialize the XLA
-    # backend for every train — including pure-host LocalAlgorithm engines
-    # that never touch jax — contending for the accelerator with any
-    # already-deployed server on the same machine
-    if os.environ.get("PIO_COORDINATOR") or os.environ.get(
-        "JAX_COORDINATOR_ADDRESS"
-    ):
+    # multi-host detection via the launcher's env contract, NOT an
+    # unconditional jax.process_count(): calling into jax here would
+    # initialize the XLA backend for every train — including pure-host
+    # LocalAlgorithm engines that never touch jax — contending for the
+    # accelerator with any already-deployed server on the same machine.
+    # A deployment that initializes jax.distributed programmatically
+    # (without the launcher env contract) is still covered: when jax is
+    # ALREADY imported AND its distributed runtime is initialized,
+    # consulting it is safe — ``is_initialized`` only reads client state,
+    # and ``process_count`` can no longer trigger a *fresh* backend init
+    # fight because distributed init implies the deployment owns the
+    # device. Without the check every such process would take the
+    # coordinator path and concurrently write metadata/models.
+    multi_host = bool(
+        os.environ.get("PIO_COORDINATOR")
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    )
+    if not multi_host and "jax" in sys.modules:
+        import jax
+
+        if getattr(jax.distributed, "is_initialized", lambda: False)():
+            multi_host = jax.process_count() > 1
+    if multi_host:
         import jax
 
         if jax.process_count() > 1 and jax.process_index() != 0:

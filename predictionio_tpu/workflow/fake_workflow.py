@@ -88,7 +88,14 @@ class FakeRun:
                             )
                         except (TypeError, ValueError):
                             n_pos = 1
-                        fn = raw.__get__(self, type(self)) if n_pos >= 2 else raw
+                        # a callable INSTANCE (defines __call__, no
+                        # __get__) is not a descriptor — invoke it
+                        # directly regardless of arity
+                        fn = (
+                            raw.__get__(self, type(self))
+                            if n_pos >= 2 and hasattr(raw, "__get__")
+                            else raw
+                        )
                     else:
                         fn = raw
                     break

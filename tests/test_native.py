@@ -224,6 +224,41 @@ class TestNativeEdgeSemantics:
         out = scan_jsonl_columnar(str(path))
         assert out["timestamps"][0] == out["timestamps"][1]
 
+    def test_malformed_compact_offset_not_hours(self, lib, tmp_path):
+        """'+530' (3 digits, rejected by fromisoformat) must not parse as
+        atoi=530 HOURS (advisor r4): the native path treats it as a
+        malformed time (epoch), never a silently skewed timestamp."""
+        path = tmp_path / "badtz.jsonl"
+        rows = [
+            {"event": "a", "entityType": "u", "entityId": "x",
+             "eventTime": "2026-07-30T12:00:00+530", "eventId": "a"},
+            {"event": "a", "entityType": "u", "entityId": "y",
+             "eventTime": "2026-07-30T12:00:00+05a0", "eventId": "b"},
+        ]
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        out = scan_jsonl_columnar(str(path))
+        # both rows survive with the malformed-time marker, not ±530h skew
+        assert list(out["timestamps"]) == [0.0, 0.0]
+
+    def test_seconds_bearing_offsets_match_python(self, lib, tmp_path):
+        """fromisoformat also accepts ±HHMMSS and ±HH:MM:SS — the native
+        guard must not call those malformed (code-review r5)."""
+        import datetime as dt
+
+        path = tmp_path / "sectz.jsonl"
+        times = ["2026-07-30T12:00:00+053007", "2026-07-30T12:00:00+05:30:07"]
+        with open(path, "w") as f:
+            for n, t in enumerate(times):
+                f.write(json.dumps({
+                    "event": "a", "entityType": "u", "entityId": f"e{n}",
+                    "eventTime": t, "eventId": f"id{n}",
+                }) + "\n")
+        out = scan_jsonl_columnar(str(path))
+        expected = dt.datetime.fromisoformat(times[0]).timestamp()
+        assert sorted(out["timestamps"]) == [expected, expected]
+
     def test_idless_rows_collapse_like_python_path(self, lib, tmp_path):
         """Rows without an eventId all share the backend dedup key \"\"
         (last wins); the native path used to keep every one of them."""

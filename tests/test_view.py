@@ -213,6 +213,91 @@ class TestDataView:
         files = [f for f in os.listdir(tmp_path / "view") if f.startswith("nowless-")]
         assert len(files) <= 4
 
+    def test_none_stamp_bypasses_cache(self, app_with_events, tmp_path, monkeypatch):
+        """A backend that cannot stamp cheaply (version_stamp() -> None,
+        the documented base-class default) must BYPASS the cache, not key
+        on the constant 'stamp:None' — which served the first npz forever
+        while new events accumulated (advisor r4)."""
+        import os
+
+        calls = []
+
+        def convert(e: Event):
+            calls.append(1)
+            return {"u": e.entity_id}
+
+        st = app_with_events
+        p_events = st.get_p_events()
+        monkeypatch.setattr(
+            type(p_events), "version_stamp", lambda self, a, c=None: None
+        )
+        kw = dict(name="nostamp", base_dir=str(tmp_path))
+        cols = view.create("viewapp", convert, **kw)
+        assert len(cols["u"]) == 6
+        n1 = len(calls)
+        # second call must RESCAN (no false cache hit) and see new events
+        app = st.get_meta_data_apps().get_by_name("viewapp")
+        st.get_l_events().insert(_ev("rate", "u9", 9, target="i9"), app.id)
+        cols2 = view.create("viewapp", convert, **kw)
+        assert len(cols2["u"]) == 7 and len(calls) > n1
+        # nothing was written for the uncacheable view
+        view_dir = tmp_path / "view"
+        if view_dir.exists():
+            assert not [f for f in os.listdir(view_dir) if f.startswith("nostamp-")]
+
+    def test_prune_spares_explicit_until_time_views(self, app_with_events, tmp_path):
+        """Explicit-until_time views are immutable and valid forever; a
+        workload alternating among >4 fixed windows must keep hitting the
+        cache (advisor r4: the prune kept only the 4 newest npz per
+        prefix, including immutable window views)."""
+        calls = []
+
+        def convert(e: Event):
+            calls.append(1)
+            return {"u": e.entity_id}
+
+        windows = [T0 + dt.timedelta(days=d) for d in range(1, 8)]
+        kw = dict(name="win", base_dir=str(tmp_path))
+        for w in windows:
+            view.create("viewapp", convert, until_time=w, **kw)
+        n1 = len(calls)
+        # every one of the 7 windows is still cached: zero re-scans
+        for w in windows:
+            view.create("viewapp", convert, until_time=w, **kw)
+        assert len(calls) == n1
+        # stamp-keyed entries are still bounded (prune applies to them)
+        for _ in range(6):
+            st = app_with_events
+            app = st.get_meta_data_apps().get_by_name("viewapp")
+            st.get_l_events().insert(_ev("rate", "u8", 8, target="i8"), app.id)
+            view.create("viewapp", convert, **kw)
+        import os
+
+        stamped = [
+            f for f in os.listdir(tmp_path / "view") if f.startswith("win-viewapp-stamp-")
+        ]
+        assert 0 < len(stamped) <= 4
+
+    def test_legacy_unmarked_entries_swept_not_orphaned(
+        self, app_with_events, tmp_path
+    ):
+        """Pre-marker npz files (written before the stamp-/t- naming) can
+        never be cache-hit again; the prune must delete them instead of
+        letting them accumulate forever (code-review r5)."""
+        import os
+
+        view_dir = tmp_path / "view"
+        view_dir.mkdir()
+        legacy = view_dir / ("leg-viewapp-" + "ab" * 8 + ".npz")
+        legacy.write_bytes(b"legacy")
+        view.create(
+            "viewapp", lambda e: {"u": e.entity_id}, name="leg",
+            base_dir=str(tmp_path),
+        )
+        names = os.listdir(view_dir)
+        assert legacy.name not in names  # swept
+        assert any(n.startswith("leg-viewapp-stamp-") for n in names)
+
     def test_empty_result(self, app_with_events, tmp_path):
         cols = view.create(
             "viewapp",

@@ -44,6 +44,51 @@ class TestRunTrain:
         models = model_io.deserialize_models(blob.models)
         assert models == [Model0(3, 1, 2)]
 
+    def test_programmatic_distributed_init_takes_worker_path(
+        self, memory_storage, monkeypatch
+    ):
+        """A deployment that initializes jax.distributed programmatically
+        (no PIO_COORDINATOR/JAX_COORDINATOR_ADDRESS env contract) must
+        still put non-zero processes on the worker path — otherwise every
+        process writes engine-instance metadata and models concurrently
+        (advisor r4). Detection keys on the already-imported jax module,
+        so no backend init is forced on pure-host engines."""
+        import jax
+
+        monkeypatch.delenv("PIO_COORDINATOR", raising=False)
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        instance_id = run_train(
+            make_engine(), manifest(), params(), storage=memory_storage
+        )
+        assert instance_id == ""  # worker: trained but never wrote metadata
+        assert not memory_storage.get_meta_data_engine_instances().get_all()
+
+    def test_plain_jax_import_stays_on_coordinator_path(
+        self, memory_storage, monkeypatch
+    ):
+        """jax being merely *imported* (it always is — controller.algorithm
+        imports it at module level) must NOT trigger a process_count()
+        probe, which would initialize the XLA backend for pure-host
+        engines and contend for an exclusively-held device (code-review
+        r5): without distributed init, the env-less train is single-host."""
+        import jax
+
+        monkeypatch.delenv("PIO_COORDINATOR", raising=False)
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+
+        def boom():  # pragma: no cover - the assertion is that it never runs
+            raise AssertionError("process_count must not be consulted")
+
+        monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+        monkeypatch.setattr(jax, "process_count", boom)
+        instance_id = run_train(
+            make_engine(), manifest(), params(), storage=memory_storage
+        )
+        assert instance_id  # single-host coordinator path wrote metadata
+
     def test_profile_dir_writes_xla_trace(self, memory_storage, tmp_path, monkeypatch):
         """PIO_PROFILE_DIR wraps engine.train in a jax profiler trace (the
         perf-attribution tool the reference lacks, SURVEY.md §5); the
@@ -275,6 +320,23 @@ class TestFakeRun:
 
         class Hello(FakeRun):
             func = lambda ctx: ctx.mode  # noqa: E731
+
+        assert Hello().run(WorkflowContext(mode="evaluation")).value == "evaluation"
+
+    def test_callable_instance_class_attribute(self):
+        """A callable INSTANCE (defines __call__, no __get__) assigned as
+        `func` must be invoked, not passed to descriptor binding (advisor
+        r4: raw.__get__ raised AttributeError for >=2-positional
+        callables)."""
+        from predictionio_tpu.workflow.context import WorkflowContext
+        from predictionio_tpu.workflow.fake_workflow import FakeRun
+
+        class TwoArgCallable:
+            def __call__(self, ctx, extra=None):
+                return ctx.mode
+
+        class Hello(FakeRun):
+            func = TwoArgCallable()
 
         assert Hello().run(WorkflowContext(mode="evaluation")).value == "evaluation"
 
