@@ -1025,7 +1025,14 @@ def _bench_twotower_recall(
 def phase_secondary(ck: _Checkpoint) -> None:
     _jax_setup()
     ck.save(naive_bayes_train_ms=round(_bench_naive_bayes(), 2))
-    ck.save(cooccurrence_build_ms=round(_bench_cooccurrence(), 1))
+    cooccur_ms = _bench_cooccurrence()
+    ck.save(
+        cooccurrence_build_ms=round(cooccur_ms, 1),
+        # the ML-1M similar-product build target (round-4 verdict #8); the
+        # native kernel runs it ~150ms on the dev host vs 945ms host-side
+        # in r3
+        cooccurrence_build_gate_ok=bool(cooccur_ms < 300.0),
+    )
     cold, warm = _bench_snapshot_ingest()
     ck.save(
         snapshot_ingest_cold_s=round(cold, 3),
@@ -1229,7 +1236,12 @@ def _bench_naive_bayes(n: int = 200_000, f: int = 64, classes: int = 8) -> float
 
 
 def _bench_cooccurrence(n_users: int = 6040, n_items: int = 3700, nnz: int = 1_000_000) -> float:
-    """Similar-product cooccurrence build at ML-1M scale (BASELINE workload 3)."""
+    """Similar-product cooccurrence build at ML-1M scale (BASELINE workload 3).
+
+    Min-of-3 with a warm native library: the build is a pure host+native
+    measurement (r5 moved the pair counting into ``pio_cooccur_topn``) and
+    single-shot timings on the 1-core bench host carry multi-hundred-ms
+    scheduler noise."""
     import numpy as np
 
     from predictionio_tpu.ops.cooccurrence import cooccurrence_top_n
@@ -1237,9 +1249,13 @@ def _bench_cooccurrence(n_users: int = 6040, n_items: int = 3700, nnz: int = 1_0
     rng = np.random.default_rng(0)
     u = rng.integers(0, n_users, nnz).astype(np.int32)
     i = (rng.zipf(1.3, nnz) % n_items).astype(np.int32)
-    t0 = time.perf_counter()
-    cooccurrence_top_n(u, i, n_items, 20)
-    return (time.perf_counter() - t0) * 1000.0
+    cooccurrence_top_n(u[:1000], i[:1000], n_items, 20)  # build/load the lib
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cooccurrence_top_n(u, i, n_items, 20)
+        best = min(best, (time.perf_counter() - t0) * 1000.0)
+    return best
 
 
 def phase_probe(ck: _Checkpoint) -> None:

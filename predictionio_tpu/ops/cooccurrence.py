@@ -22,22 +22,40 @@ def cooccurrence_top_n(
 ) -> dict[int, list[tuple[int, int]]]:
     """Returns item -> [(other_item, count)] sorted by count desc, len<=top_n.
 
-    The Spark self-join is one sparse matmul: with A the distinct binary
-    user x item interaction matrix, ``A.T @ A`` is the full cooccurrence
-    count matrix (diagonal = item popularity, zeroed out). scipy's CSR
-    product runs this at ML-1M scale in tens of milliseconds where the
-    per-user pair expansion took seconds.
+    Two formulations, fastest first:
+
+    - native (``pio_cooccur_topn``): per-user pair increments into a dense
+      count row (fits L1 for ML-scale vocabs) + C++ top-N select — the
+      whole ML-1M build lands well under the 300 ms bench gate;
+    - scipy fallback: with A the distinct binary user x item interaction
+      matrix, ``A.T @ A`` is the full cooccurrence count matrix (diagonal
+      = item popularity, zeroed out).
     """
     from scipy import sparse
+
+    from predictionio_tpu.utils.native import cooccur_topn
 
     u = np.asarray(user_idx, np.int64)
     it = np.asarray(item_idx, np.int64)
     if len(u) == 0:
         return {}
     # distinct (user, item) via 1-D codes — np.unique(axis=0) does a
-    # structured-void sort that is ~50x slower at ML-1M scale
+    # structured-void sort that is ~50x slower at ML-1M scale. The sorted
+    # codes come back grouped by user with items ascending within a user:
+    # exactly the native kernel's input contract.
     codes = np.unique(u * n_items + it)
     users, items = codes // n_items, codes % n_items
+    native = cooccur_topn(users, items, n_items, top_n)
+    if native is not None:
+        out_items, out_counts = native
+        n_valid = (out_items >= 0).sum(axis=1)  # -1 padding is a tail
+        items_l = out_items.tolist()
+        counts_l = out_counts.tolist()
+        out: dict[int, list[tuple[int, int]]] = {}
+        for item, nv in enumerate(n_valid.tolist()):
+            if nv:
+                out[item] = list(zip(items_l[item][:nv], counts_l[item][:nv]))
+        return out
     n_users = int(users.max()) + 1
     A = sparse.csr_matrix(
         (np.ones(len(users), np.int64), (users, items)),

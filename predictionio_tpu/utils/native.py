@@ -119,8 +119,48 @@ def get_library() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_int32),
         ]
+        lib.pio_cooccur_topn.restype = ctypes.c_int32
+        lib.pio_cooccur_topn.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
         _lib = lib
         return _lib
+
+
+def cooccur_topn(
+    users: np.ndarray, items: np.ndarray, n_items: int, top_n: int
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Dense-row cooccurrence count + top-N select at C++ speed. ``users``
+    must be sorted ascending with DISTINCT (user, item) pairs (the shape
+    ``np.unique`` over 1-D codes produces). Returns ``(items, counts)``
+    matrices of shape (n_items, top_n), item slots padded with -1 — or
+    None when the native library is unavailable or declines (huge vocab,
+    out-of-range ids), in which case callers use the scipy path."""
+    lib = get_library()
+    if lib is None:
+        return None
+    users = np.ascontiguousarray(users, np.int32)
+    items = np.ascontiguousarray(items, np.int32)
+    out_items = np.empty((n_items, top_n), np.int32)
+    out_counts = np.empty((n_items, top_n), np.int32)
+    rc = lib.pio_cooccur_topn(
+        users.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        items.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        users.shape[0],
+        n_items,
+        top_n,
+        out_items.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        return None
+    return out_items, out_counts
 
 
 def coo_group(

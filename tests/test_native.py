@@ -292,3 +292,80 @@ class TestNativeEdgeSemantics:
         assert cols.entity_vocab == ["e1", "e2", "e3"]  # first-use in time order
         assert list(cols.timestamps) == sorted(cols.timestamps)
         assert cols.event_ids == [ids[1], ids[2], ids[0]]
+
+
+class TestNativeCooccurrence:
+    """pio_cooccur_topn: the ML-1M similar-product pair-count build moved
+    to C++ (round-4 verdict #8). The pair-expansion python oracle pins
+    counts, order (count desc, item asc) and truncation."""
+
+    def _random_case(self, seed, n_users, n_items, nnz, top_n):
+        from predictionio_tpu.ops.cooccurrence import (
+            _cooccurrence_top_n_reference,
+            cooccurrence_top_n,
+        )
+
+        rng = np.random.default_rng(seed)
+        u = rng.integers(0, n_users, nnz).astype(np.int32)
+        i = rng.integers(0, n_items, nnz).astype(np.int32)
+        assert cooccurrence_top_n(u, i, n_items, top_n) == (
+            _cooccurrence_top_n_reference(u, i, n_items, top_n)
+        )
+
+    def test_parity_with_oracle(self, lib):
+        self._random_case(0, 40, 30, 2000, 5)
+        self._random_case(1, 7, 12, 300, 50)  # top_n > distinct neighbors
+
+    def test_parity_zipf_ties(self, lib):
+        """Skewed items produce heavy count ties — the (count desc, item
+        asc) tie-break must match the lexsort fallback exactly."""
+        from predictionio_tpu.ops.cooccurrence import (
+            _cooccurrence_top_n_reference,
+            cooccurrence_top_n,
+        )
+
+        rng = np.random.default_rng(2)
+        u = rng.integers(0, 60, 4000).astype(np.int32)
+        i = (rng.zipf(1.3, 4000) % 25).astype(np.int32)
+        assert cooccurrence_top_n(u, i, 25, 7) == (
+            _cooccurrence_top_n_reference(u, i, 25, 7)
+        )
+
+    def test_native_wrapper_contract(self, lib):
+        """Direct wrapper call: shape, -1 tail padding, sorted-input
+        requirement honored by the np.unique code path."""
+        from predictionio_tpu.utils.native import cooccur_topn
+
+        users = np.array([0, 0, 1, 1], np.int32)
+        items = np.array([1, 2, 1, 2], np.int32)
+        res = cooccur_topn(users, items, 4, 3)
+        assert res is not None
+        out_items, out_counts = res
+        assert out_items.shape == (4, 3)
+        assert list(out_items[1]) == [2, -1, -1]  # item 1 co-occurs with 2
+        assert list(out_counts[1]) == [2, 0, 0]  # in both user baskets
+        assert list(out_items[0]) == [-1, -1, -1]  # item 0 never seen
+        assert list(out_items[3]) == [-1, -1, -1]
+
+    def test_out_of_range_item_falls_back(self, lib):
+        """Ids outside [0, n_items) make the kernel decline (rc!=0) so the
+        caller can fall back instead of corrupting memory."""
+        from predictionio_tpu.utils.native import cooccur_topn
+
+        users = np.array([0, 0], np.int32)
+        items = np.array([1, 9], np.int32)
+        assert cooccur_topn(users, items, 4, 2) is None
+
+    def test_scipy_fallback_matches_oracle_without_lib(self, monkeypatch):
+        """When the native library is unavailable the scipy A.T@A path
+        serves the same answers."""
+        from predictionio_tpu.ops import cooccurrence as co
+        from predictionio_tpu.utils import native
+
+        monkeypatch.setattr(native, "get_library", lambda: None)
+        rng = np.random.default_rng(3)
+        u = rng.integers(0, 40, 2000).astype(np.int32)
+        i = rng.integers(0, 30, 2000).astype(np.int32)
+        assert co.cooccurrence_top_n(u, i, 30, 5) == (
+            co._cooccurrence_top_n_reference(u, i, 30, 5)
+        )
