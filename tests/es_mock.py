@@ -363,3 +363,19 @@ def make_server() -> tuple[ThreadingHTTPServer, str]:
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, f"http://127.0.0.1:{server.server_port}"
+
+
+if __name__ == "__main__":
+    # standalone mode: serve until killed, printing the base URL first —
+    # this is how the real-service contract lane is proven in-repo
+    # (``PIO_TEST_ES_URL`` pointed at an EXTERNAL process, see
+    # tests/test_real_service_lane.py) without a dockerized Elasticsearch
+    import sys
+
+    srv, base_url = make_server()
+    print(base_url, flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.shutdown()
+        sys.exit(0)

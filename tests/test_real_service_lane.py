@@ -1,0 +1,96 @@
+"""Proof that the real-service contract lane works end-to-end.
+
+The DAO contract suite in tests/test_storage.py accepts
+``PIO_TEST_ES_URL`` / ``PIO_TEST_PG_URL`` and runs unchanged against live
+servers (ref: the reference's dockerized LEventsSpec/PEventsSpec runs,
+``storage/jdbc/src/test/scala/.../LEventsSpec.scala:1-50``). No real
+Elasticsearch exists in this sandbox, so the lane is proven the next
+strongest way: the ES mock served as a SEPARATE OS PROCESS (network
+transport, process isolation, no shared in-process state) with the lane
+env var pointed at it — exactly how a developer points the lane at a
+staging server.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def external_es():
+    """tests.es_mock in standalone mode, in its own process."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tests.es_mock"],
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    try:
+        url = proc.stdout.readline().strip()
+        assert url.startswith("http://127.0.0.1:"), url
+        yield url
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_es_lane_runs_contract_suite_against_external_server(external_es):
+    """A representative slice of the event + metadata contract tests must
+    pass against the external server through the PIO_TEST_ES_URL lane.
+    The -k slice keeps this proof fast; the full suite runs the same way."""
+    env = {
+        **os.environ,
+        "PIO_TEST_ES_URL": external_es,
+        # the lane must not accidentally spawn in-process mocks
+        "PYTHONPATH": REPO,
+    }
+    res = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "-q", "--no-header", "-p", "no:cacheprovider",
+            "tests/test_storage.py",
+            "-k",
+            "elasticsearch and (insert_get_delete or find_filters or "
+            "channels_isolated or access_keys or models)",
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    tail = (res.stdout + res.stderr)[-2000:]
+    assert res.returncode == 0, tail
+    assert " passed" in res.stdout, tail
+
+
+def test_es_lane_alias_env_var(external_es, monkeypatch):
+    """PIO_TEST_ELASTICSEARCH_URL (the long-form alias) selects the real
+    server too: the client built by the lane talks to the external URL."""
+    monkeypatch.delenv("PIO_TEST_ES_URL", raising=False)
+    monkeypatch.setenv("PIO_TEST_ELASTICSEARCH_URL", external_es)
+    from tests.test_storage import _cleanup_client, _es_client
+
+    client = _es_client()
+    try:
+        assert not hasattr(client, "_mock_server")  # no in-process fallback
+        port = int(external_es.rsplit(":", 1)[1])
+        assert any(str(port) in u for u in client._transport.urls)
+        # one real round-trip through the external process
+        apps = client.apps()
+        from predictionio_tpu.data.storage.base import App
+
+        app_id = apps.insert(App(0, "lane-proof"))
+        assert apps.get(app_id).name == "lane-proof"
+    finally:
+        _cleanup_client(client)
