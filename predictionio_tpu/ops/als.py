@@ -717,7 +717,62 @@ def als_train(
         timings["upload_s"] = t_upload - t_pack
         timings["build_s"] = t_build - t_upload
         timings["device_s"] = time.perf_counter() - t_build
+        # block-table shapes, for the HBM bytes-moved model
+        # (solver_hbm_bytes_per_iter): nb = blocks per side, d = block width
+        timings["nb_u"] = int(dev[0].shape[0])
+        timings["nb_i"] = int(dev[4].shape[0])
+        timings["d"] = d
     return user_f[:n_users], item_f[:n_items]
+
+
+def solver_hbm_bytes_per_iter(
+    nb_u: int,
+    nb_i: int,
+    d: int,
+    f: int,
+    n_users: int,
+    n_items: int,
+    *,
+    gather_dtype: str = "f32",
+    solver: str = "cg",
+    implicit: bool = False,
+) -> int:
+    """Mandatory HBM traffic of one ALS iteration (both half-solves), in
+    bytes — the roofline denominator for ``als_hbm_util`` (bytes/iter ÷
+    measured iter time ÷ HBM bandwidth). This models the traffic the
+    formulation REQUIRES; the measured iteration can only be slower, so
+    util > 1 means the timing probe is broken, and util well below ~0.5
+    means the implementation (not the memory system) is the bottleneck.
+
+    Per half-solve with NB [d]-wide blocks over n_ent(+1 dummy) entities:
+
+    - block-stream reads: cols int32 + vals f32 + w int8 + the factor-row
+      gather (f x 4 bytes, or f x 2 under ``gather_dtype="bf16"``) —
+      NB*d*(9 + f*gb);
+    - Gram scatter-adds (read+modify+write of the [f,f]+[f]+[1] block
+      results): 2*NB*(f^2+f+1)*4;
+    - A-matrix assembly/regularization pass: 2*n_ent*f^2*4;
+    - cg solve: (f+4) batched matvecs re-reading A from HBM —
+      (f+4)*n_ent*f^2*4 — plus ~8 [f]-vector reads/writes per cg step;
+      cholesky is modeled as ~2 passes over A;
+    - implicit mode adds one shared-gram read of the opposite factors.
+    """
+    gb = 2 if gather_dtype == "bf16" else 4
+    total = 0
+    for nb, n_ent, n_opp in (
+        (nb_u, n_users + 1, n_items + 1),
+        (nb_i, n_items + 1, n_users + 1),
+    ):
+        stream = nb * d * (9 + f * gb)
+        gram_scatter = 2 * nb * (f * f + f + 1) * 4
+        assemble = 2 * n_ent * f * f * 4
+        if solver == "cg":
+            solve = (f + 4) * n_ent * (f * f + 8 * f) * 4
+        else:
+            solve = 2 * n_ent * f * f * 4
+        shared = n_opp * f * 4 if implicit else 0
+        total += stream + gram_scatter + assemble + solve + shared
+    return int(total)
 
 
 # ---------------------------------------------------------------------------

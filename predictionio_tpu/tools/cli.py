@@ -764,7 +764,7 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--appname", dest="app_name", required=True)
     x.add_argument("--output", required=True)
     x.add_argument("--channel")
-    x.add_argument("--format", default="json", choices=["json", "npz"])
+    x.add_argument("--format", default="json", choices=["json", "parquet", "npz"])
     x.set_defaults(fn=cmd_export)
 
     # templates

@@ -1,9 +1,18 @@
-"""Bulk import/export between JSON-lines files and the event store.
+"""Bulk import/export between files and the event store.
 
 Reference parity: ``tools/.../imprt/FileToEvents.scala:45-120`` (JSON lines
--> PEvents.write) and ``tools/.../export/EventsToFile.scala`` (PEvents.find
--> JSON lines; the reference also offered parquet via Spark SQL — here the
-columnar export (.npz) plays that role for training feeds).
+-> PEvents.write) and ``tools/.../export/EventsToFile.scala:85-95`` (the
+json-or-parquet switch: ``--format parquet`` wrote the events DataFrame via
+Spark SQL). Formats here:
+
+- ``json`` — wire-format JSON lines, byte-compatible with the event API;
+- ``parquet`` — one row per event with wire-named columns (``eventId``,
+  ``event``, ``entityType``, ..., ``eventTime`` as a tz-aware timestamp);
+  ``properties`` is a JSON-encoded string column rather than the
+  reference's Spark struct (schema-free properties don't fit a fixed
+  arrow struct; every consumer that reads the reference's output can
+  json-decode the column). Import accepts both layouts' common columns.
+- ``npz`` — dense columnar arrays (this framework's training feed).
 """
 
 from __future__ import annotations
@@ -27,31 +36,61 @@ def import_events(
     storage: Storage | None = None,
     batch_size: int = 10000,
 ) -> int:
-    """JSON-lines file -> event store. Returns number imported."""
+    """JSON-lines or parquet file -> event store. Returns number imported.
+    Parquet is selected by a ``.parquet`` extension."""
     storage = storage or Storage.instance()
     app_id, channel_id = resolve_app(storage, app_name, channel_name)
     levents = storage.get_l_events()
     levents.init(app_id, channel_id)
     count = 0
     batch: list[Event] = []
-    with open(input_path) as f:
-        for line_no, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                batch.append(Event.from_json_dict(json.loads(line)))
-            except Exception as exc:
-                raise ValueError(f"{input_path}:{line_no}: {exc}") from exc
+
+    def flush():
+        nonlocal count, batch
+        if batch:
+            levents.insert_batch(batch, app_id, channel_id)
+            count += len(batch)
+            batch = []
+
+    if input_path.endswith(".parquet"):
+        for ev in _iter_parquet_events(input_path):
+            batch.append(ev)
             if len(batch) >= batch_size:
-                levents.insert_batch(batch, app_id, channel_id)
-                count += len(batch)
-                batch = []
-    if batch:
-        levents.insert_batch(batch, app_id, channel_id)
-        count += len(batch)
+                flush()
+    else:
+        with open(input_path) as f:
+            for line_no, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    batch.append(Event.from_json_dict(json.loads(line)))
+                except Exception as exc:
+                    raise ValueError(f"{input_path}:{line_no}: {exc}") from exc
+                if len(batch) >= batch_size:
+                    flush()
+    flush()
     logger.info("imported %d events into app %s", count, app_name)
     return count
+
+
+def _iter_parquet_events(path: str):
+    """Yield Events from a parquet file with wire-named columns (the layout
+    ``export_events(format="parquet")`` writes; extra columns ignored)."""
+    import pyarrow.parquet as pq
+
+    pf = pq.ParquetFile(path)
+    for rb in pf.iter_batches():
+        for row in rb.to_pylist():
+            d = {k: v for k, v in row.items() if v is not None}
+            props = d.get("properties")
+            if isinstance(props, str):
+                d["properties"] = json.loads(props)
+            for key in ("eventTime", "creationTime"):
+                ts = d.get(key)
+                if ts is not None and not isinstance(ts, str):
+                    d[key] = ts.isoformat()
+            yield Event.from_json_dict(d)
 
 
 def export_events(
@@ -61,10 +100,38 @@ def export_events(
     storage: Storage | None = None,
     format: str = "json",
 ) -> int:
-    """Event store -> file. format=json (wire rows) or npz (columnar)."""
+    """Event store -> file. format=json (wire rows), parquet (wire-named
+    columns, ref EventsToFile.scala:85-95), or npz (columnar)."""
     storage = storage or Storage.instance()
     app_id, channel_id = resolve_app(storage, app_name, channel_name)
     pevents = storage.get_p_events()
+    if format == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        rows = []
+        for e in pevents.find(app_id, channel_id):
+            d = e.to_json_dict(with_creation_time=True)
+            props = d.get("properties")
+            rows.append(
+                {
+                    "eventId": d.get("eventId"),
+                    "event": d["event"],
+                    "entityType": d["entityType"],
+                    "entityId": d["entityId"],
+                    "targetEntityType": d.get("targetEntityType"),
+                    "targetEntityId": d.get("targetEntityId"),
+                    "properties": json.dumps(props, sort_keys=True)
+                    if props
+                    else None,
+                    "prId": d.get("prId"),
+                    "eventTime": d["eventTime"],
+                    "creationTime": d.get("creationTime"),
+                }
+            )
+        table = pa.Table.from_pylist(rows)
+        pq.write_table(table, output_path)
+        return len(rows)
     if format == "json":
         count = 0
         with open(output_path, "w") as f:
@@ -89,4 +156,4 @@ def export_events(
             event_vocab=np.array(col.event_vocab, dtype=object),
         )
         return len(col)
-    raise ValueError(f"unknown export format {format!r} (json|npz)")
+    raise ValueError(f"unknown export format {format!r} (json|parquet|npz)")

@@ -434,8 +434,46 @@ class TestDevicePack:
         u, i, v = self._coo(nnz=2000)
         t: dict = {}
         als_train(u, i, v, 120, 80, ALSConfig(rank=4, iterations=2), timings=t)
-        assert set(t) == {"pack_s", "upload_s", "build_s", "device_s"}
+        assert set(t) == {
+            "pack_s", "upload_s", "build_s", "device_s", "nb_u", "nb_i", "d",
+        }
         assert all(val >= 0 for val in t.values())
+        assert t["nb_u"] > 0 and t["nb_i"] > 0 and t["d"] >= 8
+
+    def test_hbm_bytes_model(self):
+        """Mandatory-traffic model for the roofline metric: bf16 gathers
+        shrink only the stream term; cg re-reads A (f+4) times vs
+        cholesky's ~2; host- and device-pack paths report identical block
+        shapes for identical data."""
+        from predictionio_tpu.ops.als import solver_hbm_bytes_per_iter
+
+        args = dict(nb_u=100, nb_i=80, d=128, f=32, n_users=1000, n_items=800)
+        f32 = solver_hbm_bytes_per_iter(**args)
+        bf16 = solver_hbm_bytes_per_iter(**args, gather_dtype="bf16")
+        stream_delta = (100 + 80) * 128 * 32 * 2  # half the gather bytes
+        assert f32 - bf16 == stream_delta
+        chol = solver_hbm_bytes_per_iter(**args, solver="cholesky")
+        assert chol < f32
+        # the dominant terms are positive and scale with the table size
+        assert solver_hbm_bytes_per_iter(
+            nb_u=200, nb_i=80, d=128, f=32, n_users=1000, n_items=800
+        ) > f32
+
+    def test_block_shapes_match_across_pack_paths(self):
+        u, i, v = self._coo(nnz=2000)
+        t_dev: dict = {}
+        t_host: dict = {}
+        als_train(
+            u, i, v, 120, 80,
+            ALSConfig(rank=4, iterations=1, pack="device"), timings=t_dev,
+        )
+        als_train(
+            u, i, v, 120, 80,
+            ALSConfig(rank=4, iterations=1, pack="host"), timings=t_host,
+        )
+        assert (t_dev["nb_u"], t_dev["nb_i"], t_dev["d"]) == (
+            t_host["nb_u"], t_host["nb_i"], t_host["d"]
+        )
 
     def test_out_of_range_indices_rejected(self):
         u, i, v = self._coo(nnz=100)
