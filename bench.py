@@ -852,13 +852,15 @@ def phase_twotower(ck: _Checkpoint) -> None:
     _, n_users, n_items, _, _, _ = _scale_params(platform)
     ck.save(twotower_examples_per_s=round(_bench_twotower(n_users, n_items), 1))
     # two-tower retrieval quality gate: recall@10 on held-out positives of a
-    # clustered synthetic dataset (random baseline ~0.01; r3 measured 0.177,
-    # r4's corrected loss + 16 epochs measures 0.485 — gate at ~1.3x
-    # headroom so regressions fail, VERDICT r3 weak #5 / next #10)
+    # clustered synthetic dataset (random baseline ~0.01; r3 measured 0.177
+    # with the pre-fix loss, r4's corrected loss + 16 epochs measures 0.485
+    # on the CPU backend — gate at 0.4 per the round-4 verdict (#7) so a
+    # regression of the duplicate-collision masking / loss fixes fails the
+    # bench rather than sliding back to the 0.177 era unnoticed)
     recall10, first_loss, last_loss = _bench_twotower_recall()
     ck.save(
         twotower_recall_at_10=round(recall10, 4),
-        twotower_recall_gate_ok=bool(recall10 > 0.37),
+        twotower_recall_gate_ok=bool(recall10 > 0.4),
         twotower_first_epoch_loss=round(first_loss, 4),
         twotower_last_epoch_loss=round(last_loss, 4),
         # training must actually optimize: final epoch loss below the first
@@ -886,6 +888,29 @@ def phase_twotower(ck: _Checkpoint) -> None:
             attention_pallas_l4k_ms=round(pallas4k, 3),
             attention_ref_l4k_ms=round(ref4k, 3),
         )
+        # the ENCODER's real head shape (H=2 heads of 32, from embed_dim 64
+        # — not the generic 8x64 sweep shape): round-4 verdict task #6
+        enc_p, enc_r, enc_err = _bench_attention(B=8, H=2, L=2048, D=32)
+        ck.save(
+            attention_encshape_pallas_ms=round(enc_p, 3),
+            attention_encshape_ref_ms=round(enc_r, 3),
+            attention_encshape_max_abs_err=float(f"{enc_err:.2e}"),
+        )
+        # full history-encoder forward, plain vs sharded-with-sp=1 (a 1x1
+        # device mesh): bounds the sharded code path's dispatch overhead on
+        # hardware without needing more chips (round-4 verdict task #6)
+        try:
+            fwd_ms = _bench_encoder_forward(sp=False)
+            sp1_ms = _bench_encoder_forward(sp=True)
+            ck.save(
+                encoder_fwd_ms=round(fwd_ms, 3),
+                encoder_sp1_fwd_ms=round(sp1_ms, 3),
+                encoder_sp1_overhead=round(sp1_ms / fwd_ms, 3)
+                if fwd_ms > 0
+                else None,
+            )
+        except Exception as exc:  # noqa: BLE001 - extra datapoint only
+            ck.save(encoder_bench_error=str(exc)[:200])
 
 
 def _bench_attention(B: int = 4, H: int = 8, L: int = 2048, D: int = 64):
@@ -941,6 +966,61 @@ def _bench_attention(B: int = 4, H: int = 8, L: int = 2048, D: int = 64):
         return max(t_hi - t_lo, 1e-9) / 32 * 1000.0
 
     return timed(pallas_fn), timed(ref_fn), err
+
+
+def _bench_encoder_forward(
+    sp: bool, B: int = 256, T: int = 256, vocab: int = 27_000
+) -> float:
+    """Per-forward latency of the two-tower history encoder (embed +
+    causal attention + masked mean-pool) at a production-ish shape.
+
+    ``sp=True`` runs the IDENTICAL encoder with a 1x1 ``(data, model)``
+    mesh attached — the sequence-parallel code path (shard_map + ring
+    collectives degenerating to P=1) on a single chip, so the difference
+    vs ``sp=False`` is pure sharded-path dispatch/compile overhead: the
+    number that bounds what sp>1 costs beyond its collectives.
+
+    Slope-timed like ``_bench_attention`` (chained scan, 2 vs 10
+    applications, input perturbed per step so XLA cannot hoist the call
+    out of the loop and the tunnel cannot memoize)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.models.twotower.model import SeqEncoder
+
+    mesh = (
+        Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("data", "model"))
+        if sp
+        else None
+    )
+    enc = SeqEncoder(
+        vocab=vocab, embed_dim=64, n_heads=2, max_len=T, sp_mesh=mesh
+    )
+    rng = np.random.default_rng(0)
+    hist = jnp.asarray(rng.integers(0, vocab, (B, T)).astype(np.int32))
+    params = enc.init(jax.random.PRNGKey(0), hist)
+
+    def chained(n):
+        @jax.jit
+        def run(hist):
+            def body(c, i):
+                out = enc.apply(params, (hist + i) % vocab)
+                return c + out.sum(), ()
+
+            tot, _ = lax.scan(body, jnp.float32(0), jnp.arange(n))
+            return tot
+
+        return run
+
+    lo, hi = chained(2), chained(10)
+    for f in (lo, hi):
+        np.asarray(f(hist))  # compile + warm
+    t_lo = min(_timed(lambda: np.asarray(lo(hist))) for _ in range(5))
+    t_hi = min(_timed(lambda: np.asarray(hi(hist))) for _ in range(5))
+    return max(t_hi - t_lo, 1e-9) / 8 * 1000.0
 
 
 def _bench_twotower(n_users: int, n_items: int, batch: int = 8192, steps: int = 20) -> float:
@@ -1337,7 +1417,9 @@ _PHASE_FNS = {
 # ---------------------------------------------------------------------------
 
 
-def _run_phase(name: str, timeout_s: int, retries: int = 1) -> tuple[dict, str | None]:
+def _run_phase(
+    name: str, timeout_s: int, retries: int = 1, env: dict | None = None
+) -> tuple[dict, str | None]:
     """Run one phase in a subprocess; returns (partial_results, error).
     Partial results survive crashes (the phase checkpoints its output file
     after every milestone); a fresh process per attempt means a wedged TPU
@@ -1353,6 +1435,7 @@ def _run_phase(name: str, timeout_s: int, retries: int = 1) -> tuple[dict, str |
                 [sys.executable, os.path.abspath(__file__), "--phase", name, "--out", out],
                 capture_output=True,
                 timeout=timeout_s,
+                env={**os.environ, **env} if env else None,
             )
             rc = proc.returncode
             tail = proc.stderr.decode(errors="replace")[-600:]
@@ -1430,6 +1513,18 @@ def main() -> int:
             # did exactly that): cheap re-probe before every device phase
             device_ok = probe_device()
         if name in _DEVICE_PHASES and not device_ok:
+            if name == "secondary":
+                # the secondary workloads (cooccurrence, ingest, snapshot,
+                # naive bayes) are mostly host+native measurements — a dead
+                # tunnel must not zero them; run on the CPU backend instead
+                res, err = _run_phase(
+                    name, timeout_s, env={"JAX_PLATFORMS": "cpu"}
+                )
+                fields.update(res)
+                fields["secondary_platform"] = "cpu_fallback"
+                if err:
+                    errors[f"{name}_error"] = err
+                continue
             skipped.append((name, timeout_s))
             errors[f"{name}_error"] = "skipped: device preflight failed"
             continue

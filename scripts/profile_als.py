@@ -34,6 +34,11 @@ def run_and_trace(scale: str, iterations: int, trace_dir: str) -> dict:
     import numpy as np
 
     sys.path.insert(0, REPO)
+    # must precede the jax import: with JAX_PLATFORMS=cpu on a tunnel host,
+    # the out-of-tree plugin's registration can hang on a wedged tunnel
+    from predictionio_tpu.utils.platform import ensure_cpu_if_requested
+
+    ensure_cpu_if_requested()
     from bench import _scale_params, synthesize_ratings
     from predictionio_tpu.ops.als import ALSConfig, als_train
 

@@ -20,7 +20,7 @@ def _run_main(monkeypatch, capsys, phase_results):
     """Invoke bench.main() orchestrator-mode with _run_phase stubbed;
     returns (rc, parsed_json_line)."""
 
-    def fake_run(name, timeout_s, retries=1):
+    def fake_run(name, timeout_s, retries=1, env=None):
         if name == "probe" and name not in phase_results:
             return {"probe_platform": "stub"}, None  # healthy device default
         return phase_results.get(name, ({}, f"{name} stub missing"))
@@ -128,12 +128,18 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     loopback serving numbers still ship, and rc is nonzero."""
     calls = []
 
-    def fake_run(name, timeout_s, retries=1):
-        calls.append(name)
+    def fake_run(name, timeout_s, retries=1, env=None):
+        calls.append((name, (env or {}).get("JAX_PLATFORMS")))
         if name == "probe":
             return {}, "phase timed out after 90s"
         if name == "serving_local":
             return {"serving_local_e2e_p50_ms": 6.0}, None
+        if name == "secondary":
+            # host-side workloads run on the CPU backend instead of being
+            # zeroed by the outage
+            assert env == {"JAX_PLATFORMS": "cpu"}
+            return {"cooccurrence_build_ms": 150.0,
+                    "cooccurrence_build_gate_ok": True}, None
         raise AssertionError(f"device phase {name} must not run")
 
     monkeypatch.setattr(bench, "_run_phase", fake_run)
@@ -141,14 +147,17 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     monkeypatch.setenv("PIO_BENCH_LATE_RETRY_DELAY_S", "0")
     rc = bench.main()
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    # only probes and the CPU phase ever run: initial + one per device
-    # phase + the late retry, never a device phase itself
-    assert [c for c in calls if c != "probe"] == ["serving_local"]
-    assert calls.count("probe") == 6  # initial + als/serving/twotower/secondary + late
+    # only probes, the CPU phase, and the CPU-fallback secondary ever run:
+    # never a device phase itself
+    names = [c[0] for c in calls]
+    assert [n for n in names if n != "probe"] == ["serving_local", "secondary"]
+    assert names.count("probe") == 6  # initial + als/serving/twotower/secondary + late
     assert rc == 1  # headline phases never ran -> degraded
     assert out["preflight_error"]
     assert out["als_error"] == "skipped: device preflight failed"
     assert out["serving_local_e2e_p50_ms"] == 6.0
+    assert out["cooccurrence_build_ms"] == 150.0
+    assert out["secondary_platform"] == "cpu_fallback"
 
 
 def test_colocated_estimate_composed_and_gated(monkeypatch, capsys):
