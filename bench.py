@@ -1537,7 +1537,10 @@ def main() -> int:
         # outage, then re-probe once and run whatever was skipped (PHASES
         # order puts the ALS headline first)
         late_delay = int(os.environ.get("PIO_BENCH_LATE_RETRY_DELAY_S", "600"))
-        if late_delay > 0:
+        # only wait out an outage that is still ongoing: when a mid-run
+        # re-probe already brought the device back, the skipped phases can
+        # be retried immediately
+        if late_delay > 0 and not device_ok:
             print(
                 f"[bench] device down; waiting {late_delay}s before the late "
                 "preflight retry",

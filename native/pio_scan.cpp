@@ -241,6 +241,17 @@ double parse_iso8601(const std::string& s) {
     const char* z = s.c_str() + zpos + 1;
     int oh = 0, om = 0, osec = 0;
     if (strchr(z, ':')) {
+      // colon form must be exactly HH:MM or HH:MM:SS (2-digit fields) —
+      // fromisoformat rejects "+5:30"/"+05:3", and sscanf would happily
+      // parse them; agree with the python path (malformed row)
+      size_t zlen = strlen(z);
+      if (zlen != 5 && zlen != 8) return 0.0;
+      for (size_t i = 0; i < zlen; ++i) {
+        bool want_colon = (i == 2 || i == 5);
+        if (want_colon ? z[i] != ':'
+                       : !isdigit(static_cast<unsigned char>(z[i])))
+          return 0.0;
+      }
       sscanf(z, "%d:%d:%d", &oh, &om, &osec);  // ":SS" optional
     } else {
       // compact form must be exactly HH, HHMM or HHMMSS, all digits —

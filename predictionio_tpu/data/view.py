@@ -22,12 +22,18 @@ import datetime as _dt
 import hashlib
 import json
 import os
+import re
 import warnings
 from typing import Any, Callable, Iterable, Mapping, Sequence
 
 import numpy as np
 
 from predictionio_tpu.data.datamap import DataMap
+
+# cache filename tails: <marker><sha1-16>.npz — anchored so one view's
+# prune can never touch another view whose name extends this one's prefix
+_VIEW_STAMPED_RE = re.compile(r"stamp-[0-9a-f]{16}\.npz")
+_VIEW_LEGACY_RE = re.compile(r"[0-9a-f]{16}\.npz")
 from predictionio_tpu.data.event import Event
 
 UTC = _dt.timezone.utc
@@ -254,24 +260,31 @@ def create(
     # per-file under try: a concurrent create() (multi-host workers share
     # the dir) may unlink an entry between listdir and the stat — that must
     # not fail a build whose own output was already written successfully.
+    # Only files whose tail is EXACTLY <marker><16-hex digest>.npz belong
+    # to this (name, app): plain startswith(prefix) also matched other
+    # views whose name/app merely extends this prefix ('als-prod-' is a
+    # string prefix of 'als-prod-eu-...'), and the legacy sweep would have
+    # deleted their valid files (code-review r5).
     aged: list[tuple[float, str]] = []
     for f in os.listdir(view_dir):
         if not (f.startswith(prefix) and f.endswith(".npz")):
             continue
         rest = f[len(prefix):]
         p = os.path.join(view_dir, f)
-        if rest.startswith("stamp-"):
+        if _VIEW_STAMPED_RE.fullmatch(rest):
             try:
                 aged.append((os.path.getmtime(p), p))
             except OSError:
                 continue  # already gone
-        elif not rest.startswith("t-"):
+        elif _VIEW_LEGACY_RE.fullmatch(rest):
             # pre-marker legacy entry: unreachable under the marker naming
             # (never hit again), so delete rather than orphan
             try:
                 os.unlink(p)
             except OSError:
                 pass
+        # anything else (incl. explicit-window "t-" entries and other
+        # views' files) is left untouched
     for _, old in sorted(aged, reverse=True)[4:]:
         try:
             os.unlink(old)

@@ -106,32 +106,67 @@ def export_events(
     app_id, channel_id = resolve_app(storage, app_name, channel_name)
     pevents = storage.get_p_events()
     if format == "parquet":
+        import datetime as _dt
+
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        rows = []
-        for e in pevents.find(app_id, channel_id):
+        # real timestamp columns (the reference's Spark schema types
+        # eventTime/creationTime as TimestampType, EventsToFile.scala) and
+        # a streaming writer: the whole store must never be materialized
+        # as one python list at ML-20M scale
+        schema = pa.schema(
+            [
+                ("eventId", pa.string()),
+                ("event", pa.string()),
+                ("entityType", pa.string()),
+                ("entityId", pa.string()),
+                ("targetEntityType", pa.string()),
+                ("targetEntityId", pa.string()),
+                ("properties", pa.string()),
+                ("prId", pa.string()),
+                ("eventTime", pa.timestamp("us", tz="UTC")),
+                ("creationTime", pa.timestamp("us", tz="UTC")),
+            ]
+        )
+
+        def row(e: Event) -> dict:
             d = e.to_json_dict(with_creation_time=True)
             props = d.get("properties")
-            rows.append(
-                {
-                    "eventId": d.get("eventId"),
-                    "event": d["event"],
-                    "entityType": d["entityType"],
-                    "entityId": d["entityId"],
-                    "targetEntityType": d.get("targetEntityType"),
-                    "targetEntityId": d.get("targetEntityId"),
-                    "properties": json.dumps(props, sort_keys=True)
-                    if props
-                    else None,
-                    "prId": d.get("prId"),
-                    "eventTime": d["eventTime"],
-                    "creationTime": d.get("creationTime"),
-                }
-            )
-        table = pa.Table.from_pylist(rows)
-        pq.write_table(table, output_path)
-        return len(rows)
+            return {
+                "eventId": d.get("eventId"),
+                "event": d["event"],
+                "entityType": d["entityType"],
+                "entityId": d["entityId"],
+                "targetEntityType": d.get("targetEntityType"),
+                "targetEntityId": d.get("targetEntityId"),
+                "properties": json.dumps(props, sort_keys=True)
+                if props
+                else None,
+                "prId": d.get("prId"),
+                "eventTime": _dt.datetime.fromisoformat(d["eventTime"]),
+                "creationTime": _dt.datetime.fromisoformat(d["creationTime"])
+                if d.get("creationTime")
+                else None,
+            }
+
+        count = 0
+        batch: list[dict] = []
+        with pq.ParquetWriter(output_path, schema) as writer:
+            for e in pevents.find(app_id, channel_id):
+                batch.append(row(e))
+                if len(batch) >= 10000:
+                    writer.write_batch(
+                        pa.RecordBatch.from_pylist(batch, schema=schema)
+                    )
+                    count += len(batch)
+                    batch = []
+            if batch:
+                writer.write_batch(
+                    pa.RecordBatch.from_pylist(batch, schema=schema)
+                )
+                count += len(batch)
+        return count
     if format == "json":
         count = 0
         with open(output_path, "w") as f:

@@ -62,7 +62,12 @@ def run_and_trace(scale: str, iterations: int, trace_dir: str) -> dict:
 
 def attribute(trace_dir: str, top_n: int = 30) -> list[tuple[str, float, int]]:
     """Aggregate XLA op events from the newest .trace.json.gz under
-    trace_dir; returns [(op_name, total_ms, count)] sorted by total."""
+    trace_dir; returns [(op_name, total_ms, count)] sorted by total.
+
+    Only DEVICE-lane events are summed when the trace has device process
+    lanes (process_name metadata matching TPU/device); host runtime rows
+    also carry ``dur`` and would otherwise swamp the op table. Falls back
+    to all lanes (with a notice) for traces without device lanes (CPU)."""
     paths = sorted(
         glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True),
         key=os.path.getmtime,
@@ -71,15 +76,33 @@ def attribute(trace_dir: str, top_n: int = 30) -> list[tuple[str, float, int]]:
         raise SystemExit(f"no .trace.json.gz under {trace_dir}")
     with gzip.open(paths[-1], "rt") as f:
         trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    proc_names: dict[object, str] = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            proc_names[ev.get("pid")] = str(
+                (ev.get("args") or {}).get("name", "")
+            )
+    device_pids = {
+        pid
+        for pid, nm in proc_names.items()
+        if any(tag in nm.lower() for tag in ("tpu", "device", "accelerator"))
+    }
+    if not device_pids:
+        print(
+            "[profile] no device lanes in trace "
+            f"({sorted(set(proc_names.values()))}); aggregating ALL lanes",
+            file=sys.stderr,
+        )
     totals: dict[str, float] = defaultdict(float)
     counts: dict[str, int] = defaultdict(int)
-    for ev in trace.get("traceEvents", []):
+    for ev in events:
         dur = ev.get("dur")  # microseconds
         name = ev.get("name")
         if not dur or not name:
             continue
-        # keep device-lane compute events; drop host-side bookkeeping rows
-        # (thread names etc. carry no dur and are already filtered)
+        if device_pids and ev.get("pid") not in device_pids:
+            continue
         totals[name] += dur / 1000.0
         counts[name] += 1
     rows = sorted(totals.items(), key=lambda kv: -kv[1])[:top_n]

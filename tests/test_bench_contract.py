@@ -255,7 +255,15 @@ def test_dead_then_alive_device_recovers_the_capture(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "_run_phase", fake_run)
     monkeypatch.setattr("sys.argv", ["bench.py"])
-    monkeypatch.setenv("PIO_BENCH_LATE_RETRY_DELAY_S", "0")
+    # deliberately NOT setting PIO_BENCH_LATE_RETRY_DELAY_S: the device
+    # recovered mid-run (device_ok True at loop exit), so the late retry
+    # must skip the delay entirely (code-review r5) — a sleep here would
+    # hang this test for 600s
+    monkeypatch.delenv("PIO_BENCH_LATE_RETRY_DELAY_S", raising=False)
+    monkeypatch.setattr(
+        bench.time, "sleep",
+        lambda s: (_ for _ in ()).throw(AssertionError(f"slept {s}s")),
+    )
     rc = bench.main()
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     # als was skipped while dead, then captured by the late retry

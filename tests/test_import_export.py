@@ -120,6 +120,22 @@ class TestParquetRoundTrip:
             assert dict(a.properties) == dict(b.properties)
             assert a.event_time == b.event_time
 
+    def test_timestamp_columns_are_typed(self, tmp_path):
+        """eventTime/creationTime must be real tz-aware timestamp columns
+        (the reference's Spark schema uses TimestampType), not ISO strings
+        (code-review r5)."""
+        pytest.importorskip("pyarrow")
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        src = _mk_storage()
+        _seed(src, n=3)
+        out = tmp_path / "t.parquet"
+        export_events(str(out), "ioapp", storage=src, format="parquet")
+        schema = pq.read_table(out).schema
+        assert schema.field("eventTime").type == pa.timestamp("us", tz="UTC")
+        assert schema.field("creationTime").type == pa.timestamp("us", tz="UTC")
+
     def test_properties_json_column(self, tmp_path):
         """Schema-free properties ride as a JSON string column (documented
         deviation from the reference's Spark struct)."""

@@ -259,6 +259,23 @@ class TestNativeEdgeSemantics:
         expected = dt.datetime.fromisoformat(times[0]).timestamp()
         assert sorted(out["timestamps"]) == [expected, expected]
 
+    def test_malformed_colon_offsets_rejected(self, lib, tmp_path):
+        """fromisoformat requires 2-digit colon-form fields; '+5:30' and
+        '+05:3' must be malformed rows in the native path too, not
+        sscanf'd into valid offsets (code-review r5)."""
+        path = tmp_path / "badcolon.jsonl"
+        rows = [
+            {"event": "a", "entityType": "u", "entityId": "x",
+             "eventTime": "2026-07-30T12:00:00+5:30", "eventId": "a"},
+            {"event": "a", "entityType": "u", "entityId": "y",
+             "eventTime": "2026-07-30T12:00:00+05:3", "eventId": "b"},
+        ]
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        out = scan_jsonl_columnar(str(path))
+        assert list(out["timestamps"]) == [0.0, 0.0]
+
     def test_idless_rows_collapse_like_python_path(self, lib, tmp_path):
         """Rows without an eventId all share the backend dedup key \"\"
         (last wins); the native path used to keep every one of them."""

@@ -298,6 +298,32 @@ class TestDataView:
         assert legacy.name not in names  # swept
         assert any(n.startswith("leg-viewapp-stamp-") for n in names)
 
+    def test_prefix_collision_files_untouched(self, app_with_events, tmp_path):
+        """One view's prune must never delete files of a DIFFERENT view
+        whose name merely extends this one's prefix ('als-prod-' is a
+        string prefix of 'als-prod-eu-...'): only tails that are exactly
+        <marker><16-hex>.npz belong to this view (code-review r5)."""
+        import os
+
+        view_dir = tmp_path / "view"
+        view_dir.mkdir()
+        # files of the colliding view "leg" for app "viewapp-eu": both an
+        # immutable window entry and a stamp entry, plus its legacy form
+        other = [
+            view_dir / ("leg-viewapp-eu-t-" + "cd" * 8 + ".npz"),
+            view_dir / ("leg-viewapp-eu-stamp-" + "ef" * 8 + ".npz"),
+            view_dir / ("leg-viewapp-eu-" + "0a" * 8 + ".npz"),
+        ]
+        for p in other:
+            p.write_bytes(b"other-view")
+        view.create(
+            "viewapp", lambda e: {"u": e.entity_id}, name="leg",
+            base_dir=str(tmp_path),
+        )
+        names = os.listdir(view_dir)
+        for p in other:
+            assert p.name in names, f"{p.name} was wrongly deleted"
+
     def test_empty_result(self, app_with_events, tmp_path):
         cols = view.create(
             "viewapp",
