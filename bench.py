@@ -218,10 +218,27 @@ def phase_als(ck: _Checkpoint) -> None:
     uf, vf = als_train(
         users_tr, items_tr, vals_tr, n_users, n_items, config, timings=t_warm
     )
-    train_wall = time.perf_counter() - t0
+    instr_wall = time.perf_counter() - t0
     device_per_iter = t_warm["device_s"] / iterations
+
+    # THE HEADLINE: a warm UNINSTRUMENTED run. The timings barriers above
+    # serialize pack -> upload -> build -> solve to cut the decomposition,
+    # but the plain path (what `pio train` runs) keeps dispatch fully
+    # async, so H2D transfer overlaps the device-side table build. The
+    # ending fetch_barrier makes it a true completion wall, not a
+    # dispatch ack (see the methodology note above).
+    from predictionio_tpu.ops.als import fetch_barrier
+
+    t0 = time.perf_counter()
+    uf, vf = als_train(users_tr, items_tr, vals_tr, n_users, n_items, config)
+    fetch_barrier(uf, vf)
+    train_wall = time.perf_counter() - t0
     ck.save(
         als_train_wall_s=round(train_wall, 3),
+        # the barrier-instrumented wall the decomposition below was cut
+        # from (>= headline: its stage barriers forbid the pipeline
+        # overlap the plain path gets)
+        als_instrumented_wall_s=round(instr_wall, 3),
         # warm-run decomposition: host group-by / H2D upload of the wire
         # arrays / device-side block-table build / solver iterations (each
         # phase barrier-confirmed)
@@ -230,8 +247,8 @@ def phase_als(ck: _Checkpoint) -> None:
         als_build_s=round(t_warm["build_s"], 3),
         als_device_s=round(t_warm["device_s"], 3),
         als_device_s_per_iter=round(device_per_iter, 3),
-        # decomposition completeness: the phases vs the wall they were cut
-        # from (should be ~1.0; <1 means untimed overhead)
+        # decomposition completeness: the phases vs the instrumented wall
+        # they were cut from (should be ~1.0; <1 means untimed overhead)
         als_decomposition_coverage=round(
             (
                 t_warm["pack_s"]
@@ -239,7 +256,7 @@ def phase_als(ck: _Checkpoint) -> None:
                 + t_warm["build_s"]
                 + t_warm["device_s"]
             )
-            / train_wall,
+            / instr_wall,
             3,
         ),
     )
