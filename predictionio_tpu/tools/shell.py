@@ -10,6 +10,12 @@ Example: list(p_event_store.find("MyApp1", limit=5))
 
 
 def run_shell() -> None:
+    # an explicit JAX_PLATFORMS=cpu shell must never touch the TPU plugin
+    # (whose registration can hang on a wedged tunnel) — same guard as the
+    # CLI entry
+    from predictionio_tpu.utils.platform import ensure_cpu_if_requested
+
+    ensure_cpu_if_requested()
     import jax
     import jax.numpy as jnp
 
