@@ -428,12 +428,45 @@ def cmd_status(args) -> int:
             print(f"  [FAILED] {f}")
         return _die("storage verification failed")
     print("  storage: all data objects verified")
-    try:
-        import jax
+    # the device probe runs in a BOUNDED subprocess: a wedged TPU-tunnel
+    # plugin hangs device init forever (observed in the wild), and `pio
+    # status` must report that, not inherit it. 45s covers a healthy cold
+    # tunnel's ~40s first contact.
+    import subprocess
 
-        print(f"  jax {jax.__version__}; devices: {jax.device_count()}")
-    except Exception as exc:  # TPU tunnel down should not fail `status`
-        print(f"  jax devices unavailable: {exc}")
+    pkg_root = os.path.dirname(os.path.dirname(predictionio_tpu.__file__))
+    probe_env = {
+        **os.environ,
+        "PYTHONPATH": pkg_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    try:
+        probe = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                # honor an explicit JAX_PLATFORMS=cpu even here: the probe
+                # exists to DETECT a wedged plugin, not to hang on it when
+                # the user asked for CPU
+                "from predictionio_tpu.utils.platform import "
+                "ensure_cpu_if_requested; ensure_cpu_if_requested(); "
+                "import jax; print(jax.__version__, jax.device_count())",
+            ],
+            capture_output=True,
+            timeout=45,
+            text=True,
+            env=probe_env,
+        )
+        if probe.returncode == 0:
+            ver, n = probe.stdout.split()
+            print(f"  jax {ver}; devices: {n}")
+        else:
+            err = probe.stderr.strip().splitlines()
+            print(f"  jax devices unavailable: {err[-1] if err else 'unknown'}")
+    except subprocess.TimeoutExpired:
+        print(
+            "  jax devices unavailable: device init timed out after 45s "
+            "(wedged accelerator tunnel?)"
+        )
     print("(sleeping)   <- your engine is ready to train")
     return 0
 
