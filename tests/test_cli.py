@@ -127,6 +127,21 @@ class TestImportExport:
         data = np.load(str(npz), allow_pickle=True)
         assert len(data["entity_ids"]) == 3
 
+        # parquet round-trip through the CLI surface (EventsToFile.scala's
+        # --format parquet switch)
+        pytest.importorskip("pyarrow")
+        pqf = tmp_path / "out.parquet"
+        code, out, _ = run(
+            capsys, "export", "--appname", "ioapp", "--output", str(pqf),
+            "--format", "parquet",
+        )
+        assert code == 0 and "Exported 3 events" in out
+        run(capsys, "app", "new", "ioapp2")
+        code, out, _ = run(
+            capsys, "import", "--appname", "ioapp2", "--input", str(pqf)
+        )
+        assert code == 0 and "Imported 3 events" in out
+
     def test_import_bad_line_reports_position(self, memory_storage, capsys, tmp_path):
         run(capsys, "app", "new", "badapp")
         src = tmp_path / "bad.json"
