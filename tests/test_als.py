@@ -459,6 +459,57 @@ class TestDevicePack:
             nb_u=200, nb_i=80, d=128, f=32, n_users=1000, n_items=800
         ) > f32
 
+    def test_ratings_wire_compression_forms(self):
+        """Smallest lossless wire form: uint8 dictionary for <=256 distinct
+        values (every star-rating dataset), f16 when exact, f32 otherwise."""
+        from predictionio_tpu.ops.als import _compress_ratings_wire
+
+        stars = np.random.default_rng(0).choice(
+            np.arange(0.5, 5.5, 0.5), size=100_000
+        ).astype(np.float32)
+        wire, table = _compress_ratings_wire(stars)
+        assert wire.dtype == np.uint8 and table is not None
+        np.testing.assert_array_equal(table[wire], stars)  # exact decode
+
+        # >256 distinct but f16-exact (integers): dictionary declines, f16
+        ints = np.arange(1000, dtype=np.float32)
+        wire, table = _compress_ratings_wire(ints)
+        assert wire.dtype == np.float16 and table is None
+        np.testing.assert_array_equal(wire.astype(np.float32), ints)
+
+        # continuous: untouched f32 (no silent quality trade)
+        cont = np.random.default_rng(1).normal(size=100_000).astype(np.float32)
+        wire, table = _compress_ratings_wire(cont)
+        assert wire.dtype == np.float32 and table is None
+
+        # sample-probe edge: first 65536 values all identical, tail adds
+        # values — table verification must still be exact over the FULL
+        # column (a wrong early exit would silently corrupt ratings)
+        tricky = np.concatenate(
+            [np.full(70_000, 3.0, np.float32), stars]
+        )
+        wire, table = _compress_ratings_wire(tricky)
+        if table is not None:
+            np.testing.assert_array_equal(table[wire], tricky)
+
+    def test_dictionary_wire_trains_identically(self):
+        """Star-rating data (dictionary wire) must produce bit-identical
+        factors to the host-pack path, which never compresses."""
+        rng = np.random.default_rng(5)
+        u = rng.integers(0, 120, 4000).astype(np.int32)
+        i = rng.integers(0, 80, 4000).astype(np.int32)
+        v = rng.choice(np.arange(1.0, 5.5, 0.5), 4000).astype(np.float32)
+        cfg_dev = ALSConfig(rank=4, iterations=3, pack="device")
+        cfg_host = ALSConfig(rank=4, iterations=3, pack="host")
+        uf_d, vf_d = als_train(u, i, v, 120, 80, cfg_dev)
+        uf_h, vf_h = als_train(u, i, v, 120, 80, cfg_host)
+        np.testing.assert_allclose(
+            np.asarray(uf_d), np.asarray(uf_h), rtol=0, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(vf_d), np.asarray(vf_h), rtol=0, atol=1e-5
+        )
+
     def test_block_shapes_match_across_pack_paths(self):
         u, i, v = self._coo(nnz=2000)
         t_dev: dict = {}
