@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.ops.als import (
     ALSConfig,
+    _compress_ratings_wire,
     _host_group_by,
     _pad_blocks,
     _solve_blocked,
@@ -173,7 +174,26 @@ def als_train_sharded(
         solver=config.solver,
         gather_dtype=config.gather_dtype,
     )
-    dev = tuple(put(a) for a in (*u_blocks, *i_blocks))
+    def put_vals(v: np.ndarray):
+        """Upload a [n_dev, nb, d] ratings table in its smallest LOSSLESS
+        form: uint8 dictionary codes + a tiny replicated value table,
+        decoded once on device by a sharded gather (same contract as the
+        single-chip wire — every star-rating dataset fits; pad zeros join
+        the dictionary). Falls back to the full f32 table otherwise."""
+        codes, table = _compress_ratings_wire(v.reshape(-1))
+        if table is None or codes.dtype != np.uint8:
+            return put(v)
+        decode = jax.jit(
+            lambda c, t: t[c.astype(jnp.int32)], out_shardings=sharded
+        )
+        return decode(put(codes.reshape(v.shape)), jax.device_put(table))
+
+    u_br, u_cols, u_v, u_w = u_blocks
+    i_br, i_cols, i_v, i_w = i_blocks
+    dev = (
+        put(u_br), put(u_cols), put_vals(u_v), put(u_w),
+        put(i_br), put(i_cols), put_vals(i_v), put(i_w),
+    )
     # one iteration per launch — same watchdog/compile rationale as
     # ops/als.py:_als_step; collectives still ride ICI inside each launch
     uf, vf = _als_sharded_init(

@@ -221,6 +221,36 @@ class TestShardedALS:
         assert rmse_multi < 0.15
         assert rmse_multi < max(5 * abs(rmse_single), 0.15)
 
+    def test_dictionary_wire_sharded_parity(self):
+        """Star-rating data rides the uint8 dictionary wire on the sharded
+        path too; factors must match the f32-wire run exactly (the decode
+        gather reproduces identical f32 values)."""
+        from predictionio_tpu.ops import als as als_mod
+        from predictionio_tpu.ops.als import ALSConfig
+        from predictionio_tpu.ops.als_sharded import als_train_sharded
+
+        u, i, _, n_u, n_i = self._problem()
+        r = np.random.default_rng(7).choice(
+            np.arange(1.0, 5.5, 0.5), len(u)
+        ).astype(np.float32)
+        cfg = ALSConfig(rank=8, iterations=4, reg=0.05, chunk=512)
+        uf_dict, vf_dict = als_train_sharded(u, i, r, n_u, n_i, cfg)
+        # force the f32 wire by disabling the compressor
+        orig = als_mod._compress_ratings_wire
+        try:
+            als_mod._compress_ratings_wire = lambda v: (v, None)
+            import predictionio_tpu.ops.als_sharded as sh
+
+            sh._compress_ratings_wire = als_mod._compress_ratings_wire
+            uf_f32, vf_f32 = als_train_sharded(u, i, r, n_u, n_i, cfg)
+        finally:
+            als_mod._compress_ratings_wire = orig
+            import predictionio_tpu.ops.als_sharded as sh
+
+            sh._compress_ratings_wire = orig
+        np.testing.assert_allclose(uf_dict, uf_f32, rtol=0, atol=1e-5)
+        np.testing.assert_allclose(vf_dict, vf_f32, rtol=0, atol=1e-5)
+
     def test_bf16_gather_quality_parity_sharded(self):
         # the sharded path must honor gather_dtype too (bf16 factors across
         # the ICI all_gather + bf16 HBM row gathers), with quality within
