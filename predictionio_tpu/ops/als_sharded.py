@@ -118,6 +118,15 @@ def _block_partition_blocked(
     return br, cols, v, w
 
 
+@functools.partial(jax.jit, static_argnames=("sharding",))
+def _decode_ratings(codes, table, sharding):
+    """One sharded gather decoding the uint8 dictionary ratings wire
+    (module-level jit: compiles once per shape, not per train)."""
+    return jax.lax.with_sharding_constraint(
+        table[codes.astype(jnp.int32)], sharding
+    )
+
+
 def als_train_sharded(
     user_idx: np.ndarray,
     item_idx: np.ndarray,
@@ -183,10 +192,9 @@ def als_train_sharded(
         codes, table = _compress_ratings_wire(v.reshape(-1))
         if table is None or codes.dtype != np.uint8:
             return put(v)
-        decode = jax.jit(
-            lambda c, t: t[c.astype(jnp.int32)], out_shardings=sharded
+        return _decode_ratings(
+            put(codes.reshape(v.shape)), jax.device_put(table), sharded
         )
-        return decode(put(codes.reshape(v.shape)), jax.device_put(table))
 
     u_br, u_cols, u_v, u_w = u_blocks
     i_br, i_cols, i_v, i_w = i_blocks

@@ -449,16 +449,26 @@ def cmd_status(args) -> int:
                 # the user asked for CPU
                 "from predictionio_tpu.utils.platform import "
                 "ensure_cpu_if_requested; ensure_cpu_if_requested(); "
-                "import jax; print(jax.__version__, jax.device_count())",
+                "import jax; print('PIO-JAX', jax.__version__, "
+                "jax.device_count())",
             ],
             capture_output=True,
             timeout=45,
             text=True,
             env=probe_env,
         )
-        if probe.returncode == 0:
-            ver, n = probe.stdout.split()
-            print(f"  jax {ver}; devices: {n}")
+        # a plugin/sitecustomize may print banners around the probe line:
+        # find OUR marker instead of assuming clean stdout
+        marker = next(
+            (
+                ln.split()
+                for ln in probe.stdout.splitlines()
+                if ln.startswith("PIO-JAX ")
+            ),
+            None,
+        )
+        if probe.returncode == 0 and marker and len(marker) == 3:
+            print(f"  jax {marker[1]}; devices: {marker[2]}")
         else:
             err = probe.stderr.strip().splitlines()
             print(f"  jax devices unavailable: {err[-1] if err else 'unknown'}")
@@ -467,6 +477,8 @@ def cmd_status(args) -> int:
             "  jax devices unavailable: device init timed out after 45s "
             "(wedged accelerator tunnel?)"
         )
+    except Exception as exc:  # noqa: BLE001 - status must never crash here
+        print(f"  jax devices unavailable: {exc}")
     print("(sleeping)   <- your engine is ready to train")
     return 0
 
