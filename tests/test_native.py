@@ -364,6 +364,21 @@ class TestNativeCooccurrence:
         assert list(out_items[0]) == [-1, -1, -1]  # item 0 never seen
         assert list(out_items[3]) == [-1, -1, -1]
 
+    def test_int32_count_path_above_uint16_users(self, lib):
+        """User ids >= 65535 select the int32 count matrix (uint16 would
+        cap a cooccurrence count at the user count); results identical."""
+        from predictionio_tpu.ops.cooccurrence import (
+            _cooccurrence_top_n_reference,
+            cooccurrence_top_n,
+        )
+
+        rng = np.random.default_rng(4)
+        u = rng.integers(65_530, 65_600, 800).astype(np.int32)  # > uint16 max
+        i = rng.integers(0, 12, 800).astype(np.int32)
+        assert cooccurrence_top_n(u, i, 12, 5) == (
+            _cooccurrence_top_n_reference(u, i, 12, 5)
+        )
+
     def test_out_of_range_item_falls_back(self, lib):
         """Ids outside [0, n_items) make the kernel decline (rc!=0) so the
         caller can fall back instead of corrupting memory."""
