@@ -156,6 +156,15 @@ def _timed(fn) -> float:
     return time.perf_counter() - t0
 
 
+def _heldout_rmse(uf, vf, users, items, vals, mask) -> float:
+    """RMSE of factor-model predictions on the held-out mask (host numpy);
+    the quality pairing every latency/wall-clock headline ships with."""
+    import numpy as np
+
+    pred = np.sum(uf[users[mask]] * vf[items[mask]], axis=1)
+    return float(np.sqrt(np.mean((pred - vals[mask]) ** 2)))
+
+
 def _free_port() -> int:
     import socket
 
@@ -176,7 +185,12 @@ def phase_als(ck: _Checkpoint) -> None:
 
     jax, platform = _jax_setup()
     scale, n_users, n_items, n_ratings, rank, iterations = _scale_params(platform)
-    from predictionio_tpu.ops.als import ALSConfig, als_train
+    from predictionio_tpu.ops.als import (
+        ALSConfig,
+        als_train,
+        fetch_barrier,
+        solver_hbm_bytes_per_iter,
+    )
 
     users, items, vals = synthesize_ratings(n_users, n_items, n_ratings)
     # 2% held-out split: wall-clock numbers without a quality gate can be
@@ -233,8 +247,6 @@ def phase_als(ck: _Checkpoint) -> None:
     # async, so H2D transfer overlaps the device-side table build. The
     # ending fetch_barrier makes it a true completion wall, not a
     # dispatch ack (see the methodology note above).
-    from predictionio_tpu.ops.als import fetch_barrier
-
     t0 = time.perf_counter()
     uf, vf = als_train(users_tr, items_tr, vals_tr, n_users, n_items, config)
     fetch_barrier(uf, vf)
@@ -287,8 +299,6 @@ def phase_als(ck: _Checkpoint) -> None:
     # instrumented train); v5e HBM peak = 819 GB/s. util > 1 = broken
     # probe (fail loudly, like the MFU gate); util << 0.5 = the gather
     # loop, not the memory system, is the bottleneck.
-    from predictionio_tpu.ops.als import solver_hbm_bytes_per_iter
-
     if platform in ("tpu", "axon") and "nb_u" in t_warm:
         hbm_bytes = solver_hbm_bytes_per_iter(
             t_warm["nb_u"], t_warm["nb_i"], t_warm["d"], rank,
@@ -335,17 +345,17 @@ def phase_als(ck: _Checkpoint) -> None:
                 timings=t_bf16,
             )
             bf16_wall = time.perf_counter() - t0
-            uf16_h, vf16_h = np.asarray(uf16), np.asarray(vf16)
-            pred16 = np.sum(
-                uf16_h[users[test_mask]] * vf16_h[items[test_mask]], axis=1
-            )
             ck.save(
                 # wall includes this variant's own compile (shapes differ
                 # from the f32 program); device_s is the comparable number
                 als_bf16_wall_s=round(bf16_wall, 3),
                 als_bf16_device_s=round(t_bf16["device_s"], 3),
                 als_bf16_heldout_rmse=round(
-                    float(np.sqrt(np.mean((pred16 - vals[test_mask]) ** 2))), 4
+                    _heldout_rmse(
+                        np.asarray(uf16), np.asarray(vf16),
+                        users, items, vals, test_mask,
+                    ),
+                    4,
                 ),
             )
         except Exception as exc:  # noqa: BLE001 - extra datapoint only
@@ -354,8 +364,7 @@ def phase_als(ck: _Checkpoint) -> None:
     # held-out quality gate (device -> host readback is the round-2 crash
     # site; the wall-clock above is already checkpointed if this faults)
     uf_host, vf_host = np.asarray(uf), np.asarray(vf)
-    pred = np.sum(uf_host[users[test_mask]] * vf_host[items[test_mask]], axis=1)
-    als_rmse = float(np.sqrt(np.mean((pred - vals[test_mask]) ** 2)))
+    als_rmse = _heldout_rmse(uf_host, vf_host, users, items, vals, test_mask)
     # synthetic ratings = low-rank + N(0, 0.3) noise clipped to [1,5] then
     # half-star quantized like real MovieLens (r5); a healthy fit lands
     # near the combined noise floor (0.338 continuous at ML-20M in r3/r4;
@@ -544,11 +553,10 @@ def phase_serving_local(ck: _Checkpoint) -> None:
                 n_users, n_items, cfg,
             )
             uf, vf = np.asarray(uf_d), np.asarray(vf_d)
-            pred = np.sum(uf[users[test_mask]] * vf[items[test_mask]], axis=1)
             ck.save(
                 serving_local_factors="cpu_als",
                 serving_local_heldout_rmse=round(
-                    float(np.sqrt(np.mean((pred - vals[test_mask]) ** 2))), 4
+                    _heldout_rmse(uf, vf, users, items, vals, test_mask), 4
                 ),
             )
         except Exception as exc:  # noqa: BLE001 - latency still worth shipping
