@@ -109,6 +109,35 @@ def attribute(trace_dir: str, top_n: int = 30) -> list[tuple[str, float, int]]:
     return [(name, ms, counts[name]) for name, ms in rows]
 
 
+_CATEGORIES = (
+    ("gather", ("gather",)),
+    ("scatter", ("scatter",)),
+    ("matmul", ("dot", "einsum", "conv")),
+    ("sort", ("sort",)),
+    ("collective", ("all-reduce", "all-gather", "all-to-all", "ppermute",
+                    "reduce-scatter", "collective")),
+    ("copy/transpose", ("copy", "transpose", "bitcast", "reshape")),
+    ("fusion (opaque)", ("fusion",)),
+)
+
+
+def categorize(rows: list[tuple[str, float, int]]) -> list[tuple[str, float]]:
+    """Roll op rows up into coarse buckets by root op name — the one-line
+    answer to 'is the iteration gather-bound?'. Fused ops stay opaque
+    (XLA hides their internals) but fusion names usually embed the
+    dominant op on TPU traces."""
+    buckets: dict[str, float] = defaultdict(float)
+    for name, ms, _ in rows:
+        low = name.lower()
+        for cat, keys in _CATEGORIES:
+            if any(k in low for k in keys):
+                buckets[cat] += ms
+                break
+        else:
+            buckets["other"] += ms
+    return sorted(buckets.items(), key=lambda kv: -kv[1])
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", default="", help="ml100k|ml1m|ml20m (default: cpu-scale)")
@@ -131,7 +160,10 @@ def main() -> int:
         lines.append(
             f"| `{name[:80]}` | {ms:.1f} | {cnt} | {100.0 * ms / total_ms:.1f}% |"
         )
-    table = "\n".join(lines)
+    cat_lines = ["", "| category | total ms | % |", "|---|---|---|"]
+    for cat, ms in categorize(rows):
+        cat_lines.append(f"| {cat} | {ms:.1f} | {100.0 * ms / total_ms:.1f}% |")
+    table = "\n".join(lines) + "\n" + "\n".join(cat_lines)
     print(table)
     out_md = os.path.join(args.trace_dir, "attribution.md")
     with open(out_md, "w") as f:
