@@ -160,6 +160,54 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     assert out["secondary_platform"] == "cpu_fallback"
 
 
+def test_failed_serving_retry_keeps_random_label(monkeypatch, capsys):
+    """If the post-recovery serving re-run fails partway, its partial
+    fields must NOT merge: serving_factors would flip to 'als' while the
+    latency numbers still came from the random-factor run (code-review
+    r5). Run-1's accurately-labeled numbers stay, with a distinct
+    serving_retry_error."""
+    probe_outcomes = iter(
+        [
+            ({}, "phase timed out after 90s"),  # initial: dead
+            ({}, "phase timed out after 90s"),  # before als: dead
+            ({"probe_platform": "tpu"}, None),  # before serving: back
+        ]
+    )
+    calls = []
+
+    def fake_run(name, timeout_s, retries=1, env=None):
+        calls.append(name)
+        if name == "probe":
+            return next(probe_outcomes, ({"probe_platform": "tpu"}, None))
+        if name == "serving":
+            if "als" in calls:  # the retry: partial checkpoint + crash
+                return {"serving_factors": "als"}, "tunnel died again"
+            return (
+                {"serving_e2e_p50_ms": 5.0, "serving_factors": "random_fallback"},
+                None,
+            )
+        results = {
+            "als": (
+                {"scale_name": "ml20m", "als_train_wall_s": 10.2,
+                 "als_heldout_rmse": 0.34, "als_rmse_gate_ok": True},
+                None,
+            ),
+            "serving_local": ({"serving_local_e2e_p50_ms": 4.0}, None),
+            "twotower": ({}, None),
+            "secondary": ({}, None),
+        }
+        return results[name]
+
+    monkeypatch.setattr(bench, "_run_phase", fake_run)
+    monkeypatch.setattr("sys.argv", ["bench.py"])
+    monkeypatch.setenv("PIO_BENCH_LATE_RETRY_DELAY_S", "0")
+    rc = bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["serving_factors"] == "random_fallback"  # label stays honest
+    assert out["serving_e2e_p50_ms"] == 5.0
+    assert out["serving_retry_error"] == "tunnel died again"
+
+
 def test_colocated_estimate_composed_and_gated(monkeypatch, capsys):
     """The co-located serving estimate (device kernel + local stack p50)
     must ship as one number with its formula stated and a <10ms gate
