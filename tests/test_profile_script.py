@@ -1,0 +1,86 @@
+"""scripts/profile_als.py trace parsing: device-lane filtering, top-N
+truncation, and the category rollup that answers 'is the ALS iteration
+gather-bound?' (round-4 verdict task #3)."""
+
+import gzip
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scripts.profile_als import attribute, categorize  # noqa: E402
+
+
+@pytest.fixture
+def trace_dir(tmp_path):
+    def write(events):
+        d = tmp_path / "plugins" / "profile" / "x"
+        d.mkdir(parents=True, exist_ok=True)
+        with gzip.open(d / "t.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": events}, f)
+        return str(tmp_path)
+
+    return write
+
+
+def test_device_lane_filtering(trace_dir):
+    path = trace_dir(
+        [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/host:CPU runtime"}},
+            {"ph": "M", "name": "process_name", "pid": 2,
+             "args": {"name": "/device:TPU:0"}},
+            {"pid": 1, "name": "host_busy_loop", "dur": 99999},
+            {"pid": 2, "name": "gather.12", "dur": 500},
+            {"pid": 2, "name": "gather.12", "dur": 700},
+            {"pid": 2, "name": "fusion.3 dot", "dur": 300},
+        ]
+    )
+    rows = attribute(path, top_n=None)
+    names = [r[0] for r in rows]
+    assert "host_busy_loop" not in names  # host lanes excluded
+    assert rows[0] == ("gather.12", 1.2, 2)
+
+
+def test_all_lanes_fallback_without_device(trace_dir, capsys):
+    path = trace_dir(
+        [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/host:CPU runtime"}},
+            {"pid": 1, "name": "cpu_op", "dur": 1000},
+        ]
+    )
+    rows = attribute(path, top_n=None)
+    assert rows == [("cpu_op", 1.0, 1)]
+
+
+def test_top_n_truncation(trace_dir):
+    path = trace_dir(
+        [{"pid": 1, "name": f"op{i}", "dur": 100 * (i + 1)} for i in range(5)]
+    )
+    assert len(attribute(path, top_n=2)) == 2
+    assert len(attribute(path, top_n=None)) == 5
+
+
+def test_categorize_buckets_and_order():
+    rows = [
+        ("gather.12", 100.0, 5),
+        ("fusion.3 dot", 50.0, 2),  # fusion named after its dominant op
+        ("scatter-add.1", 25.0, 1),
+        ("all-reduce.9", 5.0, 1),
+        ("loop_add_fusion", 10.0, 1),  # opaque fusion
+        ("mystery_op", 1.0, 1),
+    ]
+    cats = dict(categorize(rows))
+    assert cats["gather"] == 100.0
+    assert cats["matmul"] == 50.0
+    assert cats["scatter"] == 25.0
+    assert cats["collective"] == 5.0
+    assert cats["fusion (opaque)"] == 10.0
+    assert cats["other"] == 1.0
+    # sorted by total descending
+    assert [c for c, _ in categorize(rows)][0] == "gather"
