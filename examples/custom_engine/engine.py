@@ -1,0 +1,189 @@
+"""A user-authored engine from scratch: time-decayed trending items.
+
+This is the engine-developer walkthrough the reference ships as its
+template skeletons (ref ``examples/scala-parallel-*/src/main/scala/
+Engine.scala`` — DataSource/Preparator/Algorithm/Serving + an
+``engineFactory``): everything a user writes to put their own model
+behind ``pio train`` / ``pio deploy``. The model here is deliberately
+tiny — exponentially time-decayed view/buy counts — so the DASE plumbing
+stays in the foreground; the decayed accumulation itself runs under
+``jax.jit`` (a segment-sum over the event stream), making this also the
+minimal example of the JaxAlgorithm path.
+
+Run it with::
+
+    python -m predictionio_tpu.tools.cli train \
+        --engine-dir examples/custom_engine
+    python -m predictionio_tpu.tools.cli deploy \
+        --engine-dir examples/custom_engine --port 8000
+    curl -X POST :8000/queries.json -d '{"num": 5}'
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Params,
+)
+from predictionio_tpu.controller.algorithm import JaxAlgorithm
+from predictionio_tpu.controller.engine import Engine
+from predictionio_tpu.workflow.context import WorkflowContext
+
+
+# -- queries and results (the wire contract of POST /queries.json) ----------
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    num: int = 10
+    blacklist: tuple[str, ...] = ()
+
+    @classmethod
+    def from_json_dict(cls, d: dict) -> "Query":
+        return cls(
+            num=int(d.get("num", 10)),
+            blacklist=tuple(d.get("blacklist", ())),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple[ItemScore, ...]
+
+    def to_json_dict(self) -> dict:
+        return {
+            "itemScores": [
+                {"item": s.item, "score": s.score} for s in self.item_scores
+            ]
+        }
+
+
+# -- D: data source ---------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_names: tuple[str, ...] = ("view", "buy")
+
+
+@dataclasses.dataclass
+class TrainingData:
+    item_ids: np.ndarray  # [N] int32 codes into item_vocab
+    event_weights: np.ndarray  # [N] f32 (1.0 view, 3.0 buy)
+    timestamps: np.ndarray  # [N] f64 epoch seconds
+    item_vocab: list[str]
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+    params: DataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        store = ctx.p_event_store()
+        vocab: dict[str, int] = {}
+        items, weights, stamps = [], [], []
+        for e in store.find(
+            self.params.app_name or ctx.app_name,
+            channel_name=ctx.channel_name,
+            event_names=list(self.params.event_names),
+        ):
+            if not e.target_entity_id:
+                continue
+            code = vocab.setdefault(e.target_entity_id, len(vocab))
+            items.append(code)
+            weights.append(3.0 if e.event == "buy" else 1.0)
+            stamps.append(e.event_time.timestamp())
+        return TrainingData(
+            np.asarray(items, np.int32),
+            np.asarray(weights, np.float32),
+            np.asarray(stamps, np.float64),
+            list(vocab),
+        )
+
+
+# -- A/S: the jit-compiled scorer and first-serving -------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgoParams(Params):
+    half_life_days: float = 7.0
+
+
+@dataclasses.dataclass
+class TrendingModel:
+    scores: np.ndarray  # [n_items] f32, decayed popularity
+    item_vocab: list[str]
+
+
+class TrendingAlgorithm(JaxAlgorithm):
+    params_class = AlgoParams
+    params: AlgoParams
+
+    def train(self, ctx: WorkflowContext, pd: TrainingData) -> TrendingModel:
+        import jax
+        import jax.numpy as jnp
+
+        n_items = len(pd.item_vocab)
+        if n_items == 0:
+            return TrendingModel(np.zeros(0, np.float32), [])
+        now = float(pd.timestamps.max()) if len(pd.timestamps) else 0.0
+        half_life_s = self.params.half_life_days * 86400.0
+
+        @jax.jit
+        def decayed_counts(item_ids, weights, ages_s):
+            decay = jnp.exp2(-ages_s / half_life_s).astype(jnp.float32)
+            return jnp.zeros(n_items, jnp.float32).at[item_ids].add(
+                weights * decay
+            )
+
+        scores = decayed_counts(
+            pd.item_ids, pd.event_weights,
+            (now - pd.timestamps).astype(np.float32),
+        )
+        return TrendingModel(np.asarray(scores), pd.item_vocab)
+
+    def predict(self, model: TrendingModel, query: Query) -> PredictedResult:
+        order = np.argsort(-model.scores, kind="stable")
+        out = []
+        banned = set(query.blacklist)
+        for idx in order:
+            item = model.item_vocab[int(idx)]
+            if item in banned:
+                continue
+            out.append(ItemScore(item, float(model.scores[idx])))
+            if len(out) >= query.num:
+                break
+        return PredictedResult(tuple(out))
+
+
+class Preparator(BasePreparator):
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> TrainingData:
+        return td  # nothing to transform for this model
+
+
+class Serving(BaseServing):
+    def serve(self, query: Query, predictions) -> PredictedResult:
+        return predictions[0]
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        DataSource,
+        Preparator,
+        {"trending": TrendingAlgorithm},
+        Serving,
+        query_class=Query,
+    )
