@@ -31,6 +31,7 @@ from predictionio_tpu.controller import (
     BaseServing,
     Params,
 )
+from predictionio_tpu.eval.metric import AverageMetric
 from predictionio_tpu.controller.algorithm import JaxAlgorithm
 from predictionio_tpu.controller.engine import Engine
 from predictionio_tpu.workflow.context import WorkflowContext
@@ -77,6 +78,7 @@ class PredictedResult:
 class DataSourceParams(Params):
     app_name: str = ""
     event_names: tuple[str, ...] = ("view", "buy")
+    eval_k: int = 0  # folds for `pio eval`; 0 = training only
 
 
 @dataclasses.dataclass
@@ -112,6 +114,80 @@ class DataSource(BaseDataSource):
             np.asarray(stamps, np.float64),
             list(vocab),
         )
+
+    def read_eval(self, ctx: WorkflowContext):
+        """k-fold split for `pio eval`: train on k-1 folds, ask whether a
+        held-out interaction's item lands in the trending top-10."""
+        from predictionio_tpu.e2.cross_validation import k_fold_split
+
+        td = self.read_training(ctx)
+        n = len(td.item_ids)
+        folds = []
+        for train_idx, test_idx in k_fold_split(
+            list(range(n)), max(2, self.params.eval_k)
+        ):
+            tr = TrainingData(
+                td.item_ids[train_idx],
+                td.event_weights[train_idx],
+                td.timestamps[train_idx],
+                td.item_vocab,
+            )
+            qa = [
+                (Query(num=10), ActualItem(td.item_vocab[td.item_ids[i]]))
+                for i in test_idx
+            ]
+            folds.append((tr, {}, qa))
+        return folds
+
+
+@dataclasses.dataclass(frozen=True)
+class ActualItem:
+    """The held-out interaction's item (the eval ground truth)."""
+
+    item: str
+
+
+class HitAtK(AverageMetric):
+    """Fraction of held-out interactions whose item is in the served
+    top-N (a popularity model answers the same list for every query, so
+    this measures how much tail traffic the trending list captures)."""
+
+    def calculate_score(self, ei, q, p: PredictedResult, a: ActualItem) -> float:
+        return 1.0 if any(s.item == a.item for s in p.item_scores) else 0.0
+
+
+def evaluation():
+    """`pio eval engine.evaluation` over half-life variants."""
+    from predictionio_tpu.eval.evaluator import (
+        EngineParamsGenerator,
+        Evaluation,
+    )
+
+    engine = engine_factory()
+    base = engine.engine_params_from_variant(
+        {
+            "datasource": {"params": {"appName": "MyApp1", "evalK": 3}},
+            "algorithms": [
+                {"name": "trending", "params": {"halfLifeDays": 7.0}}
+            ],
+        }
+    )
+    variants = []
+    for days in (1.0, 7.0, 30.0):
+        algo_name, algo_params = base.algorithms[0]
+        variants.append(
+            dataclasses.replace(
+                base,
+                algorithms=[
+                    (algo_name, dataclasses.replace(algo_params, half_life_days=days))
+                ],
+            )
+        )
+    return Evaluation(
+        engine=engine,
+        metric=HitAtK(),
+        engine_params_generator=EngineParamsGenerator(variants),
+    )
 
 
 # -- A/S: the jit-compiled scorer and first-serving -------------------------

@@ -304,7 +304,11 @@ def cmd_eval(args) -> int:
 
     module_name, _, attr = args.evaluation.rpartition(".")
     evaluation = getattr(importlib.import_module(module_name), attr)
-    if isinstance(evaluation, type):
+    # accept an Evaluation instance, an Evaluation subclass, or a zero-arg
+    # factory function (ref Console eval: object or class name)
+    if isinstance(evaluation, type) or (
+        callable(evaluation) and not hasattr(evaluation, "run")
+    ):
         evaluation = evaluation()
     if args.engine_params_generator:
         module_name, _, attr = args.engine_params_generator.rpartition(".")

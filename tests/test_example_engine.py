@@ -89,3 +89,30 @@ def test_walkthrough_engine_end_to_end(memory_storage):
     blk = engine.decode_query({"num": 2, "blacklist": ["i1"]})
     res2 = algo.predict(models[0], blk)
     assert all(s.item != "i1" for s in res2.item_scores)
+
+
+def test_walkthrough_evaluation(memory_storage):
+    """`pio eval engine.evaluation` sweeps half-life variants with the
+    HitAtK metric over k folds and persists a best score."""
+    import sys
+
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.workflow.context import WorkflowContext
+    from predictionio_tpu.workflow.core_workflow import run_evaluation
+
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "MyApp1"))
+    _seed(memory_storage, app_id)
+    sys.path.insert(0, ENGINE_DIR)
+    try:
+        import engine as example_engine  # noqa: PLC0415 - the walkthrough module
+
+        evaluation = example_engine.evaluation()
+        ctx = WorkflowContext(mode="evaluation", _storage=memory_storage)
+        iid, result = run_evaluation(
+            evaluation, ctx=ctx, storage=memory_storage
+        )
+        assert 0.0 <= result.best_score <= 1.0
+        inst = memory_storage.get_meta_data_evaluation_instances().get(iid)
+        assert inst.status == "EVALCOMPLETED"
+    finally:
+        sys.path.remove(ENGINE_DIR)
