@@ -85,6 +85,42 @@ class TestStatusVersion:
         assert code == 0
         assert "all data objects verified" in out
 
+    def test_status_survives_wedged_device_probe(
+        self, memory_storage, capsys, monkeypatch
+    ):
+        """A hung accelerator tunnel must degrade the device line, never
+        hang or crash `pio status` (observed in the wild: the PJRT
+        plugin's registration wedges and blocks jax init forever)."""
+        import subprocess
+
+        def fake_run(*a, **kw):
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=45)
+
+        monkeypatch.setattr("subprocess.run", fake_run)
+        code, out, _ = run(capsys, "status")
+        assert code == 0
+        assert "timed out" in out
+        assert "ready to train" in out
+
+    def test_status_survives_noisy_probe_stdout(
+        self, memory_storage, capsys, monkeypatch
+    ):
+        """Plugin banners on the probe's stdout must not break the parse
+        (the marker line is searched, not assumed to be alone)."""
+        import subprocess
+
+        def fake_run(*a, **kw):
+            return subprocess.CompletedProcess(
+                a, 0,
+                stdout="some plugin banner\nPIO-JAX 9.9.9 4\ntrailer\n",
+                stderr="",
+            )
+
+        monkeypatch.setattr("subprocess.run", fake_run)
+        code, out, _ = run(capsys, "status")
+        assert code == 0
+        assert "jax 9.9.9; devices: 4" in out
+
     def test_unregister(self, capsys, tmp_path):
         # ref Console.scala:172-177: the verb is part of the CLI surface
         # (vestigial there — parsed with no dispatch case); here it is an
