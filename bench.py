@@ -361,6 +361,32 @@ def phase_als(ck: _Checkpoint) -> None:
         except Exception as exc:  # noqa: BLE001 - extra datapoint only
             ck.save(als_bf16_error=str(exc)[:200])
 
+        # extra datapoint 2: the VMEM-fused CG solver (one HBM read of the
+        # [n, f, f] systems vs f+4 — the dominant term of the roofline
+        # model). Guarded like the bf16 variant; its own RMSE recorded.
+        try:
+            t_fused: dict = {}
+            cfg_fused = ALSConfig(
+                rank=rank, iterations=iterations, reg=0.05, chunk=65536,
+                solver="cg_fused",
+            )
+            uf_f, vf_f = als_train(
+                users_tr, items_tr, vals_tr, n_users, n_items, cfg_fused,
+                timings=t_fused,
+            )
+            ck.save(
+                als_cgfused_device_s=round(t_fused["device_s"], 3),
+                als_cgfused_heldout_rmse=round(
+                    _heldout_rmse(
+                        np.asarray(uf_f), np.asarray(vf_f),
+                        users, items, vals, test_mask,
+                    ),
+                    4,
+                ),
+            )
+        except Exception as exc:  # noqa: BLE001 - extra datapoint only
+            ck.save(als_cgfused_error=str(exc)[:200])
+
     # held-out quality gate (device -> host readback is the round-2 crash
     # site; the wall-clock above is already checkpointed if this faults)
     uf_host, vf_host = np.asarray(uf), np.asarray(vf)

@@ -62,11 +62,15 @@ class ALSConfig:
     seed: int = 3
     chunk: int = 16384  # COO entries per scan step (blocked: block_d * blocks)
     block_d: int = 128  # entity-block width for the MXU Gram path
-    # "cg" | "cholesky": batched f-by-f SPD solver. Jacobi-preconditioned CG
-    # run for f+4 iterations is exact-termination on an f-dim Krylov space
-    # (it IS a direct method for these sizes, modulo fp rounding) and maps to
-    # batched MXU matvecs — measured 9x faster than jnp.linalg.cholesky +
-    # cho_solve for 138k 32x32 systems on a v5e chip, with a smaller residual.
+    # "cg" | "cg_fused" | "cholesky": batched f-by-f SPD solver.
+    # Jacobi-preconditioned CG run for f+4 iterations is exact-termination
+    # on an f-dim Krylov space (it IS a direct method for these sizes,
+    # modulo fp rounding) and maps to batched MXU matvecs — measured 9x
+    # faster than jnp.linalg.cholesky + cho_solve for 138k 32x32 systems on
+    # a v5e chip, with a smaller residual. "cg_fused" is the identical
+    # algorithm as a VMEM-resident pallas kernel: one HBM read of the
+    # [n, f, f] systems instead of f+4 (the dominant term of the HBM
+    # roofline model, docs/PERF.md); falls back to plain cg off-TPU.
     solver: str = "cg"
     # "auto" | "degree" | "constant" — see module docstring (ALS-WR)
     reg_scaling: str = "auto"
@@ -101,8 +105,10 @@ class ALSConfig:
             raise ValueError(
                 f"reg_scaling must be auto|degree|constant, got {self.reg_scaling!r}"
             )
-        if self.solver not in ("cg", "cholesky"):
-            raise ValueError(f"solver must be cg|cholesky, got {self.solver!r}")
+        if self.solver not in ("cg", "cg_fused", "cholesky"):
+            raise ValueError(
+                f"solver must be cg|cg_fused|cholesky, got {self.solver!r}"
+            )
         if self.pack not in ("auto", "device", "host"):
             raise ValueError(f"pack must be auto|device|host, got {self.pack!r}")
         if self.gather_dtype not in ("f32", "bf16"):
@@ -312,8 +318,14 @@ def _normal_equations_blocked(
 def _batched_spd_solve(A: jnp.ndarray, b: jnp.ndarray, solver: str) -> jnp.ndarray:
     """Solve B independent f-by-f SPD systems. ``cg`` = Jacobi-preconditioned
     conjugate gradient for f+4 iterations (exact termination on the f-dim
-    space; batched matvecs ride the MXU — see ALSConfig.solver); ``cholesky``
-    = LAPACK-style factorization (reference semantics, slower on TPU)."""
+    space; batched matvecs ride the MXU — see ALSConfig.solver); ``cg_fused``
+    = the same algorithm as a VMEM-resident pallas kernel (one HBM read of
+    A instead of f+4 — ops/spd_solve.py); ``cholesky`` = LAPACK-style
+    factorization (reference semantics, slower on TPU)."""
+    if solver == "cg_fused":
+        from predictionio_tpu.ops.spd_solve import batched_spd_solve_auto
+
+        return batched_spd_solve_auto(A, b)
     if solver == "cholesky":
         return jax.scipy.linalg.cho_solve((jnp.linalg.cholesky(A), True), b)
     f = A.shape[-1]

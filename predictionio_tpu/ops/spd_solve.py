@@ -1,0 +1,107 @@
+"""Batched small-SPD solve with a VMEM-resident fused-CG pallas kernel.
+
+The ALS half-solve ends with ~n_entities independent [f, f] SPD systems
+(f = rank, 16-64). The stock path (``ops/als.py:_batched_spd_solve``)
+runs Jacobi-preconditioned CG for f+4 iterations as whole-array jnp ops:
+every iteration re-reads the entire [n, f, f] A tensor from HBM — at
+ML-20M that is 36 passes over ~680 MB per side, ~70% of the iteration's
+mandatory memory traffic (docs/PERF.md round-5 HBM model).
+
+This kernel runs the IDENTICAL algorithm — same preconditioner, same
+f+4 exact-termination iteration count, same update order, so results
+match to float rounding — but tiles A into VMEM once and keeps every CG
+vector on-chip: HBM traffic drops to one read of A + the vectors, and
+the per-iteration matvecs become MXU ``dot_general``s over the resident
+tile. One pallas grid cell handles ``bs`` systems ([bs, f, f] ≈ 0.5 MB
+at bs=128, f=32).
+
+Reference analog: the per-entity normal-equation solves inside MLlib
+ALS (``CholeskySolver`` in the reference's Spark stack); redesigned
+TPU-first rather than translated.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _cg_body(A, b, iters: int):
+    """The exact Jacobi-CG from ops/als.py, on whatever arrays it is
+    handed (VMEM tiles inside the kernel; plain arrays in the fallback)."""
+    f = A.shape[-1]
+    eye = jnp.eye(f, dtype=A.dtype)
+    dinv = 1.0 / jnp.sum(A * eye, axis=-1)  # diagonal without jnp.diagonal
+
+    def mv(x):
+        return jax.lax.dot_general(
+            A, x[..., None], (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )[..., 0]
+
+    x = b * dinv
+    r = b - mv(x)
+    z = r * dinv
+    p = z
+    rz = jnp.sum(r * z, -1)
+    for _ in range(iters):  # static unroll: trip count is f+4, known
+        Ap = mv(p)
+        alpha = rz / jnp.maximum(jnp.sum(p * Ap, -1), 1e-30)
+        x = x + alpha[:, None] * p
+        r = r - alpha[:, None] * Ap
+        z = r * dinv
+        rz2 = jnp.sum(r * z, -1)
+        p = z + (rz2 / jnp.maximum(rz, 1e-30))[:, None] * p
+        rz = rz2
+    return x
+
+
+def _kernel(a_ref, b_ref, x_ref, *, iters: int):
+    x_ref[...] = _cg_body(a_ref[...], b_ref[...], iters)
+
+
+@functools.partial(jax.jit, static_argnames=("bs", "interpret"))
+def batched_spd_solve_fused(
+    A: jnp.ndarray,  # [n, f, f] SPD (regularized normal equations)
+    b: jnp.ndarray,  # [n, f]
+    bs: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Solve n independent SPD systems; one HBM read of A total.
+
+    Pads n up to a multiple of ``bs`` with identity systems (solution 0)
+    — the pad rows are sliced off before returning.
+    """
+    from jax.experimental import pallas as pl
+
+    n, f = A.shape[0], A.shape[-1]
+    iters = f + 4
+    pad = (-n) % bs
+    if pad:
+        eye = jnp.broadcast_to(jnp.eye(f, dtype=A.dtype), (pad, f, f))
+        A = jnp.concatenate([A, eye])
+        b = jnp.concatenate([b, jnp.zeros((pad, f), b.dtype)])
+    n_pad = A.shape[0]
+    out = pl.pallas_call(
+        functools.partial(_kernel, iters=iters),
+        grid=(n_pad // bs,),
+        in_specs=[
+            pl.BlockSpec((bs, f, f), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bs, f), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bs, f), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, f), jnp.float32),
+        interpret=interpret,
+    )(A.astype(jnp.float32), b.astype(jnp.float32))
+    return out[:n]
+
+
+def batched_spd_solve_auto(A: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Fused kernel on TPU; the identical-algorithm jnp path elsewhere
+    (same platform-sniff contract as ops/attention.fused_attention)."""
+    if jax.default_backend() in ("tpu", "axon"):
+        return batched_spd_solve_fused(A, b)
+    return _cg_body(A, b, A.shape[-1] + 4)
