@@ -279,6 +279,10 @@ class ALSAlgorithmParams(Params):
     # solver's Gram accumulation (halves the gather-bound loop's row bytes;
     # accumulators and solves stay f32 — see ops/als.ALSConfig.gather_dtype)
     gather_dtype: str = "f32"
+    # "cg" | "cg_fused" | "cholesky": per-entity SPD solver; "cg_fused"
+    # keeps the normal-equation systems VMEM-resident (one HBM read
+    # instead of f+4 — see ops/als.ALSConfig.solver)
+    solver: str = "cg"
 
 
 @dataclasses.dataclass
@@ -348,6 +352,7 @@ class ALSAlgorithm(JaxAlgorithm):
             alpha=self.params.alpha,
             seed=self.params.seed if self.params.seed is not None else 0,
             gather_dtype=self.params.gather_dtype,
+            solver=self.params.solver,
         )
         if self.params.distributed:
             from predictionio_tpu.ops.als_sharded import als_train_sharded

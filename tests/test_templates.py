@@ -892,6 +892,21 @@ class TestRecommendationVariants:
         with pytest.raises(ValueError, match="gather_dtype"):
             self.make(memory_storage, v)
 
+    def test_solver_param_reaches_solver(self, memory_storage):
+        """solver in engine.json flows through to ALSConfig: cg_fused
+        trains to usable factors; a bad value fails at train."""
+        from predictionio_tpu.models.recommendation.engine import Query
+
+        self.seed(memory_storage)
+        v = self.base_variant()
+        v["algorithms"][0]["params"]["solver"] = "cg_fused"
+        engine, algos, models, serving = self.make(memory_storage, v)
+        r = algos[0].predict(models[0], Query(user="u1", num=5))
+        assert len(r.item_scores) == 5
+        v["algorithms"][0]["params"]["solver"] = "lu"
+        with pytest.raises(ValueError, match="solver"):
+            self.make(memory_storage, v)
+
     def test_blacklist_items_excluded(self, memory_storage):
         from predictionio_tpu.models.recommendation.engine import Query
 
