@@ -236,12 +236,12 @@ class TrendingAlgorithm(JaxAlgorithm):
         out = []
         banned = set(query.blacklist)
         for idx in order:
+            if len(out) >= query.num:  # before append: num<=0 returns none
+                break
             item = model.item_vocab[int(idx)]
             if item in banned:
                 continue
             out.append(ItemScore(item, float(model.scores[idx])))
-            if len(out) >= query.num:
-                break
         return PredictedResult(tuple(out))
 
 

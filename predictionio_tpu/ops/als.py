@@ -328,31 +328,12 @@ def _batched_spd_solve(A: jnp.ndarray, b: jnp.ndarray, solver: str) -> jnp.ndarr
         return batched_spd_solve_auto(A, b)
     if solver == "cholesky":
         return jax.scipy.linalg.cho_solve((jnp.linalg.cholesky(A), True), b)
-    f = A.shape[-1]
-    dinv = 1.0 / jnp.diagonal(A, axis1=-2, axis2=-1)
+    # stock cg = the SAME body the fused kernel runs (ops/spd_solve.py);
+    # one shared implementation keeps the fused/stock parity contract
+    # from silently drifting
+    from predictionio_tpu.ops.spd_solve import _cg_body
 
-    def mv(x):
-        return jnp.einsum("bij,bj->bi", A, x)
-
-    x = b * dinv
-    r = b - mv(x)
-    z = r * dinv
-    p = z
-    rz = jnp.sum(r * z, -1)
-
-    def body(_, st):
-        x, r, p, rz = st
-        Ap = mv(p)
-        alpha = rz / jnp.maximum(jnp.sum(p * Ap, -1), 1e-30)
-        x = x + alpha[:, None] * p
-        r = r - alpha[:, None] * Ap
-        z = r * dinv
-        rz2 = jnp.sum(r * z, -1)
-        p = z + (rz2 / jnp.maximum(rz, 1e-30))[:, None] * p
-        return x, r, p, rz2
-
-    x, *_ = lax.fori_loop(0, f + 4, body, (x, r, p, rz))
-    return x
+    return _cg_body(A, b, A.shape[-1] + 4, unroll=False)
 
 
 def _solve_blocked(

@@ -29,9 +29,12 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _cg_body(A, b, iters: int):
-    """The exact Jacobi-CG from ops/als.py, on whatever arrays it is
-    handed (VMEM tiles inside the kernel; plain arrays in the fallback)."""
+def _cg_body(A, b, iters: int, *, unroll: bool = True):
+    """THE Jacobi-CG used everywhere: ops/als.py's stock ``cg`` branch
+    calls this with ``unroll=False`` (lax.fori_loop — small HLO for the
+    whole-array path) and the pallas kernel with ``unroll=True`` (static
+    trip count inside the grid cell). One shared body means the fused
+    kernel's 'identical algorithm' parity contract cannot silently drift."""
     f = A.shape[-1]
     eye = jnp.eye(f, dtype=A.dtype)
     dinv = 1.0 / jnp.sum(A * eye, axis=-1)  # diagonal without jnp.diagonal
@@ -42,12 +45,8 @@ def _cg_body(A, b, iters: int):
             preferred_element_type=jnp.float32,
         )[..., 0]
 
-    x = b * dinv
-    r = b - mv(x)
-    z = r * dinv
-    p = z
-    rz = jnp.sum(r * z, -1)
-    for _ in range(iters):  # static unroll: trip count is f+4, known
+    def step(st):
+        x, r, p, rz = st
         Ap = mv(p)
         alpha = rz / jnp.maximum(jnp.sum(p * Ap, -1), 1e-30)
         x = x + alpha[:, None] * p
@@ -55,8 +54,18 @@ def _cg_body(A, b, iters: int):
         z = r * dinv
         rz2 = jnp.sum(r * z, -1)
         p = z + (rz2 / jnp.maximum(rz, 1e-30))[:, None] * p
-        rz = rz2
-    return x
+        return x, r, p, rz2
+
+    x = b * dinv
+    r = b - mv(x)
+    z = r * dinv
+    st = (x, r, z, jnp.sum(r * z, -1))
+    if unroll:
+        for _ in range(iters):
+            st = step(st)
+    else:
+        st = jax.lax.fori_loop(0, iters, lambda _, s: step(s), st)
+    return st[0]
 
 
 def _kernel(a_ref, b_ref, x_ref, *, iters: int):
@@ -104,4 +113,4 @@ def batched_spd_solve_auto(A: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     (same platform-sniff contract as ops/attention.fused_attention)."""
     if jax.default_backend() in ("tpu", "axon"):
         return batched_spd_solve_fused(A, b)
-    return _cg_body(A, b, A.shape[-1] + 4)
+    return _cg_body(A, b, A.shape[-1] + 4, unroll=False)

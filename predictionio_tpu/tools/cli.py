@@ -302,6 +302,13 @@ def cmd_eval(args) -> int:
     from predictionio_tpu.workflow.core_workflow import run_evaluation
     import importlib
 
+    # user evaluations live in the engine project's cwd (ref Console eval
+    # runs from the engine dir); the installed `pio` script's sys.path[0]
+    # is its bin dir, so put the cwd on the path like load_engine does for
+    # engine dirs
+    cwd = os.getcwd()
+    if cwd not in sys.path:
+        sys.path.insert(0, cwd)
     module_name, _, attr = args.evaluation.rpartition(".")
     evaluation = getattr(importlib.import_module(module_name), attr)
     # accept an Evaluation instance, an Evaluation subclass, or a zero-arg
