@@ -164,6 +164,8 @@ class ECommAlgorithmParams(Params):
     lambda_: float = 0.01
     alpha: float = 1.0
     seed: int | None = 3
+    # "cg" | "cg_fused" | "cholesky" (see ops/als.ALSConfig.solver)
+    solver: str = "cg"
     # adjust-score variant: enable the per-request weightedItems constraint
     # lookup (off by default — it costs one event-store query per predict)
     adjust_score: bool = False
@@ -265,6 +267,7 @@ class ECommAlgorithm(JaxAlgorithm):
             implicit=True,
             alpha=self.params.alpha,
             seed=self.params.seed if self.params.seed is not None else 0,
+            solver=self.params.solver,
         )
         uf, vf = als_train(
             pd.rate_user_idx,

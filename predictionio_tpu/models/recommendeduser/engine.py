@@ -126,6 +126,8 @@ class ALSAlgorithmParams(Params):
     lambda_: float = 0.01
     alpha: float = 1.0
     seed: int | None = 3
+    # "cg" | "cg_fused" | "cholesky" (see ops/als.ALSConfig.solver)
+    solver: str = "cg"
 
 
 @dataclasses.dataclass
@@ -182,6 +184,7 @@ class ALSAlgorithm(JaxAlgorithm):
             implicit=True,
             alpha=self.params.alpha,
             seed=self.params.seed if self.params.seed is not None else 0,
+            solver=self.params.solver,
         )
         _, followed_factors = als_train(
             pair[:, 0],

@@ -331,6 +331,8 @@ class ALSAlgorithmParams(Params):
     lambda_: float = 0.01
     alpha: float = 1.0
     seed: int | None = 3
+    # "cg" | "cg_fused" | "cholesky" (see ops/als.ALSConfig.solver)
+    solver: str = "cg"
 
 
 class _ALSBase(JaxAlgorithm):
@@ -372,6 +374,7 @@ class _ALSBase(JaxAlgorithm):
             implicit=True,
             alpha=self.params.alpha,
             seed=self.params.seed if self.params.seed is not None else 0,
+            solver=self.params.solver,
         )
         _, item_factors = als_train(
             pair[:, 0],
@@ -420,6 +423,7 @@ class RateALSAlgorithm(_ALSBase):
             reg=self.params.lambda_,
             implicit=False,
             seed=self.params.seed if self.params.seed is not None else 0,
+            solver=self.params.solver,
         )
         _, item_factors = als_train(
             pd.rate_user_idx,
