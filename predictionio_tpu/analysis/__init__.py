@@ -1,0 +1,53 @@
+"""TPU-aware static analysis (`pio lint`).
+
+AST-based checks that catch the classic JAX serving failure modes at review
+time instead of at 3am on a pod: tracer-unsafe Python control flow inside
+jitted functions, recompile hazards (unhashable/scalar args, jit wrappers
+built per request, mutable closure capture), host-sync stalls on the serving
+path, unlocked shared state in threaded modules, and storage backends that
+drift from the ``storage/base.py`` abstract contract.
+
+Public surface:
+
+- :func:`analyze_paths` / :func:`analyze_source` — run the rule registry.
+- :class:`Finding`, :class:`Severity`, :class:`LintConfig`, :class:`Report`.
+- ``predictionio_tpu.analysis.cli:main`` — the ``pio lint`` / ``lint``
+  console entry point.
+
+Inline suppression: ``# pio-lint: disable=rule-id[,rule-id...] -- reason``
+on the offending line (or alone on the line above); file-level with
+``# pio-lint: disable-file=rule-id``. Suppressions should carry a reason.
+
+This package must stay importable without jax/numpy: `pio lint` runs in
+CI and pre-commit hooks where pulling in an accelerator runtime (or a
+wedged TPU tunnel plugin) is exactly what we are trying to avoid.
+"""
+
+from predictionio_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    Report,
+    Severity,
+    all_rules,
+    analyze_paths,
+    analyze_source,
+)
+
+# importing the rule modules registers their checkers
+from predictionio_tpu.analysis import (  # noqa: F401  (registration side effect)
+    rules_concurrency,
+    rules_hostsync,
+    rules_recompile,
+    rules_storage,
+    rules_tracer,
+)
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "Report",
+    "Severity",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+]

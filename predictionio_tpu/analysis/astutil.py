@@ -1,0 +1,218 @@
+"""Shared AST helpers for the rule modules.
+
+The jit-detection here is *syntactic*: it recognizes the decoration and
+call idioms this codebase (and JAX code generally) actually uses —
+``@jax.jit``, ``@functools.partial(jax.jit, static_argnames=...)``,
+``jax.jit(fn, ...)`` as an expression, ``pjit``/``shard_map`` variants —
+without importing jax or resolving names. False negatives from exotic
+aliasing (``mylint = jax.jit``) are acceptable; false positives are not.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+JIT_LAST_COMPONENTS = frozenset({"jit", "pjit", "shard_map"})
+
+# attribute reads that are static under tracing (safe to branch on)
+STATIC_ATTRS = frozenset(
+    {"shape", "ndim", "dtype", "size", "sharding", "aval", "weak_type"}
+)
+# builtin calls whose result is static even on a tracer argument
+STATIC_CALLS = frozenset({"len", "isinstance", "hasattr", "getattr", "type"})
+
+MUTATING_METHODS = frozenset(
+    {
+        "append",
+        "extend",
+        "insert",
+        "add",
+        "update",
+        "pop",
+        "popitem",
+        "clear",
+        "remove",
+        "discard",
+        "setdefault",
+        "appendleft",
+        "extendleft",
+    }
+)
+
+MUTABLE_FACTORIES = frozenset(
+    {"dict", "list", "set", "defaultdict", "OrderedDict", "Counter", "deque"}
+)
+
+
+def dotted(node: ast.AST) -> str | None:
+    """'jax.numpy.asarray' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_component(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class JitInfo:
+    """Static-argument declarations extracted from a jit decoration/call."""
+
+    kind: str  # last component: jit / pjit / shard_map
+    static_argnums: frozenset[int]
+    static_argnames: frozenset[str]
+
+
+def _const_str_or_collection(node: ast.AST | None) -> frozenset:
+    """Literal 'x', ('x', 'y'), ['x'] -> the set of constants (str or int)."""
+    if node is None:
+        return frozenset()
+    if isinstance(node, ast.Constant) and isinstance(node.value, (str, int)):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, (str, int)):
+                out.add(elt.value)
+        return frozenset(out)
+    return frozenset()
+
+
+def _info_from_keywords(kind: str, keywords: list[ast.keyword]) -> JitInfo:
+    nums: frozenset = frozenset()
+    names: frozenset = frozenset()
+    for kw in keywords:
+        if kw.arg == "static_argnums":
+            nums = frozenset(
+                v for v in _const_str_or_collection(kw.value) if isinstance(v, int)
+            )
+        elif kw.arg == "static_argnames":
+            names = frozenset(
+                v for v in _const_str_or_collection(kw.value) if isinstance(v, str)
+            )
+    return JitInfo(kind, nums, names)
+
+
+def jit_expr_info(expr: ast.AST) -> JitInfo | None:
+    """JitInfo when ``expr`` denotes a jit transform (bare or partial'd)."""
+    last = last_component(expr)
+    if last in JIT_LAST_COMPONENTS:
+        return JitInfo(last, frozenset(), frozenset())
+    if isinstance(expr, ast.Call):
+        func_last = last_component(expr.func)
+        if func_last == "partial" and expr.args:
+            inner = last_component(expr.args[0])
+            if inner in JIT_LAST_COMPONENTS:
+                return _info_from_keywords(inner, expr.keywords)
+        if func_last in JIT_LAST_COMPONENTS:
+            # jax.jit(fn, static_argnames=...) used as expression/decorator
+            return _info_from_keywords(func_last, expr.keywords)
+    return None
+
+
+def jit_decorator_info(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> JitInfo | None:
+    for dec in fn.decorator_list:
+        info = jit_expr_info(dec)
+        if info is not None:
+            return info
+    return None
+
+
+def param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def traced_param_names(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef, info: JitInfo
+) -> set[str]:
+    a = fn.args
+    positional = [p.arg for p in a.posonlyargs + a.args]
+    traced = set(positional) | {p.arg for p in a.kwonlyargs}
+    traced -= {positional[i] for i in info.static_argnums if i < len(positional)}
+    traced -= set(info.static_argnames)
+    traced -= {"self", "cls"}
+    return traced
+
+
+def dynamic_names(node: ast.AST) -> set[str]:
+    """Names whose *concrete value* the expression inspects.
+
+    ``x.shape[0]``, ``len(x)``, ``isinstance(x, T)`` and ``x is None`` are
+    static under tracing and contribute nothing; a bare ``x`` (or ``x + 1``,
+    ``x[0] > 0`` ...) forces the traced value and contributes ``x``.
+    """
+    if isinstance(node, ast.Name):
+        return {node.id}
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return set()
+        return dynamic_names(node.value)
+    if isinstance(node, ast.Call):
+        func_last = last_component(node.func)
+        if isinstance(node.func, ast.Name) and func_last in STATIC_CALLS:
+            return set()
+        out = set()
+        if isinstance(node.func, ast.Attribute):
+            out |= dynamic_names(node.func.value)
+        for arg in node.args:
+            out |= dynamic_names(arg)
+        for kw in node.keywords:
+            out |= dynamic_names(kw.value)
+        return out
+    if isinstance(node, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+    ):
+        # `x is None` / `x is not None` inspect identity, not the value
+        return set()
+    out = set()
+    for child in ast.iter_child_nodes(node):
+        out |= dynamic_names(child)
+    return out
+
+
+def is_mutable_literal(node: ast.AST) -> bool:
+    return isinstance(
+        node,
+        (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp),
+    )
+
+
+def is_mutable_factory_call(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        last = last_component(node.func)
+        return last in MUTABLE_FACTORIES
+    return False
+
+
+def walk_skipping_nested_functions(body: list[ast.stmt]):
+    """Yield every node in ``body`` without descending into nested
+    function/class definitions (their scopes are analyzed separately)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,127 @@
+"""Console entry for the analyzer: ``pio lint`` and the standalone ``lint``.
+
+Deliberately free of jax/numpy imports so it starts fast in CI and
+pre-commit hooks (and cannot hang on a wedged accelerator tunnel).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from predictionio_tpu.analysis import LintConfig, all_rules, analyze_paths
+
+
+def default_lint_paths() -> list[str]:
+    """The package itself, the bundled engine templates (inside it) and the
+    examples/ tree next to the repo root, when present."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(pkg_dir)
+    paths = [pkg_dir]
+    examples = os.path.join(root, "examples")
+    if os.path.isdir(examples):
+        paths.append(examples)
+    return paths
+
+
+def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to lint (default: the installed "
+        "predictionio_tpu package and ./examples)",
+    )
+    parser.add_argument(
+        "--format",
+        dest="output_format",
+        choices=("text", "json"),
+        default="text",
+    )
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit nonzero on warnings too, not just errors",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        dest="rules",
+        metavar="RULE-ID",
+        help="run only these rule ids (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule registry and exit"
+    )
+    parser.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print findings silenced by pio-lint comments",
+    )
+
+
+def run_lint(args) -> int:
+    if args.list_rules:
+        for meta in all_rules():
+            print(
+                f"{meta.id:<28} {meta.severity.name.lower():<8} "
+                f"[{meta.family}] {meta.summary}"
+            )
+        return 0
+    paths = args.paths or default_lint_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"[ERROR] no such path: {p}", file=sys.stderr)
+            return 2
+    if args.rules:
+        known = {m.id for m in all_rules()}
+        unknown = sorted(set(args.rules) - known)
+        if unknown:
+            # a typo'd --rule must not neuter the gate while looking green
+            print(
+                f"[ERROR] unknown rule id(s): {', '.join(unknown)} "
+                f"(see --list-rules)",
+                file=sys.stderr,
+            )
+            return 2
+    config = LintConfig(
+        enabled=frozenset(args.rules) if args.rules else None,
+    )
+    report = analyze_paths(paths, config=config)
+    if args.output_format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_json_dict() for f in report.findings],
+                    "suppressed": [f.to_json_dict() for f in report.suppressed],
+                    "files_scanned": report.files_scanned,
+                    "duration_s": round(report.duration_s, 3),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in report.findings:
+            print(f.format())
+        if args.show_suppressed:
+            for f in report.suppressed:
+                print(f"{f.format()}  (suppressed)")
+        print(report.summary())
+    failed = bool(report.errors) or (args.strict and report.warnings)
+    return 1 if failed else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="lint",
+        description="TPU-aware static analyzer for predictionio_tpu code "
+        "(tracer safety, recompile hazards, host-sync stalls, concurrency, "
+        "storage contracts)",
+    )
+    add_lint_arguments(parser)
+    return run_lint(parser.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,320 @@
+"""Analyzer engine: rule registry, suppression, file walking, reporting.
+
+Rules live in sibling ``rules_*`` modules; each declares its metadata with
+:func:`register_rule` and registers one checker callable with
+:func:`register_checker`. A checker receives a :class:`FileContext` and
+yields :class:`Finding` objects; the engine applies inline/file suppressions
+afterwards so checkers never need to know about them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import enum
+import fnmatch
+import os
+import re
+import time
+from typing import Callable, Iterable, Iterator
+
+
+class Severity(enum.IntEnum):
+    INFO = 10
+    WARNING = 20
+    ERROR = 30
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleMeta:
+    """Declared identity of one rule: id, family, default severity, docs."""
+
+    id: str
+    family: str
+    severity: Severity
+    summary: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: Severity
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.severity.name.lower()} [{self.rule}] {self.message}"
+        )
+
+    def to_json_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity.name.lower(),
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class LintConfig:
+    """Tunables a caller (CLI, tests, CI) may override."""
+
+    # modules on the request hot path: host syncs here stall the event loop
+    serving_globs: tuple[str, ...] = (
+        "*/controller/serving.py",
+        "*/workflow/create_server.py",
+        "*/data/api/*.py",
+    )
+    # function names allowed to host-sync on the serving path (startup /
+    # shutdown hooks that run outside the request loop)
+    hostsync_allow_functions: tuple[str, ...] = ()
+    # rule ids to run; None = all registered
+    enabled: frozenset[str] | None = None
+
+
+@dataclasses.dataclass
+class FileContext:
+    """Everything a checker may look at for one file."""
+
+    path: str  # absolute path on disk ('' for in-memory sources)
+    display_path: str  # what findings print
+    source: str
+    tree: ast.Module
+    config: LintConfig
+    cache: dict  # shared across the whole run (cross-file state)
+
+    def finding(self, rule_id: str, node: ast.AST | int, message: str) -> Finding:
+        meta = _RULES[rule_id]
+        if isinstance(node, int):
+            line, col = node, 0
+        else:
+            line = getattr(node, "lineno", 1)
+            col = getattr(node, "col_offset", 0)
+        return Finding(rule_id, meta.severity, self.display_path, line, col, message)
+
+
+Checker = Callable[[FileContext], Iterable[Finding]]
+
+_RULES: dict[str, RuleMeta] = {}
+_CHECKERS: list[Checker] = []
+
+
+def register_rule(
+    rule_id: str, family: str, severity: Severity, summary: str
+) -> RuleMeta:
+    if rule_id in _RULES:
+        raise ValueError(f"duplicate rule id {rule_id!r}")
+    meta = RuleMeta(rule_id, family, severity, summary)
+    _RULES[rule_id] = meta
+    return meta
+
+
+def register_checker(fn: Checker) -> Checker:
+    _CHECKERS.append(fn)
+    return fn
+
+
+def all_rules() -> list[RuleMeta]:
+    return sorted(_RULES.values(), key=lambda m: (m.family, m.id))
+
+
+# registered eagerly so FileContext.finding works for parse failures too
+register_rule(
+    "parse-error",
+    "engine",
+    Severity.ERROR,
+    "file does not parse as Python; nothing else can be checked",
+)
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*pio-lint:\s*disable(?P<file>-file)?(?:=(?P<rules>[A-Za-z0-9_\-, ]+))?"
+)
+
+
+def _parse_suppressions(
+    source: str,
+) -> tuple[dict[int, frozenset[str] | None], frozenset[str] | None, bool]:
+    """Map line -> suppressed rule ids (None = all rules) plus file-level
+    suppressions. A suppression comment alone on a line also covers the next
+    line, so decorators/long calls can be annotated above.
+
+    Returns ``(per_line, file_rules, file_all)``.
+    """
+    per_line: dict[int, frozenset[str] | None] = {}
+    file_rules: set[str] = set()
+    file_all = False
+    for lineno, text in enumerate(source.splitlines(), 1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = m.group("rules")
+        if rules is not None:
+            # anything after `--` is the required human reason, not an id
+            rules = rules.split("--", 1)[0]
+        ids = (
+            frozenset(r.strip() for r in rules.split(",") if r.strip())
+            if rules
+            else None
+        )
+        if m.group("file"):
+            if ids is None:
+                file_all = True
+            else:
+                file_rules.update(ids)
+            continue
+        targets = [lineno]
+        if text[: m.start()].strip() == "":
+            targets.append(lineno + 1)  # standalone comment covers next line
+        for t in targets:
+            prev = per_line.get(t, frozenset())
+            if prev is None or ids is None:
+                per_line[t] = None
+            else:
+                per_line[t] = prev | ids
+    return per_line, frozenset(file_rules) or None, file_all
+
+
+def _is_suppressed(
+    f: Finding,
+    per_line: dict[int, frozenset[str] | None],
+    file_rules: frozenset[str] | None,
+    file_all: bool,
+) -> bool:
+    if file_all or (file_rules and f.rule in file_rules):
+        return True
+    ids = per_line.get(f.line, frozenset())
+    return ids is None or f.rule in ids
+
+
+# ---------------------------------------------------------------------------
+# analysis drivers
+# ---------------------------------------------------------------------------
+
+
+def analyze_source(
+    source: str,
+    display_path: str,
+    config: LintConfig | None = None,
+    cache: dict | None = None,
+    path: str = "",
+) -> tuple[list[Finding], list[Finding]]:
+    """Analyze one source blob. Returns ``(active, suppressed)`` findings."""
+    config = config or LintConfig()
+    cache = cache if cache is not None else {}
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        meta = _RULES["parse-error"]
+        f = Finding(
+            meta.id,
+            meta.severity,
+            display_path,
+            exc.lineno or 1,
+            (exc.offset or 1) - 1,
+            f"syntax error: {exc.msg}",
+        )
+        return [f], []
+    ctx = FileContext(path, display_path, source, tree, config, cache)
+    raw: list[Finding] = []
+    for checker in _CHECKERS:
+        for f in checker(ctx):
+            if config.enabled is not None and f.rule not in config.enabled:
+                continue
+            raw.append(f)
+    per_line, file_rules, file_all = _parse_suppressions(source)
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in sorted(raw, key=lambda f: (f.line, f.col, f.rule)):
+        if _is_suppressed(f, per_line, file_rules, file_all):
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+@dataclasses.dataclass
+class Report:
+    findings: list[Finding]
+    suppressed: list[Finding]
+    files_scanned: int
+    duration_s: float
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity >= Severity.ERROR]
+
+    @property
+    def warnings(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == Severity.WARNING]
+
+    def summary(self) -> str:
+        return (
+            f"{len(self.errors)} error(s), {len(self.warnings)} warning(s) "
+            f"({len(self.suppressed)} suppressed) in {self.files_scanned} "
+            f"file(s) [{self.duration_s * 1000:.0f} ms]"
+        )
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in _SKIP_DIRS and not d.startswith(".")
+            )
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def analyze_paths(
+    paths: Iterable[str], config: LintConfig | None = None
+) -> Report:
+    config = config or LintConfig()
+    cache: dict = {}
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    count = 0
+    start = time.monotonic()
+    cwd = os.getcwd()
+    for file_path in iter_python_files(paths):
+        abs_path = os.path.abspath(file_path)
+        display = os.path.relpath(abs_path, cwd)
+        if display.startswith(".." + os.sep):
+            display = abs_path
+        try:
+            with open(abs_path, encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        count += 1
+        active, supp = analyze_source(
+            source, display, config=config, cache=cache, path=abs_path
+        )
+        findings.extend(active)
+        suppressed.extend(supp)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return Report(findings, suppressed, count, time.monotonic() - start)
+
+
+def matches_any_glob(display_path: str, globs: Iterable[str]) -> bool:
+    """Match a path against config globs, OS-separator agnostic."""
+    norm = display_path.replace(os.sep, "/")
+    return any(fnmatch.fnmatch(norm, g) for g in globs)

@@ -1,0 +1,109 @@
+"""Host-sync rules for serving-path modules.
+
+``block_until_ready``, ``jax.device_get`` and ``np.asarray`` on a device
+array all stall the caller until the device round-trip completes. In a
+training script that's a benchmark tool; in the asyncio serving hot path
+(`controller/serving.py`, `workflow/create_server.py`, `data/api/`) it
+parks the event loop behind TPU latency and the p99 collapses under load.
+Legitimate syncs (startup warm-up, final response materialization) get an
+inline suppression with a reason, or live in a function named in
+``LintConfig.hostsync_allow_functions``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Severity,
+    matches_any_glob,
+    register_checker,
+    register_rule,
+)
+
+register_rule(
+    "hostsync-serving-path",
+    "hostsync",
+    Severity.ERROR,
+    "blocking device->host sync (block_until_ready/device_get/np.asarray) "
+    "in a serving-path module; move it off the request path or suppress "
+    "with a reason",
+)
+
+_SYNC_METHODS = frozenset({"block_until_ready"})
+_SYNC_DOTTED_LAST2 = frozenset(
+    {
+        ("jax", "device_get"),
+        ("jax", "block_until_ready"),
+        ("np", "asarray"),
+        ("numpy", "asarray"),
+        ("onp", "asarray"),
+    }
+)
+
+
+def _sync_call_label(call: ast.Call) -> str | None:
+    """A human label when ``call`` is a blocking sync, else None."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr in _SYNC_METHODS:
+            return f".{func.attr}()"
+        d = astutil.dotted(func)
+        if d:
+            parts = tuple(d.split("."))
+            if len(parts) >= 2 and parts[-2:] in _SYNC_DOTTED_LAST2:
+                return d + "()"
+    elif isinstance(func, ast.Name) and func.id in (
+        "device_get",
+        "block_until_ready",
+    ):
+        return func.id + "()"
+    return None
+
+
+@register_checker
+def check_hostsync(ctx: FileContext):
+    cfg = ctx.config
+    # match on the absolute path when we have one: the display path is
+    # cwd-relative and would silently miss the globs when linting from
+    # inside the package tree
+    if not matches_any_glob(ctx.path or ctx.display_path, cfg.serving_globs):
+        return []
+    findings: list[Finding] = []
+    allow = set(cfg.hostsync_allow_functions)
+
+    def visit(body: list[ast.stmt], fn_stack: tuple[str, ...]):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(stmt.body, fn_stack + (stmt.name,))
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                visit(stmt.body, fn_stack)
+                continue
+            if fn_stack and fn_stack[-1] in allow:
+                continue
+            for node in astutil.walk_skipping_nested_functions([stmt]):
+                if isinstance(node, ast.Call):
+                    label = _sync_call_label(node)
+                    if label:
+                        where = (
+                            f" in {fn_stack[-1]!r}" if fn_stack else " at module level"
+                        )
+                        findings.append(
+                            ctx.finding(
+                                "hostsync-serving-path",
+                                node,
+                                f"{label} blocks on a device->host sync"
+                                f"{where} on the serving path",
+                            )
+                        )
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    visit(node.body, fn_stack + (node.name,))
+                elif isinstance(node, ast.ClassDef):
+                    visit(node.body, fn_stack)
+
+    visit(ctx.tree.body, ())
+    return findings

@@ -1,0 +1,262 @@
+"""Recompile-hazard rules.
+
+XLA compilation is cached on (pytree structure, shapes, dtypes, static-arg
+values). Three idioms silently defeat the cache and turn the serving hot
+path into a compile loop:
+
+- passing Python literals (bools, strings, lists/dicts) to a jitted
+  function at positions not declared static — strings aren't pytree leaves,
+  and structure-varying containers retrace per shape;
+- building the jit wrapper itself inside a loop (``jax.jit(f)`` per
+  request) — a fresh wrapper means a fresh cache;
+- a jitted closure capturing mutable enclosing state — the first trace
+  bakes the captured value in, later mutations are silently ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Severity,
+    register_checker,
+    register_rule,
+)
+
+register_rule(
+    "recompile-unhashable-arg",
+    "recompile",
+    Severity.WARNING,
+    "literal bool/str/list/dict argument to a jitted function at a "
+    "position not declared in static_argnums/static_argnames; each "
+    "distinct value or structure retraces",
+)
+register_rule(
+    "recompile-jit-in-loop",
+    "recompile",
+    Severity.WARNING,
+    "jax.jit/pjit/shard_map wrapper constructed inside a loop; every "
+    "iteration gets a fresh compilation cache",
+)
+register_rule(
+    "recompile-closure-capture",
+    "recompile",
+    Severity.WARNING,
+    "jitted closure captures mutable enclosing state; the first trace "
+    "freezes the captured value and later mutations are ignored",
+)
+
+
+def _collect_jitted_defs(
+    tree: ast.Module,
+) -> dict[str, tuple[astutil.JitInfo, list[str] | None]]:
+    """Module-level jitted defs: `@jax.jit def f` and `f = jax.jit(g, ...)`.
+    Maps name -> (jit info, positional param names when the def is visible —
+    needed to resolve static_argnames for positionally-passed args)."""
+    defs: dict[str, list[str]] = {
+        stmt.name: [p.arg for p in stmt.args.posonlyargs + stmt.args.args]
+        for stmt in tree.body
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    out: dict[str, tuple[astutil.JitInfo, list[str] | None]] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = astutil.jit_decorator_info(stmt)
+            if info is not None:
+                out[stmt.name] = (info, defs[stmt.name])
+        elif isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            info = astutil.jit_expr_info(stmt.value)
+            if info is not None:
+                # f = jax.jit(g): reuse g's params when g is a local def
+                inner = (
+                    stmt.value.args[0].id
+                    if stmt.value.args
+                    and isinstance(stmt.value.args[0], ast.Name)
+                    else None
+                )
+                params = defs.get(inner) if inner else None
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        out[target.id] = (info, params)
+    return out
+
+
+def _literal_kind(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, bool):
+        return "bool"
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return "str"
+    if isinstance(node, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "set"
+    return None
+
+
+def _check_call_args(
+    ctx: FileContext,
+    call: ast.Call,
+    name: str,
+    info: astutil.JitInfo,
+    params: list[str] | None,
+) -> list[Finding]:
+    findings = []
+    for i, arg in enumerate(call.args):
+        if i in info.static_argnums:
+            continue
+        # static_argnames covers positionally-passed args too (JAX resolves
+        # names to positions); credit it when the def's params are visible
+        if params and i < len(params) and params[i] in info.static_argnames:
+            continue
+        kind = _literal_kind(arg)
+        if kind:
+            findings.append(
+                ctx.finding(
+                    "recompile-unhashable-arg",
+                    arg,
+                    f"{kind} literal passed to jitted {name!r} at position "
+                    f"{i} not in static_argnums; declare it static or hoist "
+                    f"it out of the call",
+                )
+            )
+    for kw in call.keywords:
+        if kw.arg is None or kw.arg in info.static_argnames:
+            continue
+        kind = _literal_kind(kw.value)
+        if kind:
+            findings.append(
+                ctx.finding(
+                    "recompile-unhashable-arg",
+                    kw.value,
+                    f"{kind} literal passed to jitted {name!r} as "
+                    f"{kw.arg}= not in static_argnames; declare it static "
+                    f"or hoist it out of the call",
+                )
+            )
+    return findings
+
+
+def _check_jit_in_loops(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[int] = set()
+
+    def flag(sub: ast.AST, info: astutil.JitInfo):
+        if id(sub) in seen:
+            return
+        seen.add(id(sub))
+        findings.append(
+            ctx.finding(
+                "recompile-jit-in-loop",
+                sub,
+                f"{info.kind} wrapper constructed inside a loop; hoist "
+                f"the jitted callable out so the compilation cache "
+                f"survives iterations",
+            )
+        )
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        # stay out of nested defs: a function *defined* in the loop body
+        # runs later, not per iteration — but its jit decoration DOES
+        # construct a fresh wrapper each time through the loop
+        for sub in astutil.walk_skipping_nested_functions(
+            node.body + node.orelse
+        ):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in sub.decorator_list:
+                    info = astutil.jit_expr_info(dec)
+                    if info is not None:
+                        flag(dec, info)
+            elif isinstance(sub, ast.Call):
+                info = astutil.jit_expr_info(sub)
+                if info is not None:
+                    flag(sub, info)
+    return findings
+
+
+def _mutable_locals(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    """Names the enclosing function binds to mutable containers or mutates."""
+    out: set[str] = set()
+    for node in astutil.walk_skipping_nested_functions(fn.body):
+        if isinstance(node, ast.Assign):
+            if astutil.is_mutable_literal(node.value) or (
+                astutil.is_mutable_factory_call(node.value)
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        elif isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Name):
+            out.add(node.target.id)
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in astutil.MUTATING_METHODS and isinstance(
+                node.func.value, ast.Name
+            ):
+                out.add(node.func.value.id)
+    return out
+
+
+def _free_reads(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    bound = set(astutil.param_names(fn))
+    reads: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                bound.add(node.id)
+            else:
+                reads.add(node.id)
+    return reads - bound
+
+
+def _check_closure_capture(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for outer in ast.walk(ctx.tree):
+        if not isinstance(outer, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        mutable = _mutable_locals(outer)
+        if not mutable:
+            continue
+        for inner in ast.walk(outer):
+            if inner is outer or not isinstance(
+                inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if astutil.jit_decorator_info(inner) is None:
+                continue
+            hits = _free_reads(inner) & mutable
+            if hits:
+                findings.append(
+                    ctx.finding(
+                        "recompile-closure-capture",
+                        inner,
+                        f"jitted {inner.name!r} captures mutable enclosing "
+                        f"state {'/'.join(sorted(hits))!r}; pass it as an "
+                        f"argument (traced or static) instead",
+                    )
+                )
+    return findings
+
+
+@register_checker
+def check_recompile_hazards(ctx: FileContext):
+    findings: list[Finding] = []
+    jitted = _collect_jitted_defs(ctx.tree)
+    if jitted:
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in jitted
+            ):
+                info, params = jitted[node.func.id]
+                findings.extend(
+                    _check_call_args(ctx, node, node.func.id, info, params)
+                )
+    findings.extend(_check_jit_in_loops(ctx))
+    findings.extend(_check_closure_capture(ctx))
+    return findings

@@ -1,0 +1,182 @@
+"""Tracer-safety rules: Python control flow and host casts on traced values.
+
+Inside a ``jit``/``pjit``/``shard_map``-staged function the arguments are
+tracers — abstract values with a shape and dtype but no data. Any Python
+construct that needs the *data* either crashes at trace time
+(``TracerBoolConversionError``) or, worse, silently bakes the first call's
+value into the compiled program. Both are deploy-time landmines this rule
+family surfaces at review time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Severity,
+    register_checker,
+    register_rule,
+)
+
+register_rule(
+    "tracer-python-branch",
+    "tracer",
+    Severity.ERROR,
+    "Python if/while/assert branches on a traced value inside a jitted "
+    "function; use lax.cond/lax.while_loop/jnp.where or declare the "
+    "argument static",
+)
+register_rule(
+    "tracer-host-cast",
+    "tracer",
+    Severity.ERROR,
+    "int()/float()/bool()/.item() forces a traced value to a host scalar "
+    "inside a jitted function; keep the computation on-device",
+)
+
+_CAST_BUILTINS = frozenset({"int", "float", "bool"})
+_CAST_METHODS = frozenset({"item", "tolist"})
+
+
+def _check_jitted_function(
+    ctx: FileContext, fn: ast.FunctionDef | ast.AsyncFunctionDef, traced: set[str]
+) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def visit_expr_for_casts(expr: ast.AST, traced: set[str]):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _CAST_BUILTINS
+                and node.args
+            ):
+                hit = astutil.dynamic_names(node.args[0]) & traced
+                if hit:
+                    findings.append(
+                        ctx.finding(
+                            "tracer-host-cast",
+                            node,
+                            f"{node.func.id}() on traced value "
+                            f"{'/'.join(sorted(hit))!r} inside jitted "
+                            f"function {fn.name!r}",
+                        )
+                    )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CAST_METHODS
+                and not node.args
+            ):
+                hit = astutil.dynamic_names(node.func.value) & traced
+                if hit:
+                    findings.append(
+                        ctx.finding(
+                            "tracer-host-cast",
+                            node,
+                            f".{node.func.attr}() on traced value "
+                            f"{'/'.join(sorted(hit))!r} inside jitted "
+                            f"function {fn.name!r}",
+                        )
+                    )
+
+    def visit_stmts(body: list[ast.stmt], traced: set[str]):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scopes get their own decoration check
+            if isinstance(stmt, (ast.If, ast.While)):
+                hit = astutil.dynamic_names(stmt.test) & traced
+                if hit:
+                    kw = "while" if isinstance(stmt, ast.While) else "if"
+                    findings.append(
+                        ctx.finding(
+                            "tracer-python-branch",
+                            stmt,
+                            f"Python `{kw}` on traced value "
+                            f"{'/'.join(sorted(hit))!r} inside jitted "
+                            f"function {fn.name!r}; use lax.cond/"
+                            f"lax.while_loop or jnp.where",
+                        )
+                    )
+                visit_expr_for_casts(stmt.test, traced)
+                visit_stmts(stmt.body, set(traced))
+                visit_stmts(stmt.orelse, set(traced))
+                continue
+            if isinstance(stmt, ast.Assert):
+                hit = astutil.dynamic_names(stmt.test) & traced
+                if hit:
+                    findings.append(
+                        ctx.finding(
+                            "tracer-python-branch",
+                            stmt,
+                            f"`assert` on traced value "
+                            f"{'/'.join(sorted(hit))!r} inside jitted "
+                            f"function {fn.name!r}; use checkify or assert "
+                            f"on static shape/dtype only",
+                        )
+                    )
+                visit_expr_for_casts(stmt.test, traced)
+                continue
+            if isinstance(stmt, ast.Assign):
+                visit_expr_for_casts(stmt.value, traced)
+                tainted = bool(astutil.dynamic_names(stmt.value) & traced)
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        if tainted:
+                            traced.add(target.id)
+                        else:
+                            traced.discard(target.id)
+                continue
+            if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    visit_expr_for_casts(stmt.value, traced)
+                    if isinstance(stmt.target, ast.Name) and (
+                        astutil.dynamic_names(stmt.value) & traced
+                    ):
+                        traced.add(stmt.target.id)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                visit_expr_for_casts(stmt.iter, traced)
+                inner = set(traced)
+                if astutil.dynamic_names(stmt.iter) & traced and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    inner.add(stmt.target.id)
+                visit_stmts(stmt.body, inner)
+                visit_stmts(stmt.orelse, set(traced))
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                visit_stmts(stmt.body, traced)
+                continue
+            if isinstance(stmt, ast.Try):
+                visit_stmts(stmt.body, set(traced))
+                for handler in stmt.handlers:
+                    visit_stmts(handler.body, set(traced))
+                visit_stmts(stmt.orelse, set(traced))
+                visit_stmts(stmt.finalbody, set(traced))
+                continue
+            # leaf statements: scan any embedded expressions for casts
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    visit_expr_for_casts(child, traced)
+
+    visit_stmts(fn.body, set(traced))
+    return findings
+
+
+@register_checker
+def check_tracer_safety(ctx: FileContext):
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = astutil.jit_decorator_info(node)
+        if info is None:
+            continue
+        traced = astutil.traced_param_names(node, info)
+        if traced:
+            findings.extend(_check_jitted_function(ctx, node, traced))
+    return findings

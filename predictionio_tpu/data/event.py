@@ -32,7 +32,10 @@ def ensure_aware(t: _dt.datetime | None) -> _dt.datetime | None:
 
 def parse_event_time(value: str) -> _dt.datetime:
     """Parse an ISO8601 timestamp; must carry a timezone (ref wire contract)."""
-    # Python's fromisoformat handles 'Z' from 3.11 on.
+    # Python's fromisoformat only handles the 'Z' suffix from 3.11 on, but
+    # the wire format (and format_event_time) emit it; normalize for 3.10.
+    if value.endswith(("Z", "z")):
+        value = value[:-1] + "+00:00"
     t = _dt.datetime.fromisoformat(value)
     if t.tzinfo is None:
         raise ValueError(f"eventTime {value!r} must include a timezone offset")

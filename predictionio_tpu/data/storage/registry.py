@@ -51,11 +51,16 @@ _BACKENDS: dict[str, tuple[str, str]] = {
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
 
+# guards _BACKENDS: registration may run from a plugin thread while another
+# thread resolves a client class (caught by `pio lint` concurrency audit)
+_backends_lock = threading.Lock()
+
 
 def register_backend(type_name: str, module: str, class_name: str) -> None:
     """Third-party backends plug in here (the reference's equivalent is
     dropping a jar with conventionally-named classes on the classpath)."""
-    _BACKENDS[type_name] = (module, class_name)
+    with _backends_lock:
+        _BACKENDS[type_name] = (module, class_name)
 
 
 class Storage:
@@ -148,11 +153,13 @@ class Storage:
             if cfg is None:
                 raise StorageError(f"undeclared storage source {source_name}")
             type_name = cfg["TYPE"].lower()
-            entry = _BACKENDS.get(type_name)
+            with _backends_lock:
+                entry = _BACKENDS.get(type_name)
+                known = sorted(_BACKENDS)
             if entry is None:
                 raise StorageError(
                     f"unknown storage backend type {type_name!r}; "
-                    f"known: {sorted(_BACKENDS)}"
+                    f"known: {known}"
                 )
             module_name, class_name = entry
             import importlib

@@ -23,6 +23,9 @@ import shutil
 import sys
 
 import predictionio_tpu
+# import-light by design (pure stdlib AST walking, no jax/numpy) — safe to
+# pull in for every pio verb
+from predictionio_tpu.analysis.cli import add_lint_arguments, run_lint
 from predictionio_tpu.data.storage.base import AccessKey, App, Channel
 from predictionio_tpu.data.storage.registry import Storage
 
@@ -497,7 +500,12 @@ def cmd_status(args) -> int:
 def cmd_import(args) -> int:
     from predictionio_tpu.tools.import_export import import_events
 
-    n = import_events(args.input, args.app_name, args.channel)
+    try:
+        n = import_events(args.input, args.app_name, args.channel)
+    except (OSError, ValueError) as exc:
+        # surface the underlying parse/storage error (file:line: cause), not
+        # a bare nonzero exit — operators need to know WHICH line was bad
+        return _die(f"import failed: {exc}")
     print(f"Imported {n} events.")
     return 0
 
@@ -583,6 +591,10 @@ def cmd_upgrade(args) -> int:
         pass
     print("No migration necessary.")
     return 0
+
+
+def cmd_lint(args) -> int:
+    return run_lint(args)
 
 
 def cmd_version(args) -> int:
@@ -830,6 +842,15 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("name")
     x.add_argument("directory", nargs="?")
     x.set_defaults(fn=cmd_template_get)
+
+    # static analysis
+    x = sub.add_parser(
+        "lint",
+        help="TPU-aware static analysis: tracer safety, recompile hazards, "
+        "host-sync stalls, concurrency, storage contracts",
+    )
+    add_lint_arguments(x)
+    x.set_defaults(fn=cmd_lint)
 
     # run
     x = sub.add_parser("run")

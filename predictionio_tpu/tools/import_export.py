@@ -22,7 +22,7 @@ import logging
 
 import numpy as np
 
-from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.event import Event, parse_event_time
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.data.store.event_store import resolve_app
 
@@ -80,17 +80,24 @@ def _iter_parquet_events(path: str):
     import pyarrow.parquet as pq
 
     pf = pq.ParquetFile(path)
+    row_no = 0
     for rb in pf.iter_batches():
         for row in rb.to_pylist():
-            d = {k: v for k, v in row.items() if v is not None}
-            props = d.get("properties")
-            if isinstance(props, str):
-                d["properties"] = json.loads(props)
-            for key in ("eventTime", "creationTime"):
-                ts = d.get(key)
-                if ts is not None and not isinstance(ts, str):
-                    d[key] = ts.isoformat()
-            yield Event.from_json_dict(d)
+            row_no += 1
+            try:
+                d = {k: v for k, v in row.items() if v is not None}
+                props = d.get("properties")
+                if isinstance(props, str):
+                    d["properties"] = json.loads(props)
+                for key in ("eventTime", "creationTime"):
+                    ts = d.get(key)
+                    if ts is not None and not isinstance(ts, str):
+                        d[key] = ts.isoformat()
+                yield Event.from_json_dict(d)
+            except Exception as exc:
+                # same operator-facing contract as the JSON-lines path:
+                # file:row: cause (and a ValueError cmd_import will catch)
+                raise ValueError(f"{path}:{row_no}: {exc}") from exc
 
 
 def export_events(
@@ -106,8 +113,6 @@ def export_events(
     app_id, channel_id = resolve_app(storage, app_name, channel_name)
     pevents = storage.get_p_events()
     if format == "parquet":
-        import datetime as _dt
-
         import pyarrow as pa
         import pyarrow.parquet as pq
 
@@ -144,8 +149,8 @@ def export_events(
                 if props
                 else None,
                 "prId": d.get("prId"),
-                "eventTime": _dt.datetime.fromisoformat(d["eventTime"]),
-                "creationTime": _dt.datetime.fromisoformat(d["creationTime"])
+                "eventTime": parse_event_time(d["eventTime"]),
+                "creationTime": parse_event_time(d["creationTime"])
                 if d.get("creationTime")
                 else None,
             }

@@ -40,6 +40,18 @@ def _build_dir() -> str:
     return d
 
 
+def _compiler_version() -> bytes:
+    """`g++ --version` first line; a compiler upgrade must invalidate the
+    cached .so exactly like a source edit does (ABI/codegen changes)."""
+    try:
+        out = subprocess.run(
+            ["g++", "--version"], capture_output=True, timeout=15
+        ).stdout
+        return out.splitlines()[0] if out else b"unknown"
+    except (subprocess.SubprocessError, OSError, IndexError):
+        return b"unknown"
+
+
 def get_library() -> ctypes.CDLL | None:
     global _lib, _lib_failed
     with _lock:
@@ -49,8 +61,14 @@ def get_library() -> ctypes.CDLL | None:
         if not os.path.exists(src):
             _lib_failed = True
             return None
+        # cache key = source bytes + compiler identity: a stale .so must
+        # never be loaded after pio_scan.cpp OR the toolchain changes
+        h = hashlib.sha256()
         with open(src, "rb") as f:
-            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            h.update(f.read())
+        h.update(b"\0")
+        h.update(_compiler_version())
+        digest = h.hexdigest()[:16]
         so_path = os.path.join(_build_dir(), f"pio_scan_{digest}.so")
         if not os.path.exists(so_path):
             # per-process tmp name: multi-host workers share PIO_FS_BASEDIR

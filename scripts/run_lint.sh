@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Tier-1 lint gate: run the TPU-aware static analyzer over the package and
+# examples. Exits nonzero on any unsuppressed error-severity finding.
+# Usage: scripts/run_lint.sh [extra lint args...]
+set -euo pipefail
+
+repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$repo_root"
+
+exec python -m predictionio_tpu.analysis.cli "$@"

@@ -1,0 +1,630 @@
+"""Tests for the TPU-aware static analyzer (`pio lint`).
+
+One positive + one negative fixture per rule family, suppression mechanics,
+CLI surface, and the tier-1 self-lint gate: the repo's own package must
+report zero unsuppressed errors.
+"""
+
+import os
+import textwrap
+import time
+
+import pytest
+
+from predictionio_tpu.analysis import (
+    LintConfig,
+    Severity,
+    all_rules,
+    analyze_paths,
+    analyze_source,
+)
+from predictionio_tpu.analysis.cli import default_lint_paths, main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO_ROOT, "predictionio_tpu")
+
+
+def lint_snippet(source, display_path="snippet.py", config=None):
+    active, suppressed = analyze_source(
+        textwrap.dedent(source), display_path, config=config
+    )
+    return active, suppressed
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# family 1: tracer safety
+# ---------------------------------------------------------------------------
+
+
+class TestTracerRules:
+    def test_branch_on_traced_param_fires(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+            """
+        )
+        assert rule_ids(active) == ["tracer-python-branch"]
+        assert active[0].severity == Severity.ERROR
+
+    def test_branch_on_static_arg_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def f(x, mode):
+                if mode == "relu":
+                    return x * (x > 0)
+                return x
+            """
+        )
+        assert active == []
+
+    def test_while_on_alias_of_traced_fires(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                y = x * 2
+                while y.sum() > 0:
+                    y = y - 1
+                return y
+            """
+        )
+        assert rule_ids(active) == ["tracer-python-branch"]
+
+    def test_shape_branch_and_none_check_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            @jax.jit
+            def f(x, bias=None):
+                if x.shape[0] > 128:
+                    x = x[:128]
+                if bias is not None:
+                    x = x + bias
+                assert x.ndim == 2
+                return x
+            """
+        )
+        assert active == []
+
+    def test_host_cast_fires(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x) + x.sum().item()
+            """
+        )
+        assert sorted(rule_ids(active)) == ["tracer-host-cast", "tracer-host-cast"]
+
+    def test_host_cast_of_static_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def f(x, n):
+                return x * int(n)
+            """
+        )
+        assert active == []
+
+
+# ---------------------------------------------------------------------------
+# family 2: recompile hazards
+# ---------------------------------------------------------------------------
+
+
+class TestRecompileRules:
+    def test_literal_arg_not_static_fires(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            @jax.jit
+            def f(x, flag):
+                return x
+
+            def caller(v):
+                return f(v, True)
+            """
+        )
+        assert rule_ids(active) == ["recompile-unhashable-arg"]
+        assert active[0].severity == Severity.WARNING
+
+    def test_literal_arg_declared_static_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("flag",))
+            def f(x, flag):
+                return x
+
+            def caller(v):
+                return f(v, flag=True)
+            """
+        )
+        assert active == []
+
+    def test_static_argnames_covers_positional_call_quiet(self):
+        # JAX resolves static_argnames for positionally-passed args too
+        active, _ = lint_snippet(
+            """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("flag",))
+            def f(x, flag):
+                return x
+
+            def caller(v):
+                return f(v, True)
+            """
+        )
+        assert active == []
+
+    def test_jit_in_loop_fires(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            def serve(requests, fn):
+                for r in requests:
+                    jitted = jax.jit(fn)
+                    yield jitted(r)
+            """
+        )
+        assert rule_ids(active) == ["recompile-jit-in-loop"]
+
+    def test_jit_hoisted_out_of_loop_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            def serve(requests, fn):
+                jitted = jax.jit(fn)
+                for r in requests:
+                    yield jitted(r)
+            """
+        )
+        assert active == []
+
+    def test_closure_over_mutable_fires(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            def make(cfg_items):
+                cfg = {}
+                cfg.update(cfg_items)
+
+                @jax.jit
+                def predict(x):
+                    return x * cfg["scale"]
+
+                return predict
+            """
+        )
+        assert rule_ids(active) == ["recompile-closure-capture"]
+
+    def test_closure_over_immutable_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            def make(scale):
+                @jax.jit
+                def predict(x):
+                    return x * scale
+
+                return predict
+            """
+        )
+        assert active == []
+
+
+# ---------------------------------------------------------------------------
+# family 3: host-sync stalls on the serving path
+# ---------------------------------------------------------------------------
+
+SYNC_SNIPPET = """
+import numpy as np
+
+def handle(pred):
+    return np.asarray(pred).tolist()
+"""
+
+
+class TestHostSyncRules:
+    def test_sync_in_serving_module_fires(self):
+        active, _ = lint_snippet(
+            SYNC_SNIPPET, display_path="predictionio_tpu/data/api/handlers.py"
+        )
+        assert rule_ids(active) == ["hostsync-serving-path"]
+        assert active[0].severity == Severity.ERROR
+
+    def test_same_code_off_serving_path_quiet(self):
+        active, _ = lint_snippet(
+            SYNC_SNIPPET, display_path="predictionio_tpu/ops/score.py"
+        )
+        assert active == []
+
+    def test_block_until_ready_fires(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            def handle(pred):
+                jax.block_until_ready(pred)
+                return pred
+            """,
+            display_path="predictionio_tpu/controller/serving.py",
+        )
+        assert rule_ids(active) == ["hostsync-serving-path"]
+
+    def test_serving_match_is_cwd_independent(self, tmp_path, monkeypatch):
+        # the glob must key on the real path: linting from inside the tree
+        # (display path loses leading components) must not disable the rule
+        api = tmp_path / "pkg" / "data" / "api"
+        api.mkdir(parents=True)
+        (api / "handlers.py").write_text(textwrap.dedent(SYNC_SNIPPET))
+        monkeypatch.chdir(tmp_path / "pkg" / "data")
+        report = analyze_paths(["api"])
+        assert rule_ids(report.findings) == ["hostsync-serving-path"]
+
+    def test_allowlisted_function_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            def warmup(model):
+                jax.block_until_ready(model)
+            """,
+            display_path="predictionio_tpu/controller/serving.py",
+            config=LintConfig(hostsync_allow_functions=("warmup",)),
+        )
+        assert active == []
+
+
+# ---------------------------------------------------------------------------
+# family 4: concurrency
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrencyRules:
+    def test_unlocked_global_mutation_fires(self):
+        active, _ = lint_snippet(
+            """
+            import threading
+
+            _stats = {}
+
+            def serve():
+                threading.Thread(target=work).start()
+
+            def work():
+                _stats["n"] = _stats.get("n", 0) + 1
+            """
+        )
+        assert rule_ids(active) == ["concurrency-unlocked-global"]
+        assert active[0].severity == Severity.WARNING
+
+    def test_locked_mutation_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import threading
+
+            _stats = {}
+            _lock = threading.Lock()
+
+            def serve():
+                threading.Thread(target=work).start()
+
+            def work():
+                with _lock:
+                    _stats["n"] = _stats.get("n", 0) + 1
+            """
+        )
+        assert active == []
+
+    def test_unthreaded_module_quiet(self):
+        active, _ = lint_snippet(
+            """
+            _stats = {}
+
+            def work():
+                _stats["n"] = 1
+            """
+        )
+        assert active == []
+
+
+# ---------------------------------------------------------------------------
+# family 5: storage contract
+# ---------------------------------------------------------------------------
+
+BASE_PY = """
+import abc
+
+
+class Apps(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, app): ...
+
+    @abc.abstractmethod
+    def get(self, app_id): ...
+
+    @abc.abstractmethod
+    def delete(self, app_id): ...
+"""
+
+
+class TestStorageContractRule:
+    def _write_backend(self, tmp_path, body):
+        storage = tmp_path / "storage"
+        storage.mkdir()
+        (storage / "base.py").write_text(textwrap.dedent(BASE_PY))
+        (storage / "backend.py").write_text(textwrap.dedent(body))
+        return str(storage)
+
+    def test_missing_method_fires(self, tmp_path):
+        path = self._write_backend(
+            tmp_path,
+            """
+            from .base import Apps
+
+            class PartialApps(Apps):
+                def insert(self, app):
+                    return 1
+            """,
+        )
+        report = analyze_paths([path])
+        assert rule_ids(report.findings) == ["storage-missing-method"]
+        assert "delete" in report.findings[0].message
+        assert "get" in report.findings[0].message
+
+    def test_full_surface_quiet(self, tmp_path):
+        path = self._write_backend(
+            tmp_path,
+            """
+            from . import base
+
+            class FullApps(base.Apps):
+                def insert(self, app):
+                    return 1
+
+                def get(self, app_id):
+                    return None
+
+                def delete(self, app_id):
+                    pass
+            """,
+        )
+        report = analyze_paths([path])
+        assert report.findings == []
+
+    def test_local_intermediate_base_counts(self, tmp_path):
+        path = self._write_backend(
+            tmp_path,
+            """
+            from .base import Apps
+
+            class _Common(Apps):
+                def get(self, app_id):
+                    return None
+
+                def delete(self, app_id):
+                    pass
+
+            class DerivedApps(_Common):
+                def insert(self, app):
+                    return 1
+            """,
+        )
+        report = analyze_paths([path])
+        # _Common alone is missing insert; DerivedApps completes the surface
+        assert [f.message.split("'")[1] for f in report.findings] == ["_Common"]
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics: suppression, severity, parse errors
+# ---------------------------------------------------------------------------
+
+
+class TestSuppression:
+    BAD = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        if x > 0:  # pio-lint: disable=tracer-python-branch -- fixture
+            return x
+        return -x
+    """
+
+    def test_inline_suppression(self):
+        active, suppressed = lint_snippet(self.BAD)
+        assert active == []
+        assert rule_ids(suppressed) == ["tracer-python-branch"]
+
+    def test_suppression_comment_on_previous_line(self):
+        active, suppressed = lint_snippet(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                # pio-lint: disable=tracer-python-branch -- fixture
+                if x > 0:
+                    return x
+                return -x
+            """
+        )
+        assert active == []
+        assert len(suppressed) == 1
+
+    def test_file_level_suppression(self):
+        active, suppressed = lint_snippet(
+            """
+            # pio-lint: disable-file=tracer-python-branch
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+            """
+        )
+        assert active == []
+        assert len(suppressed) == 1
+
+    def test_wrong_rule_id_does_not_suppress(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:  # pio-lint: disable=tracer-host-cast
+                    return x
+                return -x
+            """
+        )
+        assert rule_ids(active) == ["tracer-python-branch"]
+
+
+class TestEngine:
+    def test_parse_error_reported_not_raised(self):
+        active, _ = lint_snippet("def broken(:\n")
+        assert rule_ids(active) == ["parse-error"]
+
+    def test_rule_registry_covers_all_families(self):
+        families = {m.family for m in all_rules()}
+        assert {
+            "tracer",
+            "recompile",
+            "hostsync",
+            "concurrency",
+            "storage-contract",
+        } <= families
+
+    def test_enabled_filter(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x) if False else -x
+            """,
+            config=LintConfig(enabled=frozenset({"tracer-python-branch"})),
+        )
+        assert all(f.rule == "tracer-python-branch" for f in active)
+
+
+# ---------------------------------------------------------------------------
+# CLI + the tier-1 self-lint gate
+# ---------------------------------------------------------------------------
+
+
+class TestCLI:
+    def test_list_rules(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        assert "tracer-python-branch" in out
+        assert "storage-missing-method" in out
+
+    def test_exit_one_on_error_finding(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\n\n@jax.jit\ndef f(x):\n    if x > 0:\n        return x\n"
+            "    return -x\n"
+        )
+        assert lint_main([str(bad)]) == 1
+        assert "tracer-python-branch" in capsys.readouterr().out
+
+    def test_warnings_pass_unless_strict(self, tmp_path, capsys):
+        warn = tmp_path / "warn.py"
+        warn.write_text(
+            "import jax\n\ndef serve(reqs, fn):\n    for r in reqs:\n"
+            "        jax.jit(fn)(r)\n"
+        )
+        assert lint_main([str(warn)]) == 0
+        assert lint_main(["--strict", str(warn)]) == 1
+        capsys.readouterr()
+
+    def test_json_format(self, tmp_path, capsys):
+        import json
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\n\n@jax.jit\ndef f(x):\n    return int(x)\n"
+        )
+        assert lint_main(["--format", "json", str(bad)]) == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data["files_scanned"] == 1
+        assert data["findings"][0]["rule"] == "tracer-host-cast"
+
+    def test_missing_path_exits_two(self, capsys):
+        assert lint_main(["/nonexistent/nowhere.py"]) == 2
+        capsys.readouterr()
+
+    def test_unknown_rule_id_exits_two(self, tmp_path, capsys):
+        # a typo'd --rule must not silently disable the gate
+        ok = tmp_path / "ok.py"
+        ok.write_text("x = 1\n")
+        assert lint_main(["--rule", "tracer-pythn-branch", str(ok)]) == 2
+        assert "unknown rule id" in capsys.readouterr().err
+
+    def test_pio_lint_subcommand(self, tmp_path, capsys):
+        from predictionio_tpu.tools.cli import main as pio_main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\n\n@jax.jit\ndef f(x):\n    assert x > 0\n    return x\n"
+        )
+        assert pio_main(["lint", str(bad)]) == 1
+        assert "tracer-python-branch" in capsys.readouterr().out
+
+
+class TestSelfLint:
+    def test_package_lints_clean(self, capsys):
+        """The tier-1 gate: the repo's own code has zero unsuppressed
+        error-severity findings, and the full walk stays well under the
+        10s budget."""
+        start = time.monotonic()
+        rc = lint_main([PKG_DIR])
+        elapsed = time.monotonic() - start
+        out = capsys.readouterr().out
+        assert rc == 0, f"self-lint found errors:\n{out}"
+        assert elapsed < 10.0, f"self-lint took {elapsed:.1f}s (budget 10s)"
+
+    def test_default_paths_cover_package_and_examples(self):
+        paths = default_lint_paths()
+        assert any(p.endswith("predictionio_tpu") for p in paths)
+        report = analyze_paths(paths)
+        # the walk must actually visit the tree, not silently skip it
+        assert report.files_scanned > 80
+        assert report.errors == []
