@@ -38,6 +38,14 @@ from predictionio_tpu.data.api.plugins import EventInfo, EventServerPluginContex
 from predictionio_tpu.data.api.stats import StatsCollector
 from predictionio_tpu.data.event import Event, parse_event_time
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.resilience import (
+    OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+    ResiliencePolicy,
+    RetryBudget,
+    RetryPolicy,
+)
 from predictionio_tpu.data.webhooks import (
     ConnectorException,
     connector_to_event,
@@ -60,6 +68,16 @@ class EventServerConfig:
     # config covers the event server too): PEM cert + key paths
     ssl_certfile: str | None = None
     ssl_keyfile: str | None = None
+    # -- resilience (see docs/resilience.md) --------------------------------
+    # transient storage failures retry with exponential backoff before the
+    # request fails; <= 1 disables retries
+    storage_retries: int = 3
+    storage_backoff_s: float = 0.05
+    # this many consecutive storage failures trip the breaker: requests
+    # then answer 503 "storage unavailable" + Retry-After instantly instead
+    # of burying a struggling backend under more timed-out work
+    breaker_threshold: int = 5
+    breaker_recovery_s: float = 5.0
 
     def ssl_context(self):
         from predictionio_tpu.utils.tls import server_ssl_context
@@ -100,6 +118,22 @@ class EventServer:
         self.stats = StatsCollector()
         self.plugin_context = plugin_context or EventServerPluginContext()
         self._runner: web.AppRunner | None = None
+        # every storage touch goes through this policy: transient failures
+        # retry with backoff (bounded by a per-process budget), persistent
+        # failure trips the breaker and requests answer 503 "storage
+        # unavailable" instead of burying the backend (see docs/resilience.md)
+        self.storage_policy = ResiliencePolicy(
+            retry=RetryPolicy(
+                max_attempts=max(1, self.config.storage_retries),
+                backoff_base_s=self.config.storage_backoff_s,
+                budget=RetryBudget(),
+            ),
+            breaker=CircuitBreaker(
+                name="eventdata",
+                failure_threshold=self.config.breaker_threshold,
+                recovery_timeout_s=self.config.breaker_recovery_s,
+            ),
+        )
 
     # ------------------------------------------------------------------ auth
     async def _authenticate(self, request: web.Request) -> AuthData | web.Response:
@@ -115,12 +149,12 @@ class EventServer:
                     return _json_error(401, "Invalid accessKey.")
             else:
                 return _json_error(401, "Missing accessKey.")
-        key = await self._run(self.access_keys.get, access_key)
+        key = await self._storage(self.access_keys.get, access_key)
         if key is None:
             return _json_error(401, "Invalid accessKey.")
         channel_id = None
         if channel_name is not None:
-            channels = await self._run(self.channels.get_by_app_id, key.appid)
+            channels = await self._storage(self.channels.get_by_app_id, key.appid)
             channel_map = {c.name: c.id for c in channels}
             if channel_name not in channel_map:
                 return _json_error(401, f"Invalid channel '{channel_name}'.")
@@ -128,7 +162,24 @@ class EventServer:
         return AuthData(key.appid, channel_id, tuple(key.events))
 
     async def _run(self, fn, *args):
+        """Plain executor hop (plugin REST and other non-storage work)."""
         return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    async def _storage(self, fn, *args):
+        """Executor hop through the storage resilience policy: transient
+        failures retry with backoff, a tripped breaker raises
+        ``CircuitOpenError`` (mapped to 503 by the middleware/handlers)."""
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.storage_policy.call(fn, *args)
+        )
+
+    @staticmethod
+    def _storage_unavailable(exc: CircuitOpenError) -> web.Response:
+        return web.json_response(
+            {"message": f"storage unavailable: {exc}"},
+            status=503,
+            headers={"Retry-After": str(max(1, round(exc.retry_after_s)))},
+        )
 
     def _bookkeep(self, app_id: int, status: int, event: Event) -> None:
         if self.config.stats:
@@ -140,12 +191,16 @@ class EventServer:
         Raises BlockedEvent when an input blocker rejects (-> 403); any other
         exception is a storage failure (-> 500)."""
         info = EventInfo(auth.app_id, auth.channel_id, event)
+        # blockers run OUTSIDE the storage policy: a rejection is a client
+        # error, and must neither be retried nor counted against the breaker
         for blocker in self.plugin_context.input_blockers.values():
             try:
                 blocker.process(info, self.plugin_context)
             except Exception as exc:
                 raise BlockedEvent(str(exc)) from exc
-        event_id = self.levents.insert(event, auth.app_id, auth.channel_id)
+        event_id = self.storage_policy.call(
+            self.levents.insert, event, auth.app_id, auth.channel_id
+        )
         for sniffer in self.plugin_context.input_sniffers.values():
             try:
                 sniffer.process(info, self.plugin_context)
@@ -156,6 +211,16 @@ class EventServer:
     # ---------------------------------------------------------------- routes
     async def handle_root(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "alive"})
+
+    async def handle_healthz(self, request: web.Request) -> web.Response:
+        """Readiness (distinct from `/` liveness): reports the storage
+        breaker so a load balancer can drain this replica while its backend
+        is unavailable instead of feeding it traffic destined for 503s."""
+        snap = self.storage_policy.snapshot()
+        ready = snap["breaker"]["state"] != OPEN
+        return web.json_response(
+            {"ready": ready, **snap}, status=200 if ready else 503
+        )
 
     async def handle_post_event(self, request: web.Request) -> web.Response:
         auth = await self._authenticate(request)
@@ -172,6 +237,8 @@ class EventServer:
             status, body = await self._run(self._insert_one, auth, event)
         except BlockedEvent as exc:
             return _json_error(403, str(exc))
+        except CircuitOpenError as exc:
+            return self._storage_unavailable(exc)
         except Exception as exc:
             logger.exception("event insert failed")
             return _json_error(500, str(exc))
@@ -208,9 +275,19 @@ class EventServer:
                 kwargs["target_entity_type"] = q["targetEntityType"]
             if "targetEntityId" in q:
                 kwargs["target_entity_id"] = q["targetEntityId"]
-            events = list(await self._run(lambda: list(self.levents.find(**kwargs))))
         except Exception as exc:
-            return _json_error(400, str(exc))
+            return _json_error(400, str(exc))  # parameter errors only
+        try:
+            events = list(
+                await self._storage(lambda: list(self.levents.find(**kwargs)))
+            )
+        except CircuitOpenError as exc:
+            return self._storage_unavailable(exc)
+        except Exception as exc:
+            # a storage failure is a server-side outage (500), never a 400:
+            # load balancers and clients must see it as retryable
+            logger.exception("event find failed")
+            return _json_error(500, str(exc))
         if not events:
             return _json_error(404, "Not Found")
         return web.json_response([e.to_json_dict() for e in events])
@@ -220,7 +297,7 @@ class EventServer:
         if isinstance(auth, web.Response):
             return auth
         event_id = request.match_info["event_id"]
-        event = await self._run(
+        event = await self._storage(
             self.levents.get, event_id, auth.app_id, auth.channel_id
         )
         if event is None:
@@ -232,7 +309,7 @@ class EventServer:
         if isinstance(auth, web.Response):
             return auth
         event_id = request.match_info["event_id"]
-        found = await self._run(
+        found = await self._storage(
             self.levents.delete, event_id, auth.app_id, auth.channel_id
         )
         if not found:
@@ -283,6 +360,8 @@ class EventServer:
                     status, body = self._insert_one(auth, event)
                 except BlockedEvent as exc:
                     status, body = 403, {"message": str(exc)}
+                except CircuitOpenError as exc:
+                    status, body = 503, {"message": f"storage unavailable: {exc}"}
                 except Exception as exc:
                     status, body = 500, {"message": str(exc)}
                 out.append((slot, event, status, body))
@@ -358,6 +437,8 @@ class EventServer:
             status, body = await self._run(self._insert_one, auth, event)
         except BlockedEvent as exc:
             return _json_error(403, str(exc))
+        except CircuitOpenError as exc:
+            return self._storage_unavailable(exc)
         except Exception as exc:
             logger.exception("webhook event insert failed")
             return _json_error(500, str(exc))
@@ -383,10 +464,21 @@ class EventServer:
 
     # ------------------------------------------------------------------- app
     def make_app(self) -> web.Application:
-        app = web.Application()
+        @web.middleware
+        async def storage_resilience(request: web.Request, handler):
+            # backstop for paths without their own mapping (auth lookups,
+            # single-event get/delete): an open breaker is a 503 with
+            # Retry-After, never a 500 stack trace
+            try:
+                return await handler(request)
+            except CircuitOpenError as exc:
+                return self._storage_unavailable(exc)
+
+        app = web.Application(middlewares=[storage_resilience])
         app.add_routes(
             [
                 web.get("/", self.handle_root),
+                web.get("/healthz", self.handle_healthz),
                 web.post("/events.json", self.handle_post_event),
                 web.get("/events.json", self.handle_get_events),
                 web.get("/events/{event_id}.json", self.handle_get_event),

@@ -47,24 +47,62 @@ class ESError(RuntimeError):
 
     Bulk-insert partial failures attach ``indexed_ids`` (documents that DID
     land) and ``attempted_ids`` (the full batch's ids, in order) — see
-    ``ESLEvents.insert_batch`` for the retry contract.
+    ``ESLEvents.insert_batch`` for the retry contract. ``transient`` is
+    True when EVERY endpoint failed at the connection level (the cluster
+    may come back; outer retry policies may replay).
     """
 
+    transient = False
     indexed_ids: list[str] = []
     attempted_ids: list[str] = []
 
 
-class _ESTransport:
-    """Minimal JSON-over-HTTP transport with host rotation."""
+def _all_endpoints_failed(last: Exception | None) -> ESError:
+    from predictionio_tpu.resilience import mark_transient
 
-    def __init__(self, urls: list[str], auth: str | None = None, timeout: float = 10.0):
+    return mark_transient(ESError(f"all elasticsearch endpoints failed: {last}"))
+
+
+class _ESTransport:
+    """Minimal JSON-over-HTTP transport with host rotation.
+
+    ``retries`` > 1 adds full-rotation passes with exponential backoff: one
+    pass tries every endpoint once (the original failover), later passes
+    give a briefly-unreachable cluster time to come back before the driver
+    reports it down."""
+
+    def __init__(
+        self,
+        urls: list[str],
+        auth: str | None = None,
+        timeout: float = 10.0,
+        retries: int = 1,
+        retry_backoff_s: float = 0.2,
+    ):
         if not urls:
             raise ESError("elasticsearch driver needs at least one endpoint")
         self.urls = urls
         self.auth = auth
         self.timeout = timeout
+        from predictionio_tpu.resilience import RetryPolicy
+
+        self._retry = RetryPolicy(
+            max_attempts=max(1, retries), backoff_base_s=retry_backoff_s
+        )
 
     def request(
+        self,
+        method: str,
+        path: str,
+        body: dict | None = None,
+        params: dict[str, str] | None = None,
+        ok_statuses: tuple[int, ...] = (),
+    ) -> dict[str, Any]:
+        return self._retry.call(
+            self._request_pass, method, path, body, params, ok_statuses
+        )
+
+    def _request_pass(
         self,
         method: str,
         path: str,
@@ -104,17 +142,23 @@ class _ESTransport:
                     # died; replaying a non-idempotent op on another endpoint
                     # double-executes it (_update double-increments a
                     # sequence; a replayed _create 409s and orphans its
-                    # sentinel). Surface the ambiguity instead.
+                    # sentinel). Surface the ambiguity instead — and tell
+                    # outer retry policies not to replay either.
                     raise ESError(
                         f"{method} {path}: connection failed after send and "
                         f"the operation is not idempotent — not retried on "
                         f"another endpoint: {exc}"
                     ) from exc
                 last = exc  # node down: try the next endpoint
-        raise ESError(f"all elasticsearch endpoints failed: {last}") from last
+        raise _all_endpoints_failed(last) from last
 
     def bulk(self, lines: list[dict], params: dict[str, str] | None = None) -> dict:
         """POST newline-delimited JSON to ``/_bulk``."""
+        return self._retry.call(self._bulk_pass, lines, params)
+
+    def _bulk_pass(
+        self, lines: list[dict], params: dict[str, str] | None = None
+    ) -> dict:
         q = f"?{urllib.parse.urlencode(params)}" if params else ""
         data = ("\n".join(json.dumps(line) for line in lines) + "\n").encode()
         last: Exception | None = None
@@ -137,7 +181,7 @@ class _ESTransport:
                 # actions (idempotent overwrite/delete), so cross-endpoint
                 # replay after an ambiguous failure is safe
                 last = exc
-        raise ESError(f"all elasticsearch endpoints failed: {last}") from last
+        raise _all_endpoints_failed(last) from last
 
 
 def _retry_safe(method: str, path: str, exc: Exception) -> bool:
@@ -1108,7 +1152,11 @@ class ESStorageClient:
             cred = f"{self.config['USERNAME']}:{self.config.get('PASSWORD', '')}"
             auth = base64.b64encode(cred.encode()).decode()
         self._transport = _ESTransport(
-            urls, auth=auth, timeout=float(self.config.get("TIMEOUT", 10.0))
+            urls,
+            auth=auth,
+            timeout=float(self.config.get("TIMEOUT", 10.0)),
+            retries=int(self.config.get("RETRIES", 1)),
+            retry_backoff_s=float(self.config.get("RETRY_BACKOFF_S", 0.2)),
         )
         self._prefix = self.config.get("INDEX_PREFIX", "pio")
         self._ensured_meta: set[str] = set()

@@ -19,10 +19,19 @@ from typing import Any
 
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import Model
+from predictionio_tpu.resilience import (
+    TRANSIENT_HTTP_STATUSES,
+    RetryPolicy,
+    mark_transient,
+)
 
 
 class HDFSError(RuntimeError):
-    pass
+    """``transient`` is True for connection failures and 5xx responses —
+    safe to retry because every operation here is idempotent (CREATE with
+    overwrite, OPEN, DELETE)."""
+
+    transient = False
 
 
 class WebHDFSModels(base.Models):
@@ -32,11 +41,18 @@ class WebHDFSModels(base.Models):
         base_path: str = "/pio_models",
         username: str | None = None,
         timeout: float = 30.0,
+        retries: int = 3,
+        retry_backoff_s: float = 0.2,
     ):
         self._url = url.rstrip("/")
         self._base = "/" + base_path.strip("/")
         self._username = username
         self._timeout = timeout
+        # retries re-run the WHOLE NameNode -> DataNode dance: a DataNode
+        # that died mid-redirect gets a fresh placement on the next attempt
+        self._retry = RetryPolicy(
+            max_attempts=max(1, retries), backoff_base_s=retry_backoff_s
+        )
 
     def _op_url(self, model_id: str, op: str, **params: str) -> str:
         safe = urllib.parse.quote(f"pio_model_{model_id}", safe="-_.~")
@@ -74,32 +90,49 @@ class WebHDFSModels(base.Models):
                     )
             return exc.code, exc.read()
         except (urllib.error.URLError, OSError) as exc:
-            raise HDFSError(f"{method} {url}: {exc}") from exc
+            raise mark_transient(HDFSError(f"{method} {url}: {exc}")) from exc
+
+    @staticmethod
+    def _check(status: int, body: bytes, ok: tuple[int, ...], what: str) -> None:
+        if status in ok:
+            return
+        err = HDFSError(f"{what}: HTTP {status}: {body[:200]!r}")
+        if status in TRANSIENT_HTTP_STATUSES:
+            mark_transient(err)
+        raise err
 
     def insert(self, model: Model) -> None:
-        # two-step write: body-less CREATE against the NameNode, then PUT
-        # the bytes at the DataNode the 307 redirect names
-        status, body = self._request(
-            "PUT",
-            self._op_url(model.id, "CREATE", overwrite="true"),
-            payload=None,
-            redirect_payload=model.models,
-        )
-        if status not in (200, 201):
-            raise HDFSError(f"CREATE {model.id}: HTTP {status}: {body[:200]!r}")
+        def once() -> None:
+            # two-step write: body-less CREATE against the NameNode, then PUT
+            # the bytes at the DataNode the 307 redirect names
+            status, body = self._request(
+                "PUT",
+                self._op_url(model.id, "CREATE", overwrite="true"),
+                payload=None,
+                redirect_payload=model.models,
+            )
+            self._check(status, body, (200, 201), f"CREATE {model.id}")
+
+        self._retry.call(once)
 
     def get(self, model_id: str) -> Model | None:
-        status, body = self._request("GET", self._op_url(model_id, "OPEN"))
-        if status == 404:
-            return None
-        if status != 200:
-            raise HDFSError(f"OPEN {model_id}: HTTP {status}: {body[:200]!r}")
-        return Model(model_id, body)
+        def once() -> Model | None:
+            status, body = self._request("GET", self._op_url(model_id, "OPEN"))
+            if status == 404:
+                return None
+            self._check(status, body, (200,), f"OPEN {model_id}")
+            return Model(model_id, body)
+
+        return self._retry.call(once)
 
     def delete(self, model_id: str) -> None:
-        status, body = self._request("DELETE", self._op_url(model_id, "DELETE"))
-        if status not in (200, 404):
-            raise HDFSError(f"DELETE {model_id}: HTTP {status}: {body[:200]!r}")
+        def once() -> None:
+            status, body = self._request(
+                "DELETE", self._op_url(model_id, "DELETE")
+            )
+            self._check(status, body, (200, 404), f"DELETE {model_id}")
+
+        self._retry.call(once)
 
 
 class HDFSStorageClient:
@@ -115,6 +148,8 @@ class HDFSStorageClient:
             base_path=cfg.get("PATH", "/pio_models"),
             username=cfg.get("USERNAME"),
             timeout=float(cfg.get("TIMEOUT", 30.0)),
+            retries=int(cfg.get("RETRIES", 3)),
+            retry_backoff_s=float(cfg.get("RETRY_BACKOFF_S", 0.2)),
         )
 
     def models(self) -> WebHDFSModels:

@@ -11,11 +11,23 @@ import os
 
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import Model
+from predictionio_tpu.resilience import RetryPolicy
+
+
+def _retryable_os_error(exc: BaseException) -> bool:
+    """Worth replaying on a network filesystem (NFS/FUSE mounts drop I/O
+    under load); a missing file is a result, not a fault."""
+    return isinstance(exc, OSError) and not isinstance(exc, FileNotFoundError)
 
 
 class LocalFSModels(base.Models):
-    def __init__(self, basedir: str):
+    def __init__(self, basedir: str, retries: int = 3):
         self._basedir = basedir
+        self._retry = RetryPolicy(
+            max_attempts=max(1, retries),
+            backoff_base_s=0.05,
+            retry_on=_retryable_os_error,
+        )
         os.makedirs(basedir, exist_ok=True)
 
     def _path(self, model_id: str) -> str:
@@ -23,23 +35,35 @@ class LocalFSModels(base.Models):
         return os.path.join(self._basedir, f"pio_model_{safe}")
 
     def insert(self, model: Model) -> None:
-        tmp = self._path(model.id) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(model.models)
-        os.replace(tmp, self._path(model.id))
+        def once() -> None:
+            tmp = self._path(model.id) + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(model.models)
+            os.replace(tmp, self._path(model.id))
+
+        self._retry.call(once)
 
     def get(self, model_id: str) -> Model | None:
-        path = self._path(model_id)
-        if not os.path.exists(path):
-            return None
-        with open(path, "rb") as f:
-            return Model(model_id, f.read())
+        def once() -> Model | None:
+            path = self._path(model_id)
+            if not os.path.exists(path):
+                return None
+            try:
+                with open(path, "rb") as f:
+                    return Model(model_id, f.read())
+            except FileNotFoundError:  # deleted between exists() and open()
+                return None
+
+        return self._retry.call(once)
 
     def delete(self, model_id: str) -> None:
-        try:
-            os.remove(self._path(model_id))
-        except FileNotFoundError:
-            pass
+        def once() -> None:
+            try:
+                os.remove(self._path(model_id))
+            except FileNotFoundError:
+                pass
+
+        self._retry.call(once)
 
 
 class LocalFSStorageClient:
@@ -51,7 +75,8 @@ class LocalFSStorageClient:
         path = self.config.get("PATH") or self.config.get("path")
         if not path:
             path = os.path.join(os.path.expanduser("~"), ".pio_store", "models")
-        self._models = LocalFSModels(path)
+        retries = int(self.config.get("RETRIES") or self.config.get("retries") or 3)
+        self._models = LocalFSModels(path, retries=retries)
 
     def models(self) -> LocalFSModels:
         return self._models

@@ -26,10 +26,19 @@ from typing import Any
 
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import Model
+from predictionio_tpu.resilience import (
+    TRANSIENT_HTTP_STATUSES,
+    RetryPolicy,
+    mark_transient,
+)
 
 
 class S3Error(RuntimeError):
-    pass
+    """``transient`` is set True for connection failures and 5xx responses
+    (safe to retry: every op here is an idempotent whole-object
+    PUT/GET/DELETE) and stays False for application errors (403, 400...)."""
+
+    transient = False
 
 
 def _sha256(data: bytes) -> str:
@@ -119,6 +128,8 @@ class S3Models(base.Models):
         secret_key: str | None = None,
         timeout: float = 30.0,
         disable_ssl_verify: bool = False,
+        retries: int = 3,
+        retry_backoff_s: float = 0.2,
     ):
         self._bucket = bucket
         self._region = region
@@ -129,6 +140,9 @@ class S3Models(base.Models):
         self._access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
         self._secret_key = secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY", "")
         self._timeout = timeout
+        self._retry = RetryPolicy(
+            max_attempts=max(1, retries), backoff_base_s=retry_backoff_s
+        )
         self._ssl_context = None
         if disable_ssl_verify:
             import ssl
@@ -141,6 +155,14 @@ class S3Models(base.Models):
         return f"{self._endpoint}{prefix}/{safe}"
 
     def _request(
+        self, method: str, url: str, payload: bytes = b""
+    ) -> tuple[int, bytes]:
+        """One logical request = up to ``retries`` wire attempts: connection
+        failures and 5xx replies retry with exponential backoff (idempotent
+        ops only live here, so replay is safe); 4xx return immediately."""
+        return self._retry.call(self._request_once, method, url, payload)
+
+    def _request_once(
         self, method: str, url: str, payload: bytes = b""
     ) -> tuple[int, bytes]:
         req = urllib.request.Request(url, data=payload or None, method=method)
@@ -160,9 +182,13 @@ class S3Models(base.Models):
             ) as resp:
                 return resp.status, resp.read()
         except urllib.error.HTTPError as exc:
+            if exc.code in TRANSIENT_HTTP_STATUSES:
+                raise mark_transient(
+                    S3Error(f"{method} {url}: HTTP {exc.code}: {exc.read()[:200]!r}")
+                ) from exc
             return exc.code, exc.read()
         except (urllib.error.URLError, OSError) as exc:
-            raise S3Error(f"{method} {url}: {exc}") from exc
+            raise mark_transient(S3Error(f"{method} {url}: {exc}")) from exc
 
     def insert(self, model: Model) -> None:
         status, body = self._request("PUT", self._url(model.id), model.models)
@@ -203,6 +229,8 @@ class S3StorageClient:
             timeout=float(cfg.get("TIMEOUT", 30.0)),
             disable_ssl_verify=str(cfg.get("DISABLE_SSL_VERIFY", "")).lower()
             in ("1", "true", "yes"),
+            retries=int(cfg.get("RETRIES", 3)),
+            retry_backoff_s=float(cfg.get("RETRY_BACKOFF_S", 0.2)),
         )
 
     def models(self) -> S3Models:

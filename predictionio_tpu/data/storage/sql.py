@@ -50,6 +50,7 @@ from predictionio_tpu.data.storage.sqlite import (
     _micros,
     _offset_of,
 )
+from predictionio_tpu.resilience import RetryPolicy
 
 logger = logging.getLogger(__name__)
 
@@ -155,6 +156,21 @@ class SQLStorageClient:
         self.dialect = _DIALECTS[dialect_name]
         self._lock = threading.RLock()
         self._initialized_event_tables: set[str] = set()
+        # reconnect-and-retry for dropped connections (see docs/resilience.md):
+        # reads retry by default; writes only with RETRY_WRITES=true, because
+        # a connection that died after the server applied the commit makes a
+        # replayed INSERT a duplicate (the ES driver documents the same
+        # ambiguity; idempotent callers can opt in)
+        self._retry = RetryPolicy(
+            max_attempts=max(1, int(self.config.get("RETRIES", 3))),
+            backoff_base_s=float(self.config.get("RETRY_BACKOFF_S", 0.1)),
+            retry_on=self._is_transient_db_error,
+        )
+        self._retry_writes = str(self.config.get("RETRY_WRITES", "")).lower() in (
+            "1",
+            "true",
+            "yes",
+        )
         self._conn = self._connect()
         self._init_schema()
 
@@ -196,9 +212,85 @@ class SQLStorageClient:
             kwargs.setdefault("check_same_thread", False)
         return self._mod.connect(**kwargs)
 
+    # -- resilience helpers -------------------------------------------------
+    # OperationalError is a grab-bag: it covers dropped connections AND
+    # permanent programming errors ('no such table', unknown column). Only
+    # messages matching these markers (the SQLAlchemy is_disconnect
+    # approach) are treated as transient — a schema mismatch must surface
+    # immediately, not become a retry + reconnect storm.
+    _DISCONNECT_MARKERS = (
+        "database is locked",  # sqlite busy: clears on retry
+        "server closed the connection",
+        "connection already closed",
+        "connection is closed",
+        "could not connect",
+        "connection refused",
+        "connection reset",
+        "connection timed out",
+        "broken pipe",
+        "lost connection",
+        "gone away",
+        "ssl connection has been closed",
+        "terminating connection",
+    )
+
+    def _is_transient_db_error(self, exc: BaseException) -> bool:
+        """Driver-level connection trouble worth a reconnect + replay."""
+        if isinstance(exc, (ConnectionError, TimeoutError)):
+            return True
+        iface = getattr(self._mod, "InterfaceError", None)
+        if iface is not None and isinstance(exc, iface):
+            return True  # interface errors are connection-level by contract
+        oper = getattr(self._mod, "OperationalError", None)
+        if oper is not None and isinstance(exc, oper):
+            msg = str(exc).lower()
+            return any(marker in msg for marker in self._DISCONNECT_MARKERS)
+        return False
+
+    def _reset_connection(self) -> None:
+        """Drop and rebuild the connection before a retry. Skipped for
+        sqlite3: its transient error (locked db) clears on the SAME
+        connection, and reconnecting would wipe a ``:memory:`` database."""
+        if self._mod.__name__ == "sqlite3":
+            return
+        with self._lock:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+            try:
+                self._conn = self._connect()
+            except Exception:
+                logger.warning("reconnect failed; next attempt will retry")
+
+    def _resilient(self, fn, write: bool):
+        if write and not self._retry_writes:
+            # no replay (ambiguous-commit risk) — but still heal a dead
+            # connection so the NEXT call works; otherwise a write-dominated
+            # workload never recovers from a server restart
+            try:
+                return fn()
+            except Exception as exc:
+                if self._is_transient_db_error(exc):
+                    self._reset_connection()
+                raise
+
+        def attempt():
+            try:
+                return fn()
+            except Exception as exc:
+                if self._is_transient_db_error(exc):
+                    self._reset_connection()
+                raise
+
+        return self._retry.call(attempt)
+
     # -- low-level helpers --------------------------------------------------
     def execute(self, statement: str, params: Sequence = ()):
         """One write statement in its own transaction; returns the cursor."""
+        return self._resilient(lambda: self._execute_once(statement, params), write=True)
+
+    def _execute_once(self, statement: str, params: Sequence = ()):
         with self._lock:
             cur = self._conn.cursor()
             try:
@@ -210,6 +302,9 @@ class SQLStorageClient:
             return cur
 
     def executemany(self, statement: str, rows: Sequence[Sequence]) -> None:
+        self._resilient(lambda: self._executemany_once(statement, rows), write=True)
+
+    def _executemany_once(self, statement: str, rows: Sequence[Sequence]) -> None:
         with self._lock:
             cur = self._conn.cursor()
             try:
@@ -220,6 +315,9 @@ class SQLStorageClient:
                 raise
 
     def query(self, statement: str, params: Sequence = ()) -> list[tuple]:
+        return self._resilient(lambda: self._query_once(statement, params), write=False)
+
+    def _query_once(self, statement: str, params: Sequence = ()) -> list[tuple]:
         with self._lock:
             cur = self._conn.cursor()
             try:

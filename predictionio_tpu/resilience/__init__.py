@@ -1,0 +1,51 @@
+"""Resilience policy library: deadlines, retry/backoff with a budget,
+circuit breakers, and fault injection.
+
+One shared vocabulary for every layer that talks to the outside world —
+the query server's request path, the event server's storage path, and the
+s3/sql/hdfs/localfs/elasticsearch backends. See ``docs/resilience.md`` for
+semantics and tuning guidance.
+"""
+
+from predictionio_tpu.resilience.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from predictionio_tpu.resilience.deadline import Deadline, DeadlineExceeded
+from predictionio_tpu.resilience.fault import FaultInjector, FaultSpec, InjectedFault
+from predictionio_tpu.resilience.retry import (
+    TRANSIENT_HTTP_STATUSES,
+    RetryBudget,
+    RetryPolicy,
+    is_transient,
+    mark_transient,
+)
+from predictionio_tpu.resilience.wrappers import (
+    ResiliencePolicy,
+    ResilientProxy,
+    wrap_dao,
+)
+
+__all__ = [
+    "CLOSED",
+    "HALF_OPEN",
+    "OPEN",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "Deadline",
+    "DeadlineExceeded",
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedFault",
+    "ResiliencePolicy",
+    "ResilientProxy",
+    "RetryBudget",
+    "RetryPolicy",
+    "TRANSIENT_HTTP_STATUSES",
+    "is_transient",
+    "mark_transient",
+    "wrap_dao",
+]

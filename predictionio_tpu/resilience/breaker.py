@@ -1,0 +1,187 @@
+"""Circuit breaker: stop hammering a dependency that is already down.
+
+Classic three-state machine:
+
+- **closed** — normal traffic; consecutive failures are counted.
+- **open** — after ``failure_threshold`` consecutive failures every call is
+  rejected instantly with ``CircuitOpenError`` (the caller converts this to
+  a 503 with ``Retry-After``) instead of burying the backend under timed-out
+  work.
+- **half-open** — after ``recovery_timeout_s`` a bounded number of probe
+  calls are let through; one success closes the circuit, one failure
+  re-opens it for another full recovery window.
+
+Thread-safe; the clock is injectable for tests. A breaker guards one
+dependency (one storage repository, one device dispatch path) and is shared
+by every call site that touches it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class CircuitOpenError(RuntimeError):
+    """Rejected without attempting the call: the circuit is open.
+
+    Not transient — retrying in-process within milliseconds is exactly the
+    hammering the breaker exists to stop. ``retry_after_s`` is the time
+    until the next half-open probe window, for a ``Retry-After`` header.
+    """
+
+    transient = False
+
+    def __init__(self, name: str, retry_after_s: float):
+        super().__init__(
+            f"circuit '{name}' is open; retry after {retry_after_s:.2f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        name: str = "",
+        failure_threshold: int = 5,
+        recovery_timeout_s: float = 5.0,
+        half_open_max_probes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.name = name or "breaker"
+        self.failure_threshold = max(1, failure_threshold)
+        self.recovery_timeout_s = recovery_timeout_s
+        self.half_open_max_probes = max(1, half_open_max_probes)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probes_inflight = 0
+        self.trips = 0  # closed/half-open -> open transitions (monitoring)
+
+    # -- state machine ------------------------------------------------------
+    def _trip(self) -> None:
+        self._state = OPEN
+        self._opened_at = self._clock()
+        self._probes_inflight = 0
+        self.trips += 1
+
+    def allow(self) -> None:
+        """Gate one call. Raises ``CircuitOpenError`` instead of allowing;
+        a successful return must be paired with ``record_success`` or
+        ``record_failure`` (or use ``call()`` which does the pairing)."""
+        with self._lock:
+            if self._state == CLOSED:
+                return
+            elapsed = self._clock() - self._opened_at
+            if self._state == OPEN:
+                if elapsed < self.recovery_timeout_s:
+                    raise CircuitOpenError(
+                        self.name, self.recovery_timeout_s - elapsed
+                    )
+                self._state = HALF_OPEN
+                self._probes_inflight = 0
+            # half-open: admit a bounded number of concurrent probes
+            if self._probes_inflight >= self.half_open_max_probes:
+                raise CircuitOpenError(self.name, self.recovery_timeout_s)
+            self._probes_inflight += 1
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == HALF_OPEN:
+                self._state = CLOSED
+                self._probes_inflight = 0
+
+    def release_probe(self) -> None:
+        """Un-claim a half-open probe slot whose call was never attempted
+        (admission-shed, expired in queue, client gone before dispatch).
+        Without this, an unrecorded probe wedges the circuit half-open —
+        rejecting everything — forever. Clamped and state-gated, so a
+        spurious release is harmless (worst case: one extra probe)."""
+        with self._lock:
+            if self._state == HALF_OPEN and self._probes_inflight > 0:
+                self._probes_inflight -= 1
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._trip()  # failed probe: full recovery window again
+                return
+            self._consecutive_failures += 1
+            if (
+                self._state == CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._trip()
+
+    def force_open(self) -> None:
+        """Administrative trip (drain a replica without killing it)."""
+        with self._lock:
+            if self._state != OPEN:
+                self._trip()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._state = CLOSED
+            self._consecutive_failures = 0
+            self._probes_inflight = 0
+
+    # -- conveniences -------------------------------------------------------
+    def call(
+        self,
+        fn: Callable[..., Any],
+        *args: Any,
+        counts_as_failure: Callable[[BaseException], bool] | None = None,
+        **kwargs: Any,
+    ) -> Any:
+        """Gate + run + record in one step. ``CircuitOpenError`` counts as
+        neither success nor failure. ``counts_as_failure`` classifies which
+        exceptions are *dependency* failures: a request-specific permanent
+        error (bad payload the backend deterministically rejects) must not
+        trip the breaker and 503 every other client — it propagates while
+        recording neither outcome (and frees its half-open probe slot)."""
+        self.allow()
+        try:
+            result = fn(*args, **kwargs)
+        except BaseException as exc:
+            if counts_as_failure is None or counts_as_failure(exc):
+                self.record_failure()
+            else:
+                self.release_probe()
+            raise
+        self.record_success()
+        return result
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # surface open->half-open lazily so monitoring doesn't need a call
+            if (
+                self._state == OPEN
+                and self._clock() - self._opened_at >= self.recovery_timeout_s
+            ):
+                return HALF_OPEN
+            return self._state
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-ready state for /healthz."""
+        with self._lock:
+            state = self._state
+            if (
+                state == OPEN
+                and self._clock() - self._opened_at >= self.recovery_timeout_s
+            ):
+                state = HALF_OPEN
+            return {
+                "name": self.name,
+                "state": state,
+                "consecutiveFailures": self._consecutive_failures,
+                "trips": self.trips,
+            }

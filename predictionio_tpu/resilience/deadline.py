@@ -1,0 +1,98 @@
+"""Request deadlines threaded through call chains.
+
+A ``Deadline`` is an absolute point on a monotonic clock, created once at
+the edge (HTTP handler, CLI entry) and passed down through every layer that
+can block — micro-batch admission, dispatch, storage calls, retries. Each
+layer asks ``remaining()`` and sizes its own timeout to fit, so a request
+spends its budget exactly once instead of stacking N independent timeouts
+whose worst case is their sum.
+
+The clock is injectable so tests advance time without sleeping.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+class DeadlineExceeded(TimeoutError):
+    """The operation's deadline passed before it completed.
+
+    Marked ``transient = False``: retrying within the same request cannot
+    help (the budget is spent) — the caller should shed the request and let
+    the client retry with a fresh deadline.
+    """
+
+    transient = False
+
+
+class Deadline:
+    """Absolute deadline on a monotonic clock. ``None`` budget = unbounded."""
+
+    __slots__ = ("_at", "_clock")
+
+    def __init__(
+        self,
+        timeout_s: float | None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._clock = clock
+        self._at = None if timeout_s is None else clock() + max(0.0, timeout_s)
+
+    @classmethod
+    def after(
+        cls, timeout_s: float | None, clock: Callable[[], float] = time.monotonic
+    ) -> "Deadline":
+        """``timeout_s <= 0`` or ``None`` builds an unbounded deadline."""
+        if timeout_s is None or timeout_s <= 0:
+            return cls(None, clock)
+        return cls(timeout_s, clock)
+
+    @classmethod
+    def never(cls) -> "Deadline":
+        return cls(None)
+
+    @property
+    def bounded(self) -> bool:
+        return self._at is not None
+
+    def remaining(self) -> float | None:
+        """Seconds left (clamped at 0), or None when unbounded."""
+        if self._at is None:
+            return None
+        return max(0.0, self._at - self._clock())
+
+    @property
+    def expired(self) -> bool:
+        return self._at is not None and self._clock() >= self._at
+
+    def check(self, what: str = "operation") -> None:
+        if self.expired:
+            raise DeadlineExceeded(f"{what}: deadline exceeded")
+
+    def clamp(self, timeout_s: float | None) -> float | None:
+        """Fit a layer-local timeout inside this deadline: the smaller of
+        the two, with None meaning unbounded on both sides."""
+        rem = self.remaining()
+        if rem is None:
+            return timeout_s
+        if timeout_s is None:
+            return rem
+        return min(rem, timeout_s)
+
+    @staticmethod
+    def min_of(deadlines: "list[Deadline]") -> "Deadline":
+        """The tightest of a set (for a micro-batch: the batch must answer
+        by its most impatient member). Unbounded members don't tighten."""
+        best: Deadline | None = None
+        for d in deadlines:
+            if not d.bounded:
+                continue
+            if best is None or d._at < best._at:  # noqa: SLF001 — same class
+                best = d
+        return best if best is not None else Deadline.never()
+
+    def __repr__(self) -> str:
+        rem = self.remaining()
+        return f"Deadline(remaining={'inf' if rem is None else f'{rem:.3f}s'})"

@@ -1,0 +1,152 @@
+"""Retry with exponential backoff + jitter, bounded by a per-process budget.
+
+Two safeguards production retry loops need and ad-hoc ``for attempt in
+range(3)`` loops lack:
+
+- **Transience classification.** Only errors that can plausibly succeed on
+  replay are retried. Backends mark their error types with a ``transient``
+  attribute (connection failures, 5xx) — everything else (4xx, schema
+  errors, ``DeadlineExceeded``) fails fast.
+- **A retry budget.** Under a full outage every request retrying N times
+  multiplies offered load by N exactly when the backend can least afford
+  it. The token-bucket budget earns fractional tokens from first attempts
+  and spends one per retry, so steady-state retries are capped at
+  ``ratio`` of traffic and a dying backend sees load *drop*, not triple.
+
+``sleep``/``rng`` are injectable so tests assert exact backoff sequences
+without real sleeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Any, Callable
+
+from predictionio_tpu.resilience.deadline import Deadline
+
+# error types that are transient by nature, no marking needed
+_TRANSIENT_TYPES = (ConnectionError, InterruptedError)
+
+# HTTP statuses worth replaying: server-side trouble a fresh attempt
+# (possibly against a recovered node) can clear. Shared by every
+# HTTP-transport backend so the classification lives in one place.
+TRANSIENT_HTTP_STATUSES = (500, 502, 503, 504)
+
+
+def mark_transient(exc: BaseException) -> BaseException:
+    """Tag an exception as replay-safe for ``is_transient`` and return it
+    (``raise mark_transient(SomeError(...)) from exc``)."""
+    exc.transient = True
+    return exc
+
+
+def is_transient(exc: BaseException) -> bool:
+    """May this error succeed on replay?
+
+    An explicit ``transient`` attribute on the exception (or its class)
+    wins in both directions; otherwise connection-level errors are
+    transient and everything else is not. ``TimeoutError`` is *not*
+    blanket-transient: ``DeadlineExceeded`` subclasses it and must never
+    be retried (it sets ``transient = False`` explicitly; a backend whose
+    timeouts are worth retrying marks its own error type).
+    """
+    marked = getattr(exc, "transient", None)
+    if marked is not None:
+        return bool(marked)
+    return isinstance(exc, _TRANSIENT_TYPES)
+
+
+class RetryBudget:
+    """Per-process token bucket shared by every call site of one policy.
+
+    Each first attempt deposits ``ratio`` tokens (capped at ``max_tokens``);
+    each retry withdraws 1. ``min_tokens`` pre-funds the bucket so a cold
+    process can still retry its first few failures.
+    """
+
+    def __init__(
+        self, ratio: float = 0.1, max_tokens: float = 100.0, min_tokens: float = 10.0
+    ):
+        self.ratio = ratio
+        self.max_tokens = max_tokens
+        self._tokens = min(min_tokens, max_tokens)
+        self._lock = threading.Lock()
+
+    def record_attempt(self) -> None:
+        with self._lock:
+            self._tokens = min(self.max_tokens, self._tokens + self.ratio)
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Exponential backoff with full jitter (attempt k sleeps a uniform
+    draw from ``[base * mult**k * (1 - jitter), base * mult**k]``, capped
+    at ``backoff_max_s``)."""
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.05
+    backoff_multiplier: float = 2.0
+    backoff_max_s: float = 2.0
+    jitter: float = 0.5  # fraction of the computed backoff randomized away
+    retry_on: Callable[[BaseException], bool] = is_transient
+    budget: RetryBudget | None = None
+    sleep: Callable[[float], None] = time.sleep
+    rng: Callable[[], float] = random.random  # uniform [0, 1)
+
+    def backoff_s(self, retry_index: int) -> float:
+        """Sleep before the (retry_index+1)-th retry (retry_index from 0)."""
+        raw = min(
+            self.backoff_max_s,
+            self.backoff_base_s * self.backoff_multiplier**retry_index,
+        )
+        return raw * (1.0 - self.jitter * self.rng())
+
+    def call(
+        self,
+        fn: Callable[..., Any],
+        *args: Any,
+        deadline: Deadline | None = None,
+        **kwargs: Any,
+    ) -> Any:
+        """Run ``fn`` with retries. The underlying error always propagates
+        unchanged — on exhaustion too, so existing ``except SomeBackendError``
+        clauses (and error attributes like the ES driver's ``indexed_ids``)
+        keep working whether or not a policy wraps the call."""
+        if self.budget is not None:
+            self.budget.record_attempt()
+        attempts = 0
+        while True:
+            if deadline is not None:
+                deadline.check("retryable call")
+            attempts += 1
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as exc:
+                if not self.retry_on(exc):
+                    raise
+                if attempts >= self.max_attempts:
+                    raise  # out of attempts
+                if self.budget is not None and not self.budget.try_spend():
+                    raise  # budget empty: shed the retry, surface the error
+                pause = self.backoff_s(attempts - 1)
+                if deadline is not None:
+                    rem = deadline.remaining()
+                    if rem is not None and pause >= rem:
+                        raise  # the backoff alone would blow the deadline
+                if pause > 0:
+                    self.sleep(pause)

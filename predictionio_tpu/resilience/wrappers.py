@@ -1,0 +1,102 @@
+"""Composition: retry x breaker x deadline as one policy object, plus a
+DAO proxy that applies the policy to every method of a storage object.
+
+Layering (outermost first): the retry loop drives attempts; every attempt
+is gated by the breaker and individually counted by it. ``CircuitOpenError``
+is non-transient, so the instant the breaker trips the retry loop stops —
+an open circuit must not be retried into.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+from predictionio_tpu.resilience.breaker import CircuitBreaker
+from predictionio_tpu.resilience.deadline import Deadline
+from predictionio_tpu.resilience.retry import RetryPolicy
+
+
+@dataclasses.dataclass
+class ResiliencePolicy:
+    """One dependency's full policy: retries (with backoff/budget) around
+    breaker-gated attempts, all inside an optional deadline."""
+
+    retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+    breaker: CircuitBreaker | None = None
+
+    def call(
+        self,
+        fn: Callable[..., Any],
+        *args: Any,
+        deadline: Deadline | None = None,
+        **kwargs: Any,
+    ) -> Any:
+        if self.breaker is None:
+            return self.retry.call(fn, *args, deadline=deadline, **kwargs)
+        breaker = self.breaker
+        # only errors the retry policy classifies as transient (dependency
+        # trouble) count against the breaker: a poison request that fails
+        # deterministically must not open the circuit for everyone else
+        classify = self.retry.retry_on
+
+        def attempt() -> Any:
+            return breaker.call(fn, *args, counts_as_failure=classify, **kwargs)
+
+        return self.retry.call(attempt, deadline=deadline)
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "breaker": self.breaker.snapshot() if self.breaker else None,
+            "retryBudgetTokens": (
+                self.retry.budget.tokens if self.retry.budget else None
+            ),
+        }
+
+
+class ResilientProxy:
+    """Every method call on the wrapped object runs through the policy.
+
+    ``exempt`` methods (e.g. ``close``) bypass it: a shutdown call must not
+    be blocked by an open breaker or retried against a dying backend.
+    """
+
+    def __init__(
+        self,
+        target: Any,
+        policy: ResiliencePolicy,
+        exempt: tuple[str, ...] = ("close",),
+    ):
+        object.__setattr__(self, "_target", target)
+        object.__setattr__(self, "_policy", policy)
+        object.__setattr__(self, "_exempt", exempt)
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._target, name)
+        if not callable(attr) or name in self._exempt or name.startswith("_"):
+            return attr
+        policy = self._policy
+
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            return policy.call(attr, *args, **kwargs)
+
+        wrapper.__name__ = getattr(attr, "__name__", name)
+        return wrapper
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        setattr(self._target, name, value)
+
+    def __repr__(self) -> str:
+        return f"ResilientProxy({self._target!r})"
+
+
+def wrap_dao(
+    dao: Any,
+    policy: ResiliencePolicy,
+    exempt: tuple[str, ...] = ("close",),
+) -> ResilientProxy:
+    """Policy-wrap a storage DAO (LEvents, Models, ...). Iterator-returning
+    scans get retry protection only on the *call* that builds the iterator;
+    mid-stream failures surface unretried (a half-consumed scan cannot be
+    safely replayed here)."""
+    return ResilientProxy(dao, policy, exempt=exempt)

@@ -352,6 +352,10 @@ def cmd_deploy(args) -> int:
         ssl_keyfile=args.ssl_keyfile,
         log_url=args.log_url,
         log_prefix=args.log_prefix or "",
+        request_timeout_s=args.request_timeout,
+        queue_high_water=args.queue_high_water,
+        breaker_threshold=args.breaker_threshold,
+        breaker_recovery_s=args.breaker_recovery,
     )
     print(f"Engine server starting on {args.ip}:{args.port} ...")
     run_query_server(args.engine_dir, args.variant, config=config)
@@ -408,6 +412,9 @@ def cmd_eventserver(args) -> int:
             stats=args.stats,
             ssl_certfile=args.ssl_certfile,
             ssl_keyfile=args.ssl_keyfile,
+            storage_retries=args.storage_retries,
+            breaker_threshold=args.breaker_threshold,
+            breaker_recovery_s=args.breaker_recovery,
         )
     )
     return 0
@@ -788,6 +795,33 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--ssl-keyfile")
     x.add_argument("--log-url", help="POST serving errors to this collector URL")
     x.add_argument("--log-prefix", help="prefix prepended to each remote log body")
+    x.add_argument(
+        "--request-timeout",
+        type=float,
+        default=10.0,
+        help="per-request deadline in seconds for /queries.json "
+        "(503 instead of hanging; <= 0 disables)",
+    )
+    x.add_argument(
+        "--queue-high-water",
+        type=int,
+        default=256,
+        help="shed load with 503 + Retry-After when this many queries are "
+        "already queued (0 = unbounded)",
+    )
+    x.add_argument(
+        "--breaker-threshold",
+        type=int,
+        default=3,
+        help="consecutive deadline-blown device calls that open the "
+        "dispatch circuit breaker",
+    )
+    x.add_argument(
+        "--breaker-recovery",
+        type=float,
+        default=5.0,
+        help="seconds an open dispatch breaker waits before probing again",
+    )
     x.set_defaults(fn=cmd_deploy)
 
     x = sub.add_parser("undeploy")
@@ -809,6 +843,25 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--stats", action="store_true")
     x.add_argument("--ssl-certfile")
     x.add_argument("--ssl-keyfile")
+    x.add_argument(
+        "--storage-retries",
+        type=int,
+        default=3,
+        help="attempts per storage call for transient failures (<= 1 disables)",
+    )
+    x.add_argument(
+        "--breaker-threshold",
+        type=int,
+        default=5,
+        help="consecutive storage failures that open the circuit breaker "
+        "(requests then answer 503 'storage unavailable')",
+    )
+    x.add_argument(
+        "--breaker-recovery",
+        type=float,
+        default=5.0,
+        help="seconds an open storage breaker waits before probing again",
+    )
     x.set_defaults(fn=cmd_eventserver)
 
     x = sub.add_parser("adminserver")

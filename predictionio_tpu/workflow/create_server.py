@@ -35,6 +35,13 @@ from aiohttp import web
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.resilience import (
+    OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+)
 from predictionio_tpu.workflow.context import WorkflowContext
 from predictionio_tpu.workflow.core_workflow import load_models_for_instance
 from predictionio_tpu.workflow.engine_loader import EngineManifest, load_engine
@@ -42,6 +49,29 @@ from predictionio_tpu.utils.histogram import LatencyHistogram
 
 logger = logging.getLogger(__name__)
 UTC = _dt.timezone.utc
+
+
+class LoadShedError(RuntimeError):
+    """Admission control rejected the request (queue over high water).
+
+    Not transient in-process: the server is telling the *client* to back
+    off (`Retry-After`), not asking itself to retry into the same queue.
+    """
+
+    transient = False
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class ShuttingDownError(RuntimeError):
+    """The server is stopping; in-flight and new requests answer 503."""
+
+    transient = False
+
+    def __init__(self):
+        super().__init__("query server is shutting down")
 
 
 @dataclasses.dataclass
@@ -68,11 +98,39 @@ class ServerConfig:
     # adaptively while the previous batch is in flight on the worker thread).
     max_batch_size: int = 128
     batch_window_ms: float = 0.0
+    # -- resilience (see docs/resilience.md) --------------------------------
+    # per-request deadline: a /queries.json answer is due within this many
+    # seconds or the request is failed with 503 instead of hanging; <= 0
+    # disables (NOT recommended: a wedged device call then blocks forever)
+    request_timeout_s: float = 10.0
+    # admission control: when this many queries are already waiting in the
+    # micro-batch queue, new arrivals are shed with 503 + Retry-After
+    # instead of growing the queue without bound; 0 = unbounded
+    queue_high_water: int = 256
+    shed_retry_after_s: float = 1.0  # Retry-After hint on load-shed 503s
+    # oversized request bodies are rejected with 413 before JSON decode
+    max_payload_bytes: int = 1 << 20
+    # background HTTP (feedback + remote log) total timeout: a stalled
+    # collector must not accumulate hung tasks forever
+    http_timeout_s: float = 10.0
+    # dispatch circuit breaker: this many consecutive watchdog trips (device
+    # calls blowing their deadline) opens the circuit and sheds all traffic
+    # for breaker_recovery_s before probing again
+    breaker_threshold: int = 3
+    breaker_recovery_s: float = 5.0
 
     def ssl_context(self):
         from predictionio_tpu.utils.tls import server_ssl_context
 
         return server_ssl_context(self.ssl_certfile, self.ssl_keyfile)
+
+
+def _swallow_result(fut) -> None:
+    """Done-callback for executor futures the watchdog may abandon: retrieve
+    the late exception so the loop never logs 'exception was never
+    retrieved' for a batch that was already failed and answered."""
+    if not fut.cancelled():
+        fut.exception()
 
 
 class _MicroBatcher:
@@ -94,41 +152,94 @@ class _MicroBatcher:
         max_batch: int,
         window_s: float,
         max_inflight: int = 4,
+        high_water: int = 0,
+        shed_retry_after_s: float = 1.0,
     ):
         import concurrent.futures
 
         self._server = server
         self.max_batch = max(1, max_batch)
         self.window_s = max(0.0, window_s)
+        self.high_water = max(0, high_water)
+        self.shed_retry_after_s = shed_retry_after_s
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: asyncio.Task | None = None
+        self._closed = False
+        self._max_fetch_workers = max(1, max_inflight)
         # dispatch runs on one thread (decode + device enqueue, fast);
         # fetches block on the transport and overlap on their own threads
         self._dispatch_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="pio-dispatch"
         )
         self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max(1, max_inflight), thread_name_prefix="pio-fetch"
+            max_workers=self._max_fetch_workers, thread_name_prefix="pio-fetch"
         )
         self._inflight = asyncio.Semaphore(max(1, max_inflight))
         self._finish_tasks: set[asyncio.Task] = set()
+        self._cancelled_tasks: list[asyncio.Task] = []
         self.batches_dispatched = 0
         self.queries_dispatched = 0
+        self.watchdog_trips = 0  # batches failed for blowing their deadline
+        self.shed_count = 0  # requests rejected by admission control
 
-    async def submit(self, payload: Any) -> Any:
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    async def submit(self, payload: Any, deadline: Deadline | None = None) -> Any:
         """Enqueue one query payload; returns the encoded result body or
-        raises the per-query error."""
+        raises the per-query error. Fails fast when the server is shutting
+        down (never restarts the collect loop against shut-down pools) and
+        sheds with ``LoadShedError`` when the queue is over high water."""
+        if self._closed:
+            raise ShuttingDownError()
+        if self.high_water and self._queue.qsize() >= self.high_water:
+            self.shed_count += 1
+            raise LoadShedError(
+                f"admission queue over high water "
+                f"({self._queue.qsize()}/{self.high_water})",
+                self.shed_retry_after_s,
+            )
+        if deadline is None:
+            deadline = Deadline.never()
         fut = asyncio.get_running_loop().create_future()
-        self._queue.put_nowait((payload, fut))
+        self._queue.put_nowait((payload, fut, deadline))
         if self._task is None or self._task.done():
             self._task = asyncio.ensure_future(self._run())
         return await fut
 
     @staticmethod
     def _fail_batch(batch: list, exc: BaseException) -> None:
-        for _, fut in batch:
+        for _, fut, _ in batch:
             if not fut.done():
                 fut.set_exception(exc)
+
+    def _replace_dispatch_pool(self) -> None:
+        """Abandon a dispatch thread stuck past its batch's deadline: the
+        single dispatch thread is the serialization point for ALL traffic,
+        so a wedged device call head-of-line-blocks every later batch
+        unless we walk away from it. The old executor is shut down without
+        cancelling the running call (it cannot be interrupted); its thread
+        finishes (or hangs) in the background while a fresh pool serves
+        new batches."""
+        import concurrent.futures
+
+        old = self._dispatch_pool
+        self._dispatch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pio-dispatch"
+        )
+        old.shutdown(wait=False)
+
+    def _replace_fetch_pool(self) -> None:
+        """Same walk-away for a finalize stuck on the transport. Other
+        in-flight finalizes on the old pool run to completion there."""
+        import concurrent.futures
+
+        old = self._fetch_pool
+        self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self._max_fetch_workers, thread_name_prefix="pio-fetch"
+        )
+        old.shutdown(wait=False)
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
@@ -147,23 +258,67 @@ class _MicroBatcher:
             except asyncio.CancelledError:
                 # shutdown while holding a collected-but-undispatched batch:
                 # its clients must get a response, not an eternal await
-                self._fail_batch(batch, RuntimeError("query server is shutting down"))
+                self._fail_batch(batch, ShuttingDownError())
                 raise
+            # requests that expired while queued are failed here, not
+            # dispatched: device work for an answer nobody is waiting on
+            # would only deepen an overload
+            live = []
+            for payload, fut, dl in batch:
+                if fut.done():  # client gone / cancelled
+                    # its probe slot (if it held one) can never be recorded
+                    self._server.dispatch_breaker.release_probe()
+                    continue
+                if dl.expired:
+                    fut.set_exception(
+                        DeadlineExceeded("query expired in admission queue")
+                    )
+                else:
+                    live.append((payload, fut, dl))
+            if not live:
+                self._inflight.release()
+                continue
+            batch = live
+            batch_deadline = Deadline.min_of([dl for _, _, dl in batch])
+            # dispatch under a watchdog. NOT wait_for(): cancelling an
+            # executor future whose fn is already running blocks until the
+            # fn returns — the exact hang the watchdog exists to escape.
+            # asyncio.wait() times out without cancelling; the stuck call
+            # is then abandoned and its pool replaced.
             try:
-                finalize = await loop.run_in_executor(
+                exec_fut = loop.run_in_executor(
                     self._dispatch_pool,
                     self._server._dispatch_query_batch,
-                    [payload for payload, _ in batch],
+                    [payload for payload, _, _ in batch],
+                )
+                exec_fut.add_done_callback(_swallow_result)
+                done, pending = await asyncio.wait(
+                    [exec_fut], timeout=batch_deadline.remaining()
                 )
             except asyncio.CancelledError:
                 self._inflight.release()
                 # shutdown mid-dispatch: this batch's clients must get a
                 # response too (close()'s drain only covers queued items)
-                self._fail_batch(batch, RuntimeError("query server is shutting down"))
+                self._fail_batch(batch, ShuttingDownError())
                 raise  # close() must actually terminate the collect loop
+            if pending:
+                # watchdog trip: fail THIS batch, walk away from the stuck
+                # dispatch thread, keep serving everyone else
+                self._inflight.release()
+                self.watchdog_trips += 1
+                self._replace_dispatch_pool()
+                self._server.dispatch_breaker.record_failure()
+                self._fail_batch(
+                    batch,
+                    DeadlineExceeded("micro-batch dispatch: deadline exceeded"),
+                )
+                continue
+            try:
+                finalize = exec_fut.result()
             except BaseException as exc:
                 self._inflight.release()
-                for _, fut in batch:
+                self._server.dispatch_breaker.record_failure()
+                for _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(exc)
                 continue
@@ -171,24 +326,51 @@ class _MicroBatcher:
             self.queries_dispatched += len(batch)
             # finish asynchronously: the collect loop immediately forms and
             # dispatches the next batch while this one's fetch is in flight
-            task = asyncio.ensure_future(self._finish(batch, finalize))
+            task = asyncio.ensure_future(
+                self._finish(batch, finalize, batch_deadline)
+            )
             self._finish_tasks.add(task)
             task.add_done_callback(self._finish_tasks.discard)
 
-    async def _finish(self, batch: list, finalize) -> None:
+    async def _finish(self, batch: list, finalize, deadline: Deadline) -> None:
         loop = asyncio.get_running_loop()
+        exec_fut = loop.run_in_executor(self._fetch_pool, finalize)
+        exec_fut.add_done_callback(_swallow_result)
         try:
-            outs = await loop.run_in_executor(self._fetch_pool, finalize)
+            done, pending = await asyncio.wait(
+                [exec_fut], timeout=deadline.remaining()
+            )
         except asyncio.CancelledError:
+            self._inflight.release()
             # shutdown: resolve the batch's futures (handlers awaiting them
             # would otherwise hang for aiohttp's whole shutdown timeout)
-            self._fail_batch(batch, RuntimeError("query server is shutting down"))
+            self._fail_batch(batch, ShuttingDownError())
             raise
+        if pending:
+            # fetch watchdog: same walk-away as dispatch (see _run); other
+            # finalizes in flight on the old pool still run to completion
+            self._inflight.release()
+            self.watchdog_trips += 1
+            self._replace_fetch_pool()
+            self._server.dispatch_breaker.record_failure()
+            self._fail_batch(
+                batch, DeadlineExceeded("micro-batch fetch: deadline exceeded")
+            )
+            return
+        try:
+            outs = exec_fut.result()
         except BaseException as exc:
+            # a finalize that raised wholesale is a dispatch-path failure
+            # (per-query errors are isolated inside finalize and arrive as
+            # entries in outs) — it must count against the breaker exactly
+            # like a failed dispatch, not close a half-open circuit
             outs = [exc] * len(batch)
+            self._server.dispatch_breaker.record_failure()
+        else:
+            self._server.dispatch_breaker.record_success()
         finally:
             self._inflight.release()
-        for (_, fut), out in zip(batch, outs):
+        for (_, fut, _), out in zip(batch, outs):
             if fut.done():  # client gone / cancelled
                 continue
             if isinstance(out, BaseException):
@@ -197,24 +379,35 @@ class _MicroBatcher:
                 fut.set_result(out)
 
     def close(self) -> None:
+        self._closed = True  # new submits fail fast from here on
         if self._task is not None:
             self._task.cancel()
+            self._cancelled_tasks.append(self._task)
             self._task = None
         for task in list(self._finish_tasks):
             task.cancel()
+            self._cancelled_tasks.append(task)
         # fail everything still queued: enqueued-but-never-collected items
         # have handlers awaiting their futures (collected/dispatched batches
         # are resolved by the _run/_finish cancellation paths)
-        exc = RuntimeError("query server is shutting down")
+        exc = ShuttingDownError()
         while True:
             try:
-                _, fut = self._queue.get_nowait()
+                _, fut, _ = self._queue.get_nowait()
             except asyncio.QueueEmpty:
                 break
             if not fut.done():
                 fut.set_exception(exc)
         self._dispatch_pool.shutdown(wait=False, cancel_futures=True)
         self._fetch_pool.shutdown(wait=False, cancel_futures=True)
+
+    async def wait_closed(self) -> None:
+        """Drain the cancellations issued by ``close()`` so shutdown leaves
+        zero pending asyncio tasks behind."""
+        tasks = [t for t in self._cancelled_tasks if not t.done()]
+        self._cancelled_tasks.clear()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
 
 
 class QueryServer:
@@ -259,10 +452,25 @@ class QueryServer:
         self._stop_event = asyncio.Event()
         # strong refs to fire-and-forget tasks (the loop keeps only weak ones)
         self._bg_tasks: set[asyncio.Task] = set()
+        # ONE shared session with a total timeout for all background HTTP
+        # (feedback + remote log): per-call bare ClientSessions with no
+        # timeout accumulated hung tasks forever against a stalled collector
+        self._http_session = None
+        # consecutive watchdog trips (device calls blowing their deadline)
+        # open this breaker; while open /queries.json sheds instantly with
+        # 503 + Retry-After instead of feeding more work to a wedged device
+        self.dispatch_breaker = CircuitBreaker(
+            name="dispatch",
+            failure_threshold=self.config.breaker_threshold,
+            recovery_timeout_s=self.config.breaker_recovery_s,
+        )
+        self._reload_lock = asyncio.Lock()
         self._batcher = _MicroBatcher(
             self,
             max_batch=self.config.max_batch_size,
             window_s=self.config.batch_window_ms / 1000.0,
+            high_water=self.config.queue_high_water,
+            shed_retry_after_s=self.config.shed_retry_after_s,
         )
         import concurrent.futures
 
@@ -279,16 +487,55 @@ class QueryServer:
             if supplied != self.config.accesskey:
                 return web.json_response({"message": "Invalid accessKey."}, status=401)
         t0 = time.perf_counter()
+        if (
+            self.config.max_payload_bytes
+            and request.content_length is not None
+            and request.content_length > self.config.max_payload_bytes
+        ):
+            return web.json_response(
+                {
+                    "message": (
+                        f"query payload too large "
+                        f"({request.content_length} > "
+                        f"{self.config.max_payload_bytes} bytes)"
+                    )
+                },
+                status=413,
+            )
         try:
             payload = await request.json()
         except Exception as exc:
             return web.json_response({"message": str(exc)}, status=400)
         try:
+            # a wedged device has tripped the dispatch breaker: shed at the
+            # door with a Retry-After instead of queueing doomed work
+            self.dispatch_breaker.allow()
+        except CircuitOpenError as exc:
+            return self._unavailable(
+                "serving temporarily unavailable (dispatch circuit open)",
+                exc.retry_after_s,
+            )
+        deadline = Deadline.after(self.config.request_timeout_s)
+        try:
             # the batcher runs decode -> supplement -> predict_batch -> serve
             # on its worker thread, so the event loop never blocks on device
             # or storage work and concurrent requests coalesce into one
-            # batched device call
-            body = await self._batcher.submit(payload)
+            # batched device call; the deadline rides along and bounds every
+            # stage (queue wait, dispatch, result fetch)
+            body = await self._batcher.submit(payload, deadline)
+        except LoadShedError as exc:
+            # this request died before any dispatch could record against the
+            # breaker: free its half-open probe slot (no-op when closed/open)
+            # or an unresolved probe would wedge the circuit half-open
+            self.dispatch_breaker.release_probe()
+            return self._unavailable(str(exc), exc.retry_after_s)
+        except DeadlineExceeded as exc:
+            self.dispatch_breaker.release_probe()
+            logger.warning("query deadline exceeded: %s", exc)
+            return self._unavailable(str(exc), self.config.shed_retry_after_s)
+        except ShuttingDownError as exc:
+            self.dispatch_breaker.release_probe()
+            return self._unavailable(str(exc), self.config.shed_retry_after_s)
         except Exception as exc:
             logger.exception("query failed")
             if self.config.log_url:
@@ -409,23 +656,44 @@ class QueryServer:
             except Exception:
                 logger.exception("output sniffer failed")
 
+    @staticmethod
+    def _unavailable(message: str, retry_after_s: float) -> web.Response:
+        """503 with a Retry-After hint — the contract load balancers and
+        well-behaved clients need to back off instead of hammering."""
+        return web.json_response(
+            {"message": message},
+            status=503,
+            headers={"Retry-After": str(max(1, round(retry_after_s)))},
+        )
+
     def _spawn_bg(self, coro) -> None:
         task = asyncio.ensure_future(coro)
         self._bg_tasks.add(task)
         task.add_done_callback(self._bg_tasks.discard)
 
+    def _http(self):
+        """The shared background-HTTP session, created lazily on the running
+        loop with a total timeout (config.http_timeout_s) and closed by
+        ``stop()``: a stalled collector now costs one bounded task, not an
+        ever-growing pile of hung ones."""
+        import aiohttp
+
+        if self._http_session is None or self._http_session.closed:
+            self._http_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.config.http_timeout_s)
+            )
+        return self._http_session
+
     async def _remote_log(self, message: str) -> None:
         """Ship a serving error to the remote collector: POST body is
         ``log_prefix`` + JSON of {engineInstance, message}
         (ref ``CreateServer.remoteLog``, CreateServer.scala:423-434)."""
-        import aiohttp
-
         body = self.config.log_prefix + json.dumps(
             {"engineInstance": self.instance_id, "message": message}
         )
         try:
-            async with aiohttp.ClientSession() as session:
-                await session.post(self.config.log_url, data=body)
+            async with self._http().post(self.config.log_url, data=body):
+                pass  # response body unused; context exit releases the conn
         except Exception:
             logger.error("Unable to send remote log")
 
@@ -436,8 +704,6 @@ class QueryServer:
         key = self.config.feedback_access_key
         if not url or not key:
             return
-        import aiohttp
-
         event = {
             "event": "predict",
             "entityType": "pio_pr",
@@ -445,10 +711,10 @@ class QueryServer:
             "properties": {"query": query, "prediction": prediction},
         }
         try:
-            async with aiohttp.ClientSession() as session:
-                await session.post(
-                    f"{url}/events.json", params={"accessKey": key}, json=event
-                )
+            async with self._http().post(
+                f"{url}/events.json", params={"accessKey": key}, json=event
+            ):
+                pass
         except Exception:
             logger.exception("feedback POST failed")
 
@@ -474,34 +740,90 @@ class QueryServer:
                         / max(1, self._batcher.batches_dispatched)
                     ),
                 },
+                "resilience": self._resilience_snapshot(),
             }
         )
 
-    async def handle_reload(self, request: web.Request) -> web.Response:
-        """Swap in the latest COMPLETED instance (ref MasterActor reload)."""
-        instances = self.storage.get_meta_data_engine_instances()
-        latest = instances.get_latest_completed(
-            self.manifest.engine_id, self.manifest.version, self.manifest.variant
+    def _resilience_snapshot(self) -> dict[str, Any]:
+        b = self._batcher
+        return {
+            "queueDepth": b.queue_depth,
+            "queueHighWater": b.high_water,
+            "watchdogTrips": b.watchdog_trips,
+            "loadShedCount": b.shed_count,
+            "breakers": {"dispatch": self.dispatch_breaker.snapshot()},
+        }
+
+    async def handle_healthz(self, request: web.Request) -> web.Response:
+        """Readiness (distinct from the `/` liveness/status page): a load
+        balancer drains this replica while the dispatch circuit is open or
+        the admission queue is at high water, instead of sending traffic
+        that would be shed."""
+        snap = self._resilience_snapshot()
+        shedding = (
+            snap["queueHighWater"] > 0
+            and snap["queueDepth"] >= snap["queueHighWater"]
         )
-        if latest is None:
-            return web.json_response(
-                {"message": "no completed engine instance found"}, status=404
+        ready = (
+            not self._batcher._closed
+            and not shedding
+            and snap["breakers"]["dispatch"]["state"] != OPEN
+        )
+        return web.json_response(
+            {"ready": ready, **snap}, status=200 if ready else 503
+        )
+
+    async def handle_reload(self, request: web.Request) -> web.Response:
+        """Swap in the latest COMPLETED instance (ref MasterActor reload).
+
+        Serialized: two concurrent /reloads used to interleave their
+        ``engine_params`` / ``_active`` / ``instance_id`` assignments and
+        could leave the server announcing instance A while serving B's
+        models. Under the lock, everything is loaded and warmed first and
+        the three fields commit together only after that succeeds."""
+        async with self._reload_lock:
+            loop = asyncio.get_running_loop()
+            latest = await loop.run_in_executor(
+                None,
+                lambda: self.storage.get_meta_data_engine_instances()
+                .get_latest_completed(
+                    self.manifest.engine_id,
+                    self.manifest.version,
+                    self.manifest.variant,
+                ),
             )
-        try:
-            engine_params = self._engine_params_of(latest)
-            models = load_models_for_instance(
-                self.engine, engine_params, latest.id, storage=self.storage
-            )
-        except Exception as exc:
-            logger.exception("reload failed")
-            return web.json_response({"message": str(exc)}, status=500)
-        _, _, algorithms, serving = self.engine.make_components(engine_params)
-        self.engine_params = engine_params
-        self._active = (algorithms, serving, models)  # atomic swap
-        self.instance_id = latest.id
-        await asyncio.get_running_loop().run_in_executor(None, self._warmup)
+            if latest is None:
+                return web.json_response(
+                    {"message": "no completed engine instance found"}, status=404
+                )
+            try:
+                engine_params = self._engine_params_of(latest)
+                models = await loop.run_in_executor(
+                    None,
+                    lambda: load_models_for_instance(
+                        self.engine, engine_params, latest.id, storage=self.storage
+                    ),
+                )
+                _, _, algorithms, serving = self.engine.make_components(
+                    engine_params
+                )
+                # warm the NEW components before they take traffic (warmup
+                # failures are non-fatal by the same contract as deploy-time
+                # warmup: the first burst just pays its XLA compiles)
+                await loop.run_in_executor(
+                    None, self._warmup_components, algorithms, models
+                )
+            except Exception as exc:
+                logger.exception("reload failed")
+                return web.json_response({"message": str(exc)}, status=500)
+            # commit: one consistent swap, nothing mutated on any failure path
+            self.engine_params = engine_params
+            self._active = (algorithms, serving, models)  # atomic swap
+            self.instance_id = latest.id
         logger.info("reloaded engine instance %s", latest.id)
-        return web.json_response({"message": "Reload successful", "instanceId": latest.id})
+        return web.json_response(
+            {"message": "Reload successful", "instanceId": latest.id}
+        )
 
     def _engine_params_of(self, instance: EngineInstance) -> EngineParams:
         variant = {
@@ -525,6 +847,7 @@ class QueryServer:
         app.add_routes(
             [
                 web.get("/", self.handle_status),
+                web.get("/healthz", self.handle_healthz),
                 web.post("/queries.json", self.handle_queries),
                 # POST is the reference's contract (CreateServer.scala:618-626);
                 # GET kept as a browser convenience
@@ -540,9 +863,24 @@ class QueryServer:
             # cancel the collect loop while its event loop is still alive
             # (otherwise the pending task leaks a "loop is closed" warning)
             self._batcher.close()
+            await self._batcher.wait_closed()
+            await self._close_background()
 
         app.on_cleanup.append(_close_batcher)
         return app
+
+    async def _close_background(self) -> None:
+        """Cancel fire-and-forget tasks and close the shared HTTP session —
+        the 'zero hung asyncio tasks after shutdown' half of the resilience
+        contract."""
+        for task in list(self._bg_tasks):
+            task.cancel()
+        if self._bg_tasks:
+            await asyncio.gather(*self._bg_tasks, return_exceptions=True)
+        self._bg_tasks.clear()
+        if self._http_session is not None and not self._http_session.closed:
+            await self._http_session.close()
+        self._http_session = None
 
     @property
     def algorithms(self) -> list[Any]:
@@ -560,6 +898,9 @@ class QueryServer:
         """Pre-compile serving programs (pow2 batch buckets etc.) so the
         first traffic burst after deploy/reload pays no XLA compiles."""
         algorithms, _, models = self._active
+        self._warmup_components(algorithms, models)
+
+    def _warmup_components(self, algorithms: list[Any], models: list[Any]) -> None:
         for algo, model in zip(algorithms, models):
             try:
                 algo.warmup_serving(model, self.config.max_batch_size)
@@ -604,7 +945,9 @@ class QueryServer:
 
     async def stop(self) -> None:
         self._batcher.close()
+        await self._batcher.wait_closed()
         self._sniffer_pool.shutdown(wait=False, cancel_futures=True)
+        await self._close_background()
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

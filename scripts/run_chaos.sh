@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Chaos gate: run the fault-injection/resilience suite (CPU-only, fast).
+# Asserts the documented degraded-mode behavior — deadline 503s, load
+# shedding, breaker trip/recovery, retry-then-succeed — under injected
+# faults. See docs/resilience.md.
+# Usage: scripts/run_chaos.sh [extra pytest args...]
+set -euo pipefail
+
+repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$repo_root"
+
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+  -p no:cacheprovider "$@"

@@ -2,9 +2,16 @@
 # Tier-1 lint gate: run the TPU-aware static analyzer over the package and
 # examples. Exits nonzero on any unsuppressed error-severity finding.
 # Usage: scripts/run_lint.sh [extra lint args...]
+#        scripts/run_lint.sh --ci   # CI entry point: lint + chaos suite
 set -euo pipefail
 
 repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$repo_root"
+
+if [[ "${1:-}" == "--ci" ]]; then
+  shift
+  python -m predictionio_tpu.analysis.cli "$@"
+  exec "$repo_root/scripts/run_chaos.sh"
+fi
 
 exec python -m predictionio_tpu.analysis.cli "$@"

@@ -1,0 +1,1080 @@
+"""Resilience layer + chaos harness tests (tier-1, CPU-only, fast).
+
+Policy units run on fake clocks/sleeps (no real waiting); the chaos tests
+inject faults with ``FaultInjector`` and assert the documented degraded
+behavior over real HTTP: retry-then-succeed, breaker trip -> 503 "storage
+unavailable" + Retry-After -> half-open recovery, deadline-exceeded 503s
+with bounded latency, bounded-queue load shedding, and zero hung asyncio
+tasks after shutdown.
+"""
+
+import asyncio
+import sqlite3
+import time
+import urllib.error
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+    FaultInjector,
+    InjectedFault,
+    ResiliencePolicy,
+    RetryBudget,
+    RetryPolicy,
+    is_transient,
+    wrap_dao,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+# ---------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_unbounded(self):
+        d = Deadline.never()
+        assert not d.bounded
+        assert d.remaining() is None
+        assert not d.expired
+        d.check()  # no raise
+        assert Deadline.after(0).remaining() is None  # <=0 disables
+        assert Deadline.after(-5).remaining() is None
+
+    def test_expiry_on_fake_clock(self):
+        clock = FakeClock()
+        d = Deadline(1.0, clock=clock)
+        assert d.remaining() == pytest.approx(1.0)
+        clock.advance(0.6)
+        assert d.remaining() == pytest.approx(0.4)
+        assert not d.expired
+        clock.advance(0.5)
+        assert d.expired
+        assert d.remaining() == 0.0
+        with pytest.raises(DeadlineExceeded):
+            d.check("unit test")
+
+    def test_clamp(self):
+        clock = FakeClock()
+        d = Deadline(2.0, clock=clock)
+        assert d.clamp(5.0) == pytest.approx(2.0)
+        assert d.clamp(0.5) == pytest.approx(0.5)
+        assert d.clamp(None) == pytest.approx(2.0)
+        assert Deadline.never().clamp(3.0) == 3.0
+        assert Deadline.never().clamp(None) is None
+
+    def test_min_of(self):
+        clock = FakeClock()
+        tight = Deadline(1.0, clock=clock)
+        loose = Deadline(9.0, clock=clock)
+        assert Deadline.min_of([loose, tight, Deadline.never()]) is tight
+        assert not Deadline.min_of([Deadline.never()]).bounded
+        assert not Deadline.min_of([]).bounded
+
+    def test_deadline_exceeded_is_not_transient(self):
+        assert not is_transient(DeadlineExceeded("x"))
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def _policy(**kw):
+    sleeps: list[float] = []
+    kw.setdefault("sleep", sleeps.append)
+    kw.setdefault("rng", lambda: 0.0)  # jitter off: deterministic backoff
+    return RetryPolicy(**kw), sleeps
+
+
+class TestRetryPolicy:
+    def test_retry_then_succeed_with_backoff(self):
+        policy, sleeps = _policy(
+            max_attempts=4, backoff_base_s=0.05, backoff_multiplier=2.0
+        )
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise InjectedFault("transient")
+            return 42
+
+        assert policy.call(flaky) == 42
+        assert calls["n"] == 3
+        assert sleeps == [pytest.approx(0.05), pytest.approx(0.1)]
+
+    def test_non_transient_not_retried(self):
+        policy, sleeps = _policy(max_attempts=5)
+        calls = {"n": 0}
+
+        def bad():
+            calls["n"] += 1
+            raise ValueError("client error")
+
+        with pytest.raises(ValueError):
+            policy.call(bad)
+        assert calls["n"] == 1 and sleeps == []
+
+    def test_exhaustion_raises_original_error(self):
+        policy, _ = _policy(max_attempts=3)
+
+        def always():
+            raise InjectedFault("still down")
+
+        with pytest.raises(InjectedFault):
+            policy.call(always)
+
+    def test_jitter_reduces_backoff(self):
+        policy, sleeps = _policy(max_attempts=2, jitter=0.5, rng=lambda: 1.0)
+        with pytest.raises(InjectedFault):
+            policy.call(lambda: (_ for _ in ()).throw(InjectedFault("x")))
+        # full-jitter draw of 1.0 halves the raw backoff (1 - 0.5*1.0)
+        assert sleeps == [pytest.approx(policy.backoff_base_s * 0.5)]
+
+    def test_budget_caps_retries(self):
+        budget = RetryBudget(ratio=0.0, max_tokens=1.0, min_tokens=1.0)
+        policy, _ = _policy(max_attempts=10, budget=budget)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise InjectedFault("down")
+
+        with pytest.raises(InjectedFault):
+            policy.call(always)
+        # 1 pre-funded token = 1 retry, then the budget sheds the rest
+        assert calls["n"] == 2
+        assert budget.tokens == 0.0
+
+    def test_budget_refills_from_first_attempts(self):
+        budget = RetryBudget(ratio=0.5, max_tokens=10.0, min_tokens=0.0)
+        policy, _ = _policy(max_attempts=2, budget=budget)
+        for _ in range(4):  # 4 successful calls deposit 2.0 tokens
+            policy.call(lambda: "ok")
+        assert budget.tokens == pytest.approx(2.0)
+
+    def test_deadline_stops_backoff(self):
+        clock = FakeClock()
+        policy, sleeps = _policy(max_attempts=10, backoff_base_s=5.0)
+        d = Deadline(1.0, clock=clock)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise InjectedFault("down")
+
+        # first backoff (5s) alone would blow the 1s deadline: no retry,
+        # the underlying error surfaces
+        with pytest.raises(InjectedFault):
+            policy.call(always, deadline=d)
+        assert calls["n"] == 1 and sleeps == []
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def make(self, **kw):
+        clock = FakeClock()
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("recovery_timeout_s", 10.0)
+        return CircuitBreaker(name="t", clock=clock, **kw), clock
+
+    def test_trips_after_consecutive_failures(self):
+        b, _ = self.make()
+        for _ in range(2):
+            b.allow()
+            b.record_failure()
+        assert b.state == CLOSED
+        b.allow()
+        b.record_failure()
+        assert b.state == OPEN
+        with pytest.raises(CircuitOpenError) as ei:
+            b.allow()
+        assert 0 < ei.value.retry_after_s <= 10.0
+
+    def test_success_resets_failure_count(self):
+        b, _ = self.make()
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == CLOSED  # never 3 consecutive
+
+    def test_half_open_probe_then_close(self):
+        b, clock = self.make()
+        for _ in range(3):
+            b.record_failure()
+        assert b.state == OPEN
+        clock.advance(10.1)
+        assert b.state == HALF_OPEN
+        b.allow()  # probe admitted
+        with pytest.raises(CircuitOpenError):
+            b.allow()  # concurrent second probe rejected
+        b.record_success()
+        assert b.state == CLOSED
+        b.allow()  # traffic flows again
+
+    def test_half_open_probe_failure_reopens(self):
+        b, clock = self.make()
+        for _ in range(3):
+            b.record_failure()
+        clock.advance(10.1)
+        b.allow()
+        b.record_failure()
+        assert b.state == OPEN
+        with pytest.raises(CircuitOpenError):
+            b.allow()
+        assert b.trips == 2
+
+    def test_call_and_snapshot(self):
+        b, _ = self.make(failure_threshold=1)
+        assert b.call(lambda: "ok") == "ok"
+        with pytest.raises(InjectedFault):
+            b.call(lambda: (_ for _ in ()).throw(InjectedFault("x")))
+        snap = b.snapshot()
+        assert snap["state"] == OPEN and snap["trips"] == 1
+        b.reset()
+        assert b.state == CLOSED
+
+    def test_circuit_open_error_is_not_transient(self):
+        assert not is_transient(CircuitOpenError("t", 1.0))
+
+    def test_release_probe_frees_wedged_half_open_slot(self):
+        b, clock = self.make()
+        for _ in range(3):
+            b.record_failure()
+        clock.advance(10.1)
+        b.allow()  # probe slot claimed...
+        with pytest.raises(CircuitOpenError):
+            b.allow()
+        b.release_probe()  # ...but the call was shed before any record
+        b.allow()  # slot is free again: the circuit is not wedged
+        b.record_success()
+        assert b.state == CLOSED
+
+    def test_release_probe_noop_outside_half_open(self):
+        b, _ = self.make()
+        b.release_probe()  # closed: harmless
+        assert b.state == CLOSED
+        for _ in range(3):
+            b.record_failure()
+        b.release_probe()  # open: harmless
+        with pytest.raises(CircuitOpenError):
+            b.allow()
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+
+class _Dummy:
+    attr = "plain"
+
+    def __init__(self):
+        self.hits = 0
+
+    def work(self, x):
+        self.hits += 1
+        return x * 2
+
+    def other(self):
+        return "other"
+
+
+class TestFaultInjector:
+    def test_fail_count_then_passthrough(self):
+        inj = FaultInjector(_Dummy())
+        inj.inject("work", fail_count=2)
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                inj.work(1)
+        assert inj.work(3) == 6
+        assert inj.faults == 2 and inj.calls == 3
+        assert inj.hits == 1  # only the passing call reached the target
+
+    def test_method_filter_and_plain_attrs(self):
+        inj = FaultInjector(_Dummy())
+        inj.inject("work", fail_count=10)
+        assert inj.other() == "other"  # unmatched method unaffected
+        assert inj.attr == "plain"  # non-callables pass through
+
+    def test_custom_exception_and_clear(self):
+        inj = FaultInjector(_Dummy())
+        inj.inject(exception=lambda m: RuntimeError(f"boom:{m}"), fail_count=1)
+        with pytest.raises(RuntimeError, match="boom:work"):
+            inj.work(1)
+        inj.clear()
+        assert inj.work(2) == 4
+
+    def test_latency_injection(self):
+        inj = FaultInjector(_Dummy())
+        inj.inject("work", latency_s=0.05)
+        t0 = time.perf_counter()
+        assert inj.work(1) == 2
+        assert time.perf_counter() - t0 >= 0.045
+
+    def test_fail_rate(self):
+        inj = FaultInjector(_Dummy(), rng=lambda: 0.0)  # rng 0 < rate: always
+        inj.inject("work", fail_rate=0.5)
+        with pytest.raises(InjectedFault):
+            inj.work(1)
+
+
+# ---------------------------------------------------------------------------
+# ResiliencePolicy composition + DAO wrap
+# ---------------------------------------------------------------------------
+
+
+class TestResiliencePolicy:
+    def test_breaker_open_stops_retries_instantly(self):
+        breaker = CircuitBreaker(name="t", failure_threshold=2, clock=FakeClock())
+        policy = ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=10, sleep=lambda s: None),
+            breaker=breaker,
+        )
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise InjectedFault("down")
+
+        # attempt 1 + attempt 2 trip the breaker; attempt 3's allow() raises
+        # CircuitOpenError which is non-transient -> loop stops at 2 calls
+        with pytest.raises(CircuitOpenError):
+            policy.call(always)
+        assert calls["n"] == 2
+        assert breaker.state == OPEN
+
+    def test_poison_request_does_not_trip_breaker(self):
+        """A request-specific permanent error (deterministic reject) must
+        not open the circuit and 503 every other client."""
+        breaker = CircuitBreaker(name="t", failure_threshold=2, clock=FakeClock())
+        policy = ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=3, sleep=lambda s: None),
+            breaker=breaker,
+        )
+        for _ in range(10):
+            with pytest.raises(ValueError):
+                policy.call(lambda: (_ for _ in ()).throw(ValueError("bad row")))
+        assert breaker.state == CLOSED
+        assert policy.call(lambda: "ok") == "ok"
+
+    def test_non_transient_probe_failure_frees_half_open_slot(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker(
+            name="t", failure_threshold=1, recovery_timeout_s=1.0, clock=clock
+        )
+        policy = ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=1, sleep=lambda s: None),
+            breaker=breaker,
+        )
+        with pytest.raises(InjectedFault):
+            policy.call(lambda: (_ for _ in ()).throw(InjectedFault("down")))
+        assert breaker.state == OPEN
+        clock.advance(1.1)
+        # half-open probe fails with a POISON error: slot freed, circuit
+        # neither closed (no success) nor re-tripped (not a dep failure)
+        with pytest.raises(ValueError):
+            policy.call(lambda: (_ for _ in ()).throw(ValueError("bad")))
+        assert policy.call(lambda: "ok") == "ok"  # next probe admitted
+        assert breaker.state == CLOSED
+
+    def test_wrap_dao_applies_policy_and_exempts_close(self):
+        target = _Dummy()
+        target.close = lambda: (_ for _ in ()).throw(InjectedFault("x"))
+        inj = FaultInjector(target)
+        inj.inject("work", fail_count=1)
+        dao = wrap_dao(
+            inj, ResiliencePolicy(retry=RetryPolicy(max_attempts=3, sleep=lambda s: None))
+        )
+        assert dao.work(2) == 4  # one injected failure, then the retry lands
+        with pytest.raises(InjectedFault):
+            dao.close()  # exempt: no retry wrapper
+
+
+# ---------------------------------------------------------------------------
+# Event server chaos: fault-injected storage on the POST path
+# ---------------------------------------------------------------------------
+
+
+def _make_event_server(**cfg_kw):
+    from predictionio_tpu.data.api.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from predictionio_tpu.data.storage.base import AccessKey, App
+    from predictionio_tpu.data.storage.registry import Storage
+
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    app_id = storage.get_meta_data_apps().insert(App(0, "chaosapp"))
+    key = storage.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    cfg_kw.setdefault("storage_retries", 3)
+    cfg_kw.setdefault("storage_backoff_s", 0.001)
+    cfg_kw.setdefault("breaker_threshold", 3)
+    cfg_kw.setdefault("breaker_recovery_s", 0.2)
+    server = EventServer(storage=storage, config=EventServerConfig(**cfg_kw))
+    injector = FaultInjector(server.levents)
+    server.levents = injector
+    return server, injector, key
+
+
+EVENT = {"event": "rate", "entityType": "user", "entityId": "u1"}
+
+
+class TestEventServerChaos:
+    def _run(self, body):
+        async def outer():
+            server, injector, key = _make_event_server()
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                await body(client, server, injector, key)
+            finally:
+                await client.close()
+            # zero hung asyncio tasks after shutdown
+            leftover = [
+                t
+                for t in asyncio.all_tasks()
+                if t is not asyncio.current_task() and not t.done()
+            ]
+            assert leftover == [], f"hung tasks after shutdown: {leftover}"
+
+        asyncio.run(outer())
+
+    def test_transient_insert_fault_retries_then_succeeds(self):
+        async def body(client, server, injector, key):
+            injector.inject("insert", fail_count=1)
+            resp = await client.post(f"/events.json?accessKey={key}", json=EVENT)
+            assert resp.status == 201
+            assert injector.faults == 1  # the fault fired and was absorbed
+
+        self._run(body)
+
+    def test_persistent_faults_trip_breaker_to_503_with_retry_after(self):
+        async def body(client, server, injector, key):
+            injector.inject("insert", fail_count=1000)
+            first = await client.post(f"/events.json?accessKey={key}", json=EVENT)
+            # 3 in-request attempts = breaker_threshold: tripped already
+            # (503 if the open circuit cut the retry loop, else 500)
+            assert first.status in (500, 503)
+            assert server.storage_policy.breaker.state == OPEN
+            shed = await client.post(f"/events.json?accessKey={key}", json=EVENT)
+            assert shed.status == 503
+            assert "Retry-After" in shed.headers
+            assert "storage unavailable" in (await shed.json())["message"]
+            # the shed request never reached storage (breaker cut it off at
+            # the auth lookup, before any insert attempt)
+            faults_at_shed = injector.faults
+            again = await client.post(f"/events.json?accessKey={key}", json=EVENT)
+            assert again.status == 503
+            assert injector.faults == faults_at_shed
+
+            # /healthz reports not-ready so a load balancer can drain us
+            hz = await client.get("/healthz")
+            assert hz.status == 503
+            data = await hz.json()
+            assert data["ready"] is False
+            assert data["breaker"]["state"] == OPEN
+
+        self._run(body)
+
+    def test_breaker_recovers_half_open_to_closed_when_faults_stop(self):
+        async def body(client, server, injector, key):
+            injector.inject("insert", fail_count=1000)
+            await client.post(f"/events.json?accessKey={key}", json=EVENT)
+            assert server.storage_policy.breaker.state == OPEN
+            injector.clear()  # faults stop
+            await asyncio.sleep(0.25)  # > breaker_recovery_s
+            ok = await client.post(f"/events.json?accessKey={key}", json=EVENT)
+            assert ok.status == 201  # half-open probe succeeded
+            assert server.storage_policy.breaker.state == CLOSED
+            hz = await client.get("/healthz")
+            assert hz.status == 200
+            assert (await hz.json())["ready"] is True
+
+        self._run(body)
+
+    def test_batch_path_reports_storage_unavailable_per_event(self):
+        async def body(client, server, injector, key):
+            injector.inject("insert", fail_count=1000)
+            # enough singles to trip the breaker
+            await client.post(f"/events.json?accessKey={key}", json=EVENT)
+            assert server.storage_policy.breaker.state == OPEN
+            # batch requests while the breaker is open: auth itself is
+            # breaker-gated, so the middleware answers 503 for the request
+            resp = await client.post(
+                f"/batch/events.json?accessKey={key}", json=[EVENT, EVENT]
+            )
+            assert resp.status == 503
+
+        self._run(body)
+
+    def test_reads_survive_transient_faults(self):
+        async def body(client, server, injector, key):
+            ok = await client.post(f"/events.json?accessKey={key}", json=EVENT)
+            assert ok.status == 201
+            injector.inject("find", fail_count=1)
+            resp = await client.get(f"/events.json?accessKey={key}")
+            assert resp.status == 200  # retried transparently
+            assert len(await resp.json()) == 1
+
+        self._run(body)
+
+    def test_storage_failure_on_reads_is_500_not_400(self):
+        async def body(client, server, injector, key):
+            # exhaust the retries without tripping the breaker: the outage
+            # must surface as a server-side 500, never a client-error 400
+            injector.inject("find", fail_count=3)
+            resp = await client.get(f"/events.json?accessKey={key}")
+            assert resp.status == 500
+            server.storage_policy.breaker.reset()
+
+        self._run(body)
+
+
+# ---------------------------------------------------------------------------
+# Query server chaos: deadlines, watchdog, shedding, breaker, reload
+# ---------------------------------------------------------------------------
+
+
+class _JsonQuery:
+    """sample_engine Query with the /queries.json codec contract."""
+
+    def __init__(self, qid: int):
+        self.qid = qid
+
+    @classmethod
+    def from_json_dict(cls, d):
+        return cls(qid=int(d["qid"]))
+
+
+def _make_query_server(**cfg_kw):
+    from predictionio_tpu.controller import Engine
+    from predictionio_tpu.workflow.create_server import QueryServer, ServerConfig
+    from predictionio_tpu.workflow.engine_loader import EngineManifest
+    from tests.sample_engine import (
+        Algo0,
+        DataSource0,
+        Model0,
+        Preparator0,
+        Serving0,
+    )
+    from tests.test_engine import params
+
+    engine = Engine(
+        {"ds": DataSource0},
+        {"prep": Preparator0},
+        {"a": Algo0},
+        {"s": Serving0},
+        query_class=_JsonQuery,
+    )
+    ep = params()
+    manifest = EngineManifest(
+        engine_id="resil",
+        version="1",
+        variant="engine.json",
+        engine_factory="tests.test_engine.make_engine",
+    )
+    cfg_kw.setdefault("request_timeout_s", 0.5)
+    cfg_kw.setdefault("shed_retry_after_s", 1.0)
+    server = QueryServer(
+        engine=engine,
+        engine_params=ep,
+        models=[Model0(3, 1, 2)],
+        manifest=manifest,
+        instance_id="inst-resil",
+        config=ServerConfig(**cfg_kw),
+    )
+    return server
+
+
+class TestQueryServerChaos:
+    def _run(self, body, **cfg_kw):
+        async def outer():
+            server = _make_query_server(**cfg_kw)
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                await body(client, server)
+            finally:
+                await client.close()
+            leftover = [
+                t
+                for t in asyncio.all_tasks()
+                if t is not asyncio.current_task() and not t.done()
+            ]
+            assert leftover == [], f"hung tasks after shutdown: {leftover}"
+
+        asyncio.run(outer())
+
+    def test_healthy_query_roundtrip(self):
+        async def body(client, server):
+            resp = await client.post("/queries.json", json={"qid": 7})
+            assert resp.status == 200
+            assert (await resp.json())["qid"] == 7
+            hz = await client.get("/healthz")
+            assert hz.status == 200
+            data = await hz.json()
+            assert data["ready"] is True
+            assert data["breakers"]["dispatch"]["state"] == CLOSED
+
+        self._run(body)
+
+    def test_hanging_predict_fails_with_bounded_latency(self, monkeypatch):
+        """A predict call that hangs past the request deadline answers 503
+        within ~the deadline — and the NEXT request is served healthily
+        (the watchdog walked away from the stuck thread)."""
+        from tests.sample_engine import Algo0, Prediction
+
+        state = {"hang": True}
+        real_predict = Algo0.predict
+
+        def flaky_predict(self, model, query):
+            if state["hang"]:
+                time.sleep(1.5)  # far past the 0.5s request deadline
+            return real_predict(self, model, query)
+
+        monkeypatch.setattr(Algo0, "predict", flaky_predict)
+
+        async def body(client, server):
+            t0 = time.perf_counter()
+            resp = await client.post("/queries.json", json={"qid": 1})
+            elapsed = time.perf_counter() - t0
+            assert resp.status == 503
+            assert "deadline" in (await resp.json())["message"]
+            assert elapsed < 1.2  # bounded: did NOT wait out the 1.5s hang
+            assert server._batcher.watchdog_trips >= 1
+            # healthy traffic resumes immediately on the fresh pool
+            state["hang"] = False
+            ok = await client.post("/queries.json", json={"qid": 2})
+            assert ok.status == 200
+            assert (await ok.json())["qid"] == 2
+
+        self._run(body, breaker_threshold=100)
+
+    def test_hanging_dispatch_fails_with_bounded_latency(self, monkeypatch):
+        """Same bound when the hang is in the dispatch phase (the single
+        dispatch thread — the head-of-line-blocking case)."""
+        from tests.sample_engine import Algo0
+
+        state = {"hang": True}
+
+        def slow_dispatch(self, model, queries):
+            if state["hang"]:
+                time.sleep(1.5)
+            return None  # fall back to the sync predict_batch path
+
+        monkeypatch.setattr(Algo0, "predict_batch_dispatch", slow_dispatch)
+
+        async def body(client, server):
+            t0 = time.perf_counter()
+            resp = await client.post("/queries.json", json={"qid": 1})
+            assert resp.status == 503
+            assert time.perf_counter() - t0 < 1.2
+            state["hang"] = False
+            ok = await client.post("/queries.json", json={"qid": 2})
+            assert ok.status == 200
+
+        self._run(body, breaker_threshold=100)
+
+    def test_watchdog_trips_open_dispatch_breaker_then_recover(self, monkeypatch):
+        from tests.sample_engine import Algo0, Prediction
+
+        state = {"hang": True}
+        real_predict = Algo0.predict
+
+        def flaky_predict(self, model, query):
+            if state["hang"]:
+                time.sleep(1.0)
+            return real_predict(self, model, query)
+
+        monkeypatch.setattr(Algo0, "predict", flaky_predict)
+
+        async def body(client, server):
+            first = await client.post("/queries.json", json={"qid": 1})
+            assert first.status == 503  # watchdog trip = breaker threshold 1
+            assert server.dispatch_breaker.state == OPEN
+            # while open: instant shed with Retry-After, nothing dispatched
+            dispatched = server._batcher.batches_dispatched
+            shed = await client.post("/queries.json", json={"qid": 2})
+            assert shed.status == 503
+            assert "Retry-After" in shed.headers
+            assert server._batcher.batches_dispatched == dispatched
+            hz = await client.get("/healthz")
+            assert hz.status == 503
+            # faults stop; after recovery the half-open probe closes it
+            state["hang"] = False
+            await asyncio.sleep(0.35)
+            ok = await client.post("/queries.json", json={"qid": 3})
+            assert ok.status == 200
+            assert server.dispatch_breaker.state == CLOSED
+            assert (await client.get("/healthz")).status == 200
+
+        self._run(
+            body,
+            request_timeout_s=0.3,
+            breaker_threshold=1,
+            breaker_recovery_s=0.3,
+        )
+
+    def test_burst_over_high_water_sheds_with_retry_after(self, monkeypatch):
+        from tests.sample_engine import Algo0
+
+        real_predict = Algo0.predict
+
+        def slow_predict(self, model, query):
+            time.sleep(0.1)
+            return real_predict(self, model, query)
+
+        monkeypatch.setattr(Algo0, "predict", slow_predict)
+
+        async def body(client, server):
+            # the 100ms flush window keeps the collect loop asleep while the
+            # burst lands, so the queue visibly exceeds high water
+            resps = await asyncio.gather(
+                *(client.post("/queries.json", json={"qid": i}) for i in range(8))
+            )
+            statuses = sorted(r.status for r in resps)
+            assert set(statuses) <= {200, 503}
+            shed = [r for r in resps if r.status == 503]
+            assert shed, f"burst was not shed: {statuses}"
+            for r in shed:
+                assert "Retry-After" in r.headers
+            assert server._batcher.shed_count >= len(shed)
+            # after the burst drains, normal service
+            ok = await client.post("/queries.json", json={"qid": 99})
+            assert ok.status == 200
+
+        self._run(
+            body,
+            queue_high_water=2,
+            batch_window_ms=100.0,
+            request_timeout_s=5.0,
+        )
+
+    def test_oversized_payload_413(self):
+        async def body(client, server):
+            resp = await client.post(
+                "/queries.json",
+                data=b'{"qid": 1, "pad": "' + b"x" * 300 + b'"}',
+                headers={"Content-Type": "application/json"},
+            )
+            assert resp.status == 413
+            assert "too large" in (await resp.json())["message"]
+
+        self._run(body, max_payload_bytes=100)
+
+    def test_submit_after_close_fails_fast(self):
+        async def body(client, server):
+            server._batcher.close()
+            with pytest.raises(RuntimeError, match="shutting down"):
+                await server._batcher.submit({"qid": 1})
+            # the collect loop was NOT restarted against shut-down pools
+            assert server._batcher._task is None
+            resp = await client.post("/queries.json", json={"qid": 1})
+            assert resp.status == 503
+
+        self._run(body)
+
+    def test_expired_in_queue_rejected_without_dispatch(self):
+        async def body(client, server):
+            clock = FakeClock()
+            already_dead = Deadline(0.0, clock=clock)
+            clock.advance(1.0)
+            with pytest.raises(DeadlineExceeded):
+                await server._batcher.submit({"qid": 1}, already_dead)
+            assert server._batcher.batches_dispatched == 0
+
+        self._run(body)
+
+
+class TestReloadAtomicity:
+    def test_concurrent_reloads_serialize_and_commit_once(self, monkeypatch):
+        import datetime as dt
+
+        from predictionio_tpu.data.storage.base import (
+            EngineInstance,
+            EngineInstanceStatus,
+        )
+        from predictionio_tpu.data.storage.registry import Storage
+        from predictionio_tpu.workflow import create_server as cs
+        from predictionio_tpu.workflow.create_server import QueryServer, ServerConfig
+        from predictionio_tpu.workflow.engine_loader import EngineManifest
+        from tests.test_engine import make_engine, params
+
+        storage = Storage(
+            env={
+                "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+            }
+        )
+        now = dt.datetime.now(tz=dt.timezone.utc)
+        latest_id = storage.get_meta_data_engine_instances().insert(
+            EngineInstance(
+                id="",
+                status=EngineInstanceStatus.COMPLETED,
+                start_time=now,
+                end_time=now,
+                engine_id="resil",
+                engine_version="1",
+                engine_variant="engine.json",
+                engine_factory="tests.test_engine.make_engine",
+                algorithms_params='[{"name": "a", "params": {"id": 3}}]',
+            )
+        )
+        concurrency = {"n": 0, "max": 0, "loads": 0}
+
+        def slow_load(engine, engine_params, instance_id, storage=None, **kw):
+            concurrency["n"] += 1
+            concurrency["max"] = max(concurrency["max"], concurrency["n"])
+            concurrency["loads"] += 1
+            time.sleep(0.1)
+            concurrency["n"] -= 1
+            return [object()]
+
+        monkeypatch.setattr(cs, "load_models_for_instance", slow_load)
+        engine = make_engine()
+        server = QueryServer(
+            engine=engine,
+            engine_params=params(),
+            models=[object()],
+            manifest=EngineManifest(
+                engine_id="resil",
+                version="1",
+                variant="engine.json",
+                engine_factory="tests.test_engine.make_engine",
+            ),
+            instance_id="old-instance",
+            storage=storage,
+            config=ServerConfig(),
+        )
+
+        async def body():
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                r1, r2 = await asyncio.gather(
+                    client.post("/reload"), client.post("/reload")
+                )
+                assert r1.status == 200 and r2.status == 200
+                assert (await r1.json())["instanceId"] == latest_id
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+        # both reloads ran, but never concurrently: the lock serialized the
+        # load -> warmup -> commit sections
+        assert concurrency["loads"] == 2
+        assert concurrency["max"] == 1
+        assert server.instance_id == latest_id
+
+
+# ---------------------------------------------------------------------------
+# Storage backend retries
+# ---------------------------------------------------------------------------
+
+
+class TestBackendRetries:
+    def test_s3_retries_connection_failures(self, monkeypatch):
+        from predictionio_tpu.data.storage.s3 import S3Models
+
+        calls = {"n": 0}
+
+        class _Resp:
+            status = 200
+
+            def read(self):
+                return b"model-bytes"
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def flaky_urlopen(req, timeout=None, context=None):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise urllib.error.URLError("connection refused")
+            return _Resp()
+
+        monkeypatch.setattr("urllib.request.urlopen", flaky_urlopen)
+        models = S3Models(
+            bucket="b",
+            endpoint="http://s3.test",
+            access_key="k",
+            secret_key="s",
+            retries=3,
+            retry_backoff_s=0.001,
+        )
+        m = models.get("m1")
+        assert m is not None and m.models == b"model-bytes"
+        assert calls["n"] == 3
+
+    def test_s3_gives_up_after_max_attempts(self, monkeypatch):
+        from predictionio_tpu.data.storage.s3 import S3Error, S3Models
+
+        def dead_urlopen(req, timeout=None, context=None):
+            raise urllib.error.URLError("still down")
+
+        monkeypatch.setattr("urllib.request.urlopen", dead_urlopen)
+        models = S3Models(
+            bucket="b",
+            endpoint="http://s3.test",
+            retries=2,
+            retry_backoff_s=0.001,
+        )
+        with pytest.raises(S3Error):
+            models.get("m1")
+
+    def test_hdfs_retries_5xx(self, monkeypatch):
+        import io
+
+        from predictionio_tpu.data.storage.hdfs import WebHDFSModels
+
+        calls = {"n": 0}
+
+        class _Resp:
+            status = 200
+
+            def read(self):
+                return b"blob"
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def flaky_urlopen(req, timeout=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise urllib.error.HTTPError(
+                    req.full_url, 503, "busy", {}, io.BytesIO(b"")
+                )
+            return _Resp()
+
+        monkeypatch.setattr("urllib.request.urlopen", flaky_urlopen)
+        models = WebHDFSModels(
+            "http://nn:9870", retries=3, retry_backoff_s=0.001
+        )
+        m = models.get("m1")
+        assert m is not None and m.models == b"blob"
+        assert calls["n"] == 2
+
+    def test_localfs_retries_transient_os_errors(self, monkeypatch, tmp_path):
+        import os as _os
+
+        from predictionio_tpu.data.storage.base import Model
+        from predictionio_tpu.data.storage.localfs import LocalFSModels
+
+        models = LocalFSModels(str(tmp_path), retries=3)
+        models._retry.backoff_base_s = 0.001
+        calls = {"n": 0}
+        real_replace = _os.replace
+
+        def flaky_replace(src, dst):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("nfs hiccup")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(_os, "replace", flaky_replace)
+        models.insert(Model("m1", b"bytes"))
+        assert calls["n"] == 2
+        assert models.get("m1").models == b"bytes"
+
+    def test_sql_read_retries_on_locked_db_but_write_does_not(self):
+        from predictionio_tpu.data.storage.sql import SQLStorageClient
+
+        client = SQLStorageClient(
+            {
+                "TYPE": "sql",
+                "MODULE": "sqlite3",
+                "DIALECT": "sqlite",
+                "CONNECT_ARGS": {"database": ":memory:"},
+                "RETRIES": 3,
+                "RETRY_BACKOFF_S": 0.001,
+            }
+        )
+        assert client._is_transient_db_error(
+            sqlite3.OperationalError("database is locked")
+        )
+        assert not client._is_transient_db_error(ValueError("nope"))
+        # OperationalError also covers PERMANENT errors: those must not be
+        # retried (a schema mismatch would become a reconnect storm)
+        assert not client._is_transient_db_error(
+            sqlite3.OperationalError("no such table: events")
+        )
+        inj = FaultInjector(client._conn)
+        inj.inject(
+            "cursor",
+            fail_count=1,
+            exception=lambda m: sqlite3.OperationalError("database is locked"),
+        )
+        client._conn = inj
+        # read path: retried transparently on the SAME connection (sqlite
+        # never reconnects — that would wipe a :memory: database)
+        assert client.query("SELECT 1") == [(1,)]
+        # write path: replay is ambiguous, so without RETRY_WRITES the
+        # transient error surfaces immediately
+        inj.inject(
+            "cursor",
+            fail_count=1,
+            exception=lambda m: sqlite3.OperationalError("database is locked"),
+        )
+        resets = {"n": 0}
+        client._reset_connection = lambda: resets.__setitem__("n", resets["n"] + 1)
+        with pytest.raises(sqlite3.OperationalError):
+            client.execute("SELECT 1")
+        # no replay, but the dead connection IS healed for the next call
+        assert resets["n"] == 1
+
+    def test_es_transport_marks_total_failure_transient(self):
+        from predictionio_tpu.data.storage.elasticsearch import (
+            ESError,
+            _ESTransport,
+        )
+
+        t = _ESTransport(
+            ["http://127.0.0.1:9"],  # discard port: refused instantly
+            retries=2,
+            retry_backoff_s=0.001,
+        )
+        with pytest.raises(ESError) as ei:
+            t.request("GET", "/_cluster/health")
+        assert is_transient(ei.value)
