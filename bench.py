@@ -879,6 +879,12 @@ asyncio.run(main())
     tput_pass, tput_elapsed = run_load(users, throughput_concurrency)
 
     batcher = server_box["server"]._batcher
+    # snapshot the server's own metrics registry before shutdown: the
+    # BENCH_*.json perf trajectory carries the server-side latency
+    # distribution (p50/p95/p99 as /metrics reports them) and the jit
+    # recompile count, so a perf regression caused by a compile storm is
+    # visible in the evidence itself, not just in wall-clock drift
+    obs = _registry_serving_summary(server_box["server"])
     # graceful shutdown ON the server loop (stopping a loop with the
     # micro-batcher task still pending spews 'Event loop is closed' noise
     # at interpreter exit and can mask the phase's real exit status)
@@ -898,7 +904,28 @@ asyncio.run(main())
             (batcher.queries_dispatched - warm_queries)
             / max(1, batcher.batches_dispatched - warm_batches)
         ),
+        **obs,
     }
+
+
+def _registry_serving_summary(server) -> dict[str, float]:
+    """Server-side observability snapshot for the bench evidence chain:
+    request-latency percentiles from the obs registry histogram plus the
+    serving-time jit recompile count (0 on a healthy pow2-bucketed run)."""
+    try:
+        summary = server._m_latency.summary(endpoint="/queries.json")
+        server.compile_watcher.sample()  # fold in compiles since last scrape
+        recompiles = server.compile_watcher.total_misses()
+        out = {
+            "serving_metrics_recompile_count": float(recompiles),
+            "serving_metrics_count": float(summary.get("count", 0)),
+        }
+        for q in ("p50", "p95", "p99"):
+            if q in summary:
+                out[f"serving_metrics_{q}_ms"] = round(summary[q] * 1000.0, 3)
+        return out
+    except Exception as exc:  # noqa: BLE001 - obs must never sink the bench
+        return {"serving_metrics_error": str(exc)}
 
 
 # ---------------------------------------------------------------------------

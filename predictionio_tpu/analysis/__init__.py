@@ -37,6 +37,7 @@ from predictionio_tpu.analysis.core import (
 from predictionio_tpu.analysis import (  # noqa: F401  (registration side effect)
     rules_concurrency,
     rules_hostsync,
+    rules_obs,
     rules_recompile,
     rules_storage,
     rules_tracer,

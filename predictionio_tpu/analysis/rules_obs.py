@@ -1,0 +1,78 @@
+"""Observability rules for serving-path modules.
+
+``print(...)`` and bare root-logger calls (``logging.info(...)`` et al.)
+on the serving path are invisible to the telemetry layer: they bypass the
+structured ``pio.trace`` JSON log (so a grep for a trace id misses them),
+they can't be correlated with a request, and ``print`` additionally
+flushes to an unbuffered fd inside the event loop. Serving code should
+record spans (``predictionio_tpu.obs.tracing.Tracer.span``) or log
+through a named module logger / the structured trace logger
+(``predictionio_tpu.obs.tracing.get_trace_logger``).
+
+Scope is the same ``LintConfig.serving_globs`` the host-sync family uses;
+training scripts and CLIs may print freely.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Severity,
+    matches_any_glob,
+    register_checker,
+    register_rule,
+)
+
+register_rule(
+    "obs-unstructured-log",
+    "obs",
+    Severity.WARNING,
+    "print()/bare logging.* call in a serving-path module; use the "
+    "structured trace logger (obs.tracing.get_trace_logger) or a span "
+    "so the output joins the request's trace",
+)
+
+# direct root-logger methods: logging.info(...) etc. — a named logger
+# (logging.getLogger(__name__).info) is fine and NOT matched
+_ROOT_LOG_METHODS = frozenset(
+    {"debug", "info", "warning", "warn", "error", "exception", "critical", "log"}
+)
+
+
+def _unstructured_label(call: ast.Call) -> str | None:
+    func = call.func
+    if isinstance(func, ast.Name) and func.id == "print":
+        return "print()"
+    if isinstance(func, ast.Attribute) and func.attr in _ROOT_LOG_METHODS:
+        d = astutil.dotted(func)
+        if d and d == f"logging.{func.attr}":
+            return d + "()"
+    return None
+
+
+@register_checker
+def check_unstructured_log(ctx: FileContext):
+    cfg = ctx.config
+    # absolute path when available: display paths are cwd-relative and
+    # would silently miss the globs when linting from inside the package
+    if not matches_any_glob(ctx.path or ctx.display_path, cfg.serving_globs):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            label = _unstructured_label(node)
+            if label:
+                findings.append(
+                    ctx.finding(
+                        "obs-unstructured-log",
+                        node,
+                        f"{label} on the serving path is invisible to the "
+                        "telemetry layer; record a span or log via the "
+                        "structured trace logger",
+                    )
+                )
+    return findings

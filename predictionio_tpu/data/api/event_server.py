@@ -9,6 +9,8 @@ Reference parity: ``data/.../api/EventServer.scala:54-663``. Route surface:
   DELETE /events/<id>.json     -> {"message": "Found"} | 404
   POST /batch/events.json      -> per-event status array, <= 50 events
   GET  /stats.json             -> ingestion stats (requires --stats)
+  GET  /metrics                -> Prometheus text exposition (obs registry)
+  GET  /traces/recent          -> recent request spans (ring buffer)
   GET  /plugins.json           -> plugin inventory
   GET  /plugins/<type>/<name>/...  -> plugin REST surface
   POST /webhooks/<name>.json   -> JSON connector ingestion
@@ -28,8 +30,10 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import contextvars
 import dataclasses
 import logging
+import time
 from typing import Any
 
 from aiohttp import web
@@ -38,6 +42,21 @@ from predictionio_tpu.data.api.plugins import EventInfo, EventServerPluginContex
 from predictionio_tpu.data.api.stats import StatsCollector
 from predictionio_tpu.data.event import Event, parse_event_time
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.data.storage.traced import trace_dao
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tracing import (
+    TRACE_HEADER,
+    Tracer,
+    get_tracer,
+    mint_trace_id,
+    reset_trace_id,
+    set_trace_id,
+)
+from predictionio_tpu.obs.web import (
+    BreakerInstruments,
+    metrics_response,
+    traces_response,
+)
 from predictionio_tpu.resilience import (
     OPEN,
     CircuitBreaker,
@@ -109,15 +128,43 @@ class EventServer:
         storage: Storage | None = None,
         config: EventServerConfig | None = None,
         plugin_context: EventServerPluginContext | None = None,
+        tracer: Tracer | None = None,
     ):
         self.storage = storage or Storage.instance()
         self.config = config or EventServerConfig()
-        self.levents = self.storage.get_l_events()
-        self.access_keys = self.storage.get_meta_data_access_keys()
-        self.channels = self.storage.get_meta_data_channels()
-        self.stats = StatsCollector()
+        # DAO calls record `storage.<dao>.<method>` spans carrying the
+        # ingress trace id (see docs/observability.md)
+        self.tracer = tracer or get_tracer()
+        self.levents = trace_dao(
+            self.storage.get_l_events(), "l_events", tracer=self.tracer
+        )
+        self.access_keys = trace_dao(
+            self.storage.get_meta_data_access_keys(),
+            "access_keys",
+            tracer=self.tracer,
+        )
+        self.channels = trace_dao(
+            self.storage.get_meta_data_channels(), "channels", tracer=self.tracer
+        )
+        self.metrics = MetricsRegistry()
+        self.stats = StatsCollector(registry=self.metrics)
         self.plugin_context = plugin_context or EventServerPluginContext()
         self._runner: web.AppRunner | None = None
+        self._m_requests = self.metrics.counter(
+            "pio_requests_total",
+            "HTTP requests served, by route and status",
+            labelnames=("endpoint", "status"),
+        )
+        self._m_latency = self.metrics.histogram(
+            "pio_request_seconds",
+            "HTTP request wall time, by route",
+            labelnames=("endpoint",),
+        )
+        self._m_retries = self.metrics.counter(
+            "pio_storage_retries_total",
+            "storage calls replayed by the retry policy",
+        )
+        self._breaker_instruments = BreakerInstruments(self.metrics)
         # every storage touch goes through this policy: transient failures
         # retry with backoff (bounded by a per-process budget), persistent
         # failure trips the breaker and requests answer 503 "storage
@@ -127,13 +174,29 @@ class EventServer:
                 max_attempts=max(1, self.config.storage_retries),
                 backoff_base_s=self.config.storage_backoff_s,
                 budget=RetryBudget(),
+                on_retry=lambda exc: self._m_retries.inc(),
             ),
-            breaker=CircuitBreaker(
-                name="eventdata",
-                failure_threshold=self.config.breaker_threshold,
-                recovery_timeout_s=self.config.breaker_recovery_s,
+            breaker=self._breaker_instruments.watch(
+                CircuitBreaker(
+                    name="eventdata",
+                    failure_threshold=self.config.breaker_threshold,
+                    recovery_timeout_s=self.config.breaker_recovery_s,
+                )
             ),
         )
+        self.metrics.register_collector(self._breaker_instruments.collect)
+
+    @staticmethod
+    def _route_label(request: web.Request) -> str:
+        """Canonical route pattern (``/events/{event_id}.json``), not the
+        raw path — raw paths would blow up metric label cardinality."""
+        try:
+            resource = request.match_info.route.resource
+            if resource is not None and resource.canonical:
+                return resource.canonical
+        except Exception:
+            pass
+        return "unmatched"
 
     # ------------------------------------------------------------------ auth
     async def _authenticate(self, request: web.Request) -> AuthData | web.Response:
@@ -162,15 +225,23 @@ class EventServer:
         return AuthData(key.appid, channel_id, tuple(key.events))
 
     async def _run(self, fn, *args):
-        """Plain executor hop (plugin REST and other non-storage work)."""
-        return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+        """Executor hop (plugin REST and other non-storage work). The
+        caller's contextvars (trace id) are copied onto the worker thread —
+        ``run_in_executor`` alone would drop them and storage spans would
+        mint orphan trace ids instead of joining the request's trace."""
+        ctx = contextvars.copy_context()
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: ctx.run(fn, *args)
+        )
 
     async def _storage(self, fn, *args):
         """Executor hop through the storage resilience policy: transient
         failures retry with backoff, a tripped breaker raises
-        ``CircuitOpenError`` (mapped to 503 by the middleware/handlers)."""
+        ``CircuitOpenError`` (mapped to 503 by the middleware/handlers).
+        Context (trace id) rides along, same as ``_run``."""
+        ctx = contextvars.copy_context()
         return await asyncio.get_running_loop().run_in_executor(
-            None, lambda: self.storage_policy.call(fn, *args)
+            None, lambda: ctx.run(self.storage_policy.call, fn, *args)
         )
 
     @staticmethod
@@ -182,8 +253,10 @@ class EventServer:
         )
 
     def _bookkeep(self, app_id: int, status: int, event: Event) -> None:
-        if self.config.stats:
-            self.stats.bookkeeping(app_id, status, event)
+        # always-on: the registry counters behind /metrics must see every
+        # event (an increment costs nothing). The --stats flag only gates
+        # SERVING the legacy /stats.json view (see handle_stats).
+        self.stats.bookkeeping(app_id, status, event)
 
     def _insert_one(self, auth: AuthData, event: Event) -> tuple[int, dict[str, Any]]:
         """Shared blocker -> insert -> sniffer path. Runs in executor.
@@ -384,6 +457,16 @@ class EventServer:
             )
         return web.json_response(self.stats.get_stats(auth.app_id))
 
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition of the full registry (request
+        latency/status, ingestion counters, retry/breaker state). Unlike
+        ``/stats.json`` this is unauthenticated by convention — scrapers
+        don't carry app access keys — and always on."""
+        return metrics_response(self.metrics)
+
+    async def handle_traces_recent(self, request: web.Request) -> web.Response:
+        return traces_response(self.tracer, request)
+
     async def handle_plugins_json(self, request: web.Request) -> web.Response:
         return web.json_response(self.plugin_context.to_json_dict())
 
@@ -465,6 +548,39 @@ class EventServer:
     # ------------------------------------------------------------------- app
     def make_app(self) -> web.Application:
         @web.middleware
+        async def observability(request: web.Request, handler):
+            # trace ingress: accept the caller's X-Pio-Trace-Id or mint
+            # one; every span below (storage DAO calls included, via the
+            # contextvar copied into executor hops) joins this trace. The
+            # id is echoed on the response so clients can correlate.
+            trace_id = request.headers.get(TRACE_HEADER) or mint_trace_id()
+            token = set_trace_id(trace_id)
+            endpoint = self._route_label(request)
+            status = 500  # an escaping exception is a 500 to the client
+            t0 = time.perf_counter()
+            try:
+                with self.tracer.span(
+                    "http.event",
+                    kind="ingress",
+                    endpoint=endpoint,
+                    method=request.method,
+                ) as sp:
+                    resp = await handler(request)
+                    status = resp.status
+                    sp.tags["status"] = status
+            except web.HTTPException as exc:
+                status = exc.status
+                raise
+            finally:
+                reset_trace_id(token)
+                self._m_requests.inc(endpoint=endpoint, status=str(status))
+                self._m_latency.observe(
+                    time.perf_counter() - t0, endpoint=endpoint
+                )
+            resp.headers[TRACE_HEADER] = trace_id
+            return resp
+
+        @web.middleware
         async def storage_resilience(request: web.Request, handler):
             # backstop for paths without their own mapping (auth lookups,
             # single-event get/delete): an open breaker is a 503 with
@@ -474,11 +590,14 @@ class EventServer:
             except CircuitOpenError as exc:
                 return self._storage_unavailable(exc)
 
-        app = web.Application(middlewares=[storage_resilience])
+        # observability outermost: the resilience 503s must be counted too
+        app = web.Application(middlewares=[observability, storage_resilience])
         app.add_routes(
             [
                 web.get("/", self.handle_root),
                 web.get("/healthz", self.handle_healthz),
+                web.get("/metrics", self.handle_metrics),
+                web.get("/traces/recent", self.handle_traces_recent),
                 web.post("/events.json", self.handle_post_event),
                 web.get("/events.json", self.handle_get_events),
                 web.get("/events/{event_id}.json", self.handle_get_event),

@@ -1,97 +1,177 @@
-"""Event-server ingestion statistics.
+"""Event-server ingestion statistics, re-based on the metrics registry.
 
 Reference parity: ``data/.../api/Stats.scala:18-82`` + ``StatsActor.scala:35-77``
 — per-app counters keyed by HTTP status code and by
 (entityType, targetEntityType, event), kept for the current hour and for the
 server lifetime, surfaced at ``/stats.json``.
+
+The lifetime store is now a pair of :class:`~predictionio_tpu.obs.metrics`
+counters (``pio_events_ingested_total`` / ``pio_events_by_type_total``) in
+the event server's registry, so the same numbers a Prometheus scrape of
+``/metrics`` sees also back the legacy ``/stats.json`` JSON — one source
+of truth instead of two bookkeeping paths. Hourly windows are derived by
+snapshotting counter values at hour boundaries and reporting the diff;
+the response shape (``currentHour`` / ``longLive`` / ``prevHour``) is
+byte-compatible with the pre-registry collector.
 """
 
 from __future__ import annotations
 
 import datetime as _dt
 import threading
-from collections import Counter
 from typing import Any
 
 from predictionio_tpu.data.event import UTC, Event, format_event_time
+from predictionio_tpu.obs.metrics import Counter, MetricsRegistry
+
+# counters store label values as strings; None target_entity_type maps to ""
+_NONE_TARGET = ""
 
 
-class Stats:
-    """One counting window (ref Stats.scala)."""
+def _snapshot_counter(counter: Counter) -> dict[tuple[str, ...], float]:
+    return dict(counter.collect())
 
-    def __init__(self, start_time: _dt.datetime):
-        self.start_time = start_time
-        self.end_time: _dt.datetime | None = None
-        self.status_code_count: Counter[tuple[int, int]] = Counter()
-        self.ete_count: Counter[tuple[int, tuple[str, str | None, str]]] = Counter()
 
-    def cutoff(self, end_time: _dt.datetime) -> None:
-        self.end_time = end_time
-
-    def update(self, app_id: int, status_code: int, event: Event) -> None:
-        self.status_code_count[(app_id, status_code)] += 1
-        key = (event.entity_type, event.target_entity_type, event.event)
-        self.ete_count[(app_id, key)] += 1
-
-    def snapshot(self, app_id: int) -> dict[str, Any]:
-        return {
-            "startTime": format_event_time(self.start_time),
-            "endTime": format_event_time(self.end_time) if self.end_time else None,
-            "basic": [
-                {
-                    "entityType": k[0],
-                    "targetEntityType": k[1],
-                    "event": k[2],
-                    "count": v,
-                }
-                for (aid, k), v in sorted(
-                    self.ete_count.items(),
-                    key=lambda item: (item[0][0], item[0][1][0], item[0][1][1] or "", item[0][1][2]),
-                )
-                if aid == app_id
-            ],
-            "statusCode": [
-                {"status": code, "count": v}
-                for (aid, code), v in sorted(self.status_code_count.items())
-                if aid == app_id
-            ],
-        }
+def _diff(
+    current: dict[tuple[str, ...], float], base: dict[tuple[str, ...], float]
+) -> dict[tuple[str, ...], float]:
+    out: dict[tuple[str, ...], float] = {}
+    for key, value in current.items():
+        delta = value - base.get(key, 0.0)
+        if delta > 0:
+            out[key] = delta
+    return out
 
 
 class StatsCollector:
-    """Hourly + lifetime windows (ref StatsActor hour-bucketing)."""
+    """Hourly + lifetime ingestion stats on top of the metrics registry
+    (ref StatsActor hour-bucketing)."""
 
-    def __init__(self):
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry or MetricsRegistry()
+        # labels: (app_id, status)
+        self._status = self.registry.counter(
+            "pio_events_ingested_total",
+            "events accepted by the collection API, by app and HTTP status",
+            labelnames=("app_id", "status"),
+        )
+        # labels: (app_id, entity_type, target_entity_type, event)
+        self._ete = self.registry.counter(
+            "pio_events_by_type_total",
+            "events accepted by the collection API, by app and "
+            "(entityType, targetEntityType, event)",
+            labelnames=("app_id", "entity_type", "target_entity_type", "event"),
+        )
         now = _dt.datetime.now(tz=UTC)
         self._lock = threading.Lock()
-        self.long_live = Stats(now)
-        self.hourly = Stats(self._floor_hour(now))
-        self.prev_hourly: Stats | None = None
+        self._start_time = now
+        self._hour_start = self._floor_hour(now)
+        # counter values at the start of the current hourly window
+        self._hour_base_status: dict[tuple[str, ...], float] = {}
+        self._hour_base_ete: dict[tuple[str, ...], float] = {}
+        # (start, end, status_diff, ete_diff) of the completed previous hour
+        self._prev_hour: (
+            tuple[
+                _dt.datetime,
+                _dt.datetime,
+                dict[tuple[str, ...], float],
+                dict[tuple[str, ...], float],
+            ]
+            | None
+        ) = None
 
     @staticmethod
     def _floor_hour(t: _dt.datetime) -> _dt.datetime:
         return t.replace(minute=0, second=0, microsecond=0)
 
     def _roll(self, now: _dt.datetime) -> None:
+        """Close the hourly window when the wall clock crosses an hour
+        boundary: the finished window becomes ``prevHour`` (as a diff of
+        counter snapshots) and the new window re-bases."""
         hour = self._floor_hour(now)
-        if hour > self.hourly.start_time:
-            self.hourly.cutoff(hour)
-            self.prev_hourly = self.hourly
-            self.hourly = Stats(hour)
+        if hour <= self._hour_start:
+            return
+        status_now = _snapshot_counter(self._status)
+        ete_now = _snapshot_counter(self._ete)
+        self._prev_hour = (
+            self._hour_start,
+            hour,
+            _diff(status_now, self._hour_base_status),
+            _diff(ete_now, self._hour_base_ete),
+        )
+        self._hour_base_status = status_now
+        self._hour_base_ete = ete_now
+        self._hour_start = hour
 
     def bookkeeping(self, app_id: int, status_code: int, event: Event) -> None:
+        # both increments happen under the collector lock so an hour-roll
+        # snapshot can never observe one counter updated and not the other
+        # (statusCode vs basic totals must always agree per window)
         with self._lock:
             self._roll(_dt.datetime.now(tz=UTC))
-            self.long_live.update(app_id, status_code, event)
-            self.hourly.update(app_id, status_code, event)
+            self._status.inc(app_id=str(app_id), status=str(status_code))
+            self._ete.inc(
+                app_id=str(app_id),
+                entity_type=event.entity_type,
+                target_entity_type=event.target_entity_type or _NONE_TARGET,
+                event=event.event,
+            )
+
+    @staticmethod
+    def _window_json(
+        app_id: int,
+        start: _dt.datetime,
+        end: _dt.datetime | None,
+        status: dict[tuple[str, ...], float],
+        ete: dict[tuple[str, ...], float],
+    ) -> dict[str, Any]:
+        aid = str(app_id)
+        basic = [
+            {
+                "entityType": k[1],
+                "targetEntityType": k[2] if k[2] != _NONE_TARGET else None,
+                "event": k[3],
+                "count": int(v),
+            }
+            for k, v in sorted(
+                ete.items(), key=lambda item: (item[0][1], item[0][2], item[0][3])
+            )
+            if k[0] == aid
+        ]
+        status_codes = [
+            {"status": int(k[1]), "count": int(v)}
+            for k, v in sorted(
+                status.items(), key=lambda item: int(item[0][1])
+            )
+            if k[0] == aid
+        ]
+        return {
+            "startTime": format_event_time(start),
+            "endTime": format_event_time(end) if end else None,
+            "basic": basic,
+            "statusCode": status_codes,
+        }
 
     def get_stats(self, app_id: int) -> dict[str, Any]:
         with self._lock:
             self._roll(_dt.datetime.now(tz=UTC))
+            status_now = _snapshot_counter(self._status)
+            ete_now = _snapshot_counter(self._ete)
             out = {
-                "currentHour": self.hourly.snapshot(app_id),
-                "longLive": self.long_live.snapshot(app_id),
+                "currentHour": self._window_json(
+                    app_id,
+                    self._hour_start,
+                    None,
+                    _diff(status_now, self._hour_base_status),
+                    _diff(ete_now, self._hour_base_ete),
+                ),
+                "longLive": self._window_json(
+                    app_id, self._start_time, None, status_now, ete_now
+                ),
             }
-            if self.prev_hourly is not None:
-                out["prevHour"] = self.prev_hourly.snapshot(app_id)
+            if self._prev_hour is not None:
+                start, end, status_diff, ete_diff = self._prev_hour
+                out["prevHour"] = self._window_json(
+                    app_id, start, end, status_diff, ete_diff
+                )
             return out

@@ -1,0 +1,64 @@
+"""Observability layer: metrics registry, request tracing, XLA profiling.
+
+One vocabulary for everything the serving/storage/training stack needs to
+be *operable* at fleet scale (see ``docs/observability.md``):
+
+- :mod:`predictionio_tpu.obs.metrics` — lock-cheap counters/gauges/
+  fixed-bucket histograms with p50/p95/p99 extraction, exported in
+  Prometheus text format from ``/metrics`` on both servers.
+- :mod:`predictionio_tpu.obs.tracing` — request-scoped trace ids
+  (minted or accepted via ``X-Pio-Trace-Id``) propagated through the
+  micro-batcher, engine dispatch, and storage DAO calls; spans land in a
+  ring buffer (``/traces/recent``) and as JSON lines on ``pio.trace``.
+- :mod:`predictionio_tpu.obs.jaxprof` — jit cache-miss accounting
+  (recompile storms become a gauge + warning), XLA compile event taps,
+  and ``block_until_ready`` stall accounting.
+
+``metrics`` and ``tracing`` are stdlib-only; ``jaxprof`` imports jax
+lazily — so the event server, ``pio top``, and the lint CLI can use this
+package without dragging in an accelerator runtime.
+"""
+
+from predictionio_tpu.obs.jaxprof import (
+    CompileWatcher,
+    install_jax_monitoring,
+    timed_block_until_ready,
+)
+from predictionio_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from predictionio_tpu.obs.tracing import (
+    TRACE_HEADER,
+    Span,
+    Tracer,
+    current_trace_id,
+    get_trace_logger,
+    get_tracer,
+    mint_trace_id,
+    reset_trace_id,
+    set_trace_id,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "TRACE_HEADER",
+    "CompileWatcher",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "Tracer",
+    "current_trace_id",
+    "get_trace_logger",
+    "get_tracer",
+    "install_jax_monitoring",
+    "mint_trace_id",
+    "reset_trace_id",
+    "set_trace_id",
+    "timed_block_until_ready",
+]

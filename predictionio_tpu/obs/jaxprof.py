@@ -1,0 +1,267 @@
+"""JAX compile/dispatch profiling hooks.
+
+TPU serving systems die of invisible compiles: a ragged request shape
+slips past the pow2 buckets, every arrival compiles a fresh XLA program
+(a full round-trip on a tunneled chip), and the operator sees only a p99
+cliff. This module makes that failure mode a first-class signal:
+
+- :class:`CompileWatcher` tracks the jit cache size of every compiled
+  function in the package (``PjitFunction._cache_size``); growth between
+  samples becomes ``pio_jit_cache_misses_total{fn=...}`` and a burst
+  above ``storm_threshold`` in one sampling interval raises the
+  ``pio_jit_recompile_storm`` gauge and logs a warning naming the
+  functions that recompiled.
+- :func:`install_jax_monitoring` taps ``jax.monitoring`` (when present)
+  for backend compile events and their durations —
+  ``pio_xla_compile_events_total`` / ``pio_xla_compile_seconds_total``.
+- :func:`timed_block_until_ready` is the sanctioned way for algorithm
+  code to host-sync: it accounts the stall into
+  ``pio_device_stall_seconds_total`` instead of losing it.
+
+jax itself is imported lazily — constructing a watcher costs nothing on
+processes (event server, ``pio top``) that never touch a device.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+import time
+from typing import Any, Callable
+
+from predictionio_tpu.obs.metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# jax.monitoring tap (process-global; registered once, read by any watcher)
+# ---------------------------------------------------------------------------
+
+_mon_lock = threading.Lock()
+_mon_installed = False
+_mon_compile_events = 0
+_mon_compile_seconds = 0.0
+
+
+def _looks_like_compile(event: str) -> bool:
+    e = event.lower()
+    return "compil" in e or "backend_compile" in e
+
+
+def _on_event(event: str, *args: Any, **kwargs: Any) -> None:
+    global _mon_compile_events
+    if _looks_like_compile(str(event)):
+        with _mon_lock:
+            _mon_compile_events += 1
+
+
+def _on_duration(event: str, duration_secs: float, *a: Any, **kw: Any) -> None:
+    global _mon_compile_seconds
+    if _looks_like_compile(str(event)):
+        with _mon_lock:
+            _mon_compile_seconds += float(duration_secs)
+
+
+def install_jax_monitoring() -> bool:
+    """Register compile-event listeners with ``jax.monitoring``.
+    Idempotent; returns False when jax (or the API) is unavailable.
+    The whole check-register-set sequence holds the lock (registration
+    is a plain list append, never re-enters this module) — a
+    check-then-act gap would let two concurrent watchers double-register
+    and permanently double-count every compile event."""
+    global _mon_installed
+    with _mon_lock:
+        if _mon_installed:
+            return True
+        try:
+            import jax.monitoring as monitoring
+
+            monitoring.register_event_listener(_on_event)
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            return False
+        _mon_installed = True
+        return True
+
+
+def monitoring_totals() -> tuple[int, float]:
+    with _mon_lock:
+        return _mon_compile_events, _mon_compile_seconds
+
+
+# ---------------------------------------------------------------------------
+# compile watcher
+# ---------------------------------------------------------------------------
+
+
+def _is_jitted(obj: Any) -> bool:
+    # PjitFunction exposes _cache_size(); duck-typed so we never need to
+    # import jax just to scan for compiled functions
+    return callable(obj) and callable(getattr(obj, "_cache_size", None))
+
+
+class CompileWatcher:
+    """Samples jit cache sizes and turns growth into metrics.
+
+    ``watch``/``watch_package`` snapshot each function's current cache
+    size as its baseline, so compiles that already happened (deploy-time
+    warmup — those are *paid for on purpose*) don't count as serving
+    recompiles. ``sample()`` is cheap (one C call per watched function)
+    and runs as a registry collector, i.e. exactly when someone scrapes
+    ``/metrics``.
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        storm_threshold: int = 4,
+        package_prefix: str = "predictionio_tpu",
+    ):
+        self.registry = registry
+        self.storm_threshold = max(1, storm_threshold)
+        self.package_prefix = package_prefix
+        self._lock = threading.Lock()
+        self._watched: dict[str, Any] = {}
+        self._last_size: dict[str, int] = {}
+        self._seen_module_count = -1  # rescan trigger (see sample())
+        self._misses = registry.counter(
+            "pio_jit_cache_misses_total",
+            "jit cache misses (recompiles) observed per engine function "
+            "since warmup",
+            labelnames=("fn",),
+        )
+        self._cache_size = registry.gauge(
+            "pio_jit_cache_size",
+            "current jit cache size (compiled program count) per function",
+            labelnames=("fn",),
+        )
+        self._storm = registry.gauge(
+            "pio_jit_recompile_storm",
+            "recompiles seen in the most recent sampling interval; values "
+            ">= the storm threshold also log a warning",
+        )
+        self._storm.set(0.0)
+        self._xla_events = registry.counter(
+            "pio_xla_compile_events_total",
+            "XLA compile events reported by jax.monitoring",
+        )
+        self._xla_seconds = registry.counter(
+            "pio_xla_compile_seconds_total",
+            "cumulative seconds spent in XLA compilation (jax.monitoring)",
+        )
+        install_jax_monitoring()
+
+    # -- registration -------------------------------------------------------
+    def watch(self, name: str, fn: Any) -> bool:
+        """Track one compiled function; baseline = its current cache size."""
+        if not _is_jitted(fn):
+            return False
+        try:
+            size = int(fn._cache_size())
+        except Exception:
+            return False
+        with self._lock:
+            if name not in self._watched:
+                self._watched[name] = fn
+                self._last_size[name] = size
+        return True
+
+    def watch_package(self) -> int:
+        """Scan loaded ``<package_prefix>`` modules for module-level jitted
+        functions (the framework keeps its serving kernels there — e.g.
+        ``ops/als.py``'s top-k programs). Returns how many are watched."""
+        for mod_name, module in list(sys.modules.items()):
+            if module is None or not mod_name.startswith(self.package_prefix):
+                continue
+            for attr, value in list(vars(module).items()):
+                if _is_jitted(value):
+                    self.watch(f"{mod_name.removeprefix(self.package_prefix + '.')}"
+                               f".{attr}", value)
+        with self._lock:
+            return len(self._watched)
+
+    # -- sampling -----------------------------------------------------------
+    def sample(self) -> int:
+        """Refresh gauges/counters; returns recompiles since last sample.
+        Registered as a registry collector so every scrape is current.
+        The module scan only re-runs when sys.modules has grown (a lazy
+        import may have brought new kernels); the steady-state cost per
+        scrape is one ``_cache_size`` read per watched function."""
+        n_modules = len(sys.modules)
+        if n_modules != self._seen_module_count:
+            self.watch_package()
+            self._seen_module_count = n_modules
+        with self._lock:
+            watched = list(self._watched.items())
+        new_misses = 0
+        stormers: list[str] = []
+        for name, fn in watched:
+            try:
+                size = int(fn._cache_size())
+            except Exception:
+                continue
+            with self._lock:
+                last = self._last_size.get(name, size)
+                delta = size - last
+                self._last_size[name] = size
+            self._cache_size.set(size, fn=name)
+            if delta > 0:
+                self._misses.inc(delta, fn=name)
+                new_misses += delta
+                stormers.append(f"{name} (+{delta})")
+        self._storm.set(float(new_misses))
+        if new_misses >= self.storm_threshold:
+            logger.warning(
+                "recompile storm: %d jit cache misses since last sample: %s",
+                new_misses,
+                ", ".join(stormers),
+            )
+        events, seconds = monitoring_totals()
+        self._xla_events.set_total(events)
+        self._xla_seconds.set_total(seconds)
+        return new_misses
+
+    def total_misses(self) -> float:
+        return self._misses.total()
+
+
+# ---------------------------------------------------------------------------
+# stall accounting
+# ---------------------------------------------------------------------------
+
+
+def timed_block_until_ready(
+    x: Any, registry: MetricsRegistry, where: str = "unspecified"
+) -> Any:
+    """``jax.block_until_ready`` that accounts its stall time.
+
+    Algorithm code that must host-sync on the serving path should do it
+    through here (and suppress the host-sync lint with a reason): the
+    stall lands in ``pio_device_stall_seconds_total{where=...}`` and the
+    ``pio_device_fetch_seconds`` histogram instead of disappearing into
+    the request wall time.
+    """
+    import jax
+
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(x)
+    elapsed = time.perf_counter() - t0
+    registry.counter(
+        "pio_device_stall_seconds_total",
+        "cumulative seconds spent blocked on device->host synchronization",
+        labelnames=("where",),
+    ).inc(elapsed, where=where)
+    registry.histogram(
+        "pio_device_fetch_seconds",
+        "device->host fetch / block_until_ready stall durations",
+    ).observe(elapsed)
+    return out
+
+
+__all__ = [
+    "CompileWatcher",
+    "install_jax_monitoring",
+    "monitoring_totals",
+    "timed_block_until_ready",
+]

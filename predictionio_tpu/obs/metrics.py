@@ -1,0 +1,446 @@
+"""Lock-cheap metrics registry: counters, gauges, fixed-bucket histograms.
+
+The reference shipped one coarse per-hour ``Stats`` map
+(``data/.../api/Stats.scala``); a TPU serving fleet needs per-request
+latency distributions, queue depths, breaker states and XLA recompile
+counts, scrapeable by Prometheus. Design constraints:
+
+- **Hot-path cheap.** ``inc``/``observe`` touch one small per-metric lock
+  around a couple of float ops — no global registry lock, no allocation
+  after the first observation of a label set. The registry lock is taken
+  only at instrument creation and scrape time.
+- **Fixed buckets.** Histograms use a declared bucket ladder (default
+  tuned for serving latency: 100us..10s) so concurrent writers only ever
+  increment integers; p50/p95/p99 are extracted at read time by walking
+  the cumulative counts (log-linear interpolation inside the bucket).
+- **Prometheus text format.** ``render_prometheus()`` emits the v0.0.4
+  exposition format (``# HELP``/``# TYPE``, ``_bucket{le=...}``/``_sum``/
+  ``_count`` for histograms) so a stock Prometheus scrape of ``/metrics``
+  works with zero adapters. ``snapshot()`` is the JSON twin for bench
+  output and dashboards.
+
+This module must stay importable without jax/numpy: the event server and
+``pio top`` use it and neither should drag in an accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Any, Callable, Iterable
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# serving-latency ladder in seconds: 100us up to 10s, roughly 2-2.5x steps.
+# Fixed (not exponential-growing) so every writer only increments ints.
+DEFAULT_BUCKETS = (
+    0.0001,
+    0.00025,
+    0.0005,
+    0.001,
+    0.0025,
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.1,
+    0.25,
+    0.5,
+    1.0,
+    2.5,
+    5.0,
+    10.0,
+)
+
+
+def _escape_label_value(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_labels(names: tuple[str, ...], values: tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape_label_value(v)}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+def format_value(v: float) -> str:
+    """Prometheus sample value: integers render without a trailing .0."""
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Metric:
+    """Shared label plumbing. Subclasses hold per-labelset state in
+    ``_series`` keyed by the tuple of label values (in ``labelnames``
+    order) and guard it with one small lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labelnames: tuple[str, ...] = ()):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid label name {ln!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._series: dict[tuple[str, ...], Any] = {}
+
+    def _key(self, labels: dict[str, str]) -> tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def collect(self) -> list[tuple[tuple[str, ...], Any]]:
+        """Snapshot of (label_values, state) pairs, stable order."""
+        with self._lock:
+            return sorted(self._series.items())
+
+    def render(self) -> list[str]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonically increasing float. ``set_total`` exists to mirror a
+    counter maintained elsewhere (e.g. the micro-batcher's plain-int
+    trip counts) without double bookkeeping — it clamps to monotonic."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "", labelnames: tuple[str, ...] = ()):
+        super().__init__(name, help, labelnames)
+        if not labelnames:
+            # an unlabeled counter scrapes as an explicit 0 before its
+            # first increment — "shed happened zero times" is a signal,
+            # a missing series is a dashboard hole
+            self._series[()] = 0.0
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def set_total(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = max(self._series.get(key, 0.0), float(value))
+
+    def value(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return self._series.get(key, 0.0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._series.values())
+
+    def render(self) -> list[str]:
+        return [
+            f"{self.name}{_format_labels(self.labelnames, k)} {format_value(v)}"
+            for k, v in self.collect()
+        ]
+
+
+class Gauge(_Metric):
+    """Point-in-time value. ``set_function`` registers a callback read at
+    collect time (queue depth, breaker state) so the hot path pays
+    nothing for gauges that merely mirror existing state."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "", labelnames: tuple[str, ...] = ()):
+        super().__init__(name, help, labelnames)
+        if not labelnames:
+            self._series[()] = 0.0  # same explicit-zero contract as Counter
+
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def set_function(self, fn: Callable[[], float], **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = fn
+
+    def value(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            v = self._series.get(key, 0.0)
+        return float(v() if callable(v) else v)
+
+    def render(self) -> list[str]:
+        out = []
+        for k, v in self.collect():
+            if callable(v):
+                try:
+                    v = float(v())
+                except Exception:
+                    continue  # a failing callback must not break the scrape
+            out.append(
+                f"{self.name}{_format_labels(self.labelnames, k)} {format_value(v)}"
+            )
+        return out
+
+
+class _HistogramSeries:
+    __slots__ = ("counts", "count", "sum", "max")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)  # +1 for the +Inf bucket
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram with percentile extraction.
+
+    ``observe`` is O(log B) (bisect into the bucket ladder) under the
+    metric lock; percentiles walk cumulative counts at read time and
+    interpolate inside the winning bucket, which is exact enough for
+    p50/p95/p99 dashboards (error bounded by bucket width).
+    """
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: tuple[str, ...] = (),
+        buckets: Iterable[float] | None = None,
+    ):
+        super().__init__(name, help, labelnames)
+        bs = tuple(sorted(buckets if buckets is not None else DEFAULT_BUCKETS))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bs
+
+    def observe(self, value: float, **labels: str) -> None:
+        import bisect
+
+        value = float(value)
+        i = bisect.bisect_left(self.buckets, value)
+        key = self._key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = _HistogramSeries(len(self.buckets))
+            series.counts[i] += 1
+            series.count += 1
+            series.sum += value
+            if value > series.max:
+                series.max = value
+
+    def _snapshot_series(self, key: tuple[str, ...]) -> _HistogramSeries | None:
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                return None
+            copy = _HistogramSeries(len(self.buckets))
+            copy.counts = list(series.counts)
+            copy.count = series.count
+            copy.sum = series.sum
+            copy.max = series.max
+            return copy
+
+    def _percentile_of(self, series: _HistogramSeries, q: float) -> float:
+        if series.count == 0:
+            return 0.0
+        target = q * series.count
+        acc = 0
+        for i, c in enumerate(series.counts):
+            prev_acc = acc
+            acc += c
+            if acc >= target:
+                if i >= len(self.buckets):  # +Inf bucket: no upper bound
+                    return self.buckets[-1]
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                frac = (target - prev_acc) / c if c else 1.0
+                return lo + (hi - lo) * frac
+        return self.buckets[-1]
+
+    def percentile(self, q: float, **labels: str) -> float:
+        series = self._snapshot_series(self._key(labels))
+        if series is None:
+            return 0.0
+        return self._percentile_of(series, q)
+
+    def summary(self, **labels: str) -> dict[str, float]:
+        """One consistent snapshot -> count/mean/p50/p95/p99/sum (seconds)."""
+        series = self._snapshot_series(self._key(labels))
+        if series is None or series.count == 0:
+            return {"count": 0}
+        return {
+            "count": series.count,
+            "sum": series.sum,
+            "mean": series.sum / series.count,
+            "p50": self._percentile_of(series, 0.50),
+            "p95": self._percentile_of(series, 0.95),
+            "p99": self._percentile_of(series, 0.99),
+            "max": series.max,
+        }
+
+    def render(self) -> list[str]:
+        out = []
+        for key, _ in self.collect():
+            series = self._snapshot_series(key)
+            if series is None:
+                continue
+            acc = 0
+            for bound, c in zip(self.buckets, series.counts):
+                acc += c
+                names = self.labelnames + ("le",)
+                values = key + (format_value(bound),)
+                out.append(
+                    f"{self.name}_bucket{_format_labels(names, values)} {acc}"
+                )
+            names = self.labelnames + ("le",)
+            out.append(
+                f"{self.name}_bucket{_format_labels(names, key + ('+Inf',))} "
+                f"{series.count}"
+            )
+            out.append(
+                f"{self.name}_sum{_format_labels(self.labelnames, key)} "
+                f"{format_value(series.sum)}"
+            )
+            out.append(
+                f"{self.name}_count{_format_labels(self.labelnames, key)} "
+                f"{series.count}"
+            )
+        return out
+
+
+class MetricsRegistry:
+    """Instrument factory + exposition. Get-or-create semantics so every
+    layer (server, batcher, stats collector, compile watcher) can ask for
+    the instrument by name without threading object references around;
+    re-declaring with a different type or label set is a programming
+    error and raises."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+        self._collectors: list[Callable[[], None]] = []
+
+    def _get_or_create(self, cls, name: str, help: str, labelnames, **kw) -> Any:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls or existing.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}{existing.labelnames}"
+                    )
+                return existing
+            metric = cls(name, help, labelnames, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(
+        self, name: str, help: str = "", labelnames: Iterable[str] = ()
+    ) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(
+        self, name: str, help: str = "", labelnames: Iterable[str] = ()
+    ) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: Iterable[str] = (),
+        buckets: Iterable[float] | None = None,
+    ) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labelnames, buckets=buckets
+        )
+
+    def get(self, name: str) -> _Metric | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def register_collector(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` before every scrape/snapshot — the hook lazy gauges
+        and the compile watcher use to refresh derived state exactly when
+        someone is looking."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass  # a broken collector must never fail the scrape
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format v0.0.4."""
+        self._run_collectors()
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: list[str] = []
+        for m in metrics:
+            if m.help:
+                escaped = m.help.replace("\\", "\\\\").replace("\n", "\\n")
+                lines.append(f"# HELP {m.name} {escaped}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON twin of the exposition: bench output and dashboards."""
+        self._run_collectors()
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        out: dict[str, Any] = {}
+        for m in metrics:
+            samples: list[dict[str, Any]] = []
+            if isinstance(m, Histogram):
+                for key, _ in m.collect():
+                    labels = dict(zip(m.labelnames, key))
+                    samples.append({"labels": labels, **m.summary(**labels)})
+            else:
+                for key, v in m.collect():
+                    if callable(v):
+                        try:
+                            v = float(v())
+                        except Exception:
+                            continue
+                    samples.append(
+                        {"labels": dict(zip(m.labelnames, key)), "value": v}
+                    )
+            out[m.name] = {"type": m.kind, "samples": samples}
+        return out

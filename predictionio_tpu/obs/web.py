@@ -1,0 +1,80 @@
+"""aiohttp glue shared by QueryServer and EventServer.
+
+Both servers export the identical observability surface — ``/metrics``
+(Prometheus text), ``/traces/recent`` (span ring), and breaker
+state/transition instruments. This module is that surface's single
+definition, so the two servers cannot drift apart route by route.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tracing import Tracer
+from predictionio_tpu.resilience import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+
+# numeric encoding of breaker states for the pio_breaker_state gauge
+BREAKER_STATE_VALUES = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class BreakerInstruments:
+    """Breaker observability: a transition counter fed by the breaker's
+    listener hook plus a state gauge refreshed at scrape time (the
+    open->half-open move happens lazily on the clock, which no listener
+    event covers)."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self._transitions = registry.counter(
+            "pio_breaker_transitions_total",
+            "circuit breaker state transitions, by breaker and target state",
+            labelnames=("breaker", "to"),
+        )
+        self._state = registry.gauge(
+            "pio_breaker_state",
+            "breaker state (0=closed, 1=half-open, 2=open)",
+            labelnames=("breaker",),
+        )
+        self._breakers: list[CircuitBreaker] = []
+
+    def watch(self, breaker: CircuitBreaker) -> CircuitBreaker:
+        """Attach the transition listener and include the breaker in
+        scrape-time state refreshes. Returns the breaker for chaining."""
+        breaker.listener = self.on_transition
+        self._breakers.append(breaker)
+        self.collect()
+        return breaker
+
+    def on_transition(self, name: str, old: str, new: str) -> None:
+        self._transitions.inc(breaker=name, to=new)
+        self._state.set(BREAKER_STATE_VALUES.get(new, -1.0), breaker=name)
+
+    def collect(self) -> None:
+        """Registry collector: refresh every watched breaker's gauge."""
+        for breaker in self._breakers:
+            state = breaker.snapshot()["state"]
+            self._state.set(
+                BREAKER_STATE_VALUES.get(state, -1.0), breaker=breaker.name
+            )
+
+
+def metrics_response(registry: MetricsRegistry) -> web.Response:
+    """Prometheus text exposition of the registry. Rendering snapshots
+    under per-metric locks; cheap enough to run on the event loop."""
+    return web.Response(
+        text=registry.render_prometheus(),
+        headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+    )
+
+
+def traces_response(tracer: Tracer, request: web.Request) -> web.Response:
+    """Recent spans from the ring buffer (``?limit=N``, newest first)."""
+    try:
+        limit = int(request.query.get("limit", 100))
+    except ValueError:
+        return web.json_response(
+            {"message": "limit must be an integer"}, status=400
+        )
+    return web.json_response({"spans": tracer.recent(limit)})

@@ -14,6 +14,11 @@ Classic three-state machine:
 Thread-safe; the clock is injectable for tests. A breaker guards one
 dependency (one storage repository, one device dispatch path) and is shared
 by every call site that touches it.
+
+State transitions are observable: pass ``listener`` (called with
+``(name, old_state, new_state)`` outside the breaker lock) and the
+servers turn every trip/recovery into metrics — silent resilience
+decisions were the gap the telemetry layer exists to close.
 """
 
 from __future__ import annotations
@@ -52,12 +57,16 @@ class CircuitBreaker:
         recovery_timeout_s: float = 5.0,
         half_open_max_probes: int = 1,
         clock: Callable[[], float] = time.monotonic,
+        listener: Callable[[str, str, str], None] | None = None,
     ):
         self.name = name or "breaker"
         self.failure_threshold = max(1, failure_threshold)
         self.recovery_timeout_s = recovery_timeout_s
         self.half_open_max_probes = max(1, half_open_max_probes)
         self._clock = clock
+        # (name, old_state, new_state) observer, invoked OUTSIDE the lock
+        # (a listener that re-enters the breaker must not deadlock)
+        self.listener = listener
         self._lock = threading.Lock()
         self._state = CLOSED
         self._consecutive_failures = 0
@@ -72,32 +81,53 @@ class CircuitBreaker:
         self._probes_inflight = 0
         self.trips += 1
 
+    def _notify(self, old_state: str, new_state: str) -> None:
+        if self.listener is not None and old_state != new_state:
+            try:
+                self.listener(self.name, old_state, new_state)
+            except Exception:
+                pass  # monitoring must never break the state machine
+
     def allow(self) -> None:
         """Gate one call. Raises ``CircuitOpenError`` instead of allowing;
         a successful return must be paired with ``record_success`` or
         ``record_failure`` (or use ``call()`` which does the pairing)."""
+        transition: tuple[str, str] | None = None
+        err: CircuitOpenError | None = None
         with self._lock:
             if self._state == CLOSED:
                 return
             elapsed = self._clock() - self._opened_at
             if self._state == OPEN:
                 if elapsed < self.recovery_timeout_s:
-                    raise CircuitOpenError(
+                    err = CircuitOpenError(
                         self.name, self.recovery_timeout_s - elapsed
                     )
-                self._state = HALF_OPEN
-                self._probes_inflight = 0
-            # half-open: admit a bounded number of concurrent probes
-            if self._probes_inflight >= self.half_open_max_probes:
-                raise CircuitOpenError(self.name, self.recovery_timeout_s)
-            self._probes_inflight += 1
+                else:
+                    self._state = HALF_OPEN
+                    self._probes_inflight = 0
+                    transition = (OPEN, HALF_OPEN)
+            if err is None:
+                # half-open: admit a bounded number of concurrent probes
+                if self._probes_inflight >= self.half_open_max_probes:
+                    err = CircuitOpenError(self.name, self.recovery_timeout_s)
+                else:
+                    self._probes_inflight += 1
+        if transition is not None:
+            self._notify(*transition)
+        if err is not None:
+            raise err
 
     def record_success(self) -> None:
+        transition: tuple[str, str] | None = None
         with self._lock:
             self._consecutive_failures = 0
             if self._state == HALF_OPEN:
                 self._state = CLOSED
                 self._probes_inflight = 0
+                transition = (HALF_OPEN, CLOSED)
+        if transition is not None:
+            self._notify(*transition)
 
     def release_probe(self) -> None:
         """Un-claim a half-open probe slot whose call was never attempted
@@ -110,28 +140,43 @@ class CircuitBreaker:
                 self._probes_inflight -= 1
 
     def record_failure(self) -> None:
+        transition: tuple[str, str] | None = None
         with self._lock:
             if self._state == HALF_OPEN:
                 self._trip()  # failed probe: full recovery window again
-                return
-            self._consecutive_failures += 1
-            if (
-                self._state == CLOSED
-                and self._consecutive_failures >= self.failure_threshold
-            ):
-                self._trip()
+                transition = (HALF_OPEN, OPEN)
+            else:
+                self._consecutive_failures += 1
+                if (
+                    self._state == CLOSED
+                    and self._consecutive_failures >= self.failure_threshold
+                ):
+                    self._trip()
+                    transition = (CLOSED, OPEN)
+        if transition is not None:
+            self._notify(*transition)
 
     def force_open(self) -> None:
         """Administrative trip (drain a replica without killing it)."""
+        transition: tuple[str, str] | None = None
         with self._lock:
             if self._state != OPEN:
+                old = self._state
                 self._trip()
+                transition = (old, OPEN)
+        if transition is not None:
+            self._notify(*transition)
 
     def reset(self) -> None:
+        transition: tuple[str, str] | None = None
         with self._lock:
+            if self._state != CLOSED:
+                transition = (self._state, CLOSED)
             self._state = CLOSED
             self._consecutive_failures = 0
             self._probes_inflight = 0
+        if transition is not None:
+            self._notify(*transition)
 
     # -- conveniences -------------------------------------------------------
     def call(

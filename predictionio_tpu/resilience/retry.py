@@ -107,6 +107,13 @@ class RetryPolicy:
     budget: RetryBudget | None = None
     sleep: Callable[[float], None] = time.sleep
     rng: Callable[[], float] = random.random  # uniform [0, 1)
+    # observability: invoked once per retry DECISION (after budget spend,
+    # before backoff) with the error being retried — the servers wire this
+    # to a `pio_storage_retries_total`-style counter. Monitoring only; a
+    # raising hook is swallowed. `retries_attempted` mirrors the same count
+    # for /healthz snapshots without requiring a hook.
+    on_retry: Callable[[BaseException], None] | None = None
+    retries_attempted: int = 0
 
     def backoff_s(self, retry_index: int) -> float:
         """Sleep before the (retry_index+1)-th retry (retry_index from 0)."""
@@ -143,6 +150,12 @@ class RetryPolicy:
                     raise  # out of attempts
                 if self.budget is not None and not self.budget.try_spend():
                     raise  # budget empty: shed the retry, surface the error
+                self.retries_attempted += 1
+                if self.on_retry is not None:
+                    try:
+                        self.on_retry(exc)
+                    except Exception:
+                        pass  # monitoring must never break the retry loop
                 pause = self.backoff_s(attempts - 1)
                 if deadline is not None:
                     rem = deadline.remaining()

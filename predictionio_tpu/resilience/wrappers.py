@@ -51,6 +51,7 @@ class ResiliencePolicy:
             "retryBudgetTokens": (
                 self.retry.budget.tokens if self.retry.budget else None
             ),
+            "retriesAttempted": self.retry.retries_attempted,
         }
 
 

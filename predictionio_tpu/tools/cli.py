@@ -6,6 +6,10 @@ Reference parity: ``tools/.../console/Console.scala:134-630`` verb set —
   app {new, list, show, delete, data-delete, channel-new, channel-delete},
   accesskey {new, list, delete}, template {list, get}, import, export, run.
 
+Beyond the reference: ``lint`` (TPU-aware static analysis) and ``top``
+(live terminal summary of a running server's /metrics — qps, p95, shed
+rate, breaker states, jit recompile count; see docs/observability.md).
+
 Where the reference assembled a spark-submit command line around JVM mains
 (``Runner.runOnSpark``, process boundary #1 in SURVEY.md section 3), this CLI
 *is* the workflow process: train/eval/deploy run in-process on the local
@@ -432,8 +436,22 @@ def cmd_dashboard(args) -> int:
     from predictionio_tpu.tools.dashboard import run_dashboard
 
     print(f"Dashboard starting on {args.ip}:{args.port} ...")
-    run_dashboard(args.ip, args.port)
+    run_dashboard(args.ip, args.port, metrics_urls=args.metrics_url or ())
     return 0
+
+
+def cmd_top(args) -> int:
+    """Live one-screen summary of a running server's /metrics (qps, p95,
+    shed rate, breaker states, recompile count)."""
+    from predictionio_tpu.tools.top import run_top
+
+    iterations = 1 if args.once else args.iterations
+    return run_top(
+        args.url,
+        interval_s=args.interval,
+        iterations=iterations,
+        clear_screen=False if args.once else None,
+    )
 
 
 def cmd_status(args) -> int:
@@ -872,7 +890,40 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser("dashboard")
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=9000)
+    x.add_argument(
+        "--metrics-url",
+        action="append",
+        help="a server base URL whose /metrics the dashboard shows as "
+        "breaker/queue/latency panels (repeatable; e.g. "
+        "http://localhost:8000)",
+    )
     x.set_defaults(fn=cmd_dashboard)
+
+    x = sub.add_parser(
+        "top",
+        help="live terminal summary of a running server's /metrics "
+        "(qps, p95, shed rate, breaker states, recompile count)",
+    )
+    x.add_argument(
+        "--url",
+        default="http://127.0.0.1:8000",
+        help="server base URL (QueryServer or EventServer)",
+    )
+    x.add_argument("--interval", type=float, default=2.0)
+    x.add_argument(
+        "-n",
+        "--iterations",
+        type=int,
+        default=None,
+        help="stop after N refreshes (default: run until Ctrl-C)",
+    )
+    x.add_argument(
+        "--once",
+        action="store_true",
+        help="print one snapshot and exit (rates need two samples and "
+        "show as '-')",
+    )
+    x.set_defaults(fn=cmd_top)
 
     # data
     x = sub.add_parser("import")

@@ -1,28 +1,52 @@
-"""Evaluation dashboard (port 9000).
+"""Evaluation + observability dashboard (port 9000).
 
 Reference parity: ``tools/.../dashboard/Dashboard.scala:44-107`` — an HTML
 page listing completed EvaluationInstances newest-first with links to their
 HTML metric reports, plus the JSON results.
+
+Beyond the reference: the dashboard can be pointed at running servers'
+``/metrics`` endpoints (``pio dashboard --metrics-url http://host:8000``,
+repeatable) and renders live breaker/queue/latency panels — qps totals,
+p50/p95/p99, shed/deadline counts, breaker states, and jit recompile
+counts — instead of only the legacy hourly stats. Panels are fetched
+server-side at page load with a short timeout; an unreachable server
+renders as such rather than failing the page.
 """
 
 from __future__ import annotations
 
 import html
+from typing import Any, Sequence
 
 from aiohttp import web
 
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.tools.top import (
+    format_number as _fmt,
+    parse_prometheus,
+    summarize,
+)
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>predictionio_tpu dashboard</title>
 <style>
 body {{ font-family: sans-serif; margin: 2rem; }}
-table {{ border-collapse: collapse; width: 100%; }}
+table {{ border-collapse: collapse; width: 100%; margin-bottom: 2rem; }}
 th, td {{ border: 1px solid #ccc; padding: 0.4rem 0.8rem; text-align: left; }}
 th {{ background: #f0f0f0; }}
+.panel {{ display: inline-block; vertical-align: top; border: 1px solid #ccc;
+  border-radius: 6px; padding: 0.8rem 1.2rem; margin: 0 1rem 1rem 0; }}
+.panel h3 {{ margin: 0 0 0.5rem 0; font-size: 0.95rem; }}
+.panel td {{ border: none; padding: 0.1rem 0.8rem 0.1rem 0; }}
+.state-open {{ color: #b00; font-weight: bold; }}
+.state-half-open {{ color: #b60; font-weight: bold; }}
+.state-closed {{ color: #080; }}
+.unreachable {{ color: #b00; }}
 </style></head>
 <body>
-<h1>Evaluation Dashboard</h1>
+<h1>Dashboard</h1>
+{observability}
+<h2>Evaluations</h2>
 <table>
 <tr><th>ID</th><th>Start</th><th>End</th><th>Evaluation</th><th>Batch</th>
 <th>Result</th><th></th></tr>
@@ -31,9 +55,89 @@ th {{ background: #f0f0f0; }}
 </body></html>"""
 
 
+def render_metrics_panel(url: str, metrics_text: str | None) -> str:
+    """One server's panel: breaker / queue / latency, from a raw /metrics
+    scrape (None = the fetch failed)."""
+    title = html.escape(url)
+    if metrics_text is None:
+        return (
+            f'<div class="panel"><h3>{title}</h3>'
+            '<p class="unreachable">unreachable</p></div>'
+        )
+    s = summarize(parse_prometheus(metrics_text))
+    breaker_cells = (
+        " ".join(
+            f'<span class="state-{html.escape(str(state))}">'
+            f"{html.escape(name)}={html.escape(str(state))}</span>"
+            for name, state in sorted((s.get("breakers") or {}).items())
+        )
+        or "-"
+    )
+    rows = [
+        ("requests", _fmt(s["requests_total"])),
+        ("errors (5xx)", _fmt(s["errors_total"])),
+        ("p50 / p95 / p99", f"{_fmt(s['p50_ms'])} / {_fmt(s['p95_ms'])} / "
+                            f"{_fmt(s['p99_ms'])} ms"),
+        ("queue depth", f"{_fmt(s['queue_depth'])} / "
+                        f"{_fmt(s['queue_high_water'])} high water"),
+        ("shed / deadline", f"{_fmt(s['shed_total'])} / "
+                            f"{_fmt(s['deadline_total'])}"),
+        ("watchdog trips", _fmt(s["watchdog_total"])),
+        ("jit recompiles", _fmt(s["recompiles"])),
+        ("storage retries", _fmt(s["retries_total"])),
+        ("breakers", breaker_cells),
+    ]
+    body = "\n".join(
+        f"<tr><td>{html.escape(k)}</td><td>{v}</td></tr>" for k, v in rows
+    )
+    return (
+        f'<div class="panel"><h3>{title}</h3><table>{body}</table></div>'
+    )
+
+
 class Dashboard:
-    def __init__(self, storage: Storage | None = None):
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        metrics_urls: Sequence[str] = (),
+    ):
         self.storage = storage or Storage.instance()
+        self.metrics_urls = list(metrics_urls)
+
+    async def _fetch_metrics(self, url: str) -> str | None:
+        """Scrape one server's /metrics; None on any failure. Split out so
+        tests can stub the network."""
+        import aiohttp
+
+        try:
+            timeout = aiohttp.ClientTimeout(total=2.0)
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+                async with session.get(f"{url}/metrics") as resp:
+                    if resp.status != 200:
+                        return None
+                    return await resp.text()
+        except Exception:
+            return None
+
+    async def _observability_html(self) -> str:
+        if not self.metrics_urls:
+            return (
+                "<p><i>No metrics sources configured — start with "
+                "<code>pio dashboard --metrics-url http://host:port</code> "
+                "to see live serving panels.</i></p>"
+            )
+        import asyncio
+
+        # all sources scraped concurrently: page latency is bounded by the
+        # slowest single fetch (~2s timeout), not the sum over down servers
+        texts = await asyncio.gather(
+            *(self._fetch_metrics(u) for u in self.metrics_urls)
+        )
+        panels = [
+            render_metrics_panel(url, text)
+            for url, text in zip(self.metrics_urls, texts)
+        ]
+        return "<h2>Serving</h2>\n" + "\n".join(panels)
 
     async def handle_index(self, request: web.Request) -> web.Response:
         instances = self.storage.get_meta_data_evaluation_instances().get_completed()
@@ -54,7 +158,11 @@ class Dashboard:
                 "</tr>"
             )
         return web.Response(
-            text=_PAGE.format(rows="\n".join(rows)), content_type="text/html"
+            text=_PAGE.format(
+                rows="\n".join(rows),
+                observability=await self._observability_html(),
+            ),
+            content_type="text/html",
         )
 
     async def handle_results_html(self, request: web.Request) -> web.Response:
@@ -97,5 +205,12 @@ class Dashboard:
         return app
 
 
-def run_dashboard(ip: str = "127.0.0.1", port: int = 9000) -> None:
-    web.run_app(Dashboard().make_app(), host=ip, port=port, print=None)
+def run_dashboard(
+    ip: str = "127.0.0.1", port: int = 9000, metrics_urls: Sequence[str] = ()
+) -> None:
+    web.run_app(
+        Dashboard(metrics_urls=metrics_urls).make_app(),
+        host=ip,
+        port=port,
+        print=None,
+    )

@@ -28,13 +28,29 @@ import datetime as _dt
 import json
 import logging
 import time
-from typing import Any
+from typing import Any, NamedTuple
 
 from aiohttp import web
 
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.obs.jaxprof import CompileWatcher
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tracing import (
+    TRACE_HEADER,
+    Tracer,
+    current_trace_id,
+    get_tracer,
+    mint_trace_id,
+    reset_trace_id,
+    set_trace_id,
+)
+from predictionio_tpu.obs.web import (
+    BreakerInstruments,
+    metrics_response,
+    traces_response,
+)
 from predictionio_tpu.resilience import (
     OPEN,
     CircuitBreaker,
@@ -45,7 +61,6 @@ from predictionio_tpu.resilience import (
 from predictionio_tpu.workflow.context import WorkflowContext
 from predictionio_tpu.workflow.core_workflow import load_models_for_instance
 from predictionio_tpu.workflow.engine_loader import EngineManifest, load_engine
-from predictionio_tpu.utils.histogram import LatencyHistogram
 
 logger = logging.getLogger(__name__)
 UTC = _dt.timezone.utc
@@ -133,6 +148,19 @@ def _swallow_result(fut) -> None:
         fut.exception()
 
 
+class _QItem(NamedTuple):
+    """One queued query: its payload, the caller's future, the request
+    deadline, the ingress trace id (the contextvar does NOT survive the
+    hop onto the dispatch thread — it rides here instead), and the
+    enqueue time (queue-wait accounting)."""
+
+    payload: Any
+    fut: asyncio.Future
+    deadline: Deadline
+    trace_id: str | None
+    t_submit: float
+
+
 class _MicroBatcher:
     """Coalesces concurrent /queries.json requests into batched predicts.
 
@@ -195,6 +223,7 @@ class _MicroBatcher:
             raise ShuttingDownError()
         if self.high_water and self._queue.qsize() >= self.high_water:
             self.shed_count += 1
+            self._server._m_shed.inc()
             raise LoadShedError(
                 f"admission queue over high water "
                 f"({self._queue.qsize()}/{self.high_water})",
@@ -203,16 +232,18 @@ class _MicroBatcher:
         if deadline is None:
             deadline = Deadline.never()
         fut = asyncio.get_running_loop().create_future()
-        self._queue.put_nowait((payload, fut, deadline))
+        self._queue.put_nowait(
+            _QItem(payload, fut, deadline, current_trace_id(), time.perf_counter())
+        )
         if self._task is None or self._task.done():
             self._task = asyncio.ensure_future(self._run())
         return await fut
 
     @staticmethod
-    def _fail_batch(batch: list, exc: BaseException) -> None:
-        for _, fut, _ in batch:
-            if not fut.done():
-                fut.set_exception(exc)
+    def _fail_batch(batch: list[_QItem], exc: BaseException) -> None:
+        for item in batch:
+            if not item.fut.done():
+                item.fut.set_exception(exc)
 
     def _replace_dispatch_pool(self) -> None:
         """Abandon a dispatch thread stuck past its batch's deadline: the
@@ -263,33 +294,38 @@ class _MicroBatcher:
             # requests that expired while queued are failed here, not
             # dispatched: device work for an answer nobody is waiting on
             # would only deepen an overload
+            collect_t = time.perf_counter()
             live = []
-            for payload, fut, dl in batch:
-                if fut.done():  # client gone / cancelled
+            for item in batch:
+                if item.fut.done():  # client gone / cancelled
                     # its probe slot (if it held one) can never be recorded
                     self._server.dispatch_breaker.release_probe()
                     continue
-                if dl.expired:
-                    fut.set_exception(
+                if item.deadline.expired:
+                    item.fut.set_exception(
                         DeadlineExceeded("query expired in admission queue")
                     )
                 else:
-                    live.append((payload, fut, dl))
+                    live.append(item)
+                    self._server._m_queue_wait.observe(
+                        collect_t - item.t_submit
+                    )
             if not live:
                 self._inflight.release()
                 continue
             batch = live
-            batch_deadline = Deadline.min_of([dl for _, _, dl in batch])
+            batch_deadline = Deadline.min_of([it.deadline for it in batch])
             # dispatch under a watchdog. NOT wait_for(): cancelling an
             # executor future whose fn is already running blocks until the
             # fn returns — the exact hang the watchdog exists to escape.
             # asyncio.wait() times out without cancelling; the stuck call
             # is then abandoned and its pool replaced.
+            dispatch_t0 = time.perf_counter()
             try:
                 exec_fut = loop.run_in_executor(
                     self._dispatch_pool,
                     self._server._dispatch_query_batch,
-                    [payload for payload, _, _ in batch],
+                    [(it.payload, it.trace_id) for it in batch],
                 )
                 exec_fut.add_done_callback(_swallow_result)
                 done, pending = await asyncio.wait(
@@ -306,6 +342,7 @@ class _MicroBatcher:
                 # dispatch thread, keep serving everyone else
                 self._inflight.release()
                 self.watchdog_trips += 1
+                self._server._m_watchdog.inc()
                 self._replace_dispatch_pool()
                 self._server.dispatch_breaker.record_failure()
                 self._fail_batch(
@@ -313,27 +350,36 @@ class _MicroBatcher:
                     DeadlineExceeded("micro-batch dispatch: deadline exceeded"),
                 )
                 continue
+            dispatch_s = time.perf_counter() - dispatch_t0
+            self._server._m_dispatch.observe(dispatch_s)
             try:
                 finalize = exec_fut.result()
             except BaseException as exc:
                 self._inflight.release()
                 self._server.dispatch_breaker.record_failure()
-                for _, fut, _ in batch:
-                    if not fut.done():
-                        fut.set_exception(exc)
+                for item in batch:
+                    if not item.fut.done():
+                        item.fut.set_exception(exc)
                 continue
             self.batches_dispatched += 1
             self.queries_dispatched += len(batch)
             # finish asynchronously: the collect loop immediately forms and
             # dispatches the next batch while this one's fetch is in flight
             task = asyncio.ensure_future(
-                self._finish(batch, finalize, batch_deadline)
+                self._finish(batch, finalize, batch_deadline, dispatch_s)
             )
             self._finish_tasks.add(task)
             task.add_done_callback(self._finish_tasks.discard)
 
-    async def _finish(self, batch: list, finalize, deadline: Deadline) -> None:
+    async def _finish(
+        self,
+        batch: list[_QItem],
+        finalize,
+        deadline: Deadline,
+        dispatch_s: float = 0.0,
+    ) -> None:
         loop = asyncio.get_running_loop()
+        fetch_t0 = time.perf_counter()
         exec_fut = loop.run_in_executor(self._fetch_pool, finalize)
         exec_fut.add_done_callback(_swallow_result)
         try:
@@ -351,12 +397,18 @@ class _MicroBatcher:
             # finalizes in flight on the old pool still run to completion
             self._inflight.release()
             self.watchdog_trips += 1
+            self._server._m_watchdog.inc()
             self._replace_fetch_pool()
             self._server.dispatch_breaker.record_failure()
             self._fail_batch(
                 batch, DeadlineExceeded("micro-batch fetch: deadline exceeded")
             )
             return
+        fetch_s = time.perf_counter() - fetch_t0
+        self._server._m_fetch.observe(fetch_s)
+        # the fetch phase is where the host blocks on the device transport:
+        # account it as stall time (see obs/jaxprof.py)
+        self._server._m_stall.inc(fetch_s, where="micro-batch-fetch")
         try:
             outs = exec_fut.result()
         except BaseException as exc:
@@ -370,13 +422,28 @@ class _MicroBatcher:
             self._server.dispatch_breaker.record_success()
         finally:
             self._inflight.release()
-        for (_, fut, _), out in zip(batch, outs):
-            if fut.done():  # client gone / cancelled
+        done_t = time.perf_counter()
+        for item, out in zip(batch, outs):
+            # one `batch` span per query, carrying the wall/queue/device
+            # split — the hop between the ingress span and any storage
+            # spans the engine's serving components recorded
+            self._server.tracer.record_span(
+                "query.batch",
+                kind="batch",
+                duration_s=done_t - item.t_submit,
+                trace_id=item.trace_id,
+                status=type(out).__name__ if isinstance(out, BaseException) else "ok",
+                batch_size=len(batch),
+                queue_ms=round((fetch_t0 - dispatch_s - item.t_submit) * 1000, 3),
+                dispatch_ms=round(dispatch_s * 1000, 3),
+                fetch_ms=round(fetch_s * 1000, 3),
+            )
+            if item.fut.done():  # client gone / cancelled
                 continue
             if isinstance(out, BaseException):
-                fut.set_exception(out)
+                item.fut.set_exception(out)
             else:
-                fut.set_result(out)
+                item.fut.set_result(out)
 
     def close(self) -> None:
         self._closed = True  # new submits fail fast from here on
@@ -393,11 +460,11 @@ class _MicroBatcher:
         exc = ShuttingDownError()
         while True:
             try:
-                _, fut, _ = self._queue.get_nowait()
+                item = self._queue.get_nowait()
             except asyncio.QueueEmpty:
                 break
-            if not fut.done():
-                fut.set_exception(exc)
+            if not item.fut.done():
+                item.fut.set_exception(exc)
         self._dispatch_pool.shutdown(wait=False, cancel_futures=True)
         self._fetch_pool.shutdown(wait=False, cancel_futures=True)
 
@@ -447,7 +514,62 @@ class QueryServer:
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
-        self.latency = LatencyHistogram()
+        # -- observability (docs/observability.md) --------------------------
+        self.metrics = MetricsRegistry()
+        self.tracer: Tracer = get_tracer()
+        m = self.metrics
+        self._m_requests = m.counter(
+            "pio_requests_total",
+            "HTTP requests served, by route and status",
+            labelnames=("endpoint", "status"),
+        )
+        # ONE latency histogram backs both the legacy `/` status page and
+        # /metrics — two independent ladders reported different p95s for
+        # the same traffic and sent operators chasing phantom regressions
+        self._m_latency = m.histogram(
+            "pio_request_seconds",
+            "HTTP request wall time, by route",
+            labelnames=("endpoint",),
+        )
+        self._m_queue_wait = m.histogram(
+            "pio_queue_wait_seconds",
+            "time queries spend in the micro-batch admission queue",
+        )
+        self._m_dispatch = m.histogram(
+            "pio_dispatch_seconds",
+            "micro-batch dispatch phase (decode + device enqueue) wall time",
+        )
+        self._m_fetch = m.histogram(
+            "pio_fetch_seconds",
+            "micro-batch fetch phase (device->host transport + serve) wall time",
+        )
+        self._m_stall = m.counter(
+            "pio_device_stall_seconds_total",
+            "cumulative seconds spent blocked on device->host synchronization",
+            labelnames=("where",),
+        )
+        self._m_shed = m.counter(
+            "pio_load_shed_total",
+            "requests rejected by admission control (503 + Retry-After)",
+        )
+        self._m_deadline = m.counter(
+            "pio_deadline_exceeded_total",
+            "requests failed for blowing their deadline (queued or in flight)",
+        )
+        self._m_watchdog = m.counter(
+            "pio_watchdog_trips_total",
+            "batches abandoned because a device call blew its deadline",
+        )
+        self._m_breaker_rejected = m.counter(
+            "pio_breaker_rejections_total",
+            "requests shed at the door because the dispatch circuit was open",
+        )
+        self._breaker_instruments = BreakerInstruments(m)
+        # jit cache misses / XLA compile events become first-class metrics;
+        # sampled at scrape time via the registry collector hook
+        self.compile_watcher = CompileWatcher(m)
+        m.register_collector(self.compile_watcher.sample)
+        m.register_collector(self._breaker_instruments.collect)
         self._runner: web.AppRunner | None = None
         self._stop_event = asyncio.Event()
         # strong refs to fire-and-forget tasks (the loop keeps only weak ones)
@@ -459,10 +581,12 @@ class QueryServer:
         # consecutive watchdog trips (device calls blowing their deadline)
         # open this breaker; while open /queries.json sheds instantly with
         # 503 + Retry-After instead of feeding more work to a wedged device
-        self.dispatch_breaker = CircuitBreaker(
-            name="dispatch",
-            failure_threshold=self.config.breaker_threshold,
-            recovery_timeout_s=self.config.breaker_recovery_s,
+        self.dispatch_breaker = self._breaker_instruments.watch(
+            CircuitBreaker(
+                name="dispatch",
+                failure_threshold=self.config.breaker_threshold,
+                recovery_timeout_s=self.config.breaker_recovery_s,
+            )
         )
         self._reload_lock = asyncio.Lock()
         self._batcher = _MicroBatcher(
@@ -472,6 +596,14 @@ class QueryServer:
             high_water=self.config.queue_high_water,
             shed_retry_after_s=self.config.shed_retry_after_s,
         )
+        # scrape-time gauges mirroring live batcher state (hot path pays 0)
+        m.gauge(
+            "pio_queue_depth", "queries waiting in the micro-batch queue"
+        ).set_function(lambda: self._batcher.queue_depth)
+        m.gauge(
+            "pio_queue_high_water",
+            "admission-control shed threshold (0 = unbounded)",
+        ).set(self.config.queue_high_water)
         import concurrent.futures
 
         self._sniffer_pool = concurrent.futures.ThreadPoolExecutor(
@@ -480,6 +612,31 @@ class QueryServer:
 
     # ---------------------------------------------------------------- routes
     async def handle_queries(self, request: web.Request) -> web.Response:
+        """Trace + metrics envelope around the query path: accept or mint
+        the request's trace id (echoed in the response), record the
+        ingress span, and count/observe every status — including the
+        shed/deadline 503s the resilience layer used to decide silently."""
+        trace_id = request.headers.get(TRACE_HEADER) or mint_trace_id()
+        token = set_trace_id(trace_id)
+        status = 500
+        t0 = time.perf_counter()
+        try:
+            with self.tracer.span(
+                "http.query", kind="ingress", endpoint="/queries.json"
+            ) as sp:
+                resp = await self._handle_queries_inner(request)
+                status = resp.status
+                sp.tags["status"] = status
+        finally:
+            reset_trace_id(token)
+            self._m_requests.inc(endpoint="/queries.json", status=str(status))
+            self._m_latency.observe(
+                time.perf_counter() - t0, endpoint="/queries.json"
+            )
+        resp.headers[TRACE_HEADER] = trace_id
+        return resp
+
+    async def _handle_queries_inner(self, request: web.Request) -> web.Response:
         if self.config.accesskey:
             supplied = request.query.get("accessKey") or request.headers.get(
                 "Authorization", ""
@@ -511,6 +668,7 @@ class QueryServer:
             # door with a Retry-After instead of queueing doomed work
             self.dispatch_breaker.allow()
         except CircuitOpenError as exc:
+            self._m_breaker_rejected.inc()
             return self._unavailable(
                 "serving temporarily unavailable (dispatch circuit open)",
                 exc.retry_after_s,
@@ -531,6 +689,7 @@ class QueryServer:
             return self._unavailable(str(exc), exc.retry_after_s)
         except DeadlineExceeded as exc:
             self.dispatch_breaker.release_probe()
+            self._m_deadline.inc()
             logger.warning("query deadline exceeded: %s", exc)
             return self._unavailable(str(exc), self.config.shed_retry_after_s)
         except ShuttingDownError as exc:
@@ -549,12 +708,11 @@ class QueryServer:
         self.request_count += 1
         self.last_serving_sec = elapsed
         self.avg_serving_sec += (elapsed - self.avg_serving_sec) / self.request_count
-        self.latency.observe(elapsed)
         if self.config.feedback:
             self._spawn_bg(self._send_feedback(payload, body))
         return web.json_response(body)
 
-    def _dispatch_query_batch(self, payloads: list[Any]):
+    def _dispatch_query_batch(self, items: list[tuple[Any, str | None]]):
         """Dispatch-phase of one micro-batch (runs on the dispatch thread):
         decode and supplement each query, then *dispatch* every algorithm's
         device work via ``predict_batch_dispatch`` without blocking on
@@ -562,18 +720,27 @@ class QueryServer:
         blocks on the transport, serves, and encodes — so the dispatcher can
         start batch n+1 while batch n's results are in flight.
 
+        ``items`` pairs each payload with its ingress trace id; the id is
+        re-installed around the per-query stages (decode/supplement here,
+        serve in finalize) so spans those stages record — a serving
+        component fetching user features from storage, say — join the
+        request's trace across the thread hop.
+
         Per-query failures are isolated: the failing slot gets its
         exception, batch mates answer normally. Finalize returns one entry
         per payload — an encoded result body or an exception."""
         # ONE read of the atomic tuple: an in-flight batch is immune to
         # /reload and always sees a consistent (algorithms, serving, models)
         algorithms, serving, models = self._active
+        payloads = [p for p, _ in items]
+        trace_ids = [t for _, t in items]
         n = len(payloads)
         outs: list[Any] = [None] * n
         queries: list[Any] = [None] * n
         supplemented: list[Any] = [None] * n
         valid: list[int] = []
         for i, payload in enumerate(payloads):
+            token = set_trace_id(trace_ids[i])
             try:
                 q = self.engine.decode_query(payload)
                 queries[i] = q
@@ -581,6 +748,8 @@ class QueryServer:
                 valid.append(i)
             except Exception as exc:
                 outs[i] = exc
+            finally:
+                reset_trace_id(token)
         sup = [supplemented[i] for i in valid]
         finalizers: list[Any] = []
         if valid:
@@ -625,6 +794,7 @@ class QueryServer:
                 preds_per_algo.append(preds)
             sniffed: list[tuple[Any, Any]] = []
             for row, i in enumerate(valid):
+                token = set_trace_id(trace_ids[i])
                 try:
                     plist = [preds[row] for preds in preds_per_algo]
                     for p in plist:
@@ -638,6 +808,8 @@ class QueryServer:
                     sniffed.append((queries[i], result))
                 except Exception as exc:
                     outs[i] = exc
+                finally:
+                    reset_trace_id(token)
             if sniffed and self.plugin_context.output_sniffers:
                 # observers are fire-and-forget on their own thread: a slow
                 # or throwing sniffer must neither delay the batch's
@@ -731,7 +903,7 @@ class QueryServer:
                 "requestCount": self.request_count,
                 "avgServingSec": self.avg_serving_sec,
                 "lastServingSec": self.last_serving_sec,
-                "latency": self.latency.summary(),
+                "latency": self._latency_summary_ms(),
                 "batching": {
                     "batches": self._batcher.batches_dispatched,
                     "queries": self._batcher.queries_dispatched,
@@ -743,6 +915,24 @@ class QueryServer:
                 "resilience": self._resilience_snapshot(),
             }
         )
+
+    def _latency_summary_ms(self) -> dict[str, Any]:
+        """Legacy status-page latency block, derived from the SAME obs
+        histogram /metrics exports (one source of truth; keys kept from
+        the pre-registry LatencyHistogram). Counts every /queries.json
+        answer including resilience 503s — the distribution an operator
+        staring at `/` should see under load."""
+        s = self._m_latency.summary(endpoint="/queries.json")
+        if s["count"] == 0:
+            return {"count": 0}
+        return {
+            "count": s["count"],
+            "mean_ms": 1000.0 * s["mean"],
+            "p50_ms": 1000.0 * s["p50"],
+            "p95_ms": 1000.0 * s["p95"],
+            "p99_ms": 1000.0 * s["p99"],
+            "max_ms": 1000.0 * s["max"],
+        }
 
     def _resilience_snapshot(self) -> dict[str, Any]:
         b = self._batcher
@@ -834,6 +1024,16 @@ class QueryServer:
         }
         return self.engine.engine_params_from_variant(variant)
 
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition: request latency histogram, queue
+        depth, shed/deadline/watchdog counters, breaker state, jit
+        recompile count — everything `pio top` and a Prometheus scrape
+        need."""
+        return metrics_response(self.metrics)
+
+    async def handle_traces_recent(self, request: web.Request) -> web.Response:
+        return traces_response(self.tracer, request)
+
     async def handle_stop(self, request: web.Request) -> web.Response:
         self._stop_event.set()
         return web.json_response({"message": "Stopping."})
@@ -848,6 +1048,8 @@ class QueryServer:
             [
                 web.get("/", self.handle_status),
                 web.get("/healthz", self.handle_healthz),
+                web.get("/metrics", self.handle_metrics),
+                web.get("/traces/recent", self.handle_traces_recent),
                 web.post("/queries.json", self.handle_queries),
                 # POST is the reference's contract (CreateServer.scala:618-626);
                 # GET kept as a browser convenience
@@ -906,6 +1108,13 @@ class QueryServer:
                 algo.warmup_serving(model, self.config.max_batch_size)
             except Exception:
                 logger.exception("serving warmup failed (continuing)")
+        # baseline the compile watcher AFTER warmup: the compiles warmup
+        # just paid for are intentional; only compiles past this point are
+        # serving-time recompiles worth alarming on
+        try:
+            self.compile_watcher.sample()
+        except Exception:
+            logger.exception("compile watcher baseline failed (continuing)")
 
     async def start(self) -> None:
         await asyncio.get_running_loop().run_in_executor(None, self._warmup)

@@ -2,12 +2,15 @@
 # Chaos gate: run the fault-injection/resilience suite (CPU-only, fast).
 # Asserts the documented degraded-mode behavior — deadline 503s, load
 # shedding, breaker trip/recovery, retry-then-succeed — under injected
-# faults. See docs/resilience.md.
+# faults, AND that the telemetry layer sees it all happen (shed/retry/
+# breaker counters moving, trace ids spanning ingress->batch->storage).
+# See docs/resilience.md and docs/observability.md.
 # Usage: scripts/run_chaos.sh [extra pytest args...]
 set -euo pipefail
 
 repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$repo_root"
 
-exec env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+exec env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_resilience.py tests/test_obs.py -q \
   -p no:cacheprovider "$@"

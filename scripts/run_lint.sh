@@ -11,6 +11,8 @@ cd "$repo_root"
 if [[ "${1:-}" == "--ci" ]]; then
   shift
   python -m predictionio_tpu.analysis.cli "$@"
+  # chaos gate includes the observability suite (tests/test_obs.py):
+  # counters moving under faults + trace propagation are CI-asserted
   exec "$repo_root/scripts/run_chaos.sh"
 fi
 

@@ -515,6 +515,77 @@ class TestSuppression:
         assert rule_ids(active) == ["tracer-python-branch"]
 
 
+class TestObsRules:
+    """obs-unstructured-log: print()/bare logging.* on serving-path modules
+    must point at the structured trace logger."""
+
+    SERVING_PATH = "pkg/data/api/handler.py"  # matches */data/api/*.py
+
+    def test_print_on_serving_path_fires(self):
+        active, _ = lint_snippet(
+            """
+            def handle(request):
+                print("got", request)
+                return request
+            """,
+            display_path=self.SERVING_PATH,
+        )
+        assert rule_ids(active) == ["obs-unstructured-log"]
+        assert active[0].severity == Severity.WARNING
+        assert "trace logger" in active[0].message
+
+    def test_bare_logging_on_serving_path_fires(self):
+        active, _ = lint_snippet(
+            """
+            import logging
+
+            def handle(request):
+                logging.info("handling %s", request)
+                logging.error("boom")
+            """,
+            display_path=self.SERVING_PATH,
+        )
+        assert rule_ids(active) == [
+            "obs-unstructured-log",
+            "obs-unstructured-log",
+        ]
+
+    def test_named_logger_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import logging
+
+            logger = logging.getLogger(__name__)
+
+            def handle(request):
+                logger.info("handling %s", request)
+            """,
+            display_path=self.SERVING_PATH,
+        )
+        assert active == []
+
+    def test_print_off_serving_path_quiet(self):
+        active, _ = lint_snippet(
+            """
+            def train_loop():
+                print("epoch done")
+            """,
+            display_path="pkg/tools/cli.py",
+        )
+        assert active == []
+
+    def test_suppressible_with_reason(self):
+        active, suppressed = lint_snippet(
+            """
+            def handle(request):
+                print("x")  # pio-lint: disable=obs-unstructured-log -- startup banner
+            """,
+            display_path=self.SERVING_PATH,
+        )
+        assert active == []
+        assert rule_ids(suppressed) == ["obs-unstructured-log"]
+
+
 class TestEngine:
     def test_parse_error_reported_not_raised(self):
         active, _ = lint_snippet("def broken(:\n")
@@ -528,6 +599,7 @@ class TestEngine:
             "hostsync",
             "concurrency",
             "storage-contract",
+            "obs",
         } <= families
 
     def test_enabled_filter(self):

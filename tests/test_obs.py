@@ -1,0 +1,807 @@
+"""Observability layer tests (tier-1, CPU-only, fast).
+
+Covers the acceptance trail of the telemetry PR: the metrics registry
+(including histogram bucket math under concurrent writers), Prometheus
+exposition + the `pio top` parser round-trip, trace-id propagation
+end-to-end (ingress header -> micro-batch -> storage span share one trace
+id, in both the ring buffer and the structured JSON log), the re-based
+/stats.json, the compile watcher, and counters moving under chaos
+(shed/deadline/breaker) on live servers.
+"""
+
+import asyncio
+import json
+import logging
+import threading
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tracing import (
+    TRACE_HEADER,
+    Tracer,
+    current_trace_id,
+    get_tracer,
+    mint_trace_id,
+    reset_trace_id,
+    set_trace_id,
+)
+from predictionio_tpu.resilience import CLOSED, OPEN
+from predictionio_tpu.tools.top import (
+    parse_prometheus,
+    render,
+    run_top,
+    summarize,
+)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+class TestCounterGauge:
+    def test_counter_labels_and_totals(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_total", "help", labelnames=("status",))
+        c.inc(status="200")
+        c.inc(2, status="200")
+        c.inc(status="503")
+        assert c.value(status="200") == 3
+        assert c.value(status="503") == 1
+        assert c.total() == 4
+
+    def test_counter_is_monotonic(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        c.inc(5)
+        c.set_total(3)  # mirror below current value: clamped, never down
+        assert c.value() == 5
+
+    def test_gauge_set_and_callback(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("depth")
+        g.set(7)
+        assert g.value() == 7
+        box = {"v": 1.0}
+        g2 = reg.gauge("live_depth")
+        g2.set_function(lambda: box["v"])
+        box["v"] = 42.0
+        assert g2.value() == 42.0
+        assert "live_depth 42" in reg.render_prometheus()
+
+    def test_get_or_create_and_conflicts(self):
+        reg = MetricsRegistry()
+        a = reg.counter("same", labelnames=("x",))
+        b = reg.counter("same", labelnames=("x",))
+        assert a is b
+        with pytest.raises(ValueError):
+            reg.gauge("same")
+        with pytest.raises(ValueError):
+            reg.counter("same", labelnames=("y",))
+        with pytest.raises(ValueError):
+            a.inc(wrong_label="1")
+
+    def test_invalid_names_rejected(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("bad name")
+        with pytest.raises(ValueError):
+            reg.counter("ok", labelnames=("bad-label",))
+
+
+class TestHistogram:
+    def test_percentiles_interpolate_in_bucket(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0))
+        for _ in range(99):
+            h.observe(0.05)  # (0.01, 0.1] bucket
+        h.observe(5.0)  # +Inf bucket
+        s = h.summary()
+        assert s["count"] == 100
+        assert 0.01 < s["p50"] <= 0.1
+        assert 0.01 < s["p95"] <= 0.1
+        # p99 still lands in the populated finite bucket (99 of 100)
+        assert s["p99"] <= 1.0
+        assert s["sum"] == pytest.approx(99 * 0.05 + 5.0)
+
+    def test_empty_summary(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat")
+        assert h.summary() == {"count": 0}
+        assert h.percentile(0.5) == 0.0
+
+    def test_bucket_math_under_concurrent_writers(self):
+        """The satellite guarantee: concurrent observes never lose or
+        double-count — total count, per-bucket sums, and _sum agree."""
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.001, 0.01, 0.1, 1.0))
+        values = (0.0005, 0.005, 0.05, 0.5, 2.0)
+        n_threads, per_thread = 8, 2000
+
+        def hammer(seed: int):
+            for i in range(per_thread):
+                h.observe(values[(i + seed) % len(values)])
+
+        threads = [
+            threading.Thread(target=hammer, args=(t,)) for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = n_threads * per_thread
+        s = h.summary()
+        assert s["count"] == total
+        expected_sum = sum(values) / len(values) * total
+        assert s["sum"] == pytest.approx(expected_sum)
+        # the rendered cumulative buckets agree with the count
+        metrics = parse_prometheus(reg.render_prometheus())
+        inf_bucket = [
+            v for labels, v in metrics["lat_bucket"] if labels["le"] == "+Inf"
+        ]
+        assert inf_bucket == [total]
+        # each value class landed in exactly one bucket: cumulative counts
+        # step by total/len(values) per populated bound
+        per_class = total // len(values)
+        cums = sorted(v for _, v in metrics["lat_bucket"])
+        assert cums == [per_class * (i + 1) for i in range(len(values))]
+
+
+class TestPrometheusExposition:
+    def test_render_and_parse_round_trip(self):
+        reg = MetricsRegistry()
+        reg.counter("req_total", "requests", labelnames=("status",)).inc(
+            3, status="200"
+        )
+        reg.gauge("depth", "queue depth").set(2)
+        reg.histogram("lat", buckets=(0.1, 1.0)).observe(0.05)
+        text = reg.render_prometheus()
+        assert "# HELP req_total requests" in text
+        assert "# TYPE req_total counter" in text
+        assert "# TYPE lat histogram" in text
+        parsed = parse_prometheus(text)
+        assert parsed["req_total"] == [({"status": "200"}, 3.0)]
+        assert parsed["depth"] == [({}, 2.0)]
+        assert ({"le": "+Inf"}, 1.0) in parsed["lat_bucket"]
+        assert parsed["lat_count"] == [({}, 1.0)]
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("esc_total", labelnames=("msg",)).inc(
+            msg='say "hi"\nback\\slash'
+        )
+        parsed = parse_prometheus(reg.render_prometheus())
+        [(labels, value)] = parsed["esc_total"]
+        assert value == 1.0
+        assert labels["msg"] == 'say "hi"\nback\\slash'
+
+    def test_collectors_run_at_scrape(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("sampled")
+        calls = []
+        reg.register_collector(lambda: (calls.append(1), g.set(len(calls))))
+        reg.render_prometheus()
+        snap = reg.snapshot()
+        assert len(calls) == 2
+        assert snap["sampled"]["samples"][0]["value"] == 2
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+
+
+class TestTracing:
+    def test_span_records_ring_and_log(self, caplog):
+        tracer = Tracer(ring_size=4)
+        token = set_trace_id("feedbeef00000000")
+        try:
+            with caplog.at_level(logging.INFO, logger="pio.trace"):
+                with tracer.span("unit.work", kind="serving", step=1) as sp:
+                    sp.tags["extra"] = "yes"
+        finally:
+            reset_trace_id(token)
+        [recent] = tracer.recent()
+        assert recent["traceId"] == "feedbeef00000000"
+        assert recent["name"] == "unit.work"
+        assert recent["kind"] == "serving"
+        assert recent["tags"] == {"step": 1, "extra": "yes"}
+        assert recent["durationMs"] >= 0
+        # the structured log line is the span as one JSON object
+        line = json.loads(caplog.records[-1].getMessage())
+        assert line["traceId"] == "feedbeef00000000"
+        assert line["status"] == "ok"
+
+    def test_span_marks_error_status_and_reraises(self):
+        tracer = Tracer()
+        with pytest.raises(ValueError):
+            with tracer.span("explodes"):
+                raise ValueError("boom")
+        assert tracer.recent()[0]["status"] == "ValueError"
+
+    def test_ring_is_bounded_newest_first(self):
+        tracer = Tracer(ring_size=3)
+        for i in range(5):
+            tracer.record_span(f"s{i}", "internal", 0.0)
+        names = [s["name"] for s in tracer.recent()]
+        assert names == ["s4", "s3", "s2"]
+        assert tracer.spans_recorded == 5
+
+    def test_contextvar_isolation(self):
+        assert current_trace_id() is None
+        token = set_trace_id("aaaa")
+        assert current_trace_id() == "aaaa"
+        reset_trace_id(token)
+        assert current_trace_id() is None
+
+    def test_mint_is_unique(self):
+        assert mint_trace_id() != mint_trace_id()
+
+
+# ---------------------------------------------------------------------------
+# compile watcher
+# ---------------------------------------------------------------------------
+
+
+class TestCompileWatcher:
+    def test_counts_recompiles_after_baseline(self, caplog):
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.obs.jaxprof import CompileWatcher
+
+        reg = MetricsRegistry()
+        watcher = CompileWatcher(reg, storm_threshold=2)
+
+        @jax.jit
+        def f(x):
+            return x * 2
+
+        f(jnp.ones(2))  # warmup compile, then baseline
+        assert watcher.watch("test.f", f)
+        assert watcher.sample() == 0  # baseline: warmup doesn't count
+        f(jnp.ones(2))  # cache hit
+        assert watcher.sample() == 0
+        with caplog.at_level(logging.WARNING):
+            f(jnp.ones(3))  # new shape -> recompile
+            f(jnp.ones(4))  # another -> storm at threshold 2
+            assert watcher.sample() == 2
+        assert watcher.total_misses() == 2
+        assert any("recompile storm" in r.getMessage() for r in caplog.records)
+        parsed = parse_prometheus(reg.render_prometheus())
+        assert (
+            sum(v for _, v in parsed["pio_jit_cache_misses_total"]) == 2
+        )
+        sizes = {l["fn"]: v for l, v in parsed["pio_jit_cache_size"]}
+        assert sizes["test.f"] == 3
+
+
+# ---------------------------------------------------------------------------
+# stats.json re-base
+# ---------------------------------------------------------------------------
+
+
+class TestStatsRebase:
+    def _event(self, name="rate", target=None):
+        from predictionio_tpu.data.event import Event
+
+        return Event(
+            event=name,
+            entity_type="user",
+            entity_id="u1",
+            target_entity_type=target,
+            target_entity_id="i1" if target else None,
+        )
+
+    def test_legacy_shape_and_registry_agree(self):
+        from predictionio_tpu.data.api.stats import StatsCollector
+
+        reg = MetricsRegistry()
+        stats = StatsCollector(registry=reg)
+        stats.bookkeeping(1, 201, self._event())
+        stats.bookkeeping(1, 201, self._event(target="item"))
+        stats.bookkeeping(1, 500, self._event())
+        stats.bookkeeping(2, 201, self._event())  # other app: filtered out
+        out = stats.get_stats(1)
+        assert out["longLive"]["statusCode"] == [
+            {"status": 201, "count": 2},
+            {"status": 500, "count": 1},
+        ]
+        basic = out["longLive"]["basic"]
+        assert {b["event"] for b in basic} == {"rate"}
+        assert {b["targetEntityType"] for b in basic} == {None, "item"}
+        assert out["currentHour"]["statusCode"] == out["longLive"]["statusCode"]
+        assert "prevHour" not in out
+        # the same totals back /metrics
+        parsed = parse_prometheus(reg.render_prometheus())
+        totals = {
+            (l["app_id"], l["status"]): v
+            for l, v in parsed["pio_events_ingested_total"]
+        }
+        assert totals[("1", "201")] == 2
+        assert totals[("2", "201")] == 1
+
+
+# ---------------------------------------------------------------------------
+# query server end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _run_query_server(body, **cfg_kw):
+    import sys
+
+    sys.path.insert(0, "tests") if "tests" not in sys.path else None
+    from tests.test_resilience import _make_query_server
+
+    async def outer():
+        get_tracer().clear()
+        server = _make_query_server(**cfg_kw)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            await body(client, server)
+        finally:
+            await client.close()
+
+    asyncio.run(outer())
+
+
+class TestQueryServerObs:
+    def test_metrics_endpoint_is_prometheus_parseable(self):
+        """Acceptance: GET /metrics on a deployed QueryServer returns
+        Prometheus-parseable text including the request latency histogram,
+        admission-queue depth, breaker state, and jit recompile count."""
+
+        async def body(client, server):
+            for qid in range(3):
+                resp = await client.post("/queries.json", json={"qid": qid})
+                assert resp.status == 200
+            m = await client.get("/metrics")
+            assert m.status == 200
+            assert m.headers["Content-Type"].startswith("text/plain")
+            parsed = parse_prometheus(await m.text())
+            assert (
+                {"endpoint": "/queries.json", "status": "200"},
+                3.0,
+            ) in parsed["pio_requests_total"]
+            assert any(
+                l.get("le") == "+Inf" and v == 3.0
+                for l, v in parsed["pio_request_seconds_bucket"]
+            )
+            assert parsed["pio_queue_depth"] == [({}, 0.0)]
+            assert ({"breaker": "dispatch"}, 0.0) in parsed["pio_breaker_state"]
+            # jit recompile count present (0 after warmup baseline is fine)
+            assert "pio_jit_recompile_storm" in parsed
+            assert "pio_load_shed_total" in parsed
+            assert "pio_deadline_exceeded_total" in parsed
+
+        _run_query_server(body)
+
+    def test_trace_id_spans_ingress_batch_and_storage(self, memory_storage):
+        """Acceptance: one trace id observed across ingress, batch, and
+        storage spans — via the ring buffer, /traces/recent, and the
+        structured JSON log."""
+        from predictionio_tpu.data.storage.traced import trace_dao
+        from tests.sample_engine import Serving0
+
+        traced_apps = trace_dao(
+            memory_storage.get_meta_data_apps(), "apps"
+        )
+
+        class StorageTouchingServing(Serving0):
+            """Realistic query-time storage read (e.g. the ecommerce
+            template fetching recent user events at predict time)."""
+
+            def supplement(self, query):
+                traced_apps.get_all()
+                return query
+
+        tid = mint_trace_id()
+
+        async def body(client, server):
+            trace_logger = logging.getLogger("pio.trace")
+            records: list[str] = []
+
+            class Capture(logging.Handler):
+                def emit(self, record):
+                    records.append(record.getMessage())
+
+            handler = Capture(level=logging.INFO)
+            old_level = trace_logger.level
+            trace_logger.setLevel(logging.INFO)
+            trace_logger.addHandler(handler)
+            try:
+                resp = await client.post(
+                    "/queries.json",
+                    json={"qid": 5},
+                    headers={TRACE_HEADER: tid},
+                )
+                assert resp.status == 200
+                assert resp.headers[TRACE_HEADER] == tid
+            finally:
+                trace_logger.removeHandler(handler)
+                trace_logger.setLevel(old_level)
+            spans = get_tracer().find(tid)
+            kinds = {s["kind"] for s in spans}
+            assert {"ingress", "batch", "storage"} <= kinds, spans
+            storage_span = next(s for s in spans if s["kind"] == "storage")
+            assert storage_span["name"] == "storage.apps.get_all"
+            batch_span = next(s for s in spans if s["kind"] == "batch")
+            for key in ("queue_ms", "dispatch_ms", "fetch_ms"):
+                assert key in batch_span["tags"]
+            # /traces/recent serves the same spans
+            t = await client.get("/traces/recent?limit=50")
+            served = [s for s in (await t.json())["spans"] if s["traceId"] == tid]
+            assert {s["kind"] for s in served} >= {"ingress", "batch", "storage"}
+            # the structured log saw all three hops under ONE trace id
+            logged = [json.loads(r) for r in records]
+            logged_kinds = {s["kind"] for s in logged if s["traceId"] == tid}
+            assert {"ingress", "batch", "storage"} <= logged_kinds
+
+        # swap the serving class into the engine the helper builds
+        import sys
+
+        sys.path.insert(0, "tests") if "tests" not in sys.path else None
+        from tests.test_resilience import _make_query_server
+
+        async def outer():
+            get_tracer().clear()
+            server = _make_query_server()
+            engine = server.engine
+            engine.serving_classes = {"s": StorageTouchingServing}
+            algorithms, serving, models = server._active
+            server._active = (algorithms, StorageTouchingServing(), models)
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                await body(client, server)
+            finally:
+                await client.close()
+
+        asyncio.run(outer())
+
+    def test_shed_and_deadline_counters_move_under_chaos(self):
+        """Acceptance: a chaos run shows shed/deadline counters moving."""
+        from tests.sample_engine import Algo0
+
+        async def body(client, server):
+            # wedge the dispatch path so queries pile into the queue
+            original = Algo0.predict_batch_dispatch
+
+            def slow_dispatch(self, model, queries):
+                import time as _t
+
+                _t.sleep(0.4)  # > request_timeout_s
+                return original(self, model, queries)
+
+            Algo0.predict_batch_dispatch = slow_dispatch
+            try:
+                results = await asyncio.gather(
+                    *(
+                        client.post("/queries.json", json={"qid": i})
+                        for i in range(8)
+                    )
+                )
+                statuses = [r.status for r in results]
+                assert all(s in (200, 503) for s in statuses)
+                assert 503 in statuses
+            finally:
+                Algo0.predict_batch_dispatch = original
+            parsed = parse_prometheus(await (await client.get("/metrics")).text())
+            shed = sum(v for _, v in parsed.get("pio_load_shed_total", ()))
+            deadlines = sum(
+                v for _, v in parsed.get("pio_deadline_exceeded_total", ())
+            )
+            assert shed + deadlines > 0
+            # 503s are counted per status by the envelope
+            assert any(
+                l.get("status") == "503" and v > 0
+                for l, v in parsed["pio_requests_total"]
+            )
+
+        _run_query_server(
+            body,
+            request_timeout_s=0.15,
+            queue_high_water=2,
+            max_batch_size=1,
+        )
+
+    def test_breaker_transitions_counted(self):
+        async def body(client, server):
+            for _ in range(server.config.breaker_threshold):
+                server.dispatch_breaker.record_failure()
+            assert server.dispatch_breaker.state == OPEN
+            parsed = parse_prometheus(await (await client.get("/metrics")).text())
+            assert (
+                {"breaker": "dispatch", "to": "open"},
+                1.0,
+            ) in parsed["pio_breaker_transitions_total"]
+            assert ({"breaker": "dispatch"}, 2.0) in parsed["pio_breaker_state"]
+            server.dispatch_breaker.reset()
+            parsed = parse_prometheus(await (await client.get("/metrics")).text())
+            assert (
+                {"breaker": "dispatch", "to": "closed"},
+                1.0,
+            ) in parsed["pio_breaker_transitions_total"]
+
+        _run_query_server(body)
+
+
+# ---------------------------------------------------------------------------
+# event server end-to-end
+# ---------------------------------------------------------------------------
+
+
+EVENT = {"event": "rate", "entityType": "user", "entityId": "u1"}
+
+
+def _run_event_server(body):
+    import sys
+
+    sys.path.insert(0, "tests") if "tests" not in sys.path else None
+    from tests.test_resilience import _make_event_server
+
+    async def outer():
+        get_tracer().clear()
+        server, injector, key = _make_event_server()
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            await body(client, server, injector, key)
+        finally:
+            await client.close()
+
+    asyncio.run(outer())
+
+
+class TestEventServerObs:
+    def test_metrics_and_trace_header(self):
+        async def body(client, server, injector, key):
+            tid = mint_trace_id()
+            resp = await client.post(
+                f"/events.json?accessKey={key}",
+                json=EVENT,
+                headers={TRACE_HEADER: tid},
+            )
+            assert resp.status == 201
+            assert resp.headers[TRACE_HEADER] == tid
+            # the storage span joined the ingress trace across the
+            # executor hop
+            spans = get_tracer().find(tid)
+            kinds = {s["kind"] for s in spans}
+            assert {"ingress", "storage"} <= kinds, spans
+            names = {s["name"] for s in spans}
+            assert "storage.l_events.insert" in names
+            parsed = parse_prometheus(await (await client.get("/metrics")).text())
+            assert (
+                {"endpoint": "/events.json", "status": "201"},
+                1.0,
+            ) in parsed["pio_requests_total"]
+            # ingestion counters are always-on (the --stats flag only
+            # gates serving the legacy /stats.json view)
+            assert any(
+                l["status"] == "201" and v == 1.0
+                for l, v in parsed["pio_events_ingested_total"]
+            )
+            assert ({"breaker": "eventdata"}, 0.0) in parsed["pio_breaker_state"]
+
+        _run_event_server(body)
+
+    def test_retry_and_breaker_counters_move_under_chaos(self):
+        """Acceptance: chaos shows retry + breaker counters moving."""
+
+        async def body(client, server, injector, key):
+            injector.inject("insert", fail_count=1)
+            resp = await client.post(f"/events.json?accessKey={key}", json=EVENT)
+            assert resp.status == 201  # retried through the transient fault
+            parsed = parse_prometheus(await (await client.get("/metrics")).text())
+            assert sum(
+                v for _, v in parsed["pio_storage_retries_total"]
+            ) >= 1.0
+            # now a persistent fault trips the breaker
+            injector.inject("insert", fail_count=1000)
+            await client.post(f"/events.json?accessKey={key}", json=EVENT)
+            assert server.storage_policy.breaker.state == OPEN
+            parsed = parse_prometheus(await (await client.get("/metrics")).text())
+            assert (
+                {"breaker": "eventdata", "to": "open"},
+                1.0,
+            ) in parsed["pio_breaker_transitions_total"]
+            assert ({"breaker": "eventdata"}, 2.0) in parsed["pio_breaker_state"]
+            server.storage_policy.breaker.reset()
+
+        _run_event_server(body)
+
+    def test_stats_json_still_backward_compatible(self):
+        import sys
+
+        sys.path.insert(0, "tests") if "tests" not in sys.path else None
+        from predictionio_tpu.data.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from tests.test_event_server import make_storage
+
+        async def outer():
+            storage, key = make_storage()
+            server = EventServer(
+                storage=storage, config=EventServerConfig(stats=True)
+            )
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                await client.post(f"/events.json?accessKey={key}", json=EVENT)
+                resp = await client.get(f"/stats.json?accessKey={key}")
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["longLive"]["statusCode"] == [
+                    {"status": 201, "count": 1}
+                ]
+                assert data["longLive"]["basic"][0]["event"] == "rate"
+                assert data["currentHour"]["startTime"]
+            finally:
+                await client.close()
+
+        asyncio.run(outer())
+
+
+# ---------------------------------------------------------------------------
+# pio top + dashboard panels
+# ---------------------------------------------------------------------------
+
+
+def _fake_metrics_text(requests=100.0, shed=5.0) -> str:
+    reg = MetricsRegistry()
+    reg.counter(
+        "pio_requests_total", labelnames=("endpoint", "status")
+    ).inc(requests, endpoint="/queries.json", status="200")
+    reg.counter("pio_load_shed_total").inc(shed)
+    reg.counter("pio_deadline_exceeded_total").inc(2)
+    reg.gauge("pio_queue_depth").set(3)
+    reg.gauge("pio_queue_high_water").set(256)
+    reg.gauge("pio_breaker_state", labelnames=("breaker",)).set(
+        2, breaker="dispatch"
+    )
+    reg.counter("pio_jit_cache_misses_total", labelnames=("fn",)).inc(
+        4, fn="ops.als._topk"
+    )
+    h = reg.histogram("pio_request_seconds", labelnames=("endpoint",))
+    for v in (0.002, 0.004, 0.008, 0.2):
+        h.observe(v, endpoint="/queries.json")
+    return reg.render_prometheus()
+
+
+class TestPioTop:
+    def test_summarize_single_sample(self):
+        s = summarize(parse_prometheus(_fake_metrics_text()))
+        assert s["requests_total"] == 100
+        assert s["shed_total"] == 5
+        assert s["queue_depth"] == 3
+        assert s["queue_high_water"] == 256
+        assert s["recompiles"] == 4
+        assert s["breakers"] == {"dispatch": "open"}
+        assert s["qps"] is None  # needs two samples
+        assert 0 < s["p50_ms"] < s["p99_ms"]
+
+    def test_rates_from_two_samples(self):
+        prev = parse_prometheus(_fake_metrics_text(requests=100, shed=5))
+        cur = parse_prometheus(_fake_metrics_text(requests=150, shed=10))
+        s = summarize(cur, prev=prev, interval_s=2.0)
+        assert s["qps"] == pytest.approx(25.0)
+        assert s["shed_rate"] == pytest.approx(2.5)
+
+    def test_render_one_screen(self):
+        s = summarize(parse_prometheus(_fake_metrics_text()))
+        screen = render(s, "http://x:8000")
+        assert "qps" in screen and "p95" in screen
+        assert "dispatch=open" in screen
+        assert "recompiles" in screen
+
+    def test_run_top_loop_with_injected_fetch(self):
+        screens: list[str] = []
+        fetches = []
+
+        def fetch(url):
+            fetches.append(url)
+            return _fake_metrics_text(requests=100 * (len(fetches)))
+
+        rc = run_top(
+            "http://fake:1",
+            interval_s=0.0,
+            iterations=3,
+            fetch=fetch,
+            out=screens.append,
+            clear_screen=False,
+            sleep=lambda s: None,
+        )
+        assert rc == 0
+        assert len(screens) == 3
+        assert "pio top — http://fake:1" in screens[0]
+
+    def test_run_top_unreachable(self):
+        screens: list[str] = []
+
+        def fetch(url):
+            raise ConnectionError("nope")
+
+        rc = run_top(
+            "http://down:1",
+            iterations=1,
+            fetch=fetch,
+            out=screens.append,
+            clear_screen=False,
+        )
+        assert rc == 0
+        assert "unreachable" in screens[0]
+
+    def test_cli_top_subcommand_registered(self):
+        from predictionio_tpu.tools.cli import build_parser
+
+        args = build_parser().parse_args(
+            ["top", "--url", "http://h:8000", "--once"]
+        )
+        assert args.url == "http://h:8000" and args.once
+
+    def test_top_against_live_server(self):
+        """pio top's fetch/parse path against a real QueryServer."""
+
+        async def body(client, server):
+            await client.post("/queries.json", json={"qid": 1})
+            text = await (await client.get("/metrics")).text()
+            s = summarize(parse_prometheus(text))
+            assert s["requests_total"] == 1
+            assert s["breakers"].get("dispatch") == CLOSED
+            assert render(s, "live")  # renders without raising
+
+        _run_query_server(body)
+
+
+class TestDashboardPanels:
+    def test_panels_render_from_metrics(self, memory_storage):
+        from predictionio_tpu.tools.dashboard import Dashboard
+
+        dash = Dashboard(
+            storage=memory_storage,
+            metrics_urls=["http://qs:8000", "http://down:9"],
+        )
+
+        async def fake_fetch(url):
+            return _fake_metrics_text() if "qs" in url else None
+
+        dash._fetch_metrics = fake_fetch
+
+        async def outer():
+            client = TestClient(TestServer(dash.make_app()))
+            await client.start_server()
+            try:
+                resp = await client.get("/")
+                assert resp.status == 200
+                page = await resp.text()
+                assert "http://qs:8000" in page
+                assert "state-open" in page  # breaker panel shows the state
+                assert "jit recompiles" in page
+                assert "unreachable" in page  # the down server degrades
+            finally:
+                await client.close()
+
+        asyncio.run(outer())
+
+    def test_no_sources_hint(self, memory_storage):
+        from predictionio_tpu.tools.dashboard import Dashboard
+
+        dash = Dashboard(storage=memory_storage)
+
+        async def outer():
+            client = TestClient(TestServer(dash.make_app()))
+            await client.start_server()
+            try:
+                page = await (await client.get("/")).text()
+                assert "--metrics-url" in page
+            finally:
+                await client.close()
+
+        asyncio.run(outer())
