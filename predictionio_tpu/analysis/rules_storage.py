@@ -144,3 +144,68 @@ def check_storage_contract(ctx: FileContext):
                 )
             )
     return findings
+
+
+# ---------------------------------------------------------------------------
+# raw pickle deserialization
+# ---------------------------------------------------------------------------
+
+register_rule(
+    "storage-raw-pickle",
+    "storage-contract",
+    Severity.ERROR,
+    "pickle.load(s) outside the checksummed model-io boundary: "
+    "deserializing unverified bytes silently turns storage corruption or "
+    "tampering into arbitrary code execution",
+)
+
+# the only files allowed to unpickle: both sit behind the PIOTPU02
+# sha256-verified framing (workflow/model_io.py) or serve verified
+# registry artifacts (registry/store.py)
+_PICKLE_ALLOWED_SUFFIXES = (
+    os.path.join("workflow", "model_io.py"),
+    os.path.join("registry", "store.py"),
+)
+
+
+@register_checker
+def check_raw_pickle(ctx: FileContext):
+    path = (ctx.path or ctx.display_path).replace("/", os.sep)
+    if any(path.endswith(suffix) for suffix in _PICKLE_ALLOWED_SUFFIXES):
+        return []
+    pickle_modules = {"pickle", "cPickle", "_pickle"}
+    # module names the pickle modules are bound to (incl. `import pickle
+    # as pkl` aliases) and bare `load`/`loads` names imported from them
+    module_names = set(pickle_modules)
+    bare: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in pickle_modules:
+                    module_names.add(alias.asname or alias.name)
+        elif isinstance(node, ast.ImportFrom) and node.module in pickle_modules:
+            for alias in node.names:
+                if alias.name in ("load", "loads"):
+                    bare.add(alias.asname or alias.name)
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        hit = (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in ("load", "loads")
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in module_names
+        ) or (isinstance(fn, ast.Name) and fn.id in bare)
+        if hit:
+            findings.append(
+                ctx.finding(
+                    "storage-raw-pickle",
+                    node,
+                    "raw pickle deserialization; route model bytes through "
+                    "workflow/model_io.py (sha256-verified PIOTPU02 framing) "
+                    "or the registry store",
+                )
+            )
+    return findings

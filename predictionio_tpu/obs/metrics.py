@@ -293,6 +293,25 @@ class Histogram(_Metric):
             return 0.0
         return self._percentile_of(series, q)
 
+    def bucket_counts(self, **labels: str) -> list[int]:
+        """Per-bucket observation counts snapshot (last slot = +Inf); a
+        zero vector when the series doesn't exist yet. Callers keep this
+        as a baseline and hand the elementwise delta of two snapshots to
+        :meth:`percentile_from_counts` — percentiles over a *window*,
+        which a lifetime histogram cannot answer directly."""
+        series = self._snapshot_series(self._key(labels))
+        if series is None:
+            return [0] * (len(self.buckets) + 1)
+        return list(series.counts)
+
+    def percentile_from_counts(self, counts: list[int], q: float) -> float:
+        """Percentile over an externally supplied bucket-count vector
+        (e.g. a delta of two :meth:`bucket_counts` snapshots)."""
+        series = _HistogramSeries(len(self.buckets))
+        series.counts = list(counts)
+        series.count = sum(counts)
+        return self._percentile_of(series, q)
+
     def summary(self, **labels: str) -> dict[str, float]:
         """One consistent snapshot -> count/mean/p50/p95/p99/sum (seconds)."""
         series = self._snapshot_series(self._key(labels))

@@ -41,8 +41,11 @@ class BreakerInstruments:
 
     def watch(self, breaker: CircuitBreaker) -> CircuitBreaker:
         """Attach the transition listener and include the breaker in
-        scrape-time state refreshes. Returns the breaker for chaining."""
-        breaker.listener = self.on_transition
+        scrape-time state refreshes. Returns the breaker for chaining.
+        Chains (never overwrites) any listener already installed — the
+        rollout router hangs its trip-to-rollback hook on the same
+        breaker the instruments watch."""
+        breaker.chain_listener(self.on_transition)
         self._breakers.append(breaker)
         self.collect()
         return breaker

@@ -1,0 +1,74 @@
+"""Lineage manifests: the self-describing identity of one model version.
+
+MLlib's persistence paper (1505.06807) motivates portable, self-describing
+model artifacts; the TensorFlow paper (1605.08695) treats versioned
+checkpoint lineage as a first-class system concern. A manifest records
+everything needed to answer "what exactly is this blob and where did it
+come from" without loading it: engine identity, a canonical hash of the
+training params, the parent version it superseded, metrics known at train
+time, and the blob's sha256 + length (verified on every read by
+:mod:`predictionio_tpu.registry.store`).
+
+Stdlib-only: ``pio models`` must start without jax/numpy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import hashlib
+import json
+from typing import Any
+
+UTC = _dt.timezone.utc
+
+
+def params_hash_of(params: Any) -> str:
+    """Canonical sha256 of an engine-params JSON structure (sorted keys,
+    compact separators) so semantically identical params always hash
+    identically regardless of dict ordering."""
+    canonical = json.dumps(params, sort_keys=True, separators=(",", ":"), default=str)
+    return hashlib.sha256(canonical.encode("utf-8")).hexdigest()
+
+
+@dataclasses.dataclass
+class ModelManifest:
+    """One versioned model artifact's lineage record."""
+
+    version: str  # registry version id, e.g. "v000007" ("" until published)
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str = ""
+    instance_id: str = ""  # metadata-store EngineInstance this came from
+    params_hash: str = ""  # params_hash_of(engine params json)
+    parent_version: str = ""  # stable version at publish time ("" for first)
+    created_at: str = ""  # ISO-8601 UTC
+    data_span: dict[str, Any] = dataclasses.field(default_factory=dict)
+    metrics: dict[str, Any] = dataclasses.field(default_factory=dict)
+    blob_sha256: str = ""  # filled by the store on publish
+    blob_size: int = 0
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json_dict(cls, data: dict[str, Any]) -> "ModelManifest":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+    @staticmethod
+    def now_iso() -> str:
+        return _dt.datetime.now(tz=UTC).isoformat()
+
+    def summary_row(self) -> dict[str, Any]:
+        """The compact row ``pio models list`` prints."""
+        return {
+            "version": self.version,
+            "created": self.created_at,
+            "instance": self.instance_id,
+            "paramsHash": self.params_hash[:12],
+            "sha256": self.blob_sha256[:12],
+            "bytes": self.blob_size,
+            "parent": self.parent_version,
+        }

@@ -1,0 +1,438 @@
+"""Content-addressed artifact store + rollout state machine.
+
+Layout under one base directory (default ``$PIO_REGISTRY_DIR``, else
+``$PIO_FS_BASEDIR/registry``)::
+
+    <base>/<engine_key>/
+        versions/v000001.json     one ModelManifest per published version
+        blobs/<sha256>            the artifact bytes, content-addressed
+        state.json                RolloutState (stable/candidate/history)
+
+``engine_key`` is a filesystem-safe digest of the engine id (engine ids
+may be absolute directory paths). Every write is atomic (tmp file +
+``os.replace`` in the same directory) so a crashed publish can never leave
+a half-written manifest that a concurrent deploy would trust. Blob reads
+re-verify the manifest's sha256 — a truncated or bit-flipped artifact
+surfaces as :class:`ArtifactIntegrityError`, never as a pickle of garbage.
+
+GC keeps the last N versions plus anything the rollout state still
+references (stable, candidate, previous stable); blobs are deleted only
+once no surviving manifest references them (two manifests may share one
+blob: re-publishing identical bytes is deduplicated by content address).
+
+The registry is the source of truth for "what serves"; the metadata
+store's EngineInstances table remains the training ledger the manifests
+point back into (docs/DECISIONS.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import re
+import tempfile
+import threading
+from typing import Any
+
+from predictionio_tpu.registry.manifest import ModelManifest
+
+logger = logging.getLogger(__name__)
+
+MODE_OFF = "off"
+MODE_CANARY = "canary"
+MODE_SHADOW = "shadow"
+
+_VERSION_RE = re.compile(r"^v(\d{6,})$")
+_HISTORY_LIMIT = 50
+
+
+class ArtifactIntegrityError(RuntimeError):
+    """An artifact failed its checksum/length verification — the bytes on
+    disk are not the bytes that were published."""
+
+
+def default_registry_dir() -> str:
+    """Resolution order: ``PIO_REGISTRY_DIR``, else ``registry/`` under
+    ``PIO_FS_BASEDIR`` (or its ``~/.pio_store`` default)."""
+    explicit = os.environ.get("PIO_REGISTRY_DIR")
+    if explicit:
+        return explicit
+    base = os.environ.get(
+        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_store")
+    )
+    return os.path.join(base, "registry")
+
+
+@dataclasses.dataclass
+class RolloutState:
+    """The rollout state machine for one engine.
+
+    ``stable`` serves pinned traffic; ``candidate`` (when set) takes the
+    configured canary fraction or shadow traffic while baking. ``history``
+    is an append-only (bounded) trail of publish/stage/promote/rollback
+    events — the audit log ``pio models show`` prints.
+    """
+
+    stable: str = ""
+    candidate: str = ""
+    mode: str = MODE_OFF  # off | canary | shadow
+    fraction: float = 0.0
+    previous_stable: str = ""  # rollback target after a promote
+    staged_at: str = ""  # when the current candidate was staged
+    updated_at: str = ""
+    history: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json_dict(cls, data: dict[str, Any]) -> "RolloutState":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """Write-then-rename in the destination directory: readers see either
+    the old complete file or the new complete file, never a prefix."""
+    directory = os.path.dirname(path)
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".tmp-")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class ArtifactStore:
+    """Versioned model artifacts + rollout state for any number of engines.
+
+    Thread-safe within one process (one lock serializes version allocation
+    and state transitions); cross-process publishers are serialized by the
+    training workflow itself (one coordinator persists — see
+    ``core_workflow.run_train``).
+    """
+
+    def __init__(self, base_dir: str | None = None):
+        self.base_dir = os.path.abspath(base_dir or default_registry_dir())
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------- layout
+    @staticmethod
+    def engine_key(engine_id: str) -> str:
+        """Filesystem-safe directory name for an engine id. Engine ids may
+        be absolute paths; keep a readable slug plus a collision-proof
+        digest."""
+        slug = re.sub(r"[^A-Za-z0-9_-]+", "-", os.path.basename(str(engine_id)))
+        slug = slug.strip("-") or "engine"
+        digest = hashlib.sha256(str(engine_id).encode("utf-8")).hexdigest()[:10]
+        return f"{slug[:40]}-{digest}"
+
+    def _engine_dir(self, engine_id: str) -> str:
+        return os.path.join(self.base_dir, self.engine_key(engine_id))
+
+    def _manifest_path(self, engine_id: str, version: str) -> str:
+        return os.path.join(self._engine_dir(engine_id), "versions", f"{version}.json")
+
+    def _blob_path(self, engine_id: str, sha256: str) -> str:
+        return os.path.join(self._engine_dir(engine_id), "blobs", sha256)
+
+    def _state_path(self, engine_id: str) -> str:
+        return os.path.join(self._engine_dir(engine_id), "state.json")
+
+    def engines(self) -> list[str]:
+        """Engine keys present in the registry (directory names; the
+        original engine id is recorded in each manifest)."""
+        if not os.path.isdir(self.base_dir):
+            return []
+        return sorted(
+            d
+            for d in os.listdir(self.base_dir)
+            if os.path.isdir(os.path.join(self.base_dir, d, "versions"))
+        )
+
+    # ------------------------------------------------------------ versions
+    def list_versions(self, engine_id: str) -> list[ModelManifest]:
+        return self.versions_by_key(self.engine_key(engine_id))
+
+    def versions_by_key(self, engine_key: str) -> list[ModelManifest]:
+        """Same listing keyed by the on-disk directory name (the admin API
+        enumerates engines by key; only manifests know the original id)."""
+        vdir = os.path.join(self.base_dir, engine_key, "versions")
+        if not os.path.isdir(vdir):
+            return []
+        out: list[ModelManifest] = []
+        for name in sorted(os.listdir(vdir)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(vdir, name), encoding="utf-8") as fh:
+                    out.append(ModelManifest.from_json_dict(json.load(fh)))
+            except (OSError, ValueError, TypeError):
+                logger.warning("unreadable manifest %s (skipped)", name)
+        out.sort(key=lambda m: m.version)
+        return out
+
+    def get_manifest(self, engine_id: str, version: str) -> ModelManifest | None:
+        path = self._manifest_path(engine_id, version)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as fh:
+            return ModelManifest.from_json_dict(json.load(fh))
+
+    def _next_version(self, engine_id: str) -> str:
+        highest = 0
+        for m in self.list_versions(engine_id):
+            match = _VERSION_RE.match(m.version)
+            if match:
+                highest = max(highest, int(match.group(1)))
+        return f"v{highest + 1:06d}"
+
+    def publish(
+        self,
+        manifest: ModelManifest,
+        blob: bytes,
+        keep_last: int | None = None,
+    ) -> ModelManifest:
+        """Write the blob (content-addressed) and its manifest atomically;
+        assign the next version id if the manifest doesn't carry one. The
+        first published version becomes stable automatically — there is
+        nothing to canary against yet."""
+        with self._lock:
+            engine_id = manifest.engine_id
+            state = self.get_state(engine_id)
+            if not manifest.version:
+                manifest.version = self._next_version(engine_id)
+            if not manifest.created_at:
+                manifest.created_at = ModelManifest.now_iso()
+            if not manifest.parent_version:
+                manifest.parent_version = state.stable
+            manifest.blob_sha256 = hashlib.sha256(blob).hexdigest()
+            manifest.blob_size = len(blob)
+            blob_path = self._blob_path(engine_id, manifest.blob_sha256)
+            if not os.path.exists(blob_path):  # dedupe by content address
+                _atomic_write(blob_path, blob)
+            _atomic_write(
+                self._manifest_path(engine_id, manifest.version),
+                json.dumps(manifest.to_json_dict(), indent=1).encode("utf-8"),
+            )
+            self._record(state, "publish", version=manifest.version)
+            if not state.stable:
+                state.stable = manifest.version
+                self._record(state, "auto-stable", version=manifest.version)
+            self._save_state(engine_id, state)
+            if keep_last:
+                self.gc(engine_id, keep_last)
+            logger.info(
+                "published %s %s (%d bytes, sha %s)",
+                self.engine_key(engine_id),
+                manifest.version,
+                manifest.blob_size,
+                manifest.blob_sha256[:12],
+            )
+            return manifest
+
+    def load_blob(self, engine_id: str, version: str) -> bytes:
+        """Read and *verify* one version's artifact bytes."""
+        manifest = self.get_manifest(engine_id, version)
+        if manifest is None:
+            raise ArtifactIntegrityError(
+                f"no manifest for version {version!r} of {engine_id!r}"
+            )
+        path = self._blob_path(engine_id, manifest.blob_sha256)
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except OSError as exc:
+            raise ArtifactIntegrityError(
+                f"artifact blob missing for {version}: {exc}"
+            ) from exc
+        if len(blob) != manifest.blob_size:
+            raise ArtifactIntegrityError(
+                f"artifact {version} length mismatch: manifest says "
+                f"{manifest.blob_size} bytes, blob is {len(blob)}"
+            )
+        digest = hashlib.sha256(blob).hexdigest()
+        if digest != manifest.blob_sha256:
+            raise ArtifactIntegrityError(
+                f"artifact {version} checksum mismatch: manifest says "
+                f"{manifest.blob_sha256[:12]}…, blob hashes to {digest[:12]}…"
+            )
+        return blob
+
+    def gc(self, engine_id: str, keep_last: int) -> list[str]:
+        """Drop all but the newest ``keep_last`` versions, never dropping
+        a version the rollout state still references. Returns the removed
+        version ids."""
+        with self._lock:
+            state = self.get_state(engine_id)
+            pinned = {state.stable, state.candidate, state.previous_stable} - {""}
+            versions = self.list_versions(engine_id)
+            # keep = newest N plus everything pinned — pins must not eat
+            # into the newest-N budget, or a publish with pinned count >=
+            # keep_last would delete the very version it just wrote
+            keep = {m.version for m in versions[-max(1, keep_last):]} | pinned
+            removed: list[str] = []
+            for m in versions:
+                if m.version in keep:
+                    continue
+                try:
+                    os.unlink(self._manifest_path(engine_id, m.version))
+                except OSError:
+                    continue
+                removed.append(m.version)
+            if removed:
+                # delete blobs no surviving manifest references
+                live_shas = {m.blob_sha256 for m in self.list_versions(engine_id)}
+                for m in versions:
+                    if m.version in removed and m.blob_sha256 not in live_shas:
+                        try:
+                            os.unlink(self._blob_path(engine_id, m.blob_sha256))
+                        except OSError:
+                            pass
+                logger.info(
+                    "gc %s: removed %s", self.engine_key(engine_id), removed
+                )
+            return removed
+
+    # --------------------------------------------------------------- state
+    def get_state(self, engine_id: str) -> RolloutState:
+        return self.state_by_key(self.engine_key(engine_id))
+
+    def state_by_key(self, engine_key: str) -> RolloutState:
+        path = os.path.join(self.base_dir, engine_key, "state.json")
+        if not os.path.exists(path):
+            return RolloutState()
+        try:
+            with open(path, encoding="utf-8") as fh:
+                return RolloutState.from_json_dict(json.load(fh))
+        except (OSError, ValueError, TypeError):
+            logger.warning(
+                "unreadable rollout state for %s; starting fresh", engine_key
+            )
+            return RolloutState()
+
+    def _save_state(self, engine_id: str, state: RolloutState) -> None:
+        state.updated_at = ModelManifest.now_iso()
+        state.history = state.history[-_HISTORY_LIMIT:]
+        _atomic_write(
+            self._state_path(engine_id),
+            json.dumps(state.to_json_dict(), indent=1).encode("utf-8"),
+        )
+
+    @staticmethod
+    def _record(state: RolloutState, action: str, **fields: Any) -> None:
+        state.history.append(
+            {"at": ModelManifest.now_iso(), "action": action, **fields}
+        )
+
+    def stage_candidate(
+        self,
+        engine_id: str,
+        version: str,
+        mode: str = MODE_CANARY,
+        fraction: float = 0.1,
+    ) -> RolloutState:
+        """Begin a progressive rollout: ``version`` starts taking the
+        canary fraction (or shadow traffic) next to the pinned stable."""
+        if mode not in (MODE_CANARY, MODE_SHADOW):
+            raise ValueError(f"mode must be canary|shadow, got {mode!r}")
+        if self.get_manifest(engine_id, version) is None:
+            raise ValueError(f"unknown version {version!r}")
+        with self._lock:
+            state = self.get_state(engine_id)
+            if version == state.stable:
+                raise ValueError(f"{version} is already stable")
+            state.candidate = version
+            state.mode = mode
+            state.fraction = max(0.0, min(1.0, float(fraction)))
+            state.staged_at = ModelManifest.now_iso()
+            self._record(
+                state, "stage", version=version, mode=mode, fraction=state.fraction
+            )
+            self._save_state(engine_id, state)
+            return state
+
+    def promote(self, engine_id: str, version: str | None = None) -> RolloutState:
+        """Candidate (or an explicit version) becomes stable; the old
+        stable is retained as the rollback target."""
+        with self._lock:
+            state = self.get_state(engine_id)
+            target = version or state.candidate
+            if not target:
+                raise ValueError("nothing to promote: no candidate staged")
+            if self.get_manifest(engine_id, target) is None:
+                raise ValueError(f"unknown version {target!r}")
+            if target == state.stable:
+                raise ValueError(f"{target} is already stable")
+            state.previous_stable = state.stable
+            state.stable = target
+            if state.candidate and state.candidate != target:
+                # promoting PAST a staged candidate obsoletes that rollout:
+                # leaving it staged would report a canary no server is
+                # baking and pin the orphan against GC forever
+                self._record(
+                    state, "unstage", version=state.candidate, reason="superseded"
+                )
+            state.candidate = ""
+            state.mode = MODE_OFF
+            state.fraction = 0.0
+            self._record(
+                state, "promote", version=target, from_=state.previous_stable
+            )
+            self._save_state(engine_id, state)
+            return state
+
+    def unstage(self, engine_id: str, reason: str = "") -> RolloutState:
+        """Drop a staged candidate ONLY — the stable pin is never touched.
+        A no-op when nothing is staged. This is the serving-side rollback
+        primitive: the server must not inherit :meth:`rollback`'s
+        previous-stable revert, or a breaker trip after a swallowed stage
+        write would silently flip the registry to an older model than the
+        one actually serving."""
+        with self._lock:
+            state = self.get_state(engine_id)
+            if state.candidate:
+                dropped = state.candidate
+                state.candidate = ""
+                state.mode = MODE_OFF
+                state.fraction = 0.0
+                self._record(state, "rollback", version=dropped, reason=reason)
+                self._save_state(engine_id, state)
+            return state
+
+    def rollback(self, engine_id: str, reason: str = "manual") -> RolloutState:
+        """Back out: drop a staged candidate if one exists, else revert
+        stable to the previous stable (post-promote regret)."""
+        with self._lock:
+            state = self.get_state(engine_id)
+            if state.candidate:
+                return self.unstage(engine_id, reason=reason)
+            if state.previous_stable:
+                reverted_from = state.stable
+                state.stable = state.previous_stable
+                state.previous_stable = ""
+                self._record(
+                    state,
+                    "rollback",
+                    version=reverted_from,
+                    to=state.stable,
+                    reason=reason,
+                )
+            else:
+                raise ValueError(
+                    "nothing to roll back: no candidate staged and no "
+                    "previous stable recorded"
+                )
+            self._save_state(engine_id, state)
+            return state

@@ -156,6 +156,23 @@ class CircuitBreaker:
         if transition is not None:
             self._notify(*transition)
 
+    def chain_listener(self, fn: Callable[[str, str, str], None]) -> None:
+        """Add a transition observer without displacing the existing one
+        (the obs instruments claim ``listener`` wholesale; the rollout
+        router needs trip notifications on the same breaker). Listeners
+        run in chain order, each isolated from the others' exceptions."""
+        previous = self.listener
+
+        def chained(name: str, old: str, new: str) -> None:
+            if previous is not None:
+                try:
+                    previous(name, old, new)
+                except Exception:
+                    pass  # monitoring must never break the state machine
+            fn(name, old, new)
+
+        self.listener = chained
+
     def force_open(self) -> None:
         """Administrative trip (drain a replica without killing it)."""
         transition: tuple[str, str] | None = None

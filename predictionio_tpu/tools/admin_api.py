@@ -3,6 +3,10 @@
 Reference parity: ``tools/.../admin/AdminAPI.scala:39-160`` +
 ``CommandClient.scala`` — GET /, GET /cmd/app, POST /cmd/app (new),
 DELETE /cmd/app/{name} and /cmd/app/{name}/data.
+
+Beyond the reference: GET /cmd/models and /cmd/models/{engine_key} expose
+the model registry's inventory (versions, rollout state, history) so
+fleet tooling can see what every engine serves without touching disk.
 """
 
 from __future__ import annotations
@@ -11,11 +15,17 @@ from aiohttp import web
 
 from predictionio_tpu.data.storage.base import AccessKey, App
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.registry.store import ArtifactStore
 
 
 class AdminServer:
-    def __init__(self, storage: Storage | None = None):
+    def __init__(
+        self,
+        storage: Storage | None = None,
+        registry_dir: str | None = None,
+    ):
         self.storage = storage or Storage.instance()
+        self.registry = ArtifactStore(registry_dir)
 
     async def handle_root(self, request: web.Request) -> web.Response:
         import predictionio_tpu
@@ -88,6 +98,40 @@ class AdminServer:
         self.storage.get_l_events().init(app.id)
         return web.json_response({"message": f"Data of app {name} deleted."})
 
+    async def handle_list_models(self, request: web.Request) -> web.Response:
+        """Registry inventory: one row per engine with its rollout state."""
+        out = []
+        for key in self.registry.engines():
+            versions = self.registry.versions_by_key(key)
+            state = self.registry.state_by_key(key)
+            out.append(
+                {
+                    "engineKey": key,
+                    "engineId": versions[-1].engine_id if versions else "",
+                    "versions": len(versions),
+                    "stable": state.stable,
+                    "candidate": state.candidate,
+                    "mode": state.mode,
+                    "fraction": state.fraction,
+                }
+            )
+        return web.json_response(
+            {"registryDir": self.registry.base_dir, "engines": out}
+        )
+
+    async def handle_show_models(self, request: web.Request) -> web.Response:
+        key = request.match_info["engine_key"]
+        versions = self.registry.versions_by_key(key)
+        if not versions:
+            return web.json_response({"message": "Not Found"}, status=404)
+        return web.json_response(
+            {
+                "engineKey": key,
+                "state": self.registry.state_by_key(key).to_json_dict(),
+                "versions": [m.to_json_dict() for m in versions],
+            }
+        )
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.add_routes(
@@ -97,11 +141,15 @@ class AdminServer:
                 web.post("/cmd/app", self.handle_new_app),
                 web.delete("/cmd/app/{name}", self.handle_delete_app),
                 web.delete("/cmd/app/{name}/data", self.handle_delete_app_data),
+                web.get("/cmd/models", self.handle_list_models),
+                web.get("/cmd/models/{engine_key}", self.handle_show_models),
             ]
         )
         return app
 
 
-def run_admin_server(ip: str = "127.0.0.1", port: int = 7071) -> None:
-    server = AdminServer()
+def run_admin_server(
+    ip: str = "127.0.0.1", port: int = 7071, registry_dir: str | None = None
+) -> None:
+    server = AdminServer(registry_dir=registry_dir)
     web.run_app(server.make_app(), host=ip, port=port, print=None)

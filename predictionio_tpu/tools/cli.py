@@ -6,9 +6,11 @@ Reference parity: ``tools/.../console/Console.scala:134-630`` verb set —
   app {new, list, show, delete, data-delete, channel-new, channel-delete},
   accesskey {new, list, delete}, template {list, get}, import, export, run.
 
-Beyond the reference: ``lint`` (TPU-aware static analysis) and ``top``
+Beyond the reference: ``lint`` (TPU-aware static analysis), ``top``
 (live terminal summary of a running server's /metrics — qps, p95, shed
-rate, breaker states, jit recompile count; see docs/observability.md).
+rate, breaker states, jit recompile count; see docs/observability.md),
+and ``models`` (model registry: versioned artifacts, canary/shadow
+rollout, promote/rollback/diff; see docs/model_registry.md).
 
 Where the reference assembled a spark-submit command line around JVM mains
 (``Runner.runOnSpark``, process boundary #1 in SURVEY.md section 3), this CLI
@@ -300,6 +302,8 @@ def cmd_train(args) -> int:
         engine_params,
         options=options,
         batch=args.batch or "",
+        registry_dir=args.registry_dir,
+        keep_versions=args.keep_versions,
     )
     print(f"Training completed. Engine instance ID: {instance_id}")
     return 0
@@ -360,6 +364,12 @@ def cmd_deploy(args) -> int:
         queue_high_water=args.queue_high_water,
         breaker_threshold=args.breaker_threshold,
         breaker_recovery_s=args.breaker_recovery,
+        registry_dir=args.registry_dir,
+        sticky_key_field=args.sticky_key,
+        candidate_breaker_threshold=args.candidate_breaker_threshold,
+        bake_window_s=args.bake_window,
+        bake_min_requests=args.bake_min_requests,
+        auto_promote=not args.no_auto_promote,
     )
     print(f"Engine server starting on {args.ip}:{args.port} ...")
     run_query_server(args.engine_dir, args.variant, config=config)
@@ -428,7 +438,7 @@ def cmd_adminserver(args) -> int:
     from predictionio_tpu.tools.admin_api import run_admin_server
 
     print(f"Admin server starting on {args.ip}:{args.port} ...")
-    run_admin_server(args.ip, args.port)
+    run_admin_server(args.ip, args.port, registry_dir=args.registry_dir)
     return 0
 
 
@@ -540,6 +550,189 @@ def cmd_export(args) -> int:
 
     n = export_events(args.output, args.app_name, args.channel, format=args.format)
     print(f"Exported {n} events.")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# model registry (docs/model_registry.md)
+# ---------------------------------------------------------------------------
+
+
+def _models_store(args):
+    from predictionio_tpu.registry import ArtifactStore
+
+    return ArtifactStore(getattr(args, "registry_dir", None) or None)
+
+
+def _models_engine_id(args) -> str:
+    if getattr(args, "engine_id", None):
+        return args.engine_id
+    from predictionio_tpu.workflow.engine_loader import load_manifest
+
+    return load_manifest(args.engine_dir, args.variant).engine_id
+
+
+def _http_json(url: str, method: str = "GET", payload=None, timeout: float = 10.0):
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        body = exc.read().decode(errors="replace")
+        try:
+            message = json.loads(body).get("message", body)
+        except ValueError:
+            message = body
+        raise RuntimeError(f"{method} {url} -> {exc.code}: {message}") from exc
+
+
+def cmd_models_list(args) -> int:
+    store = _models_store(args)
+    engine_id = _models_engine_id(args)
+    state = store.get_state(engine_id)
+    versions = store.list_versions(engine_id)
+    if not versions:
+        print(
+            f"No versions in registry {store.base_dir} for engine "
+            f"{engine_id} (key {store.engine_key(engine_id)}). "
+            "Train with PIO_REGISTRY_DIR set (or pio train --registry-dir)."
+        )
+        return 0
+    print(f"Registry: {store.base_dir} (engine key {store.engine_key(engine_id)})")
+    print(f"{'Version':<10} | {'Role':<10} | {'Created':<26} | {'Bytes':>9} | Instance")
+    for m in versions:
+        role = ""
+        if m.version == state.stable:
+            role = "stable"
+        elif m.version == state.candidate:
+            role = f"candidate ({state.mode} {state.fraction:g})"
+        created = (m.created_at or "")[:26]
+        print(f"{m.version:<10} | {role:<10} | {created:<26} | {m.blob_size:>9} | {m.instance_id}")
+    return 0
+
+
+def cmd_models_show(args) -> int:
+    if args.url:
+        data = _http_json(f"{args.url}/models")
+        if not args.version:
+            print(json.dumps(data, indent=2))
+            return 0
+        # a positional version narrows to THAT version (and errors when
+        # the server doesn't know it) instead of dumping unrelated state
+        out = {"version": args.version}
+        for role in ("stable", "candidate"):
+            lane = data.get(role)
+            if lane and lane.get("version") == args.version:
+                out["role"] = role
+                out["live"] = lane
+        registry_row = next(
+            (
+                v
+                for v in (data.get("registry") or {}).get("versions", ())
+                if v.get("version") == args.version
+            ),
+            None,
+        )
+        if registry_row is not None:
+            out["registry"] = registry_row
+        if "live" not in out and registry_row is None:
+            return _die(
+                f"version {args.version} is not known to the server at "
+                f"{args.url}"
+            )
+        print(json.dumps(out, indent=2))
+        return 0
+    store = _models_store(args)
+    engine_id = _models_engine_id(args)
+    state = store.get_state(engine_id)
+    version = args.version or state.stable
+    if not version:
+        return _die("no version given and no stable recorded; see `pio models list`")
+    manifest = store.get_manifest(engine_id, version)
+    if manifest is None:
+        return _die(f"unknown version {version}; see `pio models list`")
+    print(
+        json.dumps(
+            {"manifest": manifest.to_json_dict(), "rollout": state.to_json_dict()},
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_models_promote(args) -> int:
+    if args.url:
+        # an explicit version is sent as a guard: the server refuses (409)
+        # if it isn't the staged candidate, instead of promoting whatever
+        # happens to be staged
+        payload = {"version": args.version} if args.version else {}
+        out = _http_json(f"{args.url}/models/promote", method="POST", payload=payload)
+        print(f"Promoted {out.get('version')} (instance {out.get('instanceId')}).")
+        return 0
+    store = _models_store(args)
+    engine_id = _models_engine_id(args)
+    state = store.promote(engine_id, args.version or None)
+    print(f"Promoted {state.stable} to stable (previous: {state.previous_stable or '-'}).")
+    return 0
+
+
+def cmd_models_rollback(args) -> int:
+    if args.url:
+        out = _http_json(f"{args.url}/models/rollback", method="POST", payload={})
+        print(f"Rolled back candidate {out.get('version')}.")
+        return 0
+    store = _models_store(args)
+    engine_id = _models_engine_id(args)
+    state = store.rollback(engine_id, reason="manual (cli)")
+    print(f"Rolled back; stable is {state.stable or '-'}.")
+    return 0
+
+
+def cmd_models_stage(args) -> int:
+    """Stage a candidate on a RUNNING server (sticky canary or shadow)."""
+    out = _http_json(
+        f"{args.url}/models/candidate",
+        method="POST",
+        payload={
+            "version": args.version,
+            "mode": args.mode,
+            "fraction": args.fraction,
+        },
+    )
+    print(
+        f"Staged {out.get('version')} as {out.get('mode')} candidate "
+        f"(fraction {out.get('fraction')})."
+    )
+    return 0
+
+
+def cmd_models_diff(args) -> int:
+    store = _models_store(args)
+    engine_id = _models_engine_id(args)
+    a = store.get_manifest(engine_id, args.version_a)
+    b = store.get_manifest(engine_id, args.version_b)
+    if a is None or b is None:
+        missing = args.version_a if a is None else args.version_b
+        return _die(f"unknown version {missing}; see `pio models list`")
+    da, db = a.to_json_dict(), b.to_json_dict()
+    same = True
+    for key in sorted(set(da) | set(db)):
+        va, vb = da.get(key), db.get(key)
+        if va != vb:
+            same = False
+            print(f"{key}:")
+            print(f"  - {args.version_a}: {va}")
+            print(f"  + {args.version_b}: {vb}")
+    if same:
+        print(f"{args.version_a} and {args.version_b} are identical.")
+    elif a.params_hash == b.params_hash:
+        print("(same engine params; differs only in data/lineage)")
     return 0
 
 
@@ -793,6 +986,18 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="comma-separated remote hosts; one ssh-launched worker each",
     )
+    x.add_argument(
+        "--registry-dir",
+        help="publish the trained model into this artifact registry "
+        "(default: $PIO_REGISTRY_DIR when set, else no registry publish)",
+    )
+    x.add_argument(
+        "--keep-versions",
+        type=int,
+        default=5,
+        help="registry GC: keep this many versions (stable/candidate are "
+        "always kept)",
+    )
     x.set_defaults(fn=cmd_train)
 
     x = sub.add_parser("eval")
@@ -840,6 +1045,43 @@ def build_parser() -> argparse.ArgumentParser:
         default=5.0,
         help="seconds an open dispatch breaker waits before probing again",
     )
+    x.add_argument(
+        "--registry-dir",
+        help="serve the model registry's pinned stable version and expose "
+        "the /models rollout surface (default: registry disabled)",
+    )
+    x.add_argument(
+        "--sticky-key",
+        default="user",
+        help="query payload field whose hash pins a user to one model "
+        "during a canary",
+    )
+    x.add_argument(
+        "--candidate-breaker-threshold",
+        type=int,
+        default=3,
+        help="consecutive candidate-lane failures that force an instant "
+        "rollback",
+    )
+    x.add_argument(
+        "--bake-window",
+        type=float,
+        default=60.0,
+        help="seconds a candidate must bake before the promotion gates run",
+    )
+    x.add_argument(
+        "--bake-min-requests",
+        type=int,
+        default=20,
+        help="minimum canary queries (shadow: scored queries) before any "
+        "promote/rollback verdict",
+    )
+    x.add_argument(
+        "--no-auto-promote",
+        action="store_true",
+        help="gates report 'ready' instead of promoting; an operator "
+        "promotes via `pio models promote --url ...`",
+    )
     x.set_defaults(fn=cmd_deploy)
 
     x = sub.add_parser("undeploy")
@@ -885,6 +1127,11 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser("adminserver")
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=7071)
+    x.add_argument(
+        "--registry-dir",
+        help="model registry base dir served at /cmd/models "
+        "(default: $PIO_REGISTRY_DIR, else $PIO_FS_BASEDIR/registry)",
+    )
     x.set_defaults(fn=cmd_adminserver)
 
     x = sub.add_parser("dashboard")
@@ -938,6 +1185,56 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--channel")
     x.add_argument("--format", default="json", choices=["json", "parquet", "npz"])
     x.set_defaults(fn=cmd_export)
+
+    # model registry
+    mdl = sub.add_parser(
+        "models",
+        help="model registry: versioned artifacts, canary/shadow rollout, "
+        "promote/rollback (docs/model_registry.md)",
+    ).add_subparsers(dest="subcommand", required=True)
+
+    def models_args(x):
+        x.add_argument("--engine-dir", default=".")
+        x.add_argument("--variant")
+        x.add_argument(
+            "--engine-id",
+            help="registry engine id (skips resolving it from --engine-dir)",
+        )
+        x.add_argument(
+            "--registry-dir",
+            help="artifact registry base dir (default: $PIO_REGISTRY_DIR, "
+            "else $PIO_FS_BASEDIR/registry)",
+        )
+
+    x = mdl.add_parser("list")
+    models_args(x)
+    x.set_defaults(fn=cmd_models_list)
+    x = mdl.add_parser("show")
+    models_args(x)
+    x.add_argument("version", nargs="?", help="default: the stable version")
+    x.add_argument("--url", help="show a RUNNING server's /models instead")
+    x.set_defaults(fn=cmd_models_show)
+    x = mdl.add_parser("promote")
+    models_args(x)
+    x.add_argument("version", nargs="?", help="default: the staged candidate")
+    x.add_argument("--url", help="promote on a RUNNING server (lanes swap live)")
+    x.set_defaults(fn=cmd_models_promote)
+    x = mdl.add_parser("rollback")
+    models_args(x)
+    x.add_argument("--url", help="roll back on a RUNNING server")
+    x.set_defaults(fn=cmd_models_rollback)
+    x = mdl.add_parser("stage")
+    models_args(x)
+    x.add_argument("version")
+    x.add_argument("--url", required=True, help="running server base URL")
+    x.add_argument("--mode", choices=["canary", "shadow"], default="canary")
+    x.add_argument("--fraction", type=float, default=0.1)
+    x.set_defaults(fn=cmd_models_stage)
+    x = mdl.add_parser("diff")
+    models_args(x)
+    x.add_argument("version_a")
+    x.add_argument("version_b")
+    x.set_defaults(fn=cmd_models_diff)
 
     # templates
     tpl = sub.add_parser("template").add_subparsers(dest="subcommand", required=True)

@@ -27,6 +27,9 @@ from predictionio_tpu.resilience import CLOSED, HALF_OPEN, OPEN
 # value of the pio_breaker_state gauge -> human name
 BREAKER_STATE_NAMES = {0: CLOSED, 1: HALF_OPEN, 2: OPEN}
 
+# value of the pio_rollout_mode gauge -> human name
+ROLLOUT_MODE_NAMES = {0: "off", 1: "canary", 2: "shadow"}
+
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(?:\{(?P<labels>.*)\})?\s+"
@@ -145,6 +148,12 @@ def summarize(
             labels.get("breaker", "?"): BREAKER_STATE_NAMES.get(int(v), str(v))
             for labels, v in metrics.get("pio_breaker_state", ())
         },
+        "rollout_mode": ROLLOUT_MODE_NAMES.get(
+            int(_total(metrics, "pio_rollout_mode")), "off"
+        ),
+        "rollout_fraction": _total(metrics, "pio_rollout_fraction"),
+        "rollbacks_total": _total(metrics, "pio_rollbacks_total"),
+        "model_versions": _model_versions(metrics),
     }
     out["qps"] = None
     out["shed_rate"] = None
@@ -154,6 +163,29 @@ def summarize(
         out["qps"] = max(0.0, d_req) / interval_s
         out["shed_rate"] = max(0.0, d_shed) / interval_s
     return out
+
+
+def _model_versions(metrics: Metrics) -> dict[str, dict[str, Any]]:
+    """Per-model-version request/error totals and the lanes each version
+    serves on, from the ``pio_model_*`` rollout counters."""
+    versions: dict[str, dict[str, Any]] = {}
+    for name, field in (
+        ("pio_model_requests_total", "requests"),
+        ("pio_model_errors_total", "errors"),
+    ):
+        for labels, v in metrics.get(name, ()):
+            ver = labels.get("version")
+            if not ver:
+                continue
+            info = versions.setdefault(
+                ver, {"requests": 0.0, "errors": 0.0, "lanes": set()}
+            )
+            info[field] += v
+            if v > 0 and labels.get("lane"):
+                info["lanes"].add(labels["lane"])
+    for info in versions.values():
+        info["lanes"] = ",".join(sorted(info["lanes"])) or "-"
+    return versions
 
 
 def format_number(v: Any, suffix: str = "") -> str:
@@ -194,6 +226,22 @@ def render(summary: dict[str, Any], url: str) -> str:
         f"retries     {num(summary['retries_total']):>10}",
         f"  breakers   {breaker_line}",
     ]
+    versions = summary.get("model_versions") or {}
+    if versions:
+        parts = [
+            f"{ver}[{info['lanes']}] req {num(info['requests'])} "
+            f"err {num(info['errors'])}"
+            for ver, info in sorted(versions.items())
+        ]
+        mode = summary.get("rollout_mode", "off")
+        tail = ""
+        if mode != "off":
+            tail = f"   mode {mode}"
+            if mode == "canary":
+                tail += f"@{summary.get('rollout_fraction', 0.0):.2f}"
+        if summary.get("rollbacks_total"):
+            tail += f"   rollbacks {num(summary['rollbacks_total'])}"
+        lines.append("  models     " + "  ".join(parts) + tail)
     if summary.get("events_ingested"):
         lines.append(f"  ingested   {num(summary['events_ingested']):>12}")
     return "\n".join(lines)

@@ -72,8 +72,17 @@ def run_train(
     storage: Storage | None = None,
     batch: str = "",
     env: dict[str, str] | None = None,
+    registry_dir: str | None = None,
+    keep_versions: int = 5,
 ) -> str:
     """Run training end-to-end; returns the engine-instance id.
+
+    With a registry configured (``registry_dir`` argument or the
+    ``PIO_REGISTRY_DIR`` env var) the serialized blob is ALSO published as
+    a content-addressed, sha256-checksummed artifact with a lineage
+    manifest — the unit ``pio models`` and the progressive-rollout router
+    operate on. Publish failures never fail the train: the metadata/model
+    stores above are written first and remain authoritative for recovery.
 
     Multi-host: every process runs the same compute (SPMD — non-coordinator
     hosts must participate in the collectives inside ``engine.train``), but
@@ -165,6 +174,16 @@ def run_train(
         instance.end_time = _dt.datetime.now(tz=UTC)
         instance.spark_conf = {"train_wall_clock_sec": f"{wall:.3f}"}
         instances.update(instance)
+        _publish_to_registry(
+            manifest,
+            instance_id,
+            blob,
+            params_json,
+            wall,
+            batch,
+            registry_dir,
+            keep_versions,
+        )
         logger.info(
             "training completed: instance %s, %.2fs, %d model(s), %d byte blob",
             instance_id,
@@ -180,6 +199,56 @@ def run_train(
         raise
     finally:
         CleanupFunctions.run()
+
+
+def _publish_to_registry(
+    manifest: EngineManifest,
+    instance_id: str,
+    blob: bytes,
+    params_json: dict[str, str],
+    wall_s: float,
+    batch: str,
+    registry_dir: str | None,
+    keep_versions: int,
+) -> None:
+    """Write the trained blob into the artifact registry with its lineage
+    manifest. Atomic (tmp+rename inside the store); best-effort by
+    contract — a broken registry disk must not fail a completed train."""
+    registry_dir = registry_dir or os.environ.get("PIO_REGISTRY_DIR")
+    if not registry_dir:
+        return
+    try:
+        from predictionio_tpu.registry import (
+            ArtifactStore,
+            ModelManifest,
+            params_hash_of,
+        )
+
+        published = ArtifactStore(registry_dir).publish(
+            ModelManifest(
+                version="",
+                engine_id=manifest.engine_id,
+                engine_version=manifest.version,
+                engine_variant=manifest.variant,
+                engine_factory=manifest.engine_factory,
+                instance_id=instance_id,
+                params_hash=params_hash_of(params_json),
+                data_span={
+                    "trainedAt": ModelManifest.now_iso(),
+                    "batch": batch,
+                    "trainWallClockSec": round(wall_s, 3),
+                },
+            ),
+            blob,
+            keep_last=keep_versions,
+        )
+        logger.info(
+            "registry: published %s (instance %s)", published.version, instance_id
+        )
+    except Exception:
+        logger.exception(
+            "registry publish failed (metadata store remains authoritative)"
+        )
 
 
 def load_models_for_instance(

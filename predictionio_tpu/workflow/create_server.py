@@ -8,8 +8,14 @@ Reference parity: ``core/.../workflow/CreateServer.scala`` —
                       (:500-570); per-request latency bookkeeping (:578-585).
   GET /               engine status incl. requestCount / avgServingSec /
                       lastServingSec (:385-420).
-  GET /reload         hot-swap to the latest COMPLETED engine instance
-                      (MasterActor :317-343).
+  POST /reload        hot-swap to the latest COMPLETED engine instance
+                      (MasterActor :317-343; the GET spelling is kept for
+                      compat but logs a deprecation warning).
+  GET /models + POST /models/{candidate,promote,rollback}
+                      model registry / progressive rollout surface
+                      (docs/model_registry.md): pinned stable version,
+                      sticky canary or shadow candidate, metric-gated
+                      auto-promote and auto-rollback.
   POST/GET /stop      graceful undeploy (used by the CLI's undeploy).
   GET /plugins.json   engine-server plugin inventory.
 
@@ -27,6 +33,7 @@ import dataclasses
 import datetime as _dt
 import json
 import logging
+import threading
 import time
 from typing import Any, NamedTuple
 
@@ -51,6 +58,28 @@ from predictionio_tpu.obs.web import (
     metrics_response,
     traces_response,
 )
+from predictionio_tpu.registry.controller import (
+    VERDICT_PROMOTE,
+    VERDICT_ROLLBACK,
+    PromotionCriteria,
+    RolloutController,
+)
+from predictionio_tpu.registry.router import (
+    LANE_CANDIDATE,
+    LANE_SHADOW,
+    LANE_STABLE,
+    PLAN_OFF,
+    Lane,
+    RolloutInstruments,
+    RolloutPlan,
+    choose_lane,
+    routing_key,
+)
+from predictionio_tpu.registry.store import (
+    MODE_CANARY,
+    MODE_SHADOW,
+    ArtifactStore,
+)
 from predictionio_tpu.resilience import (
     OPEN,
     CircuitBreaker,
@@ -58,6 +87,7 @@ from predictionio_tpu.resilience import (
     Deadline,
     DeadlineExceeded,
 )
+from predictionio_tpu.workflow import model_io
 from predictionio_tpu.workflow.context import WorkflowContext
 from predictionio_tpu.workflow.core_workflow import load_models_for_instance
 from predictionio_tpu.workflow.engine_loader import EngineManifest, load_engine
@@ -133,11 +163,42 @@ class ServerConfig:
     # for breaker_recovery_s before probing again
     breaker_threshold: int = 3
     breaker_recovery_s: float = 5.0
+    # -- model registry / progressive rollout (docs/model_registry.md) ------
+    # artifact registry base dir; None disables the registry surface (the
+    # metadata store's latest-COMPLETED instance is then the only source)
+    registry_dir: str | None = None
+    # sticky canary routing: the payload field identifying the user (a
+    # user must see ONE model for a whole bake); missing fields fall back
+    # to a deterministic hash of the payload
+    sticky_key_field: str = "user"
+    # consecutive candidate-lane failures that trip the candidate breaker
+    # and force an INSTANT rollback (no bake-window wait)
+    candidate_breaker_threshold: int = 3
+    # promotion gates (see registry/controller.py PromotionCriteria)
+    bake_window_s: float = 60.0
+    bake_min_requests: int = 20
+    max_error_ratio: float = 2.0
+    max_p95_ratio: float = 1.5
+    max_divergence_rate: float = 0.25
+    auto_promote: bool = True
+    bake_check_interval_s: float = 1.0  # controller evaluation cadence
+    # shadow scoring backlog bound (batches): a candidate slower than live
+    # traffic drops shadow samples (counted) instead of growing the queue
+    # without limit — shadow is sampling, not accounting
+    shadow_max_backlog: int = 8
 
     def ssl_context(self):
         from predictionio_tpu.utils.tls import server_ssl_context
 
         return server_ssl_context(self.ssl_certfile, self.ssl_keyfile)
+
+
+def _canonical_json(value: Any) -> str:
+    """Order-independent JSON for shadow divergence comparison."""
+    try:
+        return json.dumps(value, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        return repr(value)
 
 
 def _swallow_result(fut) -> None:
@@ -410,23 +471,24 @@ class _MicroBatcher:
         # account it as stall time (see obs/jaxprof.py)
         self._server._m_stall.inc(fetch_s, where="micro-batch-fetch")
         try:
-            outs = exec_fut.result()
+            results = exec_fut.result()
         except BaseException as exc:
             # a finalize that raised wholesale is a dispatch-path failure
             # (per-query errors are isolated inside finalize and arrive as
-            # entries in outs) — it must count against the breaker exactly
-            # like a failed dispatch, not close a half-open circuit
-            outs = [exc] * len(batch)
+            # entries in the results) — it must count against the breaker
+            # exactly like a failed dispatch, not close a half-open circuit
+            results = [(exc, "")] * len(batch)
             self._server.dispatch_breaker.record_failure()
         else:
             self._server.dispatch_breaker.record_success()
         finally:
             self._inflight.release()
         done_t = time.perf_counter()
-        for item, out in zip(batch, outs):
+        for item, (out, version) in zip(batch, results):
             # one `batch` span per query, carrying the wall/queue/device
-            # split — the hop between the ingress span and any storage
-            # spans the engine's serving components recorded
+            # split AND the model version that answered — the hop between
+            # the ingress span and any storage spans the engine's serving
+            # components recorded
             self._server.tracer.record_span(
                 "query.batch",
                 kind="batch",
@@ -434,6 +496,7 @@ class _MicroBatcher:
                 trace_id=item.trace_id,
                 status=type(out).__name__ if isinstance(out, BaseException) else "ok",
                 batch_size=len(batch),
+                version=version,
                 queue_ms=round((fetch_t0 - dispatch_s - item.t_submit) * 1000, 3),
                 dispatch_ms=round(dispatch_s * 1000, 3),
                 fetch_ms=round(fetch_s * 1000, 3),
@@ -488,6 +551,8 @@ class QueryServer:
         storage: Storage | None = None,
         config: ServerConfig | None = None,
         plugin_context=None,
+        registry_store: ArtifactStore | None = None,
+        model_version: str | None = None,
     ):
         from predictionio_tpu.workflow.server_plugins import (
             EngineServerPluginContext,
@@ -500,16 +565,41 @@ class QueryServer:
         self.storage = storage or Storage.instance()
         self.config = config or ServerConfig()
         self.plugin_context = plugin_context or EngineServerPluginContext()
+        self.registry_store = registry_store or (
+            ArtifactStore(self.config.registry_dir)
+            if self.config.registry_dir
+            else None
+        )
         _, _, algorithms, serving = engine.make_components(engine_params)
-        # (algorithms, serving, models) live in ONE tuple swapped atomically:
-        # the dispatch thread snapshots it in a single attribute read, so a
-        # concurrent /reload can never pair new algorithms with old models
-        # (attribute-by-attribute assignment allowed exactly that interleave)
-        self._active: tuple[list[Any], Any, list[Any]] = (
+        # (algorithms, serving, models, version) live in ONE Lane tuple
+        # swapped atomically: the dispatch thread snapshots it in a single
+        # attribute read, so a concurrent /reload or promote can never pair
+        # new algorithms with old models (attribute-by-attribute assignment
+        # allowed exactly that interleave)
+        self._active: Lane = Lane(
             algorithms,
             serving,
             models,
+            model_version or instance_id,
+            instance_id,
+            engine_params,
         )
+        # progressive rollout: an optional candidate Lane next to stable,
+        # with the routing plan snapshotted separately (an in-flight batch
+        # keeps whatever lanes it read — same contract as /reload)
+        self._candidate: Lane | None = None
+        self._plan: RolloutPlan = PLAN_OFF
+        # serializes lane swaps across the event loop (promote endpoint,
+        # controller tick) and dispatch threads (breaker-trip rollback)
+        self._rollout_mutex = threading.Lock()
+        self._rollout_task: asyncio.Task | None = None
+        # rollout generation: bumped on every stage/promote/rollback so
+        # in-flight shadow work (queued behind a slow candidate) can tell
+        # it belongs to a PREVIOUS rollout and must not feed the breaker
+        # or counters of the current one
+        self._rollout_gen = 0
+        self._shadow_lock = threading.Lock()
+        self._shadow_pending = 0
         self.start_time = _dt.datetime.now(tz=UTC)
         self.request_count = 0
         self.avg_serving_sec = 0.0
@@ -588,6 +678,29 @@ class QueryServer:
                 recovery_timeout_s=self.config.breaker_recovery_s,
             )
         )
+        # candidate-lane breaker: consecutive candidate predict failures
+        # force an instant rollback (no bake-window wait) via the chained
+        # trip listener; the obs instruments see its transitions too
+        self.candidate_breaker = self._breaker_instruments.watch(
+            CircuitBreaker(
+                name="candidate",
+                failure_threshold=self.config.candidate_breaker_threshold,
+                recovery_timeout_s=self.config.breaker_recovery_s,
+            )
+        )
+        self.candidate_breaker.chain_listener(self._on_candidate_transition)
+        self._rollout_instruments = RolloutInstruments(m)
+        self.rollout_controller = RolloutController(
+            self._rollout_instruments,
+            PromotionCriteria(
+                bake_window_s=self.config.bake_window_s,
+                min_requests=self.config.bake_min_requests,
+                max_error_ratio=self.config.max_error_ratio,
+                max_p95_ratio=self.config.max_p95_ratio,
+                max_divergence_rate=self.config.max_divergence_rate,
+                auto_promote=self.config.auto_promote,
+            ),
+        )
         self._reload_lock = asyncio.Lock()
         self._batcher = _MicroBatcher(
             self,
@@ -608,6 +721,12 @@ class QueryServer:
 
         self._sniffer_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="pio-sniffer"
+        )
+        # shadow scoring runs off the serving path entirely: the candidate
+        # is scored on this thread, its answer discarded, divergence
+        # recorded — a slow or crashing candidate cannot touch a response
+        self._shadow_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pio-shadow"
         )
 
     # ---------------------------------------------------------------- routes
@@ -726,34 +845,103 @@ class QueryServer:
         component fetching user features from storage, say — join the
         request's trace across the thread hop.
 
+        Rollout routing happens here: ONE read each of ``_active`` /
+        ``_candidate`` / ``_plan`` means an in-flight batch is immune to
+        /reload, promote, and rollback and always sees a consistent
+        (algorithms, serving, models, version) quadruple per lane. During
+        a canary, each query's sticky key routes it to stable or candidate
+        *before* supplement (the lanes own separate serving components);
+        candidate-lane failures never surface to users — they feed the
+        candidate breaker (whose trip forces instant rollback) and the
+        query is re-answered on the stable lane.
+
         Per-query failures are isolated: the failing slot gets its
-        exception, batch mates answer normally. Finalize returns one entry
-        per payload — an encoded result body or an exception."""
-        # ONE read of the atomic tuple: an in-flight batch is immune to
-        # /reload and always sees a consistent (algorithms, serving, models)
-        algorithms, serving, models = self._active
+        exception, batch mates answer normally. Finalize returns one
+        ``(encoded result body or exception, model version)`` pair per
+        payload; the version rides into the per-query batch span."""
+        stable: Lane = self._active
+        cand: Lane | None = self._candidate
+        plan = self._plan
+        gen = self._rollout_gen
+        canary = (
+            cand is not None and plan.mode == MODE_CANARY and plan.fraction > 0
+        )
+        shadow = cand is not None and plan.mode == MODE_SHADOW
         payloads = [p for p, _ in items]
         trace_ids = [t for _, t in items]
         n = len(payloads)
         outs: list[Any] = [None] * n
+        versions: list[str] = [stable.version] * n
         queries: list[Any] = [None] * n
         supplemented: list[Any] = [None] * n
-        valid: list[int] = []
+        stable_idx: list[int] = []
+        cand_idx: list[int] = []
+        inst = self._rollout_instruments
         for i, payload in enumerate(payloads):
             token = set_trace_id(trace_ids[i])
             try:
-                q = self.engine.decode_query(payload)
-                queries[i] = q
-                supplemented[i] = serving.supplement(q)
-                valid.append(i)
-            except Exception as exc:
-                outs[i] = exc
+                try:
+                    q = self.engine.decode_query(payload)
+                    queries[i] = q
+                except Exception as exc:
+                    # client error (bad payload) — no lane touched it, so
+                    # no per-version accounting
+                    outs[i] = exc
+                    continue
+                lane = stable
+                if canary and (
+                    choose_lane(
+                        plan,
+                        routing_key(payload, self.config.sticky_key_field),
+                    )
+                    == LANE_CANDIDATE
+                ):
+                    # a failing candidate supplement degrades this query to
+                    # the stable answer, not to an error; the failure is
+                    # paired with a request so the error-RATE gate compares
+                    # like with like
+                    try:
+                        supplemented[i] = cand.serving.supplement(q)
+                        lane = cand
+                    except Exception:
+                        logger.exception("candidate supplement failed")
+                        if gen == self._rollout_gen:
+                            inst.requests.inc(
+                                version=cand.version, lane=LANE_CANDIDATE
+                            )
+                        self._record_candidate_failure(cand.version, gen)
+                if lane is stable:
+                    try:
+                        supplemented[i] = stable.serving.supplement(q)
+                    except Exception as exc:
+                        # symmetric accounting: a stable supplement failure
+                        # is a stable-lane error, not silence — otherwise a
+                        # flaky shared dependency reads as candidate-only
+                        # and rolls back a candidate no worse than stable
+                        inst.requests.inc(
+                            version=stable.version, lane=LANE_STABLE
+                        )
+                        inst.errors.inc(
+                            version=stable.version, lane=LANE_STABLE
+                        )
+                        outs[i] = exc
+                        continue
+                    stable_idx.append(i)
+                else:
+                    versions[i] = cand.version
+                    cand_idx.append(i)
             finally:
                 reset_trace_id(token)
-        sup = [supplemented[i] for i in valid]
-        finalizers: list[Any] = []
-        if valid:
-            for algo, model in zip(algorithms, models):
+        dispatched: list[tuple[Lane, str, list[int], list[Any], list[Any]]] = []
+        for lane, lane_name, idxs in (
+            (stable, LANE_STABLE, stable_idx),
+            (cand, LANE_CANDIDATE, cand_idx),
+        ):
+            if lane is None or not idxs:
+                continue
+            sup = [supplemented[i] for i in idxs]
+            finalizers: list[Any] = []
+            for algo, model in zip(lane.algorithms, lane.models):
                 fin = None
                 try:
                     fin = algo.predict_batch_dispatch(model, sup)
@@ -762,62 +950,209 @@ class QueryServer:
                         "predict_batch_dispatch failed; deferring to fetch"
                     )
                 finalizers.append(fin)
+            dispatched.append((lane, lane_name, idxs, sup, finalizers))
 
-        def finalize() -> list[Any]:
-            if not valid:
-                return outs
-            preds_per_algo: list[list[Any]] = []
-            for fin, (algo, model) in zip(finalizers, zip(algorithms, models)):
-                try:
-                    if fin is not None:
-                        preds = list(fin())
-                    else:
-                        preds = list(algo.predict_batch(model, sup))
-                    if len(preds) != len(sup):
-                        raise RuntimeError(
-                            f"predict_batch returned {len(preds)} results "
-                            f"for {len(sup)} queries"
-                        )
-                except Exception:
-                    # isolate failures: retry each query on the single path
-                    # so one poisonous query can't fail the whole batch
-                    logger.exception(
-                        "batched predict failed; falling back to per-query"
-                    )
-                    preds = []
-                    for s in sup:
-                        try:
-                            preds.append(algo.predict(model, s))
-                        except Exception as exc:
-                            logger.exception("query predict failed")
-                            preds.append(exc)
-                preds_per_algo.append(preds)
+        def finalize() -> list[tuple[Any, str]]:
             sniffed: list[tuple[Any, Any]] = []
-            for row, i in enumerate(valid):
-                token = set_trace_id(trace_ids[i])
-                try:
-                    plist = [preds[row] for preds in preds_per_algo]
-                    for p in plist:
-                        if isinstance(p, BaseException):
-                            raise p
-                    result = serving.serve(queries[i], plist)
-                    result = self.plugin_context.apply_output_blockers(
-                        self.manifest.variant, queries[i], result
-                    )
-                    outs[i] = Engine.encode_result(result)
-                    sniffed.append((queries[i], result))
-                except Exception as exc:
-                    outs[i] = exc
-                finally:
-                    reset_trace_id(token)
+            inst = self._rollout_instruments
+            for lane, lane_name, idxs, sup, finalizers in dispatched:
+                t0 = time.perf_counter()
+                preds_per_algo = self._lane_predictions(lane, sup, finalizers)
+                inst.predict_seconds.observe(
+                    time.perf_counter() - t0, version=lane.version
+                )
+                for row, i in enumerate(idxs):
+                    token = set_trace_id(trace_ids[i])
+                    # candidate accounting is generation-scoped end to end:
+                    # a stale batch must not add errorless requests to the
+                    # denominator of the NEW candidate's error-rate gate
+                    # (its errors are already dropped by the gen guard)
+                    if lane_name != LANE_CANDIDATE or gen == self._rollout_gen:
+                        inst.requests.inc(version=lane.version, lane=lane_name)
+                    try:
+                        outs[i] = self._serve_one(
+                            lane,
+                            queries[i],
+                            [preds[row] for preds in preds_per_algo],
+                            sniffed,
+                        )
+                        if lane_name == LANE_CANDIDATE and gen == self._rollout_gen:
+                            # same generation guard as the failure paths: a
+                            # stale batch's successes must not reset the
+                            # consecutive-failure count a failing successor
+                            # candidate is accumulating
+                            self.candidate_breaker.record_success()
+                    except Exception as exc:
+                        if lane_name == LANE_CANDIDATE:
+                            self._record_candidate_failure(lane.version, gen)
+                            outs[i], versions[i] = self._stable_retry(
+                                stable, queries[i], sniffed
+                            )
+                        else:
+                            inst.errors.inc(
+                                version=lane.version, lane=lane_name
+                            )
+                            outs[i] = exc
+                    finally:
+                        reset_trace_id(token)
+            if shadow:
+                pairs = [
+                    (queries[i], outs[i])
+                    for i in stable_idx
+                    if not isinstance(outs[i], BaseException)
+                ]
+                if pairs:
+                    self._submit_shadow(cand, pairs, gen)
             if sniffed and self.plugin_context.output_sniffers:
                 # observers are fire-and-forget on their own thread: a slow
                 # or throwing sniffer must neither delay the batch's
                 # responses nor overwrite a successful result
                 self._sniffer_pool.submit(self._notify_sniffers, sniffed)
-            return outs
+            return list(zip(outs, versions))
 
         return finalize
+
+    def _lane_predictions(
+        self, lane: Lane, sup: list[Any], finalizers: list[Any]
+    ) -> list[list[Any]]:
+        """One lane's per-algorithm predictions with the batch -> per-query
+        fallback: one poisonous query can't fail its batch mates."""
+        preds_per_algo: list[list[Any]] = []
+        for fin, (algo, model) in zip(
+            finalizers, zip(lane.algorithms, lane.models)
+        ):
+            try:
+                if fin is not None:
+                    preds = list(fin())
+                else:
+                    preds = list(algo.predict_batch(model, sup))
+                if len(preds) != len(sup):
+                    raise RuntimeError(
+                        f"predict_batch returned {len(preds)} results "
+                        f"for {len(sup)} queries"
+                    )
+            except Exception:
+                logger.exception(
+                    "batched predict failed; falling back to per-query"
+                )
+                preds = []
+                for s in sup:
+                    try:
+                        preds.append(algo.predict(model, s))
+                    except Exception as exc:
+                        logger.exception("query predict failed")
+                        preds.append(exc)
+            preds_per_algo.append(preds)
+        return preds_per_algo
+
+    def _serve_one(
+        self, lane: Lane, query: Any, plist: list[Any], sniffed: list
+    ) -> Any:
+        """serve + output-blockers + encode for one query on one lane;
+        raises the first per-query prediction failure."""
+        for p in plist:
+            if isinstance(p, BaseException):
+                raise p
+        result = lane.serving.serve(query, plist)
+        result = self.plugin_context.apply_output_blockers(
+            self.manifest.variant, query, result
+        )
+        sniffed.append((query, result))
+        return Engine.encode_result(result)
+
+    def _record_candidate_failure(self, version: str, gen: int | None = None) -> None:
+        """Count one candidate failure against the breaker — unless the
+        caller's rollout generation is stale (the work belongs to an
+        already promoted/rolled-back candidate and must not trip the
+        breaker of the current one)."""
+        if gen is not None and gen != self._rollout_gen:
+            return
+        self._rollout_instruments.errors.inc(
+            version=version, lane=LANE_CANDIDATE
+        )
+        self.candidate_breaker.record_failure()
+
+    def _stable_retry(
+        self, stable: Lane, query: Any, sniffed: list
+    ) -> tuple[Any, str]:
+        """Re-answer a candidate-lane query on the stable lane (single
+        query path) so canary traffic never surfaces candidate errors."""
+        inst = self._rollout_instruments
+        inst.requests.inc(version=stable.version, lane=LANE_STABLE)
+        try:
+            s = stable.serving.supplement(query)
+            plist = [
+                algo.predict(model, s)
+                for algo, model in zip(stable.algorithms, stable.models)
+            ]
+            return self._serve_one(stable, query, plist, sniffed), stable.version
+        except Exception as exc:
+            logger.exception("stable retry after candidate failure failed")
+            inst.errors.inc(version=stable.version, lane=LANE_STABLE)
+            return exc, stable.version
+
+    def _submit_shadow(
+        self, cand: Lane, pairs: list[tuple[Any, Any]], gen: int
+    ) -> None:
+        """Queue one batch for shadow scoring, bounded: a candidate slower
+        than live traffic drops samples (counted) instead of growing the
+        single-worker queue — and the memory it pins — without limit."""
+        with self._shadow_lock:
+            if self._shadow_pending >= self.config.shadow_max_backlog:
+                self._rollout_instruments.shadow_dropped.inc(
+                    len(pairs), version=cand.version
+                )
+                return
+            self._shadow_pending += 1
+        self._shadow_pool.submit(self._shadow_score, cand, pairs, gen)
+
+    def _shadow_score(
+        self, cand: Lane, pairs: list[tuple[Any, Any]], gen: int
+    ) -> None:
+        """Score the candidate on already-answered stable traffic (runs on
+        the shadow thread, fully off the serving path): the candidate's
+        answer is discarded, only the divergence/error record remains. A
+        crashing candidate trips its breaker from here exactly as it would
+        from the canary lane. Work queued for a rollout that has since
+        ended (generation mismatch) is skipped wholesale — it must not
+        feed the next candidate's breaker or counters."""
+        inst = self._rollout_instruments
+        discard: list = []
+        try:
+            for query, stable_body in pairs:
+                if gen != self._rollout_gen:
+                    return
+                try:
+                    t0 = time.perf_counter()
+                    s = cand.serving.supplement(query)
+                    plist = [
+                        algo.predict(model, s)
+                        for algo, model in zip(cand.algorithms, cand.models)
+                    ]
+                    body = self._serve_one(cand, query, plist, discard)
+                    scored_s = time.perf_counter() - t0
+                    if gen != self._rollout_gen:
+                        return  # rollout ended while this query was scoring
+                    # the latency gate needs candidate samples in shadow
+                    # mode too, or a 10x-slower candidate would sail
+                    # through on error/divergence alone (per-query single
+                    # path here vs the canary's batched path — a rough but
+                    # usable comparison basis)
+                    inst.predict_seconds.observe(scored_s, version=cand.version)
+                    inst.shadow_scored.inc(version=cand.version)
+                    if _canonical_json(body) != _canonical_json(stable_body):
+                        inst.divergence.inc(version=cand.version)
+                    self.candidate_breaker.record_success()
+                except Exception:
+                    logger.exception("shadow scoring failed")
+                    if gen != self._rollout_gen:
+                        return
+                    inst.shadow_scored.inc(version=cand.version)
+                    inst.errors.inc(version=cand.version, lane=LANE_SHADOW)
+                    self.candidate_breaker.record_failure()
+        finally:
+            with self._shadow_lock:
+                self._shadow_pending -= 1
 
     def _notify_sniffers(self, sniffed: list) -> None:
         for query, result in sniffed:
@@ -899,6 +1234,16 @@ class QueryServer:
                 "engineVariant": self.manifest.variant,
                 "engineFactory": self.manifest.engine_factory,
                 "engineInstanceId": self.instance_id,
+                "modelVersion": self._active.version,
+                "rollout": {
+                    "mode": self._plan.mode,
+                    "fraction": self._plan.fraction,
+                    "candidate": (
+                        self._candidate.version
+                        if self._candidate is not None
+                        else None
+                    ),
+                },
                 "startTime": self.start_time.isoformat(),
                 "requestCount": self.request_count,
                 "avgServingSec": self.avg_serving_sec,
@@ -963,6 +1308,16 @@ class QueryServer:
             {"ready": ready, **snap}, status=200 if ready else 503
         )
 
+    async def handle_reload_get(self, request: web.Request) -> web.Response:
+        """Deprecated GET spelling of /reload, kept for compat with old
+        deploy scripts: a state-mutating GET is cacheable/prefetchable by
+        intermediaries, which is how surprise reloads happen. Docs and
+        tools all use POST."""
+        logger.warning(
+            "GET /reload is deprecated (state-mutating GET); use POST /reload"
+        )
+        return await self.handle_reload(request)
+
     async def handle_reload(self, request: web.Request) -> web.Response:
         """Swap in the latest COMPLETED instance (ref MasterActor reload).
 
@@ -1003,26 +1358,356 @@ class QueryServer:
                 await loop.run_in_executor(
                     None, self._warmup_components, algorithms, models
                 )
+                # blocking registry manifest scan stays off the event loop
+                new_version = await loop.run_in_executor(
+                    None, self._version_for_instance, latest.id
+                )
             except Exception as exc:
                 logger.exception("reload failed")
                 return web.json_response({"message": str(exc)}, status=500)
             # commit: one consistent swap, nothing mutated on any failure path
             self.engine_params = engine_params
-            self._active = (algorithms, serving, models)  # atomic swap
-            self.instance_id = latest.id
+            with self._rollout_mutex:
+                self._active = Lane(  # atomic swap
+                    algorithms,
+                    serving,
+                    models,
+                    new_version,
+                    latest.id,
+                    engine_params,
+                )
+                self.instance_id = latest.id
+                cand = self._candidate
+                if cand is not None:
+                    # an active bake was comparing against the version that
+                    # just got replaced: rebase the baseline on the new
+                    # stable so the gates judge the candidate against what
+                    # actually serves (the retired version's counters would
+                    # freeze and collapse the error-rate allowance)
+                    self.rollout_controller.begin(
+                        new_version, cand.version, self._plan.mode
+                    )
         logger.info("reloaded engine instance %s", latest.id)
         return web.json_response(
             {"message": "Reload successful", "instanceId": latest.id}
         )
 
     def _engine_params_of(self, instance: EngineInstance) -> EngineParams:
-        variant = {
-            "datasource": {"params": json.loads(instance.data_source_params or "{}")},
-            "preparator": {"params": json.loads(instance.preparator_params or "{}")},
-            "algorithms": json.loads(instance.algorithms_params or "[]"),
-            "serving": {"params": json.loads(instance.serving_params or "{}")},
+        return _engine_params_of_instance(self.engine, instance)
+
+    # ------------------------------------------------- progressive rollout
+    def _version_for_instance(self, instance_id: str) -> str:
+        """Registry version whose lineage points at this engine instance;
+        the instance id itself when the registry doesn't know it."""
+        if self.registry_store is not None:
+            for m in self.registry_store.list_versions(self.manifest.engine_id):
+                if m.instance_id == instance_id:
+                    return m.version
+        return instance_id
+
+    def _on_candidate_transition(self, name: str, old: str, new: str) -> None:
+        """Candidate breaker trip = the fast rollback path: no bake-window
+        wait, the candidate lane is gone before the next batch forms.
+        Fires on a dispatch/shadow thread; the rollback swap is mutex-
+        guarded and pure attribute writes, so that is safe."""
+        if new == OPEN:
+            self._rollback_candidate("breaker-trip")
+
+    def stage_candidate_lane(
+        self,
+        lane: Lane,
+        mode: str = MODE_CANARY,
+        fraction: float = 0.1,
+        persist: bool = True,
+    ) -> None:
+        """Install a candidate lane and begin the bake. The sticky salt is
+        the candidate version, so every replica in a fleet canaries the
+        same user population and a later rollout resamples a fresh one."""
+        if mode not in (MODE_CANARY, MODE_SHADOW):
+            raise ValueError(f"rollout mode must be canary|shadow, got {mode!r}")
+        if lane.version == self._active.version:
+            # canarying stable against itself would also desync server and
+            # registry state (the store rejects it, and that rejection must
+            # not be swallowed as bookkeeping noise)
+            raise ValueError(f"{lane.version} is already the stable version")
+        fraction = max(0.0, min(1.0, float(fraction)))
+        with self._rollout_mutex:
+            self._rollout_gen += 1  # orphan any in-flight work of the old bake
+            self.candidate_breaker.reset()
+            self._candidate = lane
+            self._plan = RolloutPlan(
+                mode, fraction if mode == MODE_CANARY else 0.0, lane.version
+            )
+            self.rollout_controller.begin(self._active.version, lane.version, mode)
+        self._rollout_instruments.set_plan(self._plan)
+        if persist and self.registry_store is not None:
+            try:
+                self.registry_store.stage_candidate(
+                    self.manifest.engine_id,
+                    lane.version,
+                    mode=mode,
+                    fraction=fraction,
+                )
+            except Exception:
+                logger.exception("registry stage bookkeeping failed")
+        logger.info(
+            "staged candidate %s (%s, fraction %.3f)", lane.version, mode, fraction
+        )
+
+    def _promote_candidate(self) -> str | None:
+        """Candidate becomes stable (atomic Lane swap). Returns the
+        promoted version, or None when no candidate is staged."""
+        with self._rollout_mutex:
+            cand = self._candidate
+            if cand is None:
+                return None
+            self._rollout_gen += 1
+            self._active = cand
+            if cand.instance_id:
+                self.instance_id = cand.instance_id
+            if cand.engine_params is not None:
+                self.engine_params = cand.engine_params
+            self._candidate = None
+            self._plan = PLAN_OFF
+            self.rollout_controller.end()
+        self._rollout_instruments.set_plan(PLAN_OFF)
+        self._rollout_instruments.promotions.inc()
+        if self.registry_store is not None:
+            try:
+                self.registry_store.promote(self.manifest.engine_id, cand.version)
+            except Exception:
+                logger.exception("registry promote bookkeeping failed")
+        logger.info("promoted candidate %s to stable", cand.version)
+        return cand.version
+
+    def _rollback_candidate(self, reason: str, detail: str = "") -> str | None:
+        """Drop the candidate lane; stable keeps serving untouched.
+        ``reason`` is a short label (breaker-trip/manual/error-rate/
+        latency/divergence — bounded metric cardinality), ``detail`` the
+        human sentence for logs and registry history."""
+        with self._rollout_mutex:
+            cand = self._candidate
+            if cand is None:
+                return None
+            self._rollout_gen += 1
+            self._candidate = None
+            self._plan = PLAN_OFF
+            self.rollout_controller.end()
+        self._rollout_instruments.set_plan(PLAN_OFF)
+        self._rollout_instruments.rollbacks.inc(reason=reason)
+        if self.registry_store is not None:
+            try:
+                # unstage, never rollback: the store's rollback falls back
+                # to reverting the stable pin when no candidate is recorded
+                # (e.g. the stage write was swallowed), which would desync
+                # the registry from what this server actually serves
+                self.registry_store.unstage(
+                    self.manifest.engine_id,
+                    reason=(f"{reason}: {detail}" if detail else reason),
+                )
+            except Exception:
+                logger.exception("registry rollback bookkeeping failed")
+        logger.warning(
+            "candidate %s rolled back (%s) %s", cand.version, reason, detail
+        )
+        return cand.version
+
+    def _load_lane_from_registry(self, version: str) -> Lane:
+        """Registry artifact -> servable Lane: verified blob, deserialize,
+        prepare_deploy, fresh components, warmup. Blocking — run in an
+        executor. Engine params come from the lineage manifest's engine
+        instance when the metadata store still has it."""
+        store = self.registry_store
+        if store is None:
+            raise RuntimeError("no model registry configured (registry_dir)")
+        manifest = store.get_manifest(self.manifest.engine_id, version)
+        if manifest is None:
+            raise ValueError(f"unknown model version {version!r}")
+        blob = store.load_blob(self.manifest.engine_id, version)
+        persisted = model_io.deserialize_models(blob)
+        engine_params = self.engine_params
+        if manifest.instance_id:
+            instance = self.storage.get_meta_data_engine_instances().get(
+                manifest.instance_id
+            )
+            if instance is not None:
+                engine_params = self._engine_params_of(instance)
+        ctx = WorkflowContext(mode="serving", _storage=self.storage)
+        models = self.engine.prepare_deploy(ctx, engine_params, persisted)
+        _, _, algorithms, serving = self.engine.make_components(engine_params)
+        self._warmup_components(algorithms, models)
+        return Lane(
+            algorithms, serving, models, version, manifest.instance_id, engine_params
+        )
+
+    async def _rollout_loop(self) -> None:
+        """Controller heartbeat: evaluate the bake gates on a cadence and
+        apply the verdict. Promotion takes the reload lock so it can never
+        interleave with a /reload commit."""
+        while True:
+            await asyncio.sleep(self.config.bake_check_interval_s)
+            try:
+                await self._rollout_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("rollout controller tick failed")
+
+    async def _rollout_tick(self) -> None:
+        if self._candidate is None:
+            return
+        verdict, reason = self.rollout_controller.evaluate()
+        loop = asyncio.get_running_loop()
+        # promote/rollback persist registry state (fsync'd writes): executor
+        if verdict == VERDICT_PROMOTE:
+            async with self._reload_lock:
+                version = await loop.run_in_executor(
+                    None, self._promote_candidate
+                )
+            if version:
+                logger.info("auto-promoted %s: %s", version, reason)
+        elif verdict == VERDICT_ROLLBACK:
+            # "error-rate gate: ..." -> label "error-rate", detail = full text
+            await loop.run_in_executor(
+                None, self._rollback_candidate, reason.split(" ")[0], reason
+            )
+
+    def _models_snapshot(self) -> dict[str, Any]:
+        stable = self._active
+        cand = self._candidate
+        plan = self._plan
+        inst = self._rollout_instruments
+
+        def lane_json(lane: Lane) -> dict[str, Any]:
+            return {
+                "version": lane.version,
+                "instanceId": lane.instance_id,
+                "counters": inst.lane_counts(lane.version),
+                "p95PredictMs": round(inst.p95_seconds(lane.version) * 1e3, 3),
+            }
+
+        out: dict[str, Any] = {
+            "stable": lane_json(stable),
+            "candidate": lane_json(cand) if cand is not None else None,
+            "mode": plan.mode,
+            "fraction": plan.fraction,
+            "stickyKeyField": self.config.sticky_key_field,
+            "candidateBreaker": self.candidate_breaker.snapshot(),
+            "controller": self.rollout_controller.snapshot(),
         }
-        return self.engine.engine_params_from_variant(variant)
+        if self.registry_store is not None:
+            state = self.registry_store.get_state(self.manifest.engine_id)
+            out["registry"] = {
+                "dir": self.registry_store.base_dir,
+                "state": state.to_json_dict(),
+                "versions": [
+                    m.summary_row()
+                    for m in self.registry_store.list_versions(
+                        self.manifest.engine_id
+                    )
+                ],
+            }
+        return out
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        """What serves, what bakes, what the controller thinks — the JSON
+        behind `pio models show --url` and the dashboard's rollout panel.
+        The snapshot scans registry manifests on disk: executor, not event
+        loop — a dashboard polling /models on a slow volume must never
+        stall /queries.json ingress."""
+        snapshot = await asyncio.get_running_loop().run_in_executor(
+            None, self._models_snapshot
+        )
+        return web.json_response(snapshot)
+
+    async def handle_models_candidate(self, request: web.Request) -> web.Response:
+        """Stage a registry version as the rollout candidate."""
+        try:
+            body = await request.json()
+            version = str(body["version"])
+            mode = body.get("mode", MODE_CANARY)
+            fraction = float(body.get("fraction", 0.1))
+        except Exception:
+            return web.json_response(
+                {"message": "body must be JSON with a 'version' key"}, status=400
+            )
+        async with self._reload_lock:
+            try:
+                loop = asyncio.get_running_loop()
+                lane = await loop.run_in_executor(
+                    None, self._load_lane_from_registry, version
+                )
+                # staging persists registry state (fsync'd write): executor
+                await loop.run_in_executor(
+                    None,
+                    lambda: self.stage_candidate_lane(
+                        lane, mode=mode, fraction=fraction
+                    ),
+                )
+            except (ValueError, RuntimeError) as exc:
+                return web.json_response({"message": str(exc)}, status=400)
+            except Exception as exc:
+                logger.exception("staging candidate failed")
+                return web.json_response({"message": str(exc)}, status=500)
+        return web.json_response(
+            {
+                "message": "Candidate staged",
+                "version": version,
+                "mode": mode,
+                "fraction": fraction,
+            }
+        )
+
+    async def handle_models_promote(self, request: web.Request) -> web.Response:
+        """Promote the staged candidate. An explicit ``{"version": ...}``
+        in the body is a guard, not a selector: it must name the staged
+        candidate, or nothing happens (409) — silently promoting whatever
+        is staged when the operator asked for a specific version is how
+        the wrong model ships."""
+        requested = None
+        if request.can_read_body:
+            try:
+                requested = (await request.json()).get("version")
+            except Exception:
+                pass
+        async with self._reload_lock:
+            if requested is not None:
+                cand = self._candidate
+                if cand is None or cand.version != requested:
+                    staged = cand.version if cand is not None else "none"
+                    return web.json_response(
+                        {
+                            "message": (
+                                f"version {requested} is not the staged "
+                                f"candidate (staged: {staged})"
+                            )
+                        },
+                        status=409,
+                    )
+            version = await asyncio.get_running_loop().run_in_executor(
+                None, self._promote_candidate
+            )
+        if version is None:
+            return web.json_response(
+                {"message": "no candidate staged"}, status=404
+            )
+        return web.json_response(
+            {
+                "message": "Promoted",
+                "version": version,
+                "instanceId": self.instance_id,
+            }
+        )
+
+    async def handle_models_rollback(self, request: web.Request) -> web.Response:
+        version = await asyncio.get_running_loop().run_in_executor(
+            None, self._rollback_candidate, "manual"
+        )
+        if version is None:
+            return web.json_response(
+                {"message": "no candidate staged"}, status=404
+            )
+        return web.json_response({"message": "Rolled back", "version": version})
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """Prometheus text exposition: request latency histogram, queue
@@ -1051,23 +1736,37 @@ class QueryServer:
                 web.get("/metrics", self.handle_metrics),
                 web.get("/traces/recent", self.handle_traces_recent),
                 web.post("/queries.json", self.handle_queries),
-                # POST is the reference's contract (CreateServer.scala:618-626);
-                # GET kept as a browser convenience
+                # POST is the contract (CreateServer.scala:618-626); the GET
+                # spelling still works but logs a deprecation warning
                 web.post("/reload", self.handle_reload),
-                web.get("/reload", self.handle_reload),
+                web.get("/reload", self.handle_reload_get),
+                # model registry / progressive rollout surface
+                web.get("/models", self.handle_models),
+                web.post("/models/candidate", self.handle_models_candidate),
+                web.post("/models/promote", self.handle_models_promote),
+                web.post("/models/rollback", self.handle_models_rollback),
                 web.post("/stop", self.handle_stop),
                 web.get("/stop", self.handle_stop),
                 web.get("/plugins.json", self.handle_plugins),
             ]
         )
 
+        async def _start_rollout_loop(app: web.Application) -> None:
+            self._rollout_task = asyncio.ensure_future(self._rollout_loop())
+
         async def _close_batcher(app: web.Application) -> None:
+            task = self._rollout_task
+            self._rollout_task = None
+            if task is not None:
+                task.cancel()
+                await asyncio.gather(task, return_exceptions=True)
             # cancel the collect loop while its event loop is still alive
             # (otherwise the pending task leaks a "loop is closed" warning)
             self._batcher.close()
             await self._batcher.wait_closed()
             await self._close_background()
 
+        app.on_startup.append(_start_rollout_loop)
         app.on_cleanup.append(_close_batcher)
         return app
 
@@ -1086,21 +1785,25 @@ class QueryServer:
 
     @property
     def algorithms(self) -> list[Any]:
-        return self._active[0]
+        return self._active.algorithms
 
     @property
     def serving(self) -> Any:
-        return self._active[1]
+        return self._active.serving
 
     @property
     def models(self) -> list[Any]:
-        return self._active[2]
+        return self._active.models
+
+    @property
+    def model_version(self) -> str:
+        return self._active.version
 
     def _warmup(self) -> None:
         """Pre-compile serving programs (pow2 batch buckets etc.) so the
         first traffic burst after deploy/reload pays no XLA compiles."""
-        algorithms, _, models = self._active
-        self._warmup_components(algorithms, models)
+        lane = self._active
+        self._warmup_components(lane.algorithms, lane.models)
 
     def _warmup_components(self, algorithms: list[Any], models: list[Any]) -> None:
         for algo, model in zip(algorithms, models):
@@ -1156,6 +1859,7 @@ class QueryServer:
         self._batcher.close()
         await self._batcher.wait_closed()
         self._sniffer_pool.shutdown(wait=False, cancel_futures=True)
+        self._shadow_pool.shutdown(wait=False, cancel_futures=True)
         await self._close_background()
         if self._runner is not None:
             await self._runner.cleanup()
@@ -1167,6 +1871,16 @@ class QueryServer:
         await self.stop()
 
 
+def _engine_params_of_instance(engine: Engine, instance: EngineInstance) -> EngineParams:
+    variant = {
+        "datasource": {"params": json.loads(instance.data_source_params or "{}")},
+        "preparator": {"params": json.loads(instance.preparator_params or "{}")},
+        "algorithms": json.loads(instance.algorithms_params or "[]"),
+        "serving": {"params": json.loads(instance.serving_params or "{}")},
+    }
+    return engine.engine_params_from_variant(variant)
+
+
 def create_query_server(
     engine_dir: str,
     variant_path: str | None = None,
@@ -1174,11 +1888,30 @@ def create_query_server(
     config: ServerConfig | None = None,
     instance_id: str | None = None,
 ) -> QueryServer:
-    """Resolve the latest COMPLETED instance for the engine dir and build a
-    server (ref commands/Engine.deploy :207-242)."""
+    """Build a server for the engine dir. With a registry configured, the
+    registry's pinned *stable* version is the source of truth for what
+    serves (docs/DECISIONS.md — the instances table is the training
+    ledger); without one, or when the registry can't be read, the latest
+    COMPLETED instance is resolved exactly as the reference did
+    (ref commands/Engine.deploy :207-242)."""
     storage = storage or Storage.instance()
+    config = config or ServerConfig()
     manifest, engine = load_engine(engine_dir, variant_path)
+    store = ArtifactStore(config.registry_dir) if config.registry_dir else None
     instances = storage.get_meta_data_engine_instances()
+    if store is not None and not instance_id:
+        state = store.get_state(manifest.engine_id)
+        if state.stable:
+            try:
+                return _query_server_from_registry(
+                    engine, manifest, store, state.stable, storage, config
+                )
+            except Exception:
+                logger.exception(
+                    "registry stable %s unusable; falling back to the "
+                    "latest COMPLETED instance",
+                    state.stable,
+                )
     if instance_id:
         instance = instances.get(instance_id)
         if instance is None:
@@ -1205,6 +1938,51 @@ def create_query_server(
         instance_id=instance.id,
         storage=storage,
         config=config,
+        registry_store=store,
+    )
+
+
+def _query_server_from_registry(
+    engine: Engine,
+    manifest: EngineManifest,
+    store: ArtifactStore,
+    version: str,
+    storage: Storage,
+    config: ServerConfig,
+) -> QueryServer:
+    """Deploy the registry's stable version: verified blob -> deserialize
+    -> prepare_deploy, params from the lineage manifest's instance."""
+    reg_manifest = store.get_manifest(manifest.engine_id, version)
+    if reg_manifest is None:
+        raise RuntimeError(f"registry stable {version} has no manifest")
+    blob = store.load_blob(manifest.engine_id, version)
+    persisted = model_io.deserialize_models(blob)
+    engine_params = None
+    if reg_manifest.instance_id:
+        instance = storage.get_meta_data_engine_instances().get(
+            reg_manifest.instance_id
+        )
+        if instance is not None:
+            engine_params = _engine_params_of_instance(engine, instance)
+    if engine_params is None:
+        engine_params = engine.engine_params_from_variant(manifest.variant_json)
+    ctx = WorkflowContext(mode="serving", _storage=storage)
+    models = engine.prepare_deploy(ctx, engine_params, persisted)
+    logger.info(
+        "deploying registry stable %s (instance %s)",
+        version,
+        reg_manifest.instance_id or "?",
+    )
+    return QueryServer(
+        engine=engine,
+        engine_params=engine_params,
+        models=models,
+        manifest=manifest,
+        instance_id=reg_manifest.instance_id or version,
+        storage=storage,
+        config=config,
+        registry_store=store,
+        model_version=version,
     )
 
 

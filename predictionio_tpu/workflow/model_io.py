@@ -4,27 +4,79 @@ Reference parity: Kryo blobs via ``KryoInstantiator``
 (``CreateServer.scala:59-73``, ``CoreWorkflow.scala:76-81``). Here models are
 pickled pytrees; every jax array has already been pulled to host numpy by
 ``make_persistent_model`` so checkpoints are device- and sharding-agnostic
-(train on a pod slice, deploy on one host). A small header versions the
-format.
+(train on a pod slice, deploy on one host).
+
+Format v02 (``PIOTPU02``)::
+
+    magic(8) ‖ zlib(pickle(models)) ‖ sha256(compressed)(32) ‖ len(compressed)(8, big-endian)
+
+The footer makes corruption a *diagnosis*, not a mystery: a truncated or
+bit-flipped blob used to surface as an opaque ``zlib.error`` or a pickle
+exception deep in deploy; now it raises :class:`ModelIntegrityError`
+naming what mismatched. v01 blobs (no footer) are still read — integrity
+failures there are detected at decompress/unpickle time and wrapped in
+the same error type.
 """
 
 from __future__ import annotations
 
-import io
+import hashlib
 import pickle
+import struct
 import zlib
 from typing import Any
 
-MAGIC = b"PIOTPU01"
+MAGIC = b"PIOTPU02"
+MAGIC_V1 = b"PIOTPU01"
+
+_FOOTER = struct.Struct(">32sQ")  # sha256(compressed) ‖ compressed length
+
+
+class ModelIntegrityError(ValueError):
+    """The blob is not an intact predictionio_tpu model artifact (bad
+    magic, truncated, or checksum mismatch)."""
 
 
 def serialize_models(models: list[Any]) -> bytes:
     payload = pickle.dumps(models, protocol=pickle.HIGHEST_PROTOCOL)
-    return MAGIC + zlib.compress(payload, level=1)
+    compressed = zlib.compress(payload, level=1)
+    footer = _FOOTER.pack(hashlib.sha256(compressed).digest(), len(compressed))
+    return MAGIC + compressed + footer
 
 
 def deserialize_models(blob: bytes) -> list[Any]:
-    if not blob.startswith(MAGIC):
-        raise ValueError("not a predictionio_tpu model blob (bad magic)")
-    payload = zlib.decompress(blob[len(MAGIC):])
-    return pickle.loads(payload)
+    if blob.startswith(MAGIC):
+        body = blob[len(MAGIC):]
+        if len(body) < _FOOTER.size:
+            raise ModelIntegrityError(
+                f"model blob truncated: {len(body)} bytes cannot hold the "
+                f"{_FOOTER.size}-byte integrity footer"
+            )
+        compressed, footer = body[: -_FOOTER.size], body[-_FOOTER.size:]
+        digest, length = _FOOTER.unpack(footer)
+        if len(compressed) != length:
+            raise ModelIntegrityError(
+                f"model blob truncated: footer says {length} payload bytes, "
+                f"found {len(compressed)}"
+            )
+        actual = hashlib.sha256(compressed).digest()
+        if actual != digest:
+            raise ModelIntegrityError(
+                f"model blob corrupt: payload sha256 {actual.hex()[:12]}… "
+                f"does not match footer {digest.hex()[:12]}…"
+            )
+    elif blob.startswith(MAGIC_V1):
+        compressed = blob[len(MAGIC_V1):]  # v01: no footer to verify
+    else:
+        raise ModelIntegrityError(
+            "not a predictionio_tpu model blob (bad magic)"
+        )
+    try:
+        payload = zlib.decompress(compressed)
+        return pickle.loads(payload)
+    except (zlib.error, pickle.UnpicklingError, EOFError) as exc:
+        # only reachable for v01 blobs (v02 verified the checksum above) or
+        # a pickle stream damaged before v02 framing existed
+        raise ModelIntegrityError(
+            f"model blob corrupt (legacy v01 format, no checksum): {exc}"
+        ) from exc

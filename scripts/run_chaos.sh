@@ -4,7 +4,10 @@
 # shedding, breaker trip/recovery, retry-then-succeed — under injected
 # faults, AND that the telemetry layer sees it all happen (shed/retry/
 # breaker counters moving, trace ids spanning ingress->batch->storage).
-# See docs/resilience.md and docs/observability.md.
+# The rollout-under-chaos stage (tests/test_registry.py) fault-injects the
+# canary candidate lane and asserts the candidate breaker trips, the
+# router auto-rolls back to stable, and stable traffic never errors.
+# See docs/resilience.md, docs/observability.md, docs/model_registry.md.
 # Usage: scripts/run_chaos.sh [extra pytest args...]
 set -euo pipefail
 
@@ -12,5 +15,5 @@ repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$repo_root"
 
 exec env JAX_PLATFORMS=cpu python -m pytest \
-  tests/test_resilience.py tests/test_obs.py -q \
+  tests/test_resilience.py tests/test_obs.py tests/test_registry.py -q \
   -p no:cacheprovider "$@"

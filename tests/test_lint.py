@@ -700,3 +700,68 @@ class TestSelfLint:
         # the walk must actually visit the tree, not silently skip it
         assert report.files_scanned > 80
         assert report.errors == []
+
+
+# ---------------------------------------------------------------------------
+# family: storage-contract — raw pickle boundary
+# ---------------------------------------------------------------------------
+
+
+class TestStorageRawPickle:
+    SRC = """
+        import pickle
+
+        def read_model(blob):
+            return pickle.loads(blob)
+    """
+
+    def test_raw_pickle_fires_outside_boundary(self):
+        active, _ = lint_snippet(
+            self.SRC, "predictionio_tpu/data/storage/sqlite.py"
+        )
+        assert "storage-raw-pickle" in rule_ids(active)
+
+    def test_module_alias_form_fires(self):
+        active, _ = lint_snippet(
+            """
+            import pickle as pkl
+
+            def read_model(blob):
+                return pkl.loads(blob)
+            """,
+            "predictionio_tpu/data/storage/sqlite.py",
+        )
+        assert "storage-raw-pickle" in rule_ids(active)
+
+    def test_bare_import_form_fires(self):
+        active, _ = lint_snippet(
+            """
+            from pickle import loads
+
+            def read_model(blob):
+                return loads(blob)
+            """,
+            "predictionio_tpu/tools/shell.py",
+        )
+        assert "storage-raw-pickle" in rule_ids(active)
+
+    def test_model_io_and_registry_store_are_the_allowed_boundary(self):
+        for allowed in (
+            "predictionio_tpu/workflow/model_io.py",
+            "predictionio_tpu/registry/store.py",
+        ):
+            active, _ = lint_snippet(self.SRC, allowed)
+            assert "storage-raw-pickle" not in rule_ids(active)
+
+    def test_other_loads_names_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import json
+            from msgpack import loads as m_loads
+
+            def read(blob):
+                return json.loads(blob) or m_loads(blob)
+            """,
+            "predictionio_tpu/data/storage/sqlite.py",
+        )
+        assert "storage-raw-pickle" not in rule_ids(active)

@@ -453,8 +453,9 @@ class TestQueryServerObs:
             server = _make_query_server()
             engine = server.engine
             engine.serving_classes = {"s": StorageTouchingServing}
-            algorithms, serving, models = server._active
-            server._active = (algorithms, StorageTouchingServing(), models)
+            server._active = server._active._replace(
+                serving=StorageTouchingServing()
+            )
             client = TestClient(TestServer(server.make_app()))
             await client.start_server()
             try:

@@ -196,7 +196,7 @@ class TestQuickstart:
             client = TestClient(TestServer(server.make_app()))
             await client.start_server()
             try:
-                resp = await client.get("/reload")
+                resp = await client.post("/reload")
                 assert resp.status == 200
                 assert (await resp.json())["instanceId"] == second_id
                 resp = await client.get("/")

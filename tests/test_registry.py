@@ -1,0 +1,1242 @@
+"""Model registry + progressive rollout tests (tier-1, CPU-only, fast).
+
+Covers the subsystem end to end: PIOTPU02 checksummed model framing,
+content-addressed artifact store with lineage manifests and GC, the
+rollout state machine, sticky canary hashing, the metric-gated promotion
+controller, and the serving integration — including the acceptance rail:
+train -> publish v2 -> canary with sticky hashing -> injected faults on
+v2 trip the candidate breaker -> auto-rollback to v1 with zero 5xx on the
+stable lane, all visible in /metrics and the registry state.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tracing import TRACE_HEADER, get_tracer
+from predictionio_tpu.registry import (
+    ArtifactIntegrityError,
+    ArtifactStore,
+    Lane,
+    ModelManifest,
+    PromotionCriteria,
+    RolloutController,
+    RolloutInstruments,
+    params_hash_of,
+    sticky_bucket,
+)
+from predictionio_tpu.registry.controller import (
+    VERDICT_IDLE,
+    VERDICT_PROMOTE,
+    VERDICT_READY,
+    VERDICT_ROLLBACK,
+    VERDICT_WAIT,
+)
+from predictionio_tpu.registry.router import (
+    LANE_CANDIDATE,
+    LANE_STABLE,
+    RolloutPlan,
+    choose_lane,
+    routing_key,
+)
+from predictionio_tpu.resilience import CLOSED, OPEN, FaultInjector
+from predictionio_tpu.workflow import model_io
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+# ---------------------------------------------------------------------------
+# model_io: PIOTPU02 checksummed framing
+# ---------------------------------------------------------------------------
+
+
+class TestModelIOIntegrity:
+    def test_v02_roundtrip(self):
+        blob = model_io.serialize_models([{"w": [1.0, 2.0]}, "second"])
+        assert blob.startswith(model_io.MAGIC)
+        assert model_io.deserialize_models(blob) == [{"w": [1.0, 2.0]}, "second"]
+
+    def test_reads_legacy_v01(self):
+        import pickle
+        import zlib
+
+        legacy = model_io.MAGIC_V1 + zlib.compress(pickle.dumps([1, 2, 3]))
+        assert model_io.deserialize_models(legacy) == [1, 2, 3]
+
+    def test_truncation_is_a_clear_integrity_error(self):
+        blob = model_io.serialize_models([list(range(100))])
+        for cut in (len(blob) - 1, len(blob) - 20, len(model_io.MAGIC) + 4):
+            with pytest.raises(model_io.ModelIntegrityError):
+                model_io.deserialize_models(blob[:cut])
+
+    def test_bitflip_is_a_clear_integrity_error(self):
+        blob = bytearray(model_io.serialize_models([list(range(100))]))
+        blob[len(blob) // 2] ^= 0xFF
+        with pytest.raises(model_io.ModelIntegrityError) as exc_info:
+            model_io.deserialize_models(bytes(blob))
+        assert "sha256" in str(exc_info.value)
+
+    def test_corrupt_v01_wrapped_not_opaque(self):
+        import pickle
+        import zlib
+
+        legacy = model_io.MAGIC_V1 + zlib.compress(pickle.dumps([1, 2, 3]))
+        with pytest.raises(model_io.ModelIntegrityError):
+            model_io.deserialize_models(legacy[:-4])
+
+    def test_bad_magic(self):
+        with pytest.raises(model_io.ModelIntegrityError):
+            model_io.deserialize_models(b"NOTPIO00" + b"x" * 64)
+
+
+# ---------------------------------------------------------------------------
+# manifests
+# ---------------------------------------------------------------------------
+
+
+class TestManifest:
+    def test_params_hash_is_order_independent(self):
+        a = params_hash_of({"x": 1, "y": {"b": 2, "a": 3}})
+        b = params_hash_of({"y": {"a": 3, "b": 2}, "x": 1})
+        assert a == b
+        assert a != params_hash_of({"x": 2, "y": {"b": 2, "a": 3}})
+
+    def test_json_roundtrip_ignores_unknown_keys(self):
+        m = ModelManifest(
+            version="v000001",
+            engine_id="e",
+            engine_version="1",
+            engine_variant="engine.json",
+            metrics={"ndcg": 0.41},
+        )
+        data = m.to_json_dict()
+        data["future_field"] = "ignored"
+        clone = ModelManifest.from_json_dict(data)
+        assert clone.version == "v000001"
+        assert clone.metrics == {"ndcg": 0.41}
+
+
+# ---------------------------------------------------------------------------
+# artifact store
+# ---------------------------------------------------------------------------
+
+
+def _manifest(engine_id="store-test", **kw):
+    defaults = dict(
+        version="",
+        engine_id=engine_id,
+        engine_version="1",
+        engine_variant="engine.json",
+        engine_factory="pkg.mod.engine",
+    )
+    defaults.update(kw)
+    return ModelManifest(**defaults)
+
+
+class TestArtifactStore:
+    def test_publish_assigns_versions_and_auto_stable(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        m1 = store.publish(_manifest(instance_id="i1"), b"blob-one")
+        m2 = store.publish(_manifest(instance_id="i2"), b"blob-two")
+        assert m1.version == "v000001"
+        assert m2.version == "v000002"
+        assert m2.parent_version == "v000001"  # stable at publish time
+        state = store.get_state("store-test")
+        assert state.stable == "v000001"  # first publish auto-stabilizes
+        assert [m.version for m in store.list_versions("store-test")] == [
+            "v000001",
+            "v000002",
+        ]
+        assert [h["action"] for h in state.history][:2] == ["publish", "auto-stable"]
+
+    def test_load_blob_verifies_sha256(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        m = store.publish(_manifest(), b"precious bytes")
+        assert store.load_blob("store-test", m.version) == b"precious bytes"
+        blob_path = store._blob_path("store-test", m.blob_sha256)
+        with open(blob_path, "wb") as fh:
+            fh.write(b"precious bytez")  # flipped one byte, same length
+        with pytest.raises(ArtifactIntegrityError) as exc_info:
+            store.load_blob("store-test", m.version)
+        assert "checksum" in str(exc_info.value)
+        with open(blob_path, "wb") as fh:
+            fh.write(b"short")
+        with pytest.raises(ArtifactIntegrityError) as exc_info:
+            store.load_blob("store-test", m.version)
+        assert "length" in str(exc_info.value)
+
+    def test_load_blob_unknown_version(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        with pytest.raises(ArtifactIntegrityError):
+            store.load_blob("store-test", "v999999")
+
+    def test_identical_bytes_are_deduplicated(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        m1 = store.publish(_manifest(), b"same bytes")
+        m2 = store.publish(_manifest(), b"same bytes")
+        assert m1.blob_sha256 == m2.blob_sha256
+        blobs_dir = os.path.dirname(store._blob_path("store-test", m1.blob_sha256))
+        assert len(os.listdir(blobs_dir)) == 1
+
+    def test_gc_keeps_last_n_and_pinned(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        for i in range(5):
+            store.publish(_manifest(instance_id=f"i{i}"), f"blob{i}".encode())
+        # stable pin is v000001 (auto-stable); keep_last=2 drops the oldest
+        # unpinned versions
+        removed = store.gc("store-test", keep_last=2)
+        left = [m.version for m in store.list_versions("store-test")]
+        assert "v000001" in left  # pinned by stable
+        assert "v000005" in left  # newest survives
+        assert len(left) <= 3
+        for version in removed:
+            with pytest.raises(ArtifactIntegrityError):
+                store.load_blob("store-test", version)
+
+    def test_gc_pins_never_eat_the_newest_budget(self, tmp_path):
+        """With pinned count >= keep_last, publish must still keep the
+        version it just wrote (pins are additive to the newest-N set,
+        not counted against it)."""
+        store = ArtifactStore(str(tmp_path))
+        store.publish(_manifest(), b"one")  # auto-stable -> pinned
+        m2 = store.publish(_manifest(), b"two", keep_last=1)
+        left = [m.version for m in store.list_versions("store-test")]
+        assert m2.version in left  # the just-published version survives
+        assert "v000001" in left  # the stable pin survives
+
+    def test_state_machine_stage_promote_rollback(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        store.publish(_manifest(), b"one")
+        store.publish(_manifest(), b"two")
+        with pytest.raises(ValueError):
+            store.stage_candidate("store-test", "v000404")  # unknown
+        with pytest.raises(ValueError):
+            store.stage_candidate("store-test", "v000001")  # already stable
+        with pytest.raises(ValueError):
+            store.promote("store-test")  # nothing staged
+        state = store.stage_candidate(
+            "store-test", "v000002", mode="canary", fraction=0.25
+        )
+        assert (state.candidate, state.mode, state.fraction) == (
+            "v000002",
+            "canary",
+            0.25,
+        )
+        state = store.promote("store-test")
+        assert state.stable == "v000002"
+        assert state.previous_stable == "v000001"
+        assert state.candidate == "" and state.mode == "off"
+        # post-promote regret: rollback reverts to previous stable
+        state = store.rollback("store-test", reason="regret")
+        assert state.stable == "v000001"
+        with pytest.raises(ValueError):
+            store.rollback("store-test")  # nothing left to roll back
+        actions = [h["action"] for h in store.get_state("store-test").history]
+        assert actions.count("rollback") == 1
+        assert "stage" in actions and "promote" in actions
+
+    def test_promote_past_staged_candidate_unstages_it(self, tmp_path):
+        """Promoting an explicit version different from the staged
+        candidate obsoletes that rollout: an orphaned candidate would
+        report a canary no server is baking and pin the version against
+        GC forever."""
+        store = ArtifactStore(str(tmp_path))
+        store.publish(_manifest(), b"one")
+        store.publish(_manifest(), b"two")
+        store.publish(_manifest(), b"three")
+        store.stage_candidate("store-test", "v000002", mode="canary")
+        state = store.promote("store-test", "v000003")
+        assert state.stable == "v000003"
+        assert state.candidate == "" and state.mode == "off"
+        assert any(
+            h["action"] == "unstage" and h["version"] == "v000002"
+            for h in state.history
+        )
+
+    def test_no_tmp_litter(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        store.publish(_manifest(), b"x" * 1000)
+        litter = [
+            name
+            for _, _, names in os.walk(tmp_path)
+            for name in names
+            if name.startswith(".tmp-")
+        ]
+        assert litter == []
+
+
+# ---------------------------------------------------------------------------
+# sticky routing
+# ---------------------------------------------------------------------------
+
+
+class TestStickyRouting:
+    def test_deterministic_and_uniform(self):
+        buckets = [sticky_bucket(f"user{i}", "salt") for i in range(2000)]
+        assert buckets == [sticky_bucket(f"user{i}", "salt") for i in range(2000)]
+        assert all(0.0 <= b < 1.0 for b in buckets)
+        share = sum(1 for b in buckets if b < 0.1) / len(buckets)
+        assert 0.05 < share < 0.15  # ~10% +- sampling noise
+
+    def test_salt_resamples_population(self):
+        a = {i for i in range(500) if sticky_bucket(f"u{i}", "v1") < 0.2}
+        b = {i for i in range(500) if sticky_bucket(f"u{i}", "v2") < 0.2}
+        assert a != b  # a later rollout canaries a different user set
+
+    def test_choose_lane(self):
+        canary = RolloutPlan("canary", 1.0, "s")
+        assert choose_lane(canary, "anyone") == LANE_CANDIDATE
+        assert choose_lane(RolloutPlan("canary", 0.0, "s"), "u") == LANE_STABLE
+        assert choose_lane(RolloutPlan("shadow", 1.0, "s"), "u") == LANE_STABLE
+        assert choose_lane(RolloutPlan("off", 1.0, "s"), "u") == LANE_STABLE
+
+    def test_routing_key_field_and_fallback(self):
+        assert routing_key({"user": "u7", "num": 3}, "user") == "u7"
+        # missing field: still deterministic per payload
+        k1 = routing_key({"num": 3, "q": "x"}, "user")
+        k2 = routing_key({"q": "x", "num": 3}, "user")
+        assert k1 == k2
+
+
+# ---------------------------------------------------------------------------
+# rollout controller (pure decision logic on fake clock + fresh registry)
+# ---------------------------------------------------------------------------
+
+
+def _controller(mode="canary", **criteria_kw):
+    defaults = dict(bake_window_s=10.0, min_requests=10, auto_promote=True)
+    defaults.update(criteria_kw)
+    inst = RolloutInstruments(MetricsRegistry())
+    clock = FakeClock()
+    ctrl = RolloutController(inst, PromotionCriteria(**defaults), clock=clock)
+    ctrl.begin("v1", "v2", mode)
+    return ctrl, inst, clock
+
+
+class TestRolloutController:
+    def test_idle_without_active_rollout(self):
+        inst = RolloutInstruments(MetricsRegistry())
+        ctrl = RolloutController(inst, PromotionCriteria())
+        assert ctrl.evaluate()[0] == VERDICT_IDLE
+
+    def test_waits_for_window_and_sample(self):
+        ctrl, inst, clock = _controller()
+        inst.requests.inc(50, version="v2", lane=LANE_CANDIDATE)
+        assert ctrl.evaluate()[0] == VERDICT_WAIT  # window not elapsed
+        clock.advance(11)
+        assert ctrl.evaluate()[0] == VERDICT_PROMOTE
+        ctrl2, inst2, clock2 = _controller()
+        clock2.advance(11)
+        inst2.requests.inc(3, version="v2", lane=LANE_CANDIDATE)
+        assert ctrl2.evaluate()[0] == VERDICT_WAIT  # sample too small
+
+    def test_promotes_clean_candidate(self):
+        ctrl, inst, clock = _controller()
+        inst.requests.inc(100, version="v1", lane=LANE_STABLE)
+        inst.errors.inc(2, version="v1", lane=LANE_STABLE)
+        inst.requests.inc(30, version="v2", lane=LANE_CANDIDATE)
+        clock.advance(11)
+        verdict, reason = ctrl.evaluate()
+        assert verdict == VERDICT_PROMOTE
+        assert "gates passed" in reason
+
+    def test_error_rate_gate_rolls_back(self):
+        ctrl, inst, clock = _controller()
+        inst.requests.inc(100, version="v1", lane=LANE_STABLE)
+        inst.requests.inc(30, version="v2", lane=LANE_CANDIDATE)
+        inst.errors.inc(10, version="v2", lane=LANE_CANDIDATE)
+        clock.advance(11)
+        verdict, reason = ctrl.evaluate()
+        assert verdict == VERDICT_ROLLBACK
+        assert reason.startswith("error-rate")
+
+    def test_error_rate_compares_deltas_not_totals(self):
+        # candidate counters carry history from an earlier bake: only
+        # post-begin deltas may count
+        inst = RolloutInstruments(MetricsRegistry())
+        inst.errors.inc(50, version="v2", lane=LANE_CANDIDATE)  # pre-bake
+        inst.requests.inc(50, version="v2", lane=LANE_CANDIDATE)
+        clock = FakeClock()
+        ctrl = RolloutController(
+            inst,
+            PromotionCriteria(bake_window_s=10.0, min_requests=10),
+            clock=clock,
+        )
+        ctrl.begin("v1", "v2", "canary")
+        inst.requests.inc(30, version="v2", lane=LANE_CANDIDATE)  # clean bake
+        inst.requests.inc(30, version="v1", lane=LANE_STABLE)
+        clock.advance(11)
+        assert ctrl.evaluate()[0] == VERDICT_PROMOTE
+
+    def test_latency_gate_rolls_back(self):
+        ctrl, inst, clock = _controller(max_p95_ratio=1.5)
+        inst.requests.inc(30, version="v2", lane=LANE_CANDIDATE)
+        inst.requests.inc(30, version="v1", lane=LANE_STABLE)
+        for _ in range(50):
+            inst.predict_seconds.observe(0.010, version="v1")
+            inst.predict_seconds.observe(0.200, version="v2")
+        clock.advance(11)
+        verdict, reason = ctrl.evaluate()
+        assert verdict == VERDICT_ROLLBACK
+        assert reason.startswith("latency")
+
+    def test_latency_gate_is_windowed_not_lifetime(self):
+        """A re-staged candidate is judged on THIS bake's samples: slow
+        predicts from a previous (rolled-back) bake must not keep
+        re-tripping the gate after the slowness is fixed."""
+        inst = RolloutInstruments(MetricsRegistry())
+        clock = FakeClock()
+        for _ in range(50):  # previous bake: candidate was slow
+            inst.predict_seconds.observe(0.010, version="v1")
+            inst.predict_seconds.observe(0.500, version="v2")
+        ctrl = RolloutController(
+            inst,
+            PromotionCriteria(
+                bake_window_s=10.0, min_requests=10, max_p95_ratio=1.5
+            ),
+            clock=clock,
+        )
+        ctrl.begin("v1", "v2", "canary")  # re-stage after the fix
+        inst.requests.inc(30, version="v2", lane=LANE_CANDIDATE)
+        inst.requests.inc(30, version="v1", lane=LANE_STABLE)
+        for _ in range(50):  # this bake: same speed as stable
+            inst.predict_seconds.observe(0.010, version="v1")
+            inst.predict_seconds.observe(0.010, version="v2")
+        clock.advance(11)
+        assert ctrl.evaluate()[0] == VERDICT_PROMOTE
+
+    def test_shadow_divergence_gate(self):
+        ctrl, inst, clock = _controller(mode="shadow", max_divergence_rate=0.25)
+        inst.shadow_scored.inc(40, version="v2")
+        inst.divergence.inc(20, version="v2")
+        clock.advance(11)
+        verdict, reason = ctrl.evaluate()
+        assert verdict == VERDICT_ROLLBACK
+        assert reason.startswith("divergence")
+        ctrl2, inst2, clock2 = _controller(mode="shadow")
+        inst2.shadow_scored.inc(40, version="v2")
+        inst2.divergence.inc(2, version="v2")
+        clock2.advance(11)
+        assert ctrl2.evaluate()[0] == VERDICT_PROMOTE
+
+    def test_ready_when_auto_promote_disabled(self):
+        ctrl, inst, clock = _controller(auto_promote=False)
+        inst.requests.inc(30, version="v2", lane=LANE_CANDIDATE)
+        clock.advance(11)
+        assert ctrl.evaluate()[0] == VERDICT_READY
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+
+
+class _JsonQuery:
+    """sample_engine Query with the /queries.json codec contract."""
+
+    def __init__(self, qid: int):
+        self.qid = qid
+
+    @classmethod
+    def from_json_dict(cls, d):
+        return cls(qid=int(d["qid"]))
+
+
+def _memory_storage():
+    from predictionio_tpu.data.storage.registry import Storage
+
+    return Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+
+
+def _mk_engine():
+    from predictionio_tpu.controller import Engine
+    from tests.sample_engine import Algo0, DataSource0, Preparator0, Serving0
+
+    return Engine(
+        {"ds": DataSource0},
+        {"prep": Preparator0},
+        {"a": Algo0},
+        {"s": Serving0},
+        query_class=_JsonQuery,
+    )
+
+
+def _engine_manifest():
+    from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+    return EngineManifest(
+        engine_id="regtest",
+        version="1",
+        variant="engine.json",
+        engine_factory="tests.test_engine.make_engine",
+    )
+
+
+def _train_version(storage, registry_dir, algo_id):
+    """One real train -> metadata instance + registry publish."""
+    from predictionio_tpu.workflow.core_workflow import run_train
+    from tests.test_engine import params
+
+    return run_train(
+        _mk_engine(),
+        _engine_manifest(),
+        params(algos=((algo_id,),)),
+        storage=storage,
+        registry_dir=registry_dir,
+    )
+
+
+def _registry_server(tmp_path, **cfg_kw):
+    """train v1 (algo id 3) + v2 (algo id 5), deploy the registry stable."""
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        _query_server_from_registry,
+    )
+
+    storage = _memory_storage()
+    registry_dir = str(tmp_path / "registry")
+    id1 = _train_version(storage, registry_dir, algo_id=3)
+    id2 = _train_version(storage, registry_dir, algo_id=5)
+    store = ArtifactStore(registry_dir)
+    cfg_kw.setdefault("bake_check_interval_s", 30.0)  # loop idle unless asked
+    cfg_kw.setdefault("request_timeout_s", 5.0)
+    config = ServerConfig(**cfg_kw)
+    server = _query_server_from_registry(
+        _mk_engine(), _engine_manifest(), store, "v000001", storage, config
+    )
+    return server, store, (id1, id2)
+
+
+def _run_server(body_fn, server):
+    async def outer():
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            await body_fn(client)
+        finally:
+            await client.close()
+        leftover = [
+            t
+            for t in asyncio.all_tasks()
+            if t is not asyncio.current_task() and not t.done()
+        ]
+        assert leftover == [], f"hung tasks after shutdown: {leftover}"
+
+    asyncio.run(outer())
+
+
+class TestEndToEndRollout:
+    def test_train_publishes_lineage(self, tmp_path):
+        storage = _memory_storage()
+        registry_dir = str(tmp_path / "registry")
+        id1 = _train_version(storage, registry_dir, algo_id=3)
+        store = ArtifactStore(registry_dir)
+        versions = store.list_versions("regtest")
+        assert [m.version for m in versions] == ["v000001"]
+        m = versions[0]
+        assert m.instance_id == id1
+        assert m.params_hash  # canonical hash of the engine params json
+        assert m.blob_sha256 and m.blob_size > 0
+        assert "trainWallClockSec" in m.data_span
+        # the registry blob IS the deployable artifact
+        blob = store.load_blob("regtest", "v000001")
+        assert model_io.deserialize_models(blob)
+        assert store.get_state("regtest").stable == "v000001"
+
+    def test_canary_sticky_fault_injection_auto_rollback(self, tmp_path):
+        """The acceptance rail: canary at 50% with sticky hashing; injected
+        faults on v2 trip the candidate breaker; the router auto-rolls
+        back to v1 with ZERO 5xx on any lane; /metrics shows per-version
+        counters and the rollback."""
+        server, store, _ = _registry_server(tmp_path)
+        assert server.model_version == "v000001"
+
+        async def body(client):
+            # baseline: stable serves algo id 3
+            resp = await client.post("/queries.json", json={"qid": 1, "user": "u1"})
+            assert resp.status == 200
+            assert (await resp.json())["algo_id"] == 3
+            # stage v2 as a 50% canary (sticky per user)
+            resp = await client.post(
+                "/models/candidate",
+                json={"version": "v000002", "mode": "canary", "fraction": 0.5},
+            )
+            assert resp.status == 200, await resp.text()
+            # sticky hashing: a user sees ONE model across repeats, and the
+            # assignment is exactly the sticky_bucket contract
+            seen: dict[int, int] = {}
+            for _round in range(3):
+                for u in range(20):
+                    resp = await client.post(
+                        "/queries.json", json={"qid": u, "user": f"user{u}"}
+                    )
+                    assert resp.status == 200
+                    algo_id = (await resp.json())["algo_id"]
+                    assert seen.setdefault(u, algo_id) == algo_id
+            expected = {
+                u: (5 if sticky_bucket(f"user{u}", "v000002") < 0.5 else 3)
+                for u in range(20)
+            }
+            assert seen == expected
+            assert {3, 5} <= set(seen.values())  # both lanes actually served
+            # per-version request counters with version labels on /metrics
+            text = await (await client.get("/metrics")).text()
+            assert 'pio_model_requests_total{version="v000001",lane="stable"}' in text
+            assert (
+                'pio_model_requests_total{version="v000002",lane="candidate"}'
+                in text
+            )
+            # inject faults into the candidate lane's algorithm: every
+            # candidate predict now raises
+            cand = server._candidate
+            broken = FaultInjector(cand.algorithms[0])
+            broken.inject(fail_count=10_000)
+            server._candidate = cand._replace(algorithms=[broken])
+            # hammer both lanes: candidate queries fall back to stable
+            # (zero 5xx), the breaker trips, the rollout auto-rolls back
+            for _round in range(3):
+                for u in range(20):
+                    resp = await client.post(
+                        "/queries.json", json={"qid": u, "user": f"user{u}"}
+                    )
+                    assert resp.status == 200, await resp.text()
+                    assert (await resp.json())["algo_id"] == 3  # stable answer
+            assert server._candidate is None  # breaker-trip rollback fired
+            assert server.model_version == "v000001"  # stable untouched
+            assert server.candidate_breaker.snapshot()["trips"] >= 1
+            # registry state records the rollback + reason
+            state = store.get_state("regtest")
+            assert state.candidate == "" and state.stable == "v000001"
+            assert any(
+                h["action"] == "rollback" and "breaker-trip" in h.get("reason", "")
+                for h in state.history
+            )
+            # visible on /metrics and /models
+            text = await (await client.get("/metrics")).text()
+            assert 'pio_rollbacks_total{reason="breaker-trip"} 1' in text
+            data = await (await client.get("/models")).json()
+            assert data["candidate"] is None
+            assert data["stable"]["version"] == "v000001"
+            assert data["registry"]["state"]["stable"] == "v000001"
+            # post-rollback: the same traffic still answers healthily
+            resp = await client.post("/queries.json", json={"qid": 9, "user": "u9"})
+            assert resp.status == 200
+            assert (await resp.json())["algo_id"] == 3
+
+        _run_server(body, server)
+
+    def test_bake_gates_auto_promote(self, tmp_path):
+        """A clean candidate is auto-promoted once the bake window and
+        sample-size gates pass; the registry pin moves with it."""
+        server, store, (id1, id2) = _registry_server(
+            tmp_path,
+            bake_window_s=0.05,
+            bake_min_requests=5,
+            bake_check_interval_s=0.02,
+            max_p95_ratio=1000.0,  # same algo both lanes; don't flake on noise
+        )
+
+        async def body(client):
+            resp = await client.post(
+                "/models/candidate",
+                json={"version": "v000002", "mode": "canary", "fraction": 1.0},
+            )
+            assert resp.status == 200, await resp.text()
+            for i in range(8):
+                resp = await client.post(
+                    "/queries.json", json={"qid": i, "user": f"user{i}"}
+                )
+                assert resp.status == 200
+                assert (await resp.json())["algo_id"] == 5  # fraction 1.0
+            deadline = time.monotonic() + 5.0
+            while server.model_version != "v000002":
+                assert time.monotonic() < deadline, "auto-promote never fired"
+                await asyncio.sleep(0.02)
+            assert server._candidate is None
+            assert server.instance_id == id2
+            # the registry write lands just after the in-memory lane swap
+            while store.get_state("regtest").stable != "v000002":
+                assert time.monotonic() < deadline, "registry pin never moved"
+                await asyncio.sleep(0.02)
+            state = store.get_state("regtest")
+            assert state.previous_stable == "v000001"
+            text = await (await client.get("/metrics")).text()
+            assert "pio_promotions_total 1" in text
+
+        _run_server(body, server)
+
+    def test_manual_promote_and_rollback_endpoints(self, tmp_path):
+        server, store, (id1, id2) = _registry_server(tmp_path, auto_promote=False)
+
+        async def body(client):
+            resp = await client.post("/models/promote")
+            assert resp.status == 404  # nothing staged
+            resp = await client.post("/models/rollback")
+            assert resp.status == 404
+            resp = await client.post(
+                "/models/candidate", json={"version": "v000002", "fraction": 0.1}
+            )
+            assert resp.status == 200
+            # an explicit version that is NOT the staged candidate is a
+            # guard violation, not a selector: 409, nothing promoted
+            resp = await client.post(
+                "/models/promote", json={"version": "v000404"}
+            )
+            assert resp.status == 409
+            assert server._candidate is not None
+            assert server.model_version == "v000001"
+            resp = await client.post("/models/promote")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["version"] == "v000002"
+            assert data["instanceId"] == id2
+            assert server.model_version == "v000002"
+            assert store.get_state("regtest").stable == "v000002"
+            # unknown version -> 400, nothing changes
+            resp = await client.post(
+                "/models/candidate", json={"version": "v000404"}
+            )
+            assert resp.status == 400
+            assert server._candidate is None
+            # staging the serving stable against itself -> 400, and the
+            # server/registry states stay in sync (no phantom rollout)
+            resp = await client.post(
+                "/models/candidate", json={"version": "v000002"}
+            )
+            assert resp.status == 400
+            assert "already the stable" in (await resp.json())["message"]
+            assert server._candidate is None
+            assert store.get_state("regtest").candidate == ""
+
+        _run_server(body, server)
+
+    def test_registry_is_deploy_source_of_truth(self, tmp_path):
+        """create_query_server prefers the registry's pinned stable over
+        the newest COMPLETED instance: a newer (possibly bad) train does
+        not change what serves until promoted."""
+        from predictionio_tpu.workflow.create_server import (
+            _query_server_from_registry,
+            ServerConfig,
+        )
+
+        server, store, (id1, id2) = _registry_server(tmp_path)
+        # v2 is the newer instance, but the registry pin says v000001
+        assert server.model_version == "v000001"
+        assert server.instance_id == id1
+        # promote in the registry, redeploy -> v2 serves
+        store.promote("regtest", "v000002")
+        server2 = _query_server_from_registry(
+            _mk_engine(),
+            _engine_manifest(),
+            store,
+            store.get_state("regtest").stable,
+            server.storage,
+            ServerConfig(),
+        )
+        assert server2.model_version == "v000002"
+        assert server2.instance_id == id2
+
+
+# ---------------------------------------------------------------------------
+# shadow mode
+# ---------------------------------------------------------------------------
+
+
+class _TagModel:
+    def __init__(self, tag):
+        self.tag = tag
+
+
+class _TagAlgo:
+    """Minimal lane algorithm: echoes its model's tag; tunable latency to
+    widen race windows in the swap-consistency test."""
+
+    def __init__(self, delay_s: float = 0.0, fail: bool = False):
+        self.delay_s = delay_s
+        self.fail = fail
+
+    def predict_batch_dispatch(self, model, queries):
+        def fin():
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            if self.fail:
+                raise RuntimeError("injected lane failure")
+            return [model.tag for _ in queries]
+
+        return fin
+
+    def predict_batch(self, model, queries):
+        if self.fail:
+            raise RuntimeError("injected lane failure")
+        return [model.tag for _ in queries]
+
+    def predict(self, model, query):
+        if self.fail:
+            raise RuntimeError("injected lane failure")
+        return model.tag
+
+    def warmup_serving(self, model, max_batch):
+        pass
+
+
+class _TagServing:
+    def __init__(self, tag, shift: int = 0):
+        self.tag = tag
+        self.shift = shift
+
+    def supplement(self, query):
+        return query
+
+    def serve(self, query, predictions):
+        return {
+            "model": predictions[0],
+            "serving": self.tag,
+            "qid": query.qid + self.shift,
+        }
+
+
+def _tag_lane(tag, **algo_kw):
+    return Lane(
+        [_TagAlgo(**algo_kw)], _TagServing(tag), [_TagModel(tag)], tag, f"inst-{tag}"
+    )
+
+
+def _tag_server(**cfg_kw):
+    from predictionio_tpu.workflow.create_server import QueryServer, ServerConfig
+    from predictionio_tpu.workflow.engine_loader import EngineManifest
+    from tests.test_engine import params
+
+    cfg_kw.setdefault("bake_check_interval_s", 30.0)
+    server = QueryServer(
+        engine=_mk_engine(),
+        engine_params=params(),
+        models=[_TagModel("v1")],
+        manifest=EngineManifest(
+            engine_id="tagtest",
+            version="1",
+            variant="engine.json",
+            engine_factory="tests.test_engine.make_engine",
+        ),
+        instance_id="inst-v1",
+        config=ServerConfig(**cfg_kw),
+    )
+    server._active = _tag_lane("v1")
+    return server
+
+
+class TestShadowMode:
+    def test_shadow_scores_async_and_records_divergence(self, tmp_path):
+        server = _tag_server()
+        # candidate serves a DIFFERENT qid (shift) -> every comparison diverges
+        server.stage_candidate_lane(
+            _tag_lane("v2")._replace(serving=_TagServing("v2", shift=1000)),
+            mode="shadow",
+            persist=False,
+        )
+
+        async def body(client):
+            for i in range(6):
+                resp = await client.post(
+                    "/queries.json", json={"qid": i, "user": f"u{i}"}
+                )
+                assert resp.status == 200
+                data = await resp.json()
+                # responses come from stable; candidate answers discarded
+                assert data["model"] == "v1" and data["qid"] == i
+            inst = server._rollout_instruments
+            deadline = time.monotonic() + 5.0
+            while inst.shadow_scored.value(version="v2") < 6:
+                assert time.monotonic() < deadline, "shadow scoring never ran"
+                await asyncio.sleep(0.01)
+            assert inst.divergence.value(version="v2") == inst.shadow_scored.value(
+                version="v2"
+            )
+            # shadow scoring feeds the latency gate too: without candidate
+            # predict samples a slow candidate would sail through on
+            # error/divergence alone
+            assert inst.predict_seconds.summary(version="v2")["count"] >= 6
+            assert server.candidate_breaker.snapshot()["state"] == CLOSED
+
+        _run_server(body, server)
+
+    def test_shadow_failures_feed_candidate_breaker(self):
+        server = _tag_server(candidate_breaker_threshold=3)
+        server.stage_candidate_lane(
+            _tag_lane("v2", fail=True), mode="shadow", persist=False
+        )
+
+        async def body(client):
+            for i in range(6):
+                resp = await client.post(
+                    "/queries.json", json={"qid": i, "user": f"u{i}"}
+                )
+                assert resp.status == 200  # shadow failures never hit users
+            deadline = time.monotonic() + 5.0
+            while server._candidate is not None:
+                assert (
+                    time.monotonic() < deadline
+                ), "shadow breaker trip never rolled back"
+                await asyncio.sleep(0.01)
+            assert server.model_version == "v1"
+
+        _run_server(body, server)
+
+
+class TestRolloutGeneration:
+    def test_stale_generation_work_cannot_touch_the_next_rollout(self):
+        """Shadow/canary work queued for a rollout that has since ended
+        must not feed the breaker or counters of the current one — a
+        slow crashing candidate's backlog could otherwise roll back a
+        healthy successor."""
+        server = _tag_server(candidate_breaker_threshold=1)
+        server.stage_candidate_lane(
+            _tag_lane("v2", fail=True), mode="shadow", persist=False
+        )
+        stale_gen = server._rollout_gen
+        server._rollback_candidate("manual")
+        inst = server._rollout_instruments
+        # stale canary-path failure: dropped entirely
+        server._record_candidate_failure("v2", stale_gen)
+        assert server.candidate_breaker.snapshot()["state"] == CLOSED
+        assert inst.errors.value(version="v2", lane=LANE_CANDIDATE) == 0
+        # stale shadow batch: skipped wholesale, backlog slot released
+        with server._shadow_lock:
+            server._shadow_pending += 1  # as _submit_shadow would have
+        server._shadow_score(
+            _tag_lane("v2", fail=True),
+            [(_JsonQuery(1), {"qid": 1})],
+            stale_gen,
+        )
+        assert inst.shadow_scored.value(version="v2") == 0
+        assert server.candidate_breaker.snapshot()["state"] == CLOSED
+        assert server._shadow_pending == 0
+
+    def test_serving_rollback_never_reverts_registry_stable(self, tmp_path):
+        """When the registry never recorded the stage (write swallowed), a
+        breaker-trip rollback must be a registry no-op — not a previous-
+        stable revert that would point new deploys at an older model than
+        the one actually serving."""
+        store = ArtifactStore(str(tmp_path / "registry"))
+        store.publish(_manifest(engine_id="gentest"), b"one")
+        store.publish(_manifest(engine_id="gentest"), b"two")
+        store.promote("gentest", "v000002")  # previous_stable = v000001
+        server = _tag_server()
+        server.registry_store = store
+        server.manifest.engine_id = "gentest"
+        server.stage_candidate_lane(_tag_lane("v3"), persist=False)
+        assert server._rollback_candidate("breaker-trip") == "v3"
+        state = store.get_state("gentest")
+        assert state.stable == "v000002"  # NOT flipped back to v000001
+        assert state.previous_stable == "v000001"
+
+    def test_shadow_backlog_is_bounded(self):
+        server = _tag_server(shadow_max_backlog=2)
+        server.stage_candidate_lane(_tag_lane("v2"), mode="shadow", persist=False)
+        cand = server._candidate
+        with server._shadow_lock:
+            server._shadow_pending = 2  # backlog full
+        server._submit_shadow(cand, [(_JsonQuery(1), {"qid": 1})] * 3, server._rollout_gen)
+        inst = server._rollout_instruments
+        assert inst.shadow_dropped.value(version="v2") == 3  # counted, not queued
+        with server._shadow_lock:
+            server._shadow_pending = 0
+
+
+# ---------------------------------------------------------------------------
+# swap consistency under concurrent traffic (reload/promote contract)
+# ---------------------------------------------------------------------------
+
+
+class TestSwapConsistencyUnderTraffic:
+    def test_concurrent_promotes_never_mix_lanes(self):
+        """Queries in flight during version swaps must each see ONE
+        consistent (algorithms, serving, models, version) quadruple, and
+        their trace spans must carry the model version that answered."""
+        server = _tag_server()
+        lanes = {"v1": _tag_lane("v1", delay_s=0.002), "v2": _tag_lane("v2", delay_s=0.002)}
+        server._active = lanes["v1"]
+        tracer = get_tracer()
+
+        async def churn():
+            for _ in range(25):
+                nxt = "v2" if server.model_version == "v1" else "v1"
+                server.stage_candidate_lane(
+                    lanes[nxt], fraction=0.0, persist=False
+                )
+                assert server._promote_candidate() == nxt
+                await asyncio.sleep(0.001)
+
+        async def one_query(client, i):
+            trace_id = f"swaptrace{i:04d}"
+            resp = await client.post(
+                "/queries.json",
+                json={"qid": i},
+                headers={TRACE_HEADER: trace_id},
+            )
+            assert resp.status == 200
+            data = await resp.json()
+            # the quadruple consistency contract: model, serving (and the
+            # qid echoed through that serving) all come from ONE lane
+            assert data["model"] == data["serving"], data
+            assert data["qid"] == i
+            return trace_id, data["model"]
+
+        async def body(client):
+            results, _ = await asyncio.gather(
+                asyncio.gather(*[one_query(client, i) for i in range(80)]),
+                churn(),
+            )
+            versions = {v for _, v in results}
+            assert versions <= {"v1", "v2"}
+            # every query's batch span carries the version that answered it
+            checked = 0
+            for trace_id, version in results:
+                for span in tracer.find(trace_id):
+                    if span["name"] == "query.batch":
+                        assert span["tags"]["version"] == version
+                        checked += 1
+            assert checked >= 40  # ring keeps the recent ones at minimum
+
+        _run_server(body, server)
+
+
+# ---------------------------------------------------------------------------
+# /reload deprecation + instance id contract
+# ---------------------------------------------------------------------------
+
+
+class TestReloadContract:
+    def _reload_server(self, monkeypatch):
+        import datetime as dt
+
+        from predictionio_tpu.data.storage.base import (
+            EngineInstance,
+            EngineInstanceStatus,
+        )
+        from predictionio_tpu.workflow import create_server as cs
+        from predictionio_tpu.workflow.create_server import (
+            QueryServer,
+            ServerConfig,
+        )
+        from tests.test_engine import params
+
+        storage = _memory_storage()
+        now = dt.datetime.now(tz=dt.timezone.utc)
+        latest_id = storage.get_meta_data_engine_instances().insert(
+            EngineInstance(
+                id="",
+                status=EngineInstanceStatus.COMPLETED,
+                start_time=now,
+                end_time=now,
+                engine_id="reloadtest",
+                engine_version="1",
+                engine_variant="engine.json",
+                engine_factory="tests.test_engine.make_engine",
+                algorithms_params='[{"name": "a", "params": {"id": 3}}]',
+            )
+        )
+        monkeypatch.setattr(
+            cs, "load_models_for_instance", lambda *a, **kw: [object()]
+        )
+        from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+        server = QueryServer(
+            engine=_mk_engine(),
+            engine_params=params(),
+            models=[object()],
+            manifest=EngineManifest(
+                engine_id="reloadtest",
+                version="1",
+                variant="engine.json",
+                engine_factory="tests.test_engine.make_engine",
+            ),
+            instance_id="old-instance",
+            storage=storage,
+            config=ServerConfig(),
+        )
+        return server, latest_id
+
+    def test_post_is_canonical_get_warns_both_return_instance(
+        self, monkeypatch, caplog
+    ):
+        import logging
+
+        server, latest_id = self._reload_server(monkeypatch)
+
+        async def body(client):
+            with caplog.at_level(
+                logging.WARNING, logger="predictionio_tpu.workflow.create_server"
+            ):
+                resp = await client.post("/reload")
+                assert resp.status == 200
+                assert (await resp.json())["instanceId"] == latest_id
+            assert not any("deprecated" in r.message for r in caplog.records)
+            with caplog.at_level(
+                logging.WARNING, logger="predictionio_tpu.workflow.create_server"
+            ):
+                resp = await client.get("/reload")
+                assert resp.status == 200
+                # the GET spelling still works and returns the swapped-in
+                # instance id, but logs the deprecation
+                assert (await resp.json())["instanceId"] == latest_id
+            assert any("deprecated" in r.message for r in caplog.records)
+
+        _run_server(body, server)
+
+
+# ---------------------------------------------------------------------------
+# pio models CLI
+# ---------------------------------------------------------------------------
+
+
+class TestModelsCli:
+    def _seed(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        store.publish(
+            _manifest(engine_id="cliengine", instance_id="i1"), b"blob-one"
+        )
+        store.publish(
+            _manifest(
+                engine_id="cliengine",
+                instance_id="i2",
+                metrics={"rmse": 0.5},
+            ),
+            b"blob-two",
+        )
+        return store
+
+    def _run(self, tmp_path, *argv):
+        from predictionio_tpu.tools.cli import main
+
+        return main(
+            [
+                "models",
+                argv[0],
+                "--engine-id",
+                "cliengine",
+                "--registry-dir",
+                str(tmp_path),
+                *argv[1:],
+            ]
+        )
+
+    def test_list_show_promote_rollback_diff(self, tmp_path, capsys):
+        store = self._seed(tmp_path)
+        assert self._run(tmp_path, "list") == 0
+        out = capsys.readouterr().out
+        assert "v000001" in out and "stable" in out and "v000002" in out
+
+        assert self._run(tmp_path, "show", "v000002") == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["manifest"]["version"] == "v000002"
+        assert data["manifest"]["metrics"] == {"rmse": 0.5}
+        assert data["rollout"]["stable"] == "v000001"
+
+        assert self._run(tmp_path, "promote", "v000002") == 0
+        assert "Promoted v000002" in capsys.readouterr().out
+        assert store.get_state("cliengine").stable == "v000002"
+
+        assert self._run(tmp_path, "rollback") == 0
+        capsys.readouterr()
+        assert store.get_state("cliengine").stable == "v000001"
+
+        assert self._run(tmp_path, "diff", "v000001", "v000002") == 0
+        out = capsys.readouterr().out
+        assert "instance_id" in out and "blob_sha256" in out
+        assert "same engine params" in out
+
+    def test_errors_exit_nonzero(self, tmp_path, capsys):
+        self._seed(tmp_path)
+        assert self._run(tmp_path, "show", "v000404") != 0
+        capsys.readouterr()
+        assert self._run(tmp_path, "promote", "v000001") != 0  # already stable
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# admin API registry inventory
+# ---------------------------------------------------------------------------
+
+
+class TestAdminModels:
+    def test_inventory_endpoints(self, tmp_path):
+        from predictionio_tpu.tools.admin_api import AdminServer
+
+        store = ArtifactStore(str(tmp_path))
+        store.publish(_manifest(engine_id="adminengine"), b"blob")
+        server = AdminServer(
+            storage=_memory_storage(), registry_dir=str(tmp_path)
+        )
+
+        async def body():
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                data = await (await client.get("/cmd/models")).json()
+                assert len(data["engines"]) == 1
+                row = data["engines"][0]
+                assert row["engineId"] == "adminengine"
+                assert row["stable"] == "v000001"
+                detail = await (
+                    await client.get(f"/cmd/models/{row['engineKey']}")
+                ).json()
+                assert [v["version"] for v in detail["versions"]] == ["v000001"]
+                missing = await client.get("/cmd/models/nope")
+                assert missing.status == 404
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# pio top rollout line
+# ---------------------------------------------------------------------------
+
+
+class TestTopRolloutLine:
+    def test_summarize_and_render_model_versions(self):
+        from predictionio_tpu.tools.top import parse_prometheus, render, summarize
+
+        text = "\n".join(
+            [
+                'pio_model_requests_total{version="v000001",lane="stable"} 90',
+                'pio_model_requests_total{version="v000002",lane="candidate"} 10',
+                'pio_model_errors_total{version="v000002",lane="candidate"} 2',
+                "pio_rollout_mode 1",
+                "pio_rollout_fraction 0.1",
+                'pio_rollbacks_total{reason="breaker-trip"} 1',
+                "pio_requests_total 100",
+            ]
+        )
+        summary = summarize(parse_prometheus(text))
+        assert summary["model_versions"]["v000001"]["requests"] == 90
+        assert summary["model_versions"]["v000002"]["errors"] == 2
+        assert summary["rollout_mode"] == "canary"
+        assert summary["rollbacks_total"] == 1
+        screen = render(summary, "http://x")
+        assert "v000001[stable]" in screen
+        assert "v000002[candidate]" in screen
+        assert "mode canary@0.10" in screen
+        assert "rollbacks 1" in screen
