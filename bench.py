@@ -72,12 +72,16 @@ PHASES: list[tuple[str, int]] = [
 # When the device preflight fails (e.g. a dead TPU tunnel — observed
 # mid-round-4: every device call hung forever), these are skipped quickly
 # instead of silently burning 2x timeout per phase (~2h), and the bench
-# still ships the loopback serving numbers + the error fields. A failed
-# preflight is NOT terminal (round 4 lost its entire device capture to a
-# single up-front probe timeout): the probe is retried before each device
-# phase and once more near the end of the run (after an optional delay,
-# ``PIO_BENCH_LATE_RETRY_DELAY_S``), and any phases skipped while the
-# device was down are re-run if it comes back.
+# still ships the loopback serving numbers + the error fields. The probe
+# runs ONCE up front and the verdict is cached for the whole run — round 5
+# showed five consecutive 90s preflight timeouts (a re-probe before every
+# device phase, ~8 min wasted against an outage that never cleared).
+# A failed preflight is still NOT terminal (round 4 lost its entire device
+# capture to a single up-front probe timeout): ONE late retry near the end
+# of the run (after an optional delay, ``PIO_BENCH_LATE_RETRY_DELAY_S``)
+# re-probes and re-runs any skipped phases if the device came back.
+# ``--cpu-only`` skips probing entirely; ``preflight_attempts`` in the
+# JSON records how many probes actually ran.
 _DEVICE_PHASES = {"als", "serving", "twotower", "secondary"}
 _PREFLIGHT_TIMEOUT_S = 90  # first tunnel contact legitimately takes ~40s
 
@@ -1557,6 +1561,13 @@ def main() -> int:
     parser.add_argument(
         "--only", help="comma-separated phase subset (orchestrator mode)"
     )
+    parser.add_argument(
+        "--cpu-only",
+        action="store_true",
+        help="skip the device preflight entirely: device phases are "
+        "skipped (secondary runs on the CPU backend) and no probe or "
+        "late retry ever runs",
+    )
     args = parser.parse_args()
 
     if args.phase:  # child mode
@@ -1579,8 +1590,13 @@ def main() -> int:
     fields: dict = {}
     errors: dict[str, str] = {}
 
+    fields["preflight_attempts"] = 0
+
     def probe_device() -> bool:
-        """One preflight attempt; records/clears ``preflight_error``."""
+        """One preflight attempt; records/clears ``preflight_error``.
+        The verdict is CACHED by the caller for the whole run (round 5:
+        five consecutive 90s probe timeouts before the CPU fallback)."""
+        fields["preflight_attempts"] += 1
         probe_res, probe_err = _run_phase("probe", _PREFLIGHT_TIMEOUT_S, retries=0)
         fields.update(probe_res)
         if probe_err is None:
@@ -1591,13 +1607,16 @@ def main() -> int:
         return False
 
     need_device = any(name in _DEVICE_PHASES for name, _ in selected)
-    device_ok = probe_device() if need_device else True
+    if args.cpu_only:
+        fields["bench_cpu_only"] = True
+        device_ok = False
+    else:
+        device_ok = probe_device() if need_device else True
     skipped: list[tuple[str, int]] = []
+    skip_reason = (
+        "skipped: --cpu-only" if args.cpu_only else "skipped: device preflight failed"
+    )
     for name, timeout_s in selected:
-        if name in _DEVICE_PHASES and not device_ok:
-            # a transient tunnel outage must not zero the round (round 4
-            # did exactly that): cheap re-probe before every device phase
-            device_ok = probe_device()
         if name in _DEVICE_PHASES and not device_ok:
             if name == "secondary":
                 # the secondary workloads (cooccurrence, ingest, snapshot,
@@ -1612,21 +1631,21 @@ def main() -> int:
                     errors[f"{name}_error"] = err
                 continue
             skipped.append((name, timeout_s))
-            errors[f"{name}_error"] = "skipped: device preflight failed"
+            errors[f"{name}_error"] = skip_reason
             continue
         res, err = _run_phase(name, timeout_s)
         fields.update(res)
         if err:
             errors[f"{name}_error"] = err
-    if skipped:
+    if skipped and not args.cpu_only:
         # last chance near the end of the run window: wait out a transient
         # outage, then re-probe once and run whatever was skipped (PHASES
         # order puts the ALS headline first)
         late_delay = int(os.environ.get("PIO_BENCH_LATE_RETRY_DELAY_S", "600"))
-        # only wait out an outage that is still ongoing: when a mid-run
-        # re-probe already brought the device back, the skipped phases can
-        # be retried immediately
-        if late_delay > 0 and not device_ok:
+        # skipped non-empty implies the cached verdict is "down" (there is
+        # no mid-run re-probe to flip it back), so the outage is by
+        # definition still ongoing: wait it out, then probe once
+        if late_delay > 0:
             print(
                 f"[bench] device down; waiting {late_delay}s before the late "
                 "preflight retry",
@@ -1719,7 +1738,15 @@ def main() -> int:
     # "shipped" means actual measurements — phase metadata (platform, scale,
     # factor provenance) is written before any timed region and must not
     # make a fully-crashed run look healthy
-    meta_keys = {"platform", "scale", "serving_factors", "probe_platform"}
+    meta_keys = {
+        "platform",
+        "scale",
+        "serving_factors",
+        "probe_platform",
+        "preflight_attempts",
+        "bench_cpu_only",
+        "secondary_platform",
+    }
     shipped = any(k not in meta_keys for k in fields)
     # a failed device preflight means the headline phases never ran: the
     # (loopback-only) JSON above still ships for forensics, but automation

@@ -40,6 +40,7 @@ from predictionio_tpu.analysis import (  # noqa: F401  (registration side effect
     rules_obs,
     rules_recompile,
     rules_storage,
+    rules_stream,
     rules_tracer,
 )
 

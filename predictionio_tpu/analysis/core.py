@@ -74,6 +74,9 @@ class LintConfig:
     # function names allowed to host-sync on the serving path (startup /
     # shutdown hooks that run outside the request loop)
     hostsync_allow_functions: tuple[str, ...] = ()
+    # modules on the stream (speed-layer) path: event-store reads here
+    # must be bounded (rule stream-unbounded-drain)
+    stream_globs: tuple[str, ...] = ("*/stream/*.py",)
     # rule ids to run; None = all registered
     enabled: frozenset[str] | None = None
 

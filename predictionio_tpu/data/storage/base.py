@@ -279,6 +279,49 @@ class Models(abc.ABC):
 # ---------------------------------------------------------------------------
 
 
+def event_seq_key(e: Event) -> tuple[int, str]:
+    """The event store's total ORDERING CONTRACT for tailing reads.
+
+    Events order by ``(creation_time micros, event_id)`` — creation time
+    (when the store accepted the row, not the client-supplied event time)
+    with the event id as the tiebreak. Two events accepted in the same
+    microsecond therefore still have ONE total order on every backend, so
+    a resumed tail (``find_after``) can neither skip nor double-read
+    either of them. Backends with a native sequence (SQL creationTime
+    column + id) implement the same order server-side.
+    """
+    return (int(e.creation_time.timestamp() * 1_000_000), e.event_id or "")
+
+
+def check_tail_limit(limit: int) -> int:
+    """``find_after`` requires an explicit non-negative bound on EVERY
+    backend — ``find``'s "negative = no cap" convention must not leak in,
+    or the same call would return everything on the scan backends and
+    ``LIMIT 0`` (nothing, forever) on SQL."""
+    if limit is None or int(limit) < 0:
+        raise ValueError(f"find_after requires a non-negative limit, got {limit!r}")
+    return int(limit)
+
+
+def scan_find_after(
+    events: "Iterable[Event]",
+    cursor: tuple[int, str] | None,
+    limit: int,
+) -> list[Event]:
+    """Shared scan-based ``find_after``: filter strictly past the cursor,
+    sort by :func:`event_seq_key`, cap at ``limit``. O(table) — backends
+    with an index override ``find_after`` instead of calling this."""
+    limit = check_tail_limit(limit)
+    keyed = [
+        (key, e)
+        for e in events
+        for key in (event_seq_key(e),)
+        if cursor is None or key > (int(cursor[0]), str(cursor[1]))
+    ]
+    keyed.sort(key=lambda p: p[0])
+    return [e for _, e in keyed[:limit]]
+
+
 class LEvents(abc.ABC):
     """Row-level event CRUD with the reference's filter surface
     (ref LEvents.scala futureFind :188-200 — 9 filter dimensions + limit +
@@ -336,6 +379,42 @@ class LEvents(abc.ABC):
         ``None`` = must be absent, a string = must equal. ``limit=None`` means
         no cap; the reference treats limit=-1 the same way.
         """
+
+    def find_after(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        cursor: tuple[int, str] | None = None,
+        limit: int = 100,
+    ) -> list[Event]:
+        """Ordered tail read for the speed layer: up to ``limit`` events
+        strictly after ``cursor`` in :func:`event_seq_key` order
+        (``(creation_time micros, event_id)`` — the documented tiebreak).
+
+        The cursor is EXCLUSIVE (the event at the cursor position is
+        already consumed); ``None`` starts from the beginning. ``limit``
+        must be non-negative on every backend (``find``'s negative
+        no-cap convention does not apply — see :func:`check_tail_limit`).
+        This generic implementation is an O(table) scan + sort; the
+        sql/sqlite drivers override it with an indexed range read.
+        Callers on the stream path must always pass an explicit ``limit``
+        (lint rule ``stream-unbounded-drain``).
+        """
+        return scan_find_after(
+            self.find(app_id=app_id, channel_id=channel_id), cursor, limit
+        )
+
+    def seq_head(
+        self, app_id: int, channel_id: int | None = None
+    ) -> tuple[int, str] | None:
+        """The store's current tail-order head — max :func:`event_seq_key`
+        over the app's events, ``None`` when empty. Seeds a fresh stream
+        cursor ("start from now"). One O(table) scan here; the sql/sqlite
+        drivers answer from the ``(creationTime, id)`` index."""
+        return max(
+            (event_seq_key(e) for e in self.find(app_id=app_id, channel_id=channel_id)),
+            default=None,
+        )
 
     def aggregate_properties(
         self,

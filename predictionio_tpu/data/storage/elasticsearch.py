@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import base64
 import datetime as _dt
+import dataclasses
 import json
 import urllib.error
 import urllib.parse
@@ -29,7 +30,7 @@ import urllib.request
 import uuid
 from typing import Any, Iterable, Iterator, Sequence
 
-from predictionio_tpu.data.event import Event, format_event_time
+from predictionio_tpu.data.event import Event, format_event_time, parse_event_time
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
     AccessKey,
@@ -867,7 +868,7 @@ class ESLEvents(base.LEvents):
         self, event_id: str, app_id: int, channel_id: int | None = None
     ) -> Event | None:
         d = self._docs(app_id, channel_id).get(event_id)
-        return Event.from_json_dict(d) if d else None
+        return _doc_to_event(d) if d else None
 
     def delete(
         self, event_id: str, app_id: int, channel_id: int | None = None
@@ -955,7 +956,21 @@ class ESLEvents(base.LEvents):
 
                 hits = itertools.islice(hits, limit)
         for d in hits:
-            yield Event.from_json_dict(d)
+            yield _doc_to_event(d)
+
+
+def _doc_to_event(d: dict) -> Event:
+    """Stored doc -> Event, restoring the fields the REST decoder
+    deliberately ignores (the API disables ``creationTime``, but the
+    STORED doc carries it and the tail-read ordering contract —
+    ``base.event_seq_key`` — depends on it round-tripping; without this,
+    every scan re-minted creation_time = now() and a ``find_after``
+    cursor could never pass a row)."""
+    e = Event.from_json_dict(d)
+    raw_ct = d.get("creationTime")
+    if raw_ct:
+        e = dataclasses.replace(e, creation_time=parse_event_time(raw_ct))
+    return e
 
 
 class ESPEvents(base.PEvents):
@@ -1028,7 +1043,7 @@ class ESPEvents(base.PEvents):
 
         def one(i: int) -> Iterator[Event]:
             for d in docs.scan_sliced(query, i, n):
-                yield Event.from_json_dict(d)
+                yield _doc_to_event(d)
 
         return [one(i) for i in range(n)]
 

@@ -199,6 +199,19 @@ class JSONLLEvents(base.LEvents):
             events = events[:limit]
         return iter(events)
 
+    def find_after(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        cursor: tuple[int, str] | None = None,
+        limit: int = 100,
+    ) -> list[Event]:
+        """Scan-based tail read; the dedup-by-id scan keeps upsert
+        semantics (a re-appended event tails at its NEW creation time)."""
+        return base.scan_find_after(
+            self._files.scan(app_id, channel_id), cursor, limit
+        )
+
 
 class JSONLPEvents(base.PEvents):
     def __init__(self, files: JSONLEventFiles):

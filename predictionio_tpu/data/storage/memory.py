@@ -163,6 +163,17 @@ class MemoryLEvents(base.LEvents):
             events = events[:limit]
         return iter(events)
 
+    def find_after(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        cursor: tuple[int, str] | None = None,
+        limit: int = 100,
+    ) -> list[base.Event]:
+        with self._store._lock:
+            events = list(self._store.table(app_id, channel_id).values())
+        return base.scan_find_after(events, cursor, limit)
+
 
 class MemoryPEvents(base.PEvents):
     def __init__(self, store: MemoryEventStore, levents: MemoryLEvents | None = None):

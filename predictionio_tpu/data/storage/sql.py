@@ -467,6 +467,14 @@ class SQLStorageClient:
             self.execute(f"CREATE INDEX {table}_time ON {table} (eventTime)")
         except Exception:
             pass  # index exists (CREATE INDEX IF NOT EXISTS isn't MySQL-portable)
+        try:
+            # tail-read index: the (creationTime, id) ordering contract of
+            # base.event_seq_key, served by a range scan (find_after)
+            self.execute(
+                f"CREATE INDEX {table}_ctime ON {table} (creationTime, id)"
+            )
+        except Exception:
+            pass
         # seed the version row so later bumps are a single UPDATE that can
         # join the data-write transaction (atomic data+stamp commit)
         try:
@@ -758,6 +766,40 @@ class SQLLEvents(base.LEvents):
             statement += f" LIMIT {int(limit)}"
         # streamed: bounded memory even on multi-million-row scans
         return (self._row_to_event(r) for r in self._c.query_iter(statement, params))
+
+    def find_after(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        cursor: tuple[int, str] | None = None,
+        limit: int = 100,
+    ) -> list[Event]:
+        """Indexed tail read on ``(creationTime, id)`` (see
+        ``ensure_event_table``'s ``_ctime`` index) — the ordering contract
+        of ``base.event_seq_key`` executed server-side."""
+        limit = base.check_tail_limit(limit)
+        table = _event_table(app_id, channel_id)
+        self._c.ensure_event_table(table)
+        where, params = "", []
+        if cursor is not None:
+            where = " WHERE creationTime > ? OR (creationTime = ? AND id > ?)"
+            params = [int(cursor[0]), int(cursor[0]), str(cursor[1])]
+        statement = (
+            f"SELECT {_EVENT_COLS} FROM {table}{where} "
+            f"ORDER BY creationTime, id LIMIT {limit}"
+        )
+        return [self._row_to_event(r) for r in self._c.query(statement, params)]
+
+    def seq_head(
+        self, app_id: int, channel_id: int | None = None
+    ) -> tuple[int, str] | None:
+        table = _event_table(app_id, channel_id)
+        self._c.ensure_event_table(table)
+        rows = self._c.query(
+            f"SELECT creationTime, id FROM {table} "
+            "ORDER BY creationTime DESC, id DESC LIMIT 1"
+        )
+        return (int(rows[0][0]), str(rows[0][1])) if rows else None
 
 
 class SQLPEvents(base.PEvents):

@@ -110,6 +110,7 @@ CREATE TABLE IF NOT EXISTS {table} (
 );
 CREATE INDEX IF NOT EXISTS {table}_time ON {table} (eventTime);
 CREATE INDEX IF NOT EXISTS {table}_entity ON {table} (entityType, entityId);
+CREATE INDEX IF NOT EXISTS {table}_ctime ON {table} (creationTime, id);
 """
 
 
@@ -434,6 +435,50 @@ class SQLiteLEvents(base.LEvents):
                 return iter(())
             raise
         return (self._row_to_event(r) for r in rows)
+
+    def find_after(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        cursor: tuple[int, str] | None = None,
+        limit: int = 100,
+    ) -> list[Event]:
+        """Indexed tail read on ``(creationTime, id)`` — the ordering
+        contract of ``base.event_seq_key`` executed server-side. The id
+        column is ASCII hex, so SQL text comparison and python string
+        comparison agree on the tiebreak."""
+        limit = base.check_tail_limit(limit)
+        table = _event_table(app_id, channel_id)
+        where, params = "", []
+        if cursor is not None:
+            where = " WHERE creationTime > ? OR (creationTime = ? AND id > ?)"
+            params = [int(cursor[0]), int(cursor[0]), str(cursor[1])]
+        sql = (
+            f"SELECT * FROM {table}{where} "
+            f"ORDER BY creationTime, id LIMIT {limit}"
+        )
+        try:
+            rows = self._c.query(sql, params)
+        except sqlite3.OperationalError as exc:
+            if _is_missing_table(exc):
+                return []
+            raise
+        return [self._row_to_event(r) for r in rows]
+
+    def seq_head(
+        self, app_id: int, channel_id: int | None = None
+    ) -> tuple[int, str] | None:
+        table = _event_table(app_id, channel_id)
+        try:
+            rows = self._c.query(
+                f"SELECT creationTime, id FROM {table} "
+                "ORDER BY creationTime DESC, id DESC LIMIT 1"
+            )
+        except sqlite3.OperationalError as exc:
+            if _is_missing_table(exc):
+                return None
+            raise
+        return (int(rows[0][0]), str(rows[0][1])) if rows else None
 
 
 class SQLitePEvents(base.PEvents):

@@ -269,6 +269,9 @@ def cmd_train(args) -> int:
     from predictionio_tpu.workflow.core_workflow import run_train
     from predictionio_tpu.workflow.engine_loader import load_engine
 
+    if getattr(args, "follow", False) and not args.app_name:
+        # fail BEFORE the (possibly hours-long) train, not after it
+        return _die("pio train --follow requires --app-name")
     hosts = [h for h in (args.hosts or "").split(",") if h]
     if (args.num_hosts > 1 or hosts) and "PIO_PROCESS_ID" not in os.environ:
         # launcher role (ref Runner.runOnSpark, Runner.scala:185-334): spawn
@@ -306,7 +309,118 @@ def cmd_train(args) -> int:
         keep_versions=args.keep_versions,
     )
     print(f"Training completed. Engine instance ID: {instance_id}")
+    if getattr(args, "follow", False):
+        # lambda-architecture handoff: the batch train just published the
+        # stable; keep tailing the event store and publishing candidates
+        print("Entering follow mode (speed layer)...")
+        return _run_stream(args, manifest)
     return 0
+
+
+def _run_stream(args, manifest) -> int:
+    """Build and run the speed-layer pipeline (shared by ``pio stream``
+    and ``pio train --follow``); see docs/streaming.md."""
+    from predictionio_tpu.data.store.event_store import resolve_app
+    from predictionio_tpu.registry import ArtifactStore
+    from predictionio_tpu.stream import (
+        CursorStore,
+        EventTailer,
+        StreamConfig,
+        StreamInstruments,
+        StreamPipeline,
+        trainer_for_models,
+    )
+    from predictionio_tpu.workflow import model_io
+
+    if not args.app_name:
+        return _die("--app-name is required to tail an event store")
+    storage = _storage()
+    app_id, channel_id = resolve_app(storage, args.app_name, args.channel or None)
+    registry_dir = args.registry_dir or os.environ.get("PIO_REGISTRY_DIR")
+    store = ArtifactStore(registry_dir)
+    state = store.get_state(manifest.engine_id)
+    if not state.stable:
+        return _die(
+            f"no stable model in registry {store.base_dir} for engine "
+            f"{manifest.engine_id}; run `pio train --registry-dir ...` first"
+        )
+    models = model_io.deserialize_models(
+        store.load_blob(manifest.engine_id, state.stable)
+    )
+    trainer = trainer_for_models(models)
+    tailer = EventTailer(
+        storage.get_l_events(),
+        app_id,
+        channel_id,
+        batch_limit=args.batch_limit,
+        safety_lag_s=getattr(args, "safety_lag", 0.0),
+    )
+    cursors = CursorStore(getattr(args, "cursor_dir", None))
+    cursor = cursors.load(app_id, channel_id)
+    if cursor.position is None and not args.from_beginning:
+        # fresh cursor: the stable already covers history — start at the
+        # store head so only NEW events fold in (--from-beginning replays)
+        head = tailer.head_position()
+        if head is not None:
+            cursor.seed(head)
+            cursors.save(cursor)
+    config = StreamConfig(
+        engine_id=manifest.engine_id,
+        engine_version=manifest.version,
+        engine_variant=manifest.variant,
+        engine_factory=manifest.engine_factory,
+        mode=args.mode,
+        fraction=args.fraction,
+        publish_min_events=args.publish_min_events,
+        interval_s=args.interval,
+    )
+    stage_hook = None
+    if getattr(args, "notify_url", None):
+
+        def stage_hook(version, mode, fraction, _url=args.notify_url):
+            _http_json(
+                f"{_url}/models/candidate",
+                method="POST",
+                payload={"version": version, "mode": mode, "fraction": fraction},
+            )
+
+    instruments = StreamInstruments()
+    pipeline = StreamPipeline(
+        tailer,
+        trainer,
+        cursors,
+        store,
+        config,
+        instruments=instruments,
+        stage_hook=stage_hook,
+    )
+    metrics_server = None
+    if getattr(args, "metrics_port", 0):
+        from predictionio_tpu.stream.pipeline import serve_metrics
+
+        metrics_server = serve_metrics(instruments.registry, args.metrics_port)
+        print(f"Metrics on http://0.0.0.0:{args.metrics_port}/metrics")
+    print(
+        f"Streaming app {args.app_name} (id {app_id}) -> registry "
+        f"{store.base_dir} [{trainer.name}, {config.mode}@{config.fraction:g}]"
+    )
+    try:
+        pipeline.run_forever(max_cycles=args.cycles)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if metrics_server is not None:
+            metrics_server.shutdown()
+    return 0
+
+
+def cmd_stream(args) -> int:
+    """Speed layer: tail the event store, fold events into the stable
+    model incrementally, publish registry candidates continuously."""
+    from predictionio_tpu.workflow.engine_loader import load_manifest
+
+    manifest = load_manifest(args.engine_dir, args.variant)
+    return _run_stream(args, manifest)
 
 
 def cmd_eval(args) -> int:
@@ -960,6 +1074,76 @@ def build_parser() -> argparse.ArgumentParser:
         x.add_argument("--engine-dir", default=".")
         x.add_argument("--variant")
 
+    def stream_args(x, require_app: bool):
+        """Speed-layer flags shared by `pio stream` and `pio train --follow`."""
+        x.add_argument(
+            "--app-name",
+            required=require_app,
+            default=None if require_app else "",
+            help="app whose event store to tail",
+        )
+        x.add_argument("--channel", default="", help="channel name (optional)")
+        x.add_argument(
+            "--interval", type=float, default=5.0, help="seconds between cycles"
+        )
+        x.add_argument(
+            "--batch-limit",
+            type=int,
+            default=500,
+            help="events per drain micro-batch (the backpressure unit)",
+        )
+        x.add_argument(
+            "--safety-lag",
+            type=float,
+            default=0.5,
+            help="seconds the drain stays behind the wall clock, so a "
+            "concurrently committing insert cannot land behind the "
+            "cursor and be skipped (0 disables)",
+        )
+        x.add_argument(
+            "--publish-min-events",
+            type=int,
+            default=1,
+            help="publish a candidate once this many new events folded in",
+        )
+        x.add_argument(
+            "--mode",
+            choices=("canary", "shadow"),
+            default="canary",
+            help="rollout mode published candidates are staged with",
+        )
+        x.add_argument(
+            "--fraction", type=float, default=0.1, help="canary fraction"
+        )
+        x.add_argument(
+            "--from-beginning",
+            action="store_true",
+            help="a fresh cursor replays the whole store instead of "
+            "starting at the head",
+        )
+        x.add_argument(
+            "--cursor-dir", help="cursor state dir (default: $PIO_STREAM_DIR)"
+        )
+        x.add_argument(
+            "--cycles",
+            type=int,
+            default=None,
+            help="stop after N cycles (default: run until interrupted)",
+        )
+        x.add_argument(
+            "--notify-url",
+            help="POST staged candidates to this query server's "
+            "/models/candidate instead of writing registry rollout state "
+            "directly",
+        )
+        x.add_argument(
+            "--metrics-port",
+            type=int,
+            default=0,
+            help="serve the pipeline's pio_stream_* metrics at "
+            "http://0.0.0.0:PORT/metrics (for `pio top`); 0 disables",
+        )
+
     x = sub.add_parser("build")
     engine_args(x)
     x.set_defaults(fn=cmd_build)
@@ -998,7 +1182,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="registry GC: keep this many versions (stable/candidate are "
         "always kept)",
     )
+    x.add_argument(
+        "--follow",
+        action="store_true",
+        help="after training, keep tailing the event store and publish "
+        "registry candidates continuously (speed layer; requires "
+        "--app-name — see docs/streaming.md)",
+    )
+    stream_args(x, require_app=False)
     x.set_defaults(fn=cmd_train)
+
+    x = sub.add_parser(
+        "stream",
+        help="speed layer: tail the event store, fold events into the "
+        "stable model, publish registry candidates (docs/streaming.md)",
+    )
+    engine_args(x)
+    x.add_argument(
+        "--registry-dir",
+        help="artifact registry holding the stable model and receiving "
+        "candidates (default: $PIO_REGISTRY_DIR)",
+    )
+    stream_args(x, require_app=True)
+    x.set_defaults(fn=cmd_stream)
 
     x = sub.add_parser("eval")
     x.add_argument("evaluation", help="dotted path to an Evaluation")

@@ -120,9 +120,11 @@ def summarize(
     metrics: Metrics,
     prev: Metrics | None = None,
     interval_s: float | None = None,
+    now: float | None = None,
 ) -> dict[str, Any]:
     """Digest one scrape (optionally against the previous one for rates)
-    into the flat dict ``render`` prints and tests assert on."""
+    into the flat dict ``render`` prints and tests assert on. ``now``
+    (unix seconds; injectable for tests) anchors age computations."""
     requests = _total(metrics, "pio_requests_total")
     errors = sum(
         v
@@ -155,14 +157,44 @@ def summarize(
         "rollbacks_total": _total(metrics, "pio_rollbacks_total"),
         "model_versions": _model_versions(metrics),
     }
+    out["stream"] = _stream_summary(metrics, now)
     out["qps"] = None
     out["shed_rate"] = None
+    out["stream_drain_rate"] = None
     if prev is not None and interval_s and interval_s > 0:
         d_req = requests - _total(prev, "pio_requests_total")
         d_shed = out["shed_total"] - _total(prev, "pio_load_shed_total")
         out["qps"] = max(0.0, d_req) / interval_s
         out["shed_rate"] = max(0.0, d_shed) / interval_s
+        if out["stream"] is not None:
+            d_drain = out["stream"]["drains_total"] - _total(
+                prev, "pio_stream_drains_total"
+            )
+            out["stream_drain_rate"] = max(0.0, d_drain) / interval_s
     return out
+
+
+def _stream_summary(metrics: Metrics, now: float | None) -> dict[str, Any] | None:
+    """The speed-layer line, from the ``pio_stream_*`` family; None when
+    no stream pipeline exports into this endpoint."""
+    if not any(
+        name in metrics
+        for name in ("pio_stream_drains_total", "pio_stream_lag_events")
+    ):
+        return None
+    last_ts = _total(metrics, "pio_stream_last_publish_timestamp")
+    age = None
+    if last_ts > 0:
+        age = max(0.0, (now if now is not None else time.time()) - last_ts)
+    return {
+        "lag_events": _total(metrics, "pio_stream_lag_events"),
+        "lag_seconds": _total(metrics, "pio_stream_lag_seconds"),
+        "drains_total": _total(metrics, "pio_stream_drains_total"),
+        "events_total": _total(metrics, "pio_stream_events_total"),
+        "publishes_total": _total(metrics, "pio_stream_publishes_total"),
+        "drift_suppressed": _total(metrics, "pio_stream_drift_suppressed_total"),
+        "last_publish_age_s": age,
+    }
 
 
 def _model_versions(metrics: Metrics) -> dict[str, dict[str, Any]]:
@@ -242,6 +274,21 @@ def render(summary: dict[str, Any], url: str) -> str:
         if summary.get("rollbacks_total"):
             tail += f"   rollbacks {num(summary['rollbacks_total'])}"
         lines.append("  models     " + "  ".join(parts) + tail)
+    stream = summary.get("stream")
+    if stream is not None:
+        age = stream.get("last_publish_age_s")
+        published = f"published {num(stream['publishes_total'])}"
+        if age is not None:
+            published += f" (age {num(round(age, 1), 's')})"
+        drain_rate = summary.get("stream_drain_rate")
+        drains = f"drains {num(stream['drains_total'])}"
+        if drain_rate is not None:
+            drains = f"drains {num(drain_rate, '/s')} ({num(stream['drains_total'])})"
+        lines.append(
+            f"  stream     lag {num(stream['lag_events'])} ev / "
+            f"{num(round(stream['lag_seconds'], 1), 's')}   {drains}   "
+            f"{published}   drift-suppressed {num(stream['drift_suppressed'])}"
+        )
     if summary.get("events_ingested"):
         lines.append(f"  ingested   {num(summary['events_ingested']):>12}")
     return "\n".join(lines)

@@ -7,7 +7,12 @@
 # The rollout-under-chaos stage (tests/test_registry.py) fault-injects the
 # canary candidate lane and asserts the candidate breaker trips, the
 # router auto-rolls back to stable, and stable traffic never errors.
-# See docs/resilience.md, docs/observability.md, docs/model_registry.md.
+# The tail-under-chaos stage (tests/test_stream.py) kills the speed-layer
+# pipeline mid-drain under fault injection, restarts it, and asserts the
+# cursor resumes with no skipped events and no duplicate registry publish
+# (plus the full e2e: ingest -> stream -> candidate -> bake -> promote).
+# See docs/resilience.md, docs/observability.md, docs/model_registry.md,
+# docs/streaming.md.
 # Usage: scripts/run_chaos.sh [extra pytest args...]
 set -euo pipefail
 
@@ -15,5 +20,6 @@ repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$repo_root"
 
 exec env JAX_PLATFORMS=cpu python -m pytest \
-  tests/test_resilience.py tests/test_obs.py tests/test_registry.py -q \
+  tests/test_resilience.py tests/test_obs.py tests/test_registry.py \
+  tests/test_stream.py -q \
   -p no:cacheprovider "$@"

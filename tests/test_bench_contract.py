@@ -122,9 +122,10 @@ def test_fully_crashed_run_is_rc1(monkeypatch, capsys):
 
 def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     """A permanently dead device (hung TPU tunnel, observed mid-round-4)
-    must degrade the run in minutes, not burn 2x timeout in every device
-    phase: the probe is retried between phases (never the phases
-    themselves), device phases are skipped with explicit errors, the CPU
+    must degrade the run in minutes, not burn a probe timeout per device
+    phase (round 5: five consecutive 90s preflight timeouts, ~8 min
+    wasted): the verdict is probed ONCE and cached, with exactly one late
+    retry. Device phases are skipped with explicit errors, the CPU
     loopback serving numbers still ship, and rc is nonzero."""
     calls = []
 
@@ -148,16 +149,50 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     rc = bench.main()
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     # only probes, the CPU phase, and the CPU-fallback secondary ever run:
-    # never a device phase itself
+    # never a device phase itself, and never a per-phase re-probe
     names = [c[0] for c in calls]
     assert [n for n in names if n != "probe"] == ["serving_local", "secondary"]
-    assert names.count("probe") == 6  # initial + als/serving/twotower/secondary + late
+    assert names.count("probe") == 2  # initial + the single late retry
+    assert out["preflight_attempts"] == 2
     assert rc == 1  # headline phases never ran -> degraded
     assert out["preflight_error"]
     assert out["als_error"] == "skipped: device preflight failed"
     assert out["serving_local_e2e_p50_ms"] == 6.0
     assert out["cooccurrence_build_ms"] == 150.0
     assert out["secondary_platform"] == "cpu_fallback"
+
+
+def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
+    """--cpu-only must never probe or late-retry: device phases skip with
+    an explicit marker, secondary runs on the CPU backend, and the JSON
+    records zero preflight attempts."""
+    calls = []
+
+    def fake_run(name, timeout_s, retries=1, env=None):
+        calls.append(name)
+        assert name != "probe", "--cpu-only must never probe"
+        if name == "serving_local":
+            return {"serving_local_e2e_p50_ms": 6.0}, None
+        if name == "secondary":
+            assert env == {"JAX_PLATFORMS": "cpu"}
+            return {"naive_bayes_train_ms": 50.0}, None
+        raise AssertionError(f"device phase {name} must not run")
+
+    monkeypatch.setattr(bench, "_run_phase", fake_run)
+    monkeypatch.setattr("sys.argv", ["bench.py", "--cpu-only"])
+    monkeypatch.setattr(
+        bench.time, "sleep",
+        lambda s: (_ for _ in ()).throw(AssertionError(f"slept {s}s")),
+    )
+    rc = bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0  # a requested CPU-only run that shipped numbers is healthy
+    assert calls == ["serving_local", "secondary"]
+    assert out["preflight_attempts"] == 0
+    assert out["bench_cpu_only"] is True
+    assert out["als_error"] == "skipped: --cpu-only"
+    assert "preflight_error" not in out  # requested degradation, not a fault
+    assert out["serving_local_e2e_p50_ms"] == 6.0
 
 
 def test_failed_serving_retry_keeps_random_label(monkeypatch, capsys):
@@ -168,9 +203,8 @@ def test_failed_serving_retry_keeps_random_label(monkeypatch, capsys):
     serving_retry_error."""
     probe_outcomes = iter(
         [
-            ({}, "phase timed out after 90s"),  # initial: dead
-            ({}, "phase timed out after 90s"),  # before als: dead
-            ({"probe_platform": "tpu"}, None),  # before serving: back
+            ({}, "phase timed out after 90s"),  # initial: dead (cached)
+            ({"probe_platform": "tpu"}, None),  # late retry: back
         ]
     )
     calls = []
@@ -180,8 +214,10 @@ def test_failed_serving_retry_keeps_random_label(monkeypatch, capsys):
         if name == "probe":
             return next(probe_outcomes, ({"probe_platform": "tpu"}, None))
         if name == "serving":
-            if "als" in calls:  # the retry: partial checkpoint + crash
+            if calls.count("serving") > 1:  # the retry: partial + crash
                 return {"serving_factors": "als"}, "tunnel died again"
+            # first (late-retry) run raced the factor handoff: measured
+            # over random factors even though als completed
             return (
                 {"serving_e2e_p50_ms": 5.0, "serving_factors": "random_fallback"},
                 None,
@@ -267,16 +303,16 @@ def test_colocated_estimate_absent_without_device_half(monkeypatch, capsys):
 
 def test_dead_then_alive_device_recovers_the_capture(monkeypatch, capsys):
     """Fault injection for the round-4 failure mode: the tunnel is dead at
-    bench start but comes back mid-run. The orchestrator's between-phase /
-    late preflight retries must capture the skipped device phases instead
-    of shipping a zeroed round (round 4 lost every device number to one
-    up-front probe timeout)."""
+    bench start but comes back before the end of the run. The single late
+    preflight retry must capture every skipped device phase instead of
+    shipping a zeroed round (round 4 lost every device number to one
+    up-front probe timeout) — without any per-phase re-probing (round 5's
+    8-minute probe-timeout burn)."""
     calls = []
     probe_outcomes = iter(
         [
             ({}, "phase timed out after 90s"),  # initial preflight: dead
-            ({}, "phase timed out after 90s"),  # retry before als: dead
-            ({"probe_platform": "tpu"}, None),  # retry before serving: back!
+            ({"probe_platform": "tpu"}, None),  # late retry: back!
         ]
     )
 
@@ -285,8 +321,8 @@ def test_dead_then_alive_device_recovers_the_capture(monkeypatch, capsys):
         if name == "probe":
             return next(probe_outcomes, ({"probe_platform": "tpu"}, None))
         if name == "serving":
-            # first run happens while als is still skipped -> random
-            # factors; the post-recovery re-run must see the real ones
+            # the late retry runs the skipped phases in PHASES order, so
+            # serving re-runs after als and sees the real factors
             factors = "als" if "als" in calls else "random_fallback"
             return (
                 {"serving_e2e_p50_ms": 5.0, "serving_factors": factors},
@@ -310,21 +346,19 @@ def test_dead_then_alive_device_recovers_the_capture(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "_run_phase", fake_run)
     monkeypatch.setattr("sys.argv", ["bench.py"])
-    # deliberately NOT setting PIO_BENCH_LATE_RETRY_DELAY_S: the device
-    # recovered mid-run (device_ok True at loop exit), so the late retry
-    # must skip the delay entirely (code-review r5) — a sleep here would
-    # hang this test for 600s
-    monkeypatch.delenv("PIO_BENCH_LATE_RETRY_DELAY_S", raising=False)
+    monkeypatch.setenv("PIO_BENCH_LATE_RETRY_DELAY_S", "0")
     monkeypatch.setattr(
         bench.time, "sleep",
         lambda s: (_ for _ in ()).throw(AssertionError(f"slept {s}s")),
     )
     rc = bench.main()
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    # als was skipped while dead, then captured by the late retry — and
-    # serving, which first measured over random factors, was re-run after
-    # the recovery so its latency pairs with real quality
-    assert calls[-2:] == ["als", "serving"]
+    names = [n for n in calls]
+    assert names.count("probe") == 2  # initial + late retry, nothing per-phase
+    assert out["preflight_attempts"] == 2
+    # als was skipped while dead, then captured by the late retry; serving
+    # re-ran after it so its latency pairs with real quality
+    assert "als" in calls and calls.index("als") > calls.index("serving_local")
     assert out["serving_factors"] == "als"
     assert out["value"] == 10.2  # the headline survived the outage
     assert "als_error" not in out

@@ -448,6 +448,76 @@ class TestStorageContractRule:
 
 
 # ---------------------------------------------------------------------------
+# family: stream path (speed layer)
+# ---------------------------------------------------------------------------
+
+
+class TestStreamRules:
+    def test_unbounded_find_after_fires(self):
+        active, _ = lint_snippet(
+            """
+            def drain(levents, app):
+                return levents.find_after(app, cursor=None)
+            """,
+            display_path="pkg/stream/tailer.py",
+        )
+        assert rule_ids(active) == ["stream-unbounded-drain"]
+
+    def test_unbounded_dao_find_fires(self):
+        active, _ = lint_snippet(
+            """
+            def catch_up(levents):
+                return list(levents.find(app_id=1, event_names=["rate"]))
+            """,
+            display_path="pkg/stream/pipeline.py",
+        )
+        assert rule_ids(active) == ["stream-unbounded-drain"]
+
+    def test_bounded_reads_quiet(self):
+        active, _ = lint_snippet(
+            """
+            def drain(levents, app, cursor):
+                a = levents.find_after(app, cursor=cursor, limit=100)
+                b = levents.find(app_id=app, limit=50)
+                return a, b
+            """,
+            display_path="pkg/stream/tailer.py",
+        )
+        assert active == []
+
+    def test_str_find_and_off_path_reads_quiet(self):
+        # str.find is not an event-store read; and the same unbounded DAO
+        # read OUTSIDE the stream path is another rule's problem
+        active, _ = lint_snippet(
+            """
+            def misc(levents, name):
+                idx = name.find(":")
+                return idx
+            """,
+            display_path="pkg/stream/util.py",
+        )
+        assert active == []
+        active, _ = lint_snippet(
+            """
+            def batch_read(levents):
+                return list(levents.find(app_id=1))
+            """,
+            display_path="pkg/workflow/train.py",
+        )
+        assert active == []
+
+    def test_limit_none_is_still_unbounded(self):
+        active, _ = lint_snippet(
+            """
+            def drain(levents, app):
+                return levents.find_after(app, cursor=None, limit=None)
+            """,
+            display_path="pkg/stream/tailer.py",
+        )
+        assert rule_ids(active) == ["stream-unbounded-drain"]
+
+
+# ---------------------------------------------------------------------------
 # engine mechanics: suppression, severity, parse errors
 # ---------------------------------------------------------------------------
 

@@ -702,6 +702,42 @@ class TestPioTop:
         assert "dispatch=open" in screen
         assert "recompiles" in screen
 
+    def test_stream_line_absent_without_stream_metrics(self):
+        s = summarize(parse_prometheus(_fake_metrics_text()))
+        assert s["stream"] is None
+        assert "stream" not in render(s, "http://x")
+
+    def test_stream_line_parsed_and_rendered(self):
+        text = "\n".join(
+            [
+                "pio_stream_lag_events 42",
+                "pio_stream_lag_seconds 3.5",
+                "pio_stream_drains_total 120",
+                "pio_stream_events_total 6000",
+                "pio_stream_publishes_total 4",
+                "pio_stream_drift_suppressed_total 1",
+                "pio_stream_last_publish_timestamp 990",
+            ]
+        )
+        s = summarize(parse_prometheus(text), now=1000.0)
+        assert s["stream"]["lag_events"] == 42
+        assert s["stream"]["lag_seconds"] == pytest.approx(3.5)
+        assert s["stream"]["publishes_total"] == 4
+        assert s["stream"]["drift_suppressed"] == 1
+        assert s["stream"]["last_publish_age_s"] == pytest.approx(10.0)
+        screen = render(s, "http://x")
+        assert "stream" in screen
+        assert "lag 42 ev / 3.5s" in screen
+        assert "published 4 (age 10s)" in screen
+        assert "drift-suppressed 1" in screen
+
+    def test_stream_drain_rate_from_two_samples(self):
+        prev = parse_prometheus("pio_stream_drains_total 100")
+        cur = parse_prometheus("pio_stream_drains_total 110")
+        s = summarize(cur, prev=prev, interval_s=5.0)
+        assert s["stream_drain_rate"] == pytest.approx(2.0)
+        assert "drains 2/s (110)" in render(s, "http://x")
+
     def test_run_top_loop_with_injected_fetch(self):
         screens: list[str] = []
         fetches = []

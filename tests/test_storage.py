@@ -313,6 +313,93 @@ class TestLEvents:
 
 
 # ---------------------------------------------------------------------------
+# find_after: the (creation_time, id) tail-read ordering contract
+# ---------------------------------------------------------------------------
+
+
+def _cev(eid: str, *, n: int = 0, ct: dt.datetime):
+    """Event with a controlled creation_time + event id (the tiebreak)."""
+    return Event(
+        event="rate",
+        entity_type="user",
+        entity_id="u1",
+        event_time=t(n),
+        event_id=eid,
+        creation_time=ct,
+    )
+
+
+class TestFindAfter:
+    """Every backend must honor base.event_seq_key's total order: creation
+    time micros, event id as the tiebreak — a resumed tail never skips or
+    double-reads an event that landed with an equal timestamp."""
+
+    def test_equal_timestamp_paging_never_skips_or_dupes(self, client):
+        from predictionio_tpu.data.storage.base import event_seq_key
+
+        l = client.l_events()
+        l.init(APP)
+        tie = t(5)
+        # inserted in shuffled order; ids decide the order within the tie
+        for eid, n in (("cb", 1), ("ca", 2), ("cd", 3), ("cc", 4)):
+            l.insert(_cev(eid, n=n, ct=tie), APP)
+        l.insert(_cev("za", n=9, ct=t(7)), APP)  # strictly later row
+        seen: list[str] = []
+        cursor = None
+        while True:
+            batch = l.find_after(APP, cursor=cursor, limit=1)
+            if not batch:
+                break
+            assert len(batch) == 1
+            seen.append(batch[0].event_id)
+            cursor = event_seq_key(batch[0])
+        assert seen == ["ca", "cb", "cc", "cd", "za"]
+
+    def test_cursor_is_exclusive_and_limit_bounds(self, client):
+        from predictionio_tpu.data.storage.base import event_seq_key
+
+        l = client.l_events()
+        l.init(APP)
+        tie = t(3)
+        for eid in ("aa", "ab", "ac"):
+            l.insert(_cev(eid, ct=tie), APP)
+        first = l.find_after(APP, cursor=None, limit=2)
+        assert [e.event_id for e in first] == ["aa", "ab"]
+        rest = l.find_after(APP, cursor=event_seq_key(first[-1]), limit=50)
+        assert [e.event_id for e in rest] == ["ac"]
+        # an event landing LATER with the same creation timestamp but a
+        # higher id is still picked up by the same cursor
+        l.insert(_cev("zz", ct=tie), APP)
+        more = l.find_after(APP, cursor=event_seq_key(rest[-1]), limit=50)
+        assert [e.event_id for e in more] == ["zz"]
+        assert l.find_after(APP, cursor=event_seq_key(more[-1]), limit=50) == []
+
+    def test_negative_limit_rejected_on_every_backend(self, client):
+        """find's 'negative = no cap' convention must NOT leak into the
+        tail read: it would mean 'everything' on scan backends and
+        LIMIT 0 (nothing, forever) on SQL — so it is an error everywhere."""
+        l = client.l_events()
+        l.init(APP)
+        l.insert(_cev("aa", ct=t(1)), APP)
+        with pytest.raises(ValueError):
+            l.find_after(APP, cursor=None, limit=-1)
+
+    def test_seq_head_matches_tail_order(self, client):
+        from predictionio_tpu.data.storage.base import event_seq_key
+
+        l = client.l_events()
+        l.init(APP)
+        assert l.seq_head(APP) is None
+        tie = t(4)
+        for eid in ("ba", "bz", "bm"):
+            l.insert(_cev(eid, ct=tie), APP)
+        # head = max (creation, id): the id tiebreak decides within the tie
+        head = l.seq_head(APP)
+        assert head == (event_seq_key(_cev("bz", ct=tie))[0], "bz")
+        assert l.find_after(APP, cursor=head, limit=10) == []
+
+
+# ---------------------------------------------------------------------------
 # PEvents contract + columnar export
 # ---------------------------------------------------------------------------
 
