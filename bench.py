@@ -46,6 +46,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import subprocess
 import sys
 import tempfile
@@ -914,8 +915,10 @@ asyncio.run(main())
 
 def _registry_serving_summary(server) -> dict[str, float]:
     """Server-side observability snapshot for the bench evidence chain:
-    request-latency percentiles from the obs registry histogram plus the
-    serving-time jit recompile count (0 on a healthy pow2-bucketed run)."""
+    request-latency percentiles from the obs registry histogram, the full
+    per-phase waterfall (ingress parse .. respond — the attribution the
+    transport-gap work lands against), and the serving-time jit recompile
+    count (0 on a healthy pow2-bucketed run)."""
     try:
         summary = server._m_latency.summary(endpoint="/queries.json")
         server.compile_watcher.sample()  # fold in compiles since last scrape
@@ -927,6 +930,14 @@ def _registry_serving_summary(server) -> dict[str, float]:
         for q in ("p50", "p95", "p99"):
             if q in summary:
                 out[f"serving_metrics_{q}_ms"] = round(summary[q] * 1000.0, 3)
+        # the phase waterfall: per-phase p50/p95/mean in ms, flat keys so
+        # --compare diffs them field by field like any other percentile
+        for phase, info in server.waterfall.snapshot().items():
+            for stat in ("p50", "p95", "mean"):
+                if stat in info:
+                    out[f"serving_phase_{phase}_{stat}_ms"] = round(
+                        info[stat] * 1000.0, 3
+                    )
         return out
     except Exception as exc:  # noqa: BLE001 - obs must never sink the bench
         return {"serving_metrics_error": str(exc)}
@@ -1478,6 +1489,140 @@ def _bench_cooccurrence(n_users: int = 6040, n_items: int = 3700, nnz: int = 1_0
     return best
 
 
+# ---------------------------------------------------------------------------
+# Perf-regression gate: --compare (ROADMAP item 5 — the trajectory is gated,
+# not asserted: every later scaling PR lands with its perf delta recorded)
+# ---------------------------------------------------------------------------
+
+# fields where smaller is better (latencies, wall-clocks); "value" is the
+# headline train wall-clock after main() pops als_train_wall_s into it
+_COMPARE_LOWER_IS_BETTER = frozenset(
+    {
+        "value",
+        "serving_e2e_p50_ms",
+        "serving_e2e_p95_ms",
+        "serving_local_e2e_p50_ms",
+        "serving_local_e2e_p95_ms",
+        "serving_metrics_p50_ms",
+        "serving_metrics_p95_ms",
+        "serving_metrics_p99_ms",
+        "serving_local_metrics_p50_ms",
+        "serving_local_metrics_p95_ms",
+        "serving_local_metrics_p99_ms",
+        "serving_device_p50_ms",
+        "serving_seq_p50_ms",
+        "serving_colocated_p50_est_ms",
+        "als_device_s_per_iter",
+        "ecommerce_p50_ms",
+        "naive_bayes_train_ms",
+        "cooccurrence_build_ms",
+        "event_ingest_batch_p50_ms",
+    }
+)
+# the per-phase waterfall percentiles ride the same gate, whatever phases
+# the run exported
+_COMPARE_LOWER_RE = re.compile(
+    r"^serving(_local)?_phase_[a-z_]+_(p50|p95|mean)_ms$"
+)
+_COMPARE_HIGHER_IS_BETTER = frozenset(
+    {
+        "serving_e2e_qps",
+        "serving_local_e2e_qps",
+        "serving_batched_qps",
+        "serving_seq_qps",
+        "twotower_examples_per_s",
+        "event_ingest_eps",
+    }
+)
+
+
+def _compare_direction(field: str) -> int:
+    """+1 = higher is worse (latency), -1 = lower is worse (throughput),
+    0 = not a gated field."""
+    if field in _COMPARE_LOWER_IS_BETTER or _COMPARE_LOWER_RE.match(field):
+        return 1
+    if field in _COMPARE_HIGHER_IS_BETTER:
+        return -1
+    return 0
+
+
+def compare_bench(
+    current: dict,
+    priors: list[dict],
+    tolerance: float = 0.25,
+    min_abs_ms: float = 0.5,
+) -> dict:
+    """Diff the gated percentile/throughput fields of ``current`` against
+    the BEST value any prior round achieved (min for latencies, max for
+    throughputs). A field regresses when it is worse than best-prior by
+    more than ``tolerance`` (relative) AND, for millisecond fields, by
+    more than ``min_abs_ms`` absolute — sub-millisecond phases jitter by
+    large ratios on shared CI hosts and must not trip the gate on noise.
+
+    Returns the flat ``compare_*`` verdict fields recorded into the bench
+    JSON; ``compare_ok`` is the gate."""
+    regressions: list[dict] = []
+    improvements = 0
+    compared = 0
+    for field, cur in sorted(current.items()):
+        direction = _compare_direction(field)
+        if direction == 0 or not isinstance(cur, (int, float)) or cur is None:
+            continue
+        prior_vals = [
+            p[field]
+            for p in priors
+            if isinstance(p.get(field), (int, float))
+        ]
+        if not prior_vals:
+            continue
+        best = min(prior_vals) if direction > 0 else max(prior_vals)
+        compared += 1
+        if best <= 0:
+            continue  # degenerate prior; a ratio against it is meaningless
+        ratio = cur / best
+        if direction > 0:
+            regressed = ratio > 1.0 + tolerance and (
+                not field.endswith("_ms") or (cur - best) > min_abs_ms
+            )
+            improved = ratio < 1.0
+        else:
+            regressed = ratio < 1.0 - tolerance
+            improved = ratio > 1.0
+        if regressed:
+            regressions.append(
+                {
+                    "field": field,
+                    "current": cur,
+                    "best_prior": best,
+                    "ratio": round(ratio, 4),
+                }
+            )
+        elif improved:
+            improvements += 1
+    return {
+        "compare_ok": not regressions,
+        "compare_tolerance": tolerance,
+        "compare_fields": compared,
+        "compare_improvements": improvements,
+        "compare_regressions": regressions,
+    }
+
+
+def _load_bench_json(path: str) -> dict:
+    """A bench evidence file: either a bare JSON object or the last JSON
+    line of a captured bench stdout."""
+    with open(path) as fh:
+        text = fh.read().strip()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        for line in reversed(text.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        raise
+
+
 def phase_probe(ck: _Checkpoint) -> None:
     """Device preflight: one trivial jitted dispatch + value readback.
     Exits 0 iff the default backend actually executes and returns data —
@@ -1568,7 +1713,49 @@ def main() -> int:
         "skipped (secondary runs on the CPU backend) and no probe or "
         "late retry ever runs",
     )
+    parser.add_argument(
+        "--compare",
+        nargs="+",
+        metavar="PRIOR_JSON",
+        help="perf-regression gate: diff this run's e2e/phase percentiles "
+        "against the best value across the given prior BENCH_r*.json "
+        "round(s); exits nonzero on regression beyond the tolerance, with "
+        "the verdict recorded in the JSON line",
+    )
+    parser.add_argument(
+        "--current",
+        metavar="CURRENT_JSON",
+        help="with --compare: run no phases, just gate an existing bench "
+        "JSON against the prior(s) (CI fixture mode)",
+    )
+    parser.add_argument(
+        "--compare-tolerance",
+        type=float,
+        default=0.25,
+        help="relative regression tolerance for --compare (default 0.25)",
+    )
     args = parser.parse_args()
+
+    if args.current and not args.compare:
+        parser.error("--current requires --compare")
+    if args.compare and args.current:
+        # pure compare mode: no phases, no jax — gate file against file(s)
+        current = _load_bench_json(args.current)
+        priors = [_load_bench_json(p) for p in args.compare]
+        verdict = compare_bench(
+            current, priors, tolerance=args.compare_tolerance
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "bench_compare",
+                    "compare_current": args.current,
+                    "compare_baselines": list(args.compare),
+                    **verdict,
+                }
+            )
+        )
+        return 0 if verdict["compare_ok"] else 1
 
     if args.phase:  # child mode
         out = args.out or os.path.join(
@@ -1713,6 +1900,22 @@ def main() -> int:
         **errors,
         "bench_host_cores": os.cpu_count(),
     }
+    compare_ok = True
+    if args.compare:
+        # the perf-regression gate: this run vs the best prior round(s);
+        # the verdict rides in the evidence line itself
+        try:
+            priors = [_load_bench_json(p) for p in args.compare]
+            verdict = compare_bench(
+                result, priors, tolerance=args.compare_tolerance
+            )
+        except (OSError, json.JSONDecodeError) as exc:
+            verdict = {
+                "compare_ok": False,
+                "compare_error": f"unreadable prior: {exc}",
+            }
+        result.update(compare_baselines=list(args.compare), **verdict)
+        compare_ok = bool(verdict["compare_ok"])
     print(json.dumps(result))
     # Exit code: 0 = shipped numbers AND every quality gate that ran passed.
     # The gates are load-bearing (9ec18f4): a wall-clock headline with junk
@@ -1752,7 +1955,11 @@ def main() -> int:
     # (loopback-only) JSON above still ships for forensics, but automation
     # must see the run as degraded
     preflight_ok = "preflight_error" not in errors
-    return 0 if (shipped and gates_ok and pairs_ok and preflight_ok) else 1
+    return (
+        0
+        if (shipped and gates_ok and pairs_ok and preflight_ok and compare_ok)
+        else 1
+    )
 
 
 if __name__ == "__main__":

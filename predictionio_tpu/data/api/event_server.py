@@ -52,9 +52,11 @@ from predictionio_tpu.obs.tracing import (
     reset_trace_id,
     set_trace_id,
 )
+from predictionio_tpu.obs.slo import SLOEngine, counter_ratio_source
 from predictionio_tpu.obs.web import (
     BreakerInstruments,
     metrics_response,
+    slo_response,
     traces_response,
 )
 from predictionio_tpu.resilience import (
@@ -75,6 +77,20 @@ from predictionio_tpu.data.webhooks import (
 logger = logging.getLogger(__name__)
 
 MAX_EVENTS_PER_BATCH_REQUEST = 50  # ref EventServer.scala:70
+
+# canonical routes that ARE the collection API — the availability SLO
+# rates these and only these. Health checks, scrapes, and trace reads go
+# through the same counting middleware; folding them into the
+# denominator would let monitoring traffic mask a 100% ingestion outage.
+COLLECTION_ENDPOINTS = frozenset(
+    {
+        "/events.json",
+        "/events/{event_id}.json",
+        "/batch/events.json",
+        "/webhooks/{name}.json",
+        "/webhooks/{name}",
+    }
+)
 
 
 @dataclasses.dataclass
@@ -97,6 +113,10 @@ class EventServerConfig:
     # of burying a struggling backend under more timed-out work
     breaker_threshold: int = 5
     breaker_recovery_s: float = 5.0
+    # ingestion availability SLO (docs/observability.md): non-5xx fraction
+    # of collection-API answers, evaluated as multi-window burn rates on
+    # /slo and the pio_slo_* gauges
+    slo_availability_objective: float = 0.999
 
     def ssl_context(self):
         from predictionio_tpu.utils.tls import server_ssl_context
@@ -185,6 +205,21 @@ class EventServer:
             ),
         )
         self.metrics.register_collector(self._breaker_instruments.collect)
+        # the ingestion availability objective, burning against the same
+        # request counter the envelope middleware maintains (one source of
+        # truth — see obs/slo.py)
+        self.slo = SLOEngine(self.metrics)
+        self.slo.add(
+            "availability",
+            "collection API answered without a 5xx",
+            self.config.slo_availability_objective,
+            counter_ratio_source(
+                self._m_requests,
+                bad=lambda l: l.get("status", "").startswith("5"),
+                match=lambda l: l.get("endpoint") in COLLECTION_ENDPOINTS,
+            ),
+        )
+        self.metrics.register_collector(self.slo.collect)
 
     @staticmethod
     def _route_label(request: web.Request) -> str:
@@ -461,8 +496,13 @@ class EventServer:
         """Prometheus text exposition of the full registry (request
         latency/status, ingestion counters, retry/breaker state). Unlike
         ``/stats.json`` this is unauthenticated by convention — scrapers
-        don't carry app access keys — and always on."""
-        return metrics_response(self.metrics)
+        don't carry app access keys — and always on. OpenMetrics
+        negotiation (Accept header or ``?exemplars=1``) adds per-bucket
+        trace-id exemplars."""
+        return metrics_response(self.metrics, request)
+
+    async def handle_slo(self, request: web.Request) -> web.Response:
+        return slo_response(self.slo)
 
     async def handle_traces_recent(self, request: web.Request) -> web.Response:
         return traces_response(self.tracer, request)
@@ -597,6 +637,7 @@ class EventServer:
                 web.get("/", self.handle_root),
                 web.get("/healthz", self.handle_healthz),
                 web.get("/metrics", self.handle_metrics),
+                web.get("/slo", self.handle_slo),
                 web.get("/traces/recent", self.handle_traces_recent),
                 web.post("/events.json", self.handle_post_event),
                 web.get("/events.json", self.handle_get_events),

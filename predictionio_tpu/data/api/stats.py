@@ -110,6 +110,11 @@ class StatsCollector:
         with self._lock:
             self._roll(_dt.datetime.now(tz=UTC))
             self._status.inc(app_id=str(app_id), status=str(status_code))
+            # deliberate bounded cardinality: event shapes come from the
+            # app's schema (a handful of event names/entity types per app,
+            # not per-request ids) — the documented /metrics caveat in
+            # docs/observability.md
+            # pio-lint: disable=obs-label-cardinality -- event shapes bounded by app schema, documented caveat
             self._ete.inc(
                 app_id=str(app_id),
                 entity_type=event.entity_type,

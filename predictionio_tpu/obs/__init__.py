@@ -13,10 +13,17 @@ be *operable* at fleet scale (see ``docs/observability.md``):
 - :mod:`predictionio_tpu.obs.jaxprof` — jit cache-miss accounting
   (recompile storms become a gauge + warning), XLA compile event taps,
   and ``block_until_ready`` stall accounting.
+- :mod:`predictionio_tpu.obs.waterfall` — per-request latency
+  attribution: every query accounted into an explicit phase waterfall
+  (``pio_phase_seconds{phase=…}``) with trace-id exemplars per bucket.
+- :mod:`predictionio_tpu.obs.slo` — declarative objectives (latency,
+  availability, shed rate) evaluated as multi-window burn rates from
+  registry counter snapshots; ``/slo`` + ``pio_slo_*`` gauges.
 
-``metrics`` and ``tracing`` are stdlib-only; ``jaxprof`` imports jax
-lazily — so the event server, ``pio top``, and the lint CLI can use this
-package without dragging in an accelerator runtime.
+``metrics``, ``tracing``, ``waterfall``, and ``slo`` are stdlib-only;
+``jaxprof`` imports jax lazily — so the event server, ``pio top``, and
+the lint CLI can use this package without dragging in an accelerator
+runtime.
 """
 
 from predictionio_tpu.obs.jaxprof import (
@@ -31,6 +38,13 @@ from predictionio_tpu.obs.metrics import (
     Histogram,
     MetricsRegistry,
 )
+from predictionio_tpu.obs.slo import (
+    SLOEngine,
+    counter_ratio_source,
+    histogram_threshold_source,
+    paired_counter_source,
+)
+from predictionio_tpu.obs.waterfall import PHASES, PhaseWaterfall, phase_tags_ms
 from predictionio_tpu.obs.tracing import (
     TRACE_HEADER,
     Span,
@@ -45,14 +59,21 @@ from predictionio_tpu.obs.tracing import (
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "PHASES",
     "TRACE_HEADER",
     "CompileWatcher",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "PhaseWaterfall",
+    "SLOEngine",
     "Span",
     "Tracer",
+    "counter_ratio_source",
+    "histogram_threshold_source",
+    "paired_counter_source",
+    "phase_tags_ms",
     "current_trace_id",
     "get_trace_logger",
     "get_tracer",

@@ -111,7 +111,7 @@ class _Metric:
         with self._lock:
             return sorted(self._series.items())
 
-    def render(self) -> list[str]:
+    def render(self, exemplars: bool = False) -> list[str]:
         raise NotImplementedError
 
 
@@ -151,7 +151,7 @@ class Counter(_Metric):
         with self._lock:
             return sum(self._series.values())
 
-    def render(self) -> list[str]:
+    def render(self, exemplars: bool = False) -> list[str]:
         return [
             f"{self.name}{_format_labels(self.labelnames, k)} {format_value(v)}"
             for k, v in self.collect()
@@ -194,7 +194,7 @@ class Gauge(_Metric):
             v = self._series.get(key, 0.0)
         return float(v() if callable(v) else v)
 
-    def render(self) -> list[str]:
+    def render(self, exemplars: bool = False) -> list[str]:
         out = []
         for k, v in self.collect():
             if callable(v):
@@ -209,13 +209,17 @@ class Gauge(_Metric):
 
 
 class _HistogramSeries:
-    __slots__ = ("counts", "count", "sum", "max")
+    __slots__ = ("counts", "count", "sum", "max", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * (n_buckets + 1)  # +1 for the +Inf bucket
         self.count = 0
         self.sum = 0.0
         self.max = 0.0
+        # bucket index -> (exemplar_id, observed_value): the most recent
+        # trace id observed into each bucket, so a tail bucket links to a
+        # concrete trace in /traces/recent (OpenMetrics exemplars)
+        self.exemplars: dict[int, tuple[str, float]] = {}
 
 
 class Histogram(_Metric):
@@ -242,7 +246,13 @@ class Histogram(_Metric):
             raise ValueError("histogram needs at least one bucket bound")
         self.buckets = bs
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(
+        self, value: float, exemplar: str | None = None, **labels: str
+    ) -> None:
+        """Record one observation. ``exemplar`` (typically the request's
+        trace id) is kept per bucket — last writer wins — and rendered in
+        the OpenMetrics exposition so a p99 outlier links to a concrete
+        trace instead of being an anonymous count."""
         import bisect
 
         value = float(value)
@@ -257,6 +267,8 @@ class Histogram(_Metric):
             series.sum += value
             if value > series.max:
                 series.max = value
+            if exemplar:
+                series.exemplars[i] = (str(exemplar), value)
 
     def _snapshot_series(self, key: tuple[str, ...]) -> _HistogramSeries | None:
         with self._lock:
@@ -268,6 +280,7 @@ class Histogram(_Metric):
             copy.count = series.count
             copy.sum = series.sum
             copy.max = series.max
+            copy.exemplars = dict(series.exemplars)
             return copy
 
     def _percentile_of(self, series: _HistogramSeries, q: float) -> float:
@@ -312,6 +325,23 @@ class Histogram(_Metric):
         series.count = sum(counts)
         return self._percentile_of(series, q)
 
+    def exemplars(self, **labels: str) -> dict[str, dict[str, Any]]:
+        """``{bucket_le: {"exemplar": id, "value": seconds}}`` snapshot of
+        the per-bucket exemplars (``le`` formatted like the exposition,
+        ``+Inf`` for the overflow bucket); empty when none captured."""
+        series = self._snapshot_series(self._key(labels))
+        if series is None:
+            return {}
+        out: dict[str, dict[str, Any]] = {}
+        for i, (ex, v) in sorted(series.exemplars.items()):
+            le = (
+                format_value(self.buckets[i])
+                if i < len(self.buckets)
+                else "+Inf"
+            )
+            out[le] = {"exemplar": ex, "value": v}
+        return out
+
     def summary(self, **labels: str) -> dict[str, float]:
         """One consistent snapshot -> count/mean/p50/p95/p99/sum (seconds)."""
         series = self._snapshot_series(self._key(labels))
@@ -327,24 +357,41 @@ class Histogram(_Metric):
             "max": series.max,
         }
 
-    def render(self) -> list[str]:
+    @staticmethod
+    def _exemplar_suffix(series: _HistogramSeries, i: int) -> str:
+        """OpenMetrics exemplar clause for bucket ``i`` (empty when none):
+        ``# {trace_id="…"} <value>`` appended after the bucket sample."""
+        entry = series.exemplars.get(i)
+        if entry is None:
+            return ""
+        ex, v = entry
+        return f' # {{trace_id="{_escape_label_value(ex)}"}} {format_value(v)}'
+
+    def render(self, exemplars: bool = False) -> list[str]:
         out = []
         for key, _ in self.collect():
             series = self._snapshot_series(key)
             if series is None:
                 continue
             acc = 0
-            for bound, c in zip(self.buckets, series.counts):
+            for i, (bound, c) in enumerate(zip(self.buckets, series.counts)):
                 acc += c
                 names = self.labelnames + ("le",)
                 values = key + (format_value(bound),)
+                suffix = self._exemplar_suffix(series, i) if exemplars else ""
                 out.append(
-                    f"{self.name}_bucket{_format_labels(names, values)} {acc}"
+                    f"{self.name}_bucket{_format_labels(names, values)} "
+                    f"{acc}{suffix}"
                 )
             names = self.labelnames + ("le",)
+            suffix = (
+                self._exemplar_suffix(series, len(self.buckets))
+                if exemplars
+                else ""
+            )
             out.append(
                 f"{self.name}_bucket{_format_labels(names, key + ('+Inf',))} "
-                f"{series.count}"
+                f"{series.count}{suffix}"
             )
             out.append(
                 f"{self.name}_sum{_format_labels(self.labelnames, key)} "
@@ -425,8 +472,12 @@ class MetricsRegistry:
             except Exception:
                 pass  # a broken collector must never fail the scrape
 
-    def render_prometheus(self) -> str:
-        """Prometheus text exposition format v0.0.4."""
+    def render_prometheus(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition format v0.0.4. With ``exemplars=True``
+        histogram bucket lines carry OpenMetrics exemplar clauses
+        (``… # {trace_id="…"} value``) and the output ends with ``# EOF``
+        — serve that variant only to scrapers that negotiated OpenMetrics
+        (a strict v0.0.4 parser rejects exemplar syntax)."""
         self._run_collectors()
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
@@ -436,7 +487,9 @@ class MetricsRegistry:
                 escaped = m.help.replace("\\", "\\\\").replace("\n", "\\n")
                 lines.append(f"# HELP {m.name} {escaped}")
             lines.append(f"# TYPE {m.name} {m.kind}")
-            lines.extend(m.render())
+            lines.extend(m.render(exemplars=exemplars))
+        if exemplars:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> dict[str, Any]:

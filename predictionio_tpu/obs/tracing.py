@@ -74,6 +74,12 @@ class Span:
     name: str
     kind: str = "internal"
     span_id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex[:8])
+    # timing hygiene contract: start_time is a WALL-CLOCK display anchor
+    # only (correlating spans with external logs); every duration in this
+    # module — span durations, phase timings, queue waits — is measured
+    # from time.perf_counter()/time.monotonic(), never as a wall-clock
+    # delta, so an NTP step can shift where a span *appears* on a timeline
+    # but can never corrupt how long anything *took*
     start_time: float = dataclasses.field(default_factory=time.time)
     duration_s: float = 0.0
     status: str = "ok"

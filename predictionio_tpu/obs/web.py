@@ -11,6 +11,7 @@ from __future__ import annotations
 from aiohttp import web
 
 from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.slo import SLOEngine
 from predictionio_tpu.obs.tracing import Tracer
 from predictionio_tpu.resilience import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 
@@ -18,6 +19,9 @@ from predictionio_tpu.resilience import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 BREAKER_STATE_VALUES = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 
 class BreakerInstruments:
@@ -63,13 +67,38 @@ class BreakerInstruments:
             )
 
 
-def metrics_response(registry: MetricsRegistry) -> web.Response:
+def _wants_exemplars(request: web.Request | None) -> bool:
+    """Exemplars ride only on negotiated scrapes: OpenMetrics in Accept
+    (what Prometheus sends when exemplar scraping is on) or an explicit
+    ``?exemplars=1``. The default stays strict v0.0.4 — a plain-text
+    parser rejects exemplar syntax, and breaking every stock scrape to
+    decorate buckets would be a bad trade."""
+    if request is None:
+        return False
+    if request.query.get("exemplars", "") not in ("", "0", "false"):
+        return True
+    return "openmetrics" in request.headers.get("Accept", "").lower()
+
+
+def metrics_response(
+    registry: MetricsRegistry, request: web.Request | None = None
+) -> web.Response:
     """Prometheus text exposition of the registry. Rendering snapshots
     under per-metric locks; cheap enough to run on the event loop."""
+    exemplars = _wants_exemplars(request)
     return web.Response(
-        text=registry.render_prometheus(),
-        headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+        text=registry.render_prometheus(exemplars=exemplars),
+        headers={
+            "Content-Type": (
+                OPENMETRICS_CONTENT_TYPE if exemplars else PROMETHEUS_CONTENT_TYPE
+            )
+        },
     )
+
+
+def slo_response(engine: SLOEngine) -> web.Response:
+    """The ``/slo`` JSON report: burn rates per objective and window."""
+    return web.json_response(engine.report())
 
 
 def traces_response(tracer: Tracer, request: web.Request) -> web.Response:

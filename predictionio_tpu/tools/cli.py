@@ -566,7 +566,7 @@ def cmd_dashboard(args) -> int:
 
 def cmd_top(args) -> int:
     """Live one-screen summary of a running server's /metrics (qps, p95,
-    shed rate, breaker states, recompile count)."""
+    waterfall, SLO burn, shed rate, breaker states, recompile count)."""
     from predictionio_tpu.tools.top import run_top
 
     iterations = 1 if args.once else args.iterations
@@ -575,6 +575,7 @@ def cmd_top(args) -> int:
         interval_s=args.interval,
         iterations=iterations,
         clear_screen=False if args.once else None,
+        json_mode=args.json,
     )
 
 
@@ -1375,6 +1376,12 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="print one snapshot and exit (rates need two samples and "
         "show as '-')",
+    )
+    x.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable output: one JSON snapshot per line instead "
+        "of the terminal screen (for CI and fleet tooling)",
     )
     x.set_defaults(fn=cmd_top)
 

@@ -22,6 +22,9 @@ import time
 import urllib.request
 from typing import Any, Callable
 
+# the request-ordered phase vocabulary — single source of truth in
+# obs.waterfall (stdlib-only, so it costs `pio top` nothing)
+from predictionio_tpu.obs.waterfall import PHASES as _PHASE_ORDER
 from predictionio_tpu.resilience import CLOSED, HALF_OPEN, OPEN
 
 # value of the pio_breaker_state gauge -> human name
@@ -56,10 +59,14 @@ def parse_prometheus(text: str) -> dict[str, list[tuple[dict[str, str], float]]]
     """Parse Prometheus text exposition into
     ``{metric_name: [(labels, value), ...]}``. Comment/HELP/TYPE lines are
     skipped; histogram series keep their ``_bucket``/``_sum``/``_count``
-    suffixes as distinct names."""
+    suffixes as distinct names. OpenMetrics exemplar clauses
+    (``… # {trace_id="…"} value``) are stripped — the sample value still
+    parses even when the scrape negotiated exemplars."""
     out: dict[str, list[tuple[dict[str, str], float]]] = {}
     for line in text.splitlines():
-        line = line.strip()
+        # exemplar separator is a literal " # " outside label quotes; none
+        # of the framework's label values contain one
+        line = line.split(" # ", 1)[0].strip()
         if not line or line.startswith("#"):
             continue
         m = _SAMPLE_RE.match(line)
@@ -87,12 +94,16 @@ def _total(metrics: Metrics, name: str, **match: str) -> float:
     )
 
 
-def _histogram_quantile(metrics: Metrics, name: str, q: float) -> float:
+def _histogram_quantile(
+    metrics: Metrics, name: str, q: float, **match: str
+) -> float:
     """Recompute a quantile from ``<name>_bucket{le=...}`` cumulative
-    counts, summed across label sets (linear interpolation in-bucket,
-    mirroring obs.metrics.Histogram)."""
+    counts, summed across label sets matching ``match`` (linear
+    interpolation in-bucket, mirroring obs.metrics.Histogram)."""
     buckets: dict[float, float] = {}
     for labels, v in metrics.get(f"{name}_bucket", ()):
+        if any(labels.get(k) != mv for k, mv in match.items()):
+            continue
         le = _parse_value(labels.get("le", "+Inf"))
         buckets[le] = buckets.get(le, 0.0) + v
     if not buckets:
@@ -157,6 +168,8 @@ def summarize(
         "rollbacks_total": _total(metrics, "pio_rollbacks_total"),
         "model_versions": _model_versions(metrics),
     }
+    out["phases"] = _phase_summary(metrics)
+    out["slo"] = _slo_summary(metrics)
     out["stream"] = _stream_summary(metrics, now)
     out["qps"] = None
     out["shed_rate"] = None
@@ -172,6 +185,58 @@ def summarize(
             )
             out["stream_drain_rate"] = max(0.0, d_drain) / interval_s
     return out
+
+
+
+
+def _phase_summary(metrics: Metrics) -> dict[str, dict[str, float]] | None:
+    """The latency-attribution waterfall, from ``pio_phase_seconds``:
+    per-phase p50/p95 (ms) and count, request-ordered. None when the
+    endpoint doesn't export the waterfall (e.g. an event server)."""
+    if "pio_phase_seconds_bucket" not in metrics:
+        return None
+    counts: dict[str, float] = {}
+    for labels, v in metrics.get("pio_phase_seconds_count", ()):
+        phase = labels.get("phase")
+        if phase:
+            counts[phase] = counts.get(phase, 0.0) + v
+    out: dict[str, dict[str, float]] = {}
+    for phase in _PHASE_ORDER:
+        if not counts.get(phase):
+            continue
+        out[phase] = {
+            "count": counts[phase],
+            "p50_ms": _histogram_quantile(
+                metrics, "pio_phase_seconds", 0.50, phase=phase
+            )
+            * 1e3,
+            "p95_ms": _histogram_quantile(
+                metrics, "pio_phase_seconds", 0.95, phase=phase
+            )
+            * 1e3,
+        }
+    return out or None
+
+
+def _slo_summary(metrics: Metrics) -> dict[str, dict[str, Any]] | None:
+    """The SLO burn-rate block, from the ``pio_slo_*`` gauges: per-SLO
+    objective, per-window burn rates, and the alerting bit."""
+    if "pio_slo_objective" not in metrics:
+        return None
+    out: dict[str, dict[str, Any]] = {}
+    for labels, v in metrics.get("pio_slo_objective", ()):
+        name = labels.get("slo")
+        if name:
+            out[name] = {"objective": v, "burn": {}, "alerting": False}
+    for labels, v in metrics.get("pio_slo_burn_rate", ()):
+        name, window = labels.get("slo"), labels.get("window")
+        if name in out and window:
+            out[name]["burn"][window] = v
+    for labels, v in metrics.get("pio_slo_alerting", ()):
+        name = labels.get("slo")
+        if name in out:
+            out[name]["alerting"] = bool(v)
+    return out or None
 
 
 def _stream_summary(metrics: Metrics, now: float | None) -> dict[str, Any] | None:
@@ -258,6 +323,29 @@ def render(summary: dict[str, Any], url: str) -> str:
         f"retries     {num(summary['retries_total']):>10}",
         f"  breakers   {breaker_line}",
     ]
+    phases = summary.get("phases") or {}
+    if phases:
+        # the waterfall line: request-ordered per-phase p50s plus their sum
+        # — the at-a-glance answer to "where do the milliseconds go"
+        parts = [
+            f"{phase.replace('_', ' ')} {info['p50_ms']:.2f}"
+            for phase, info in phases.items()
+        ]
+        total_p50 = sum(info["p50_ms"] for info in phases.values())
+        lines.append(
+            "  waterfall  " + " | ".join(parts) + f"   (p50 ms, Σ {total_p50:.2f})"
+        )
+    slos = summary.get("slo") or {}
+    if slos:
+        parts = []
+        for name, info in sorted(slos.items()):
+            burns = "/".join(
+                f"{info['burn'][w]:.2f}"
+                for w in sorted(info["burn"], key=float)
+            )
+            state = "ALERT" if info.get("alerting") else "ok"
+            parts.append(f"{name} burn {burns or '-'} {state}")
+        lines.append("  slo        " + "   ".join(parts))
     versions = summary.get("model_versions") or {}
     if versions:
         parts = [
@@ -307,12 +395,18 @@ def run_top(
     out: Callable[[str], None] = print,
     clear_screen: bool | None = None,
     sleep: Callable[[float], None] = time.sleep,
+    json_mode: bool = False,
 ) -> int:
     """Poll-and-render loop. ``iterations=None`` runs until interrupted;
-    fetch/out/sleep are injectable so tests drive it without a network."""
+    fetch/out/sleep are injectable so tests drive it without a network.
+    ``json_mode`` emits one machine-readable JSON object per snapshot
+    (one per line, no screen control codes) so CI and fleet tooling can
+    consume the same digest the terminal screen renders."""
+    import json as _json
+
     fetch = fetch or fetch_metrics
     if clear_screen is None:
-        clear_screen = sys.stdout.isatty()
+        clear_screen = sys.stdout.isatty() and not json_mode
     prev: Metrics | None = None
     prev_t: float | None = None
     n = 0
@@ -324,18 +418,24 @@ def run_top(
             try:
                 text = fetch(url)
             except Exception as exc:
-                out(f"pio top — {url}: unreachable ({exc})")
+                if json_mode:
+                    out(_json.dumps({"url": url, "error": str(exc)}))
+                else:
+                    out(f"pio top — {url}: unreachable ({exc})")
                 prev, prev_t = None, None
             else:
                 metrics = parse_prometheus(text)
                 now = time.monotonic()
                 dt = (now - prev_t) if prev_t is not None else None
                 summary = summarize(metrics, prev=prev, interval_s=dt)
-                screen = render(summary, url)
-                if clear_screen:
-                    out("\x1b[2J\x1b[H" + screen)
+                if json_mode:
+                    out(_json.dumps({"url": url, "time": time.time(), **summary}))
                 else:
-                    out(screen)
+                    screen = render(summary, url)
+                    if clear_screen:
+                        out("\x1b[2J\x1b[H" + screen)
+                    else:
+                        out(screen)
                 prev, prev_t = metrics, now
             n += 1
             if iterations is None or n < iterations:

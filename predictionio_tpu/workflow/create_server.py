@@ -35,7 +35,7 @@ import json
 import logging
 import threading
 import time
-from typing import Any, NamedTuple
+from typing import Any
 
 from aiohttp import web
 
@@ -53,9 +53,28 @@ from predictionio_tpu.obs.tracing import (
     reset_trace_id,
     set_trace_id,
 )
+from predictionio_tpu.obs.slo import (
+    SLOEngine,
+    counter_ratio_source,
+    histogram_threshold_source,
+    paired_counter_source,
+)
+from predictionio_tpu.obs.waterfall import (
+    PHASE_BATCH_ASSEMBLY,
+    PHASE_DEVICE_COMPUTE,
+    PHASE_DISPATCH,
+    PHASE_FETCH,
+    PHASE_INGRESS_PARSE,
+    PHASE_QUEUE_WAIT,
+    PHASE_RESPOND,
+    PHASE_SERVE,
+    PhaseWaterfall,
+    phase_tags_ms,
+)
 from predictionio_tpu.obs.web import (
     BreakerInstruments,
     metrics_response,
+    slo_response,
     traces_response,
 )
 from predictionio_tpu.registry.controller import (
@@ -186,6 +205,16 @@ class ServerConfig:
     # traffic drops shadow samples (counted) instead of growing the queue
     # without limit — shadow is sampling, not accounting
     shadow_max_backlog: int = 8
+    # -- SLOs (docs/observability.md): burn rates on /slo + pio_slo_* ------
+    # latency objective: this fraction of /queries.json answers must land
+    # at or under the threshold (default = the paper's <10ms p50 deploy
+    # target; keep the threshold on a histogram bucket bound)
+    slo_latency_threshold_s: float = 0.010
+    slo_latency_objective: float = 0.50
+    # availability objective: non-5xx fraction of /queries.json answers
+    slo_availability_objective: float = 0.999
+    # shed objective: fraction of arrivals NOT rejected by admission control
+    slo_shed_objective: float = 0.99
 
     def ssl_context(self):
         from predictionio_tpu.utils.tls import server_ssl_context
@@ -209,17 +238,22 @@ def _swallow_result(fut) -> None:
         fut.exception()
 
 
-class _QItem(NamedTuple):
+@dataclasses.dataclass
+class _QItem:
     """One queued query: its payload, the caller's future, the request
     deadline, the ingress trace id (the contextvar does NOT survive the
-    hop onto the dispatch thread — it rides here instead), and the
-    enqueue time (queue-wait accounting)."""
+    hop onto the dispatch thread — it rides here instead), the enqueue
+    time (queue-wait accounting), and the mutable ``phases`` channel the
+    handler shares with the batcher so per-request waterfall timestamps
+    (``t_collect``/``t_done``) flow back without changing ``submit``'s
+    return contract."""
 
     payload: Any
     fut: asyncio.Future
     deadline: Deadline
     trace_id: str | None
     t_submit: float
+    phases: dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 class _MicroBatcher:
@@ -275,11 +309,21 @@ class _MicroBatcher:
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
-    async def submit(self, payload: Any, deadline: Deadline | None = None) -> Any:
+    async def submit(
+        self,
+        payload: Any,
+        deadline: Deadline | None = None,
+        phases: dict[str, float] | None = None,
+        t_submit: float | None = None,
+    ) -> Any:
         """Enqueue one query payload; returns the encoded result body or
         raises the per-query error. Fails fast when the server is shutting
         down (never restarts the collect loop against shut-down pools) and
-        sheds with ``LoadShedError`` when the queue is over high water."""
+        sheds with ``LoadShedError`` when the queue is over high water.
+        ``phases`` (optional) is filled with waterfall timestamps
+        (``t_collect``, ``t_done``) as the query moves through the
+        pipeline; ``t_submit`` lets the caller anchor the queue-wait phase
+        at its own last measured boundary so adjacent phases tile."""
         if self._closed:
             raise ShuttingDownError()
         if self.high_water and self._queue.qsize() >= self.high_water:
@@ -294,7 +338,14 @@ class _MicroBatcher:
             deadline = Deadline.never()
         fut = asyncio.get_running_loop().create_future()
         self._queue.put_nowait(
-            _QItem(payload, fut, deadline, current_trace_id(), time.perf_counter())
+            _QItem(
+                payload,
+                fut,
+                deadline,
+                current_trace_id(),
+                t_submit if t_submit is not None else time.perf_counter(),
+                phases if phases is not None else {},
+            )
         )
         if self._task is None or self._task.done():
             self._task = asyncio.ensure_future(self._run())
@@ -368,8 +419,11 @@ class _MicroBatcher:
                     )
                 else:
                     live.append(item)
-                    self._server._m_queue_wait.observe(
-                        collect_t - item.t_submit
+                    queue_wait_s = collect_t - item.t_submit
+                    item.phases["t_collect"] = collect_t
+                    self._server._m_queue_wait.observe(queue_wait_s)
+                    self._server.waterfall.observe(
+                        PHASE_QUEUE_WAIT, queue_wait_s, item.trace_id
                     )
             if not live:
                 self._inflight.release()
@@ -413,6 +467,16 @@ class _MicroBatcher:
                 continue
             dispatch_s = time.perf_counter() - dispatch_t0
             self._server._m_dispatch.observe(dispatch_s)
+            # batch-scoped waterfall phases: every rider waits out the whole
+            # batch, so each query is accounted the batch's duration
+            assembly_s = max(0.0, dispatch_t0 - collect_t)
+            for item in batch:
+                self._server.waterfall.observe(
+                    PHASE_BATCH_ASSEMBLY, assembly_s, item.trace_id
+                )
+                self._server.waterfall.observe(
+                    PHASE_DISPATCH, dispatch_s, item.trace_id
+                )
             try:
                 finalize = exec_fut.result()
             except BaseException as exc:
@@ -427,7 +491,13 @@ class _MicroBatcher:
             # finish asynchronously: the collect loop immediately forms and
             # dispatches the next batch while this one's fetch is in flight
             task = asyncio.ensure_future(
-                self._finish(batch, finalize, batch_deadline, dispatch_s)
+                self._finish(
+                    batch,
+                    finalize,
+                    batch_deadline,
+                    dispatch_s,
+                    dispatch_t0 + dispatch_s,
+                )
             )
             self._finish_tasks.add(task)
             task.add_done_callback(self._finish_tasks.discard)
@@ -438,6 +508,7 @@ class _MicroBatcher:
         finalize,
         deadline: Deadline,
         dispatch_s: float = 0.0,
+        dispatch_end: float = 0.0,
     ) -> None:
         loop = asyncio.get_running_loop()
         fetch_t0 = time.perf_counter()
@@ -484,10 +555,28 @@ class _MicroBatcher:
         finally:
             self._inflight.release()
         done_t = time.perf_counter()
+        # waterfall decomposition of the dispatch-end -> results-distributed
+        # window: device compute and serve are measured inside finalize (it
+        # publishes them via its `timings` attribute); everything else in
+        # the window — executor hop, transport readback, result unpack — is
+        # the fetch residual
+        timings = getattr(finalize, "timings", None) or {}
+        device_s = max(0.0, timings.get("device_s", 0.0))
+        serve_s = max(0.0, timings.get("serve_s", 0.0))
+        window_s = (done_t - dispatch_end) if dispatch_end else fetch_s
+        fetch_resid_s = max(0.0, window_s - device_s - serve_s)
+        wf = self._server.waterfall
         for item, (out, version) in zip(batch, results):
-            # one `batch` span per query, carrying the wall/queue/device
-            # split AND the model version that answered — the hop between
-            # the ingress span and any storage spans the engine's serving
+            wf.observe(PHASE_DEVICE_COMPUTE, device_s, item.trace_id)
+            wf.observe(PHASE_FETCH, fetch_resid_s, item.trace_id)
+            wf.observe(PHASE_SERVE, serve_s, item.trace_id)
+            item.phases["t_done"] = done_t
+            queue_s = max(
+                0.0, item.phases.get("t_collect", item.t_submit) - item.t_submit
+            )
+            # one `batch` span per query, carrying the full phase waterfall
+            # AND the model version that answered — the hop between the
+            # ingress span and any storage spans the engine's serving
             # components recorded
             self._server.tracer.record_span(
                 "query.batch",
@@ -497,9 +586,14 @@ class _MicroBatcher:
                 status=type(out).__name__ if isinstance(out, BaseException) else "ok",
                 batch_size=len(batch),
                 version=version,
-                queue_ms=round((fetch_t0 - dispatch_s - item.t_submit) * 1000, 3),
+                queue_ms=round(queue_s * 1000, 3),
                 dispatch_ms=round(dispatch_s * 1000, 3),
                 fetch_ms=round(fetch_s * 1000, 3),
+                **phase_tags_ms(
+                    device_compute=device_s,
+                    serve=serve_s,
+                    fetch_residual=fetch_resid_s,
+                ),
             )
             if item.fut.done():  # client gone / cancelled
                 continue
@@ -655,11 +749,54 @@ class QueryServer:
             "requests shed at the door because the dispatch circuit was open",
         )
         self._breaker_instruments = BreakerInstruments(m)
+        # per-request latency attribution: every query accounted into the
+        # phase waterfall (pio_phase_seconds{phase=...}) with trace-id
+        # exemplars — see obs/waterfall.py for the phase boundaries
+        self.waterfall = PhaseWaterfall(m)
+        # declarative SLOs evaluated as multi-window burn rates from the
+        # instruments above (obs/slo.py): /slo + pio_slo_* gauges
+        self.slo = SLOEngine(m)
+        _queries = "/queries.json"
+        self.slo.add(
+            "latency",
+            f"{_queries} answered within "
+            f"{self.config.slo_latency_threshold_s * 1000:g} ms",
+            self.config.slo_latency_objective,
+            histogram_threshold_source(
+                self._m_latency,
+                self.config.slo_latency_threshold_s,
+                endpoint=_queries,
+            ),
+        )
+        self.slo.add(
+            "availability",
+            f"{_queries} answered without a 5xx",
+            self.config.slo_availability_objective,
+            counter_ratio_source(
+                self._m_requests,
+                bad=lambda l: l.get("status", "").startswith("5"),
+                match=lambda l: l.get("endpoint") == _queries,
+            ),
+        )
+        self.slo.add(
+            "shed",
+            f"{_queries} arrivals not rejected by admission control",
+            self.config.slo_shed_objective,
+            paired_counter_source(
+                counter_ratio_source(
+                    self._m_requests,
+                    bad=lambda l: False,
+                    match=lambda l: l.get("endpoint") == _queries,
+                ),
+                self._m_shed,
+            ),
+        )
         # jit cache misses / XLA compile events become first-class metrics;
         # sampled at scrape time via the registry collector hook
         self.compile_watcher = CompileWatcher(m)
         m.register_collector(self.compile_watcher.sample)
         m.register_collector(self._breaker_instruments.collect)
+        m.register_collector(self.slo.collect)
         self._runner: web.AppRunner | None = None
         self._stop_event = asyncio.Event()
         # strong refs to fire-and-forget tasks (the loop keeps only weak ones)
@@ -739,23 +876,43 @@ class QueryServer:
         token = set_trace_id(trace_id)
         status = 500
         t0 = time.perf_counter()
+        # per-request waterfall channel: the inner handler and the batcher
+        # fill it with phase timestamps; the ingress span carries the
+        # handler-side phases as tags
+        phases: dict[str, float] = {"t_start": t0}
         try:
             with self.tracer.span(
                 "http.query", kind="ingress", endpoint="/queries.json"
             ) as sp:
-                resp = await self._handle_queries_inner(request)
+                resp = await self._handle_queries_inner(request, phases)
                 status = resp.status
                 sp.tags["status"] = status
+                if phases.get("t_done") is not None:
+                    phases["respond_s"] = time.perf_counter() - phases["t_done"]
+                sp.tags.update(
+                    phase_tags_ms(
+                        ingress_parse=phases.get("parse_s"),
+                        respond=phases.get("respond_s"),
+                    )
+                )
         finally:
             reset_trace_id(token)
+            # ONE end timestamp anchors both the e2e histogram and the
+            # respond phase, so the waterfall tiles the same wall clock the
+            # latency histogram reports (the reconciliation contract)
+            t_end = time.perf_counter()
             self._m_requests.inc(endpoint="/queries.json", status=str(status))
-            self._m_latency.observe(
-                time.perf_counter() - t0, endpoint="/queries.json"
-            )
+            self._m_latency.observe(t_end - t0, endpoint="/queries.json")
+            t_done = phases.get("t_done")
+            if t_done is not None:
+                self.waterfall.observe(PHASE_RESPOND, t_end - t_done, trace_id)
         resp.headers[TRACE_HEADER] = trace_id
         return resp
 
-    async def _handle_queries_inner(self, request: web.Request) -> web.Response:
+    async def _handle_queries_inner(
+        self, request: web.Request, phases: dict[str, float] | None = None
+    ) -> web.Response:
+        phases = {} if phases is None else phases
         if self.config.accesskey:
             supplied = request.query.get("accessKey") or request.headers.get(
                 "Authorization", ""
@@ -792,6 +949,14 @@ class QueryServer:
                 "serving temporarily unavailable (dispatch circuit open)",
                 exc.retry_after_s,
             )
+        # ingress parse complete (auth + size check + JSON decode +
+        # breaker admission) — the first waterfall phase. The same
+        # timestamp anchors the queue-wait phase so the two tile exactly
+        # (the observation cost itself lands in queue_wait).
+        t_parse_end = time.perf_counter()
+        parse_s = t_parse_end - phases.get("t_start", t0)
+        phases["parse_s"] = parse_s
+        self.waterfall.observe(PHASE_INGRESS_PARSE, parse_s, current_trace_id())
         deadline = Deadline.after(self.config.request_timeout_s)
         try:
             # the batcher runs decode -> supplement -> predict_batch -> serve
@@ -799,7 +964,9 @@ class QueryServer:
             # or storage work and concurrent requests coalesce into one
             # batched device call; the deadline rides along and bounds every
             # stage (queue wait, dispatch, result fetch)
-            body = await self._batcher.submit(payload, deadline)
+            body = await self._batcher.submit(
+                payload, deadline, phases=phases, t_submit=t_parse_end
+            )
         except LoadShedError as exc:
             # this request died before any dispatch could record against the
             # breaker: free its half-open probe slot (no-op when closed/open)
@@ -829,6 +996,10 @@ class QueryServer:
         self.avg_serving_sec += (elapsed - self.avg_serving_sec) / self.request_count
         if self.config.feedback:
             self._spawn_bg(self._send_feedback(payload, body))
+        # the respond phase (results distributed -> future resumed ->
+        # response serialized) is observed by the envelope in
+        # handle_queries, anchored on the same end timestamp as the e2e
+        # latency histogram
         return web.json_response(body)
 
     def _dispatch_query_batch(self, items: list[tuple[Any, str | None]]):
@@ -952,17 +1123,26 @@ class QueryServer:
                 finalizers.append(fin)
             dispatched.append((lane, lane_name, idxs, sup, finalizers))
 
+        # finalize publishes its measured sub-phases here: the fetch-thread
+        # wall decomposes into device compute (blocked on device results),
+        # serve (per-query serve + encode), and a transport/hop residual
+        # the batcher derives (see _finish)
+        timings: dict[str, float] = {"device_s": 0.0, "serve_s": 0.0}
+
         def finalize() -> list[tuple[Any, str]]:
             sniffed: list[tuple[Any, Any]] = []
             inst = self._rollout_instruments
             for lane, lane_name, idxs, sup, finalizers in dispatched:
                 t0 = time.perf_counter()
                 preds_per_algo = self._lane_predictions(lane, sup, finalizers)
+                lane_predict_s = time.perf_counter() - t0
+                timings["device_s"] += lane_predict_s
                 inst.predict_seconds.observe(
-                    time.perf_counter() - t0, version=lane.version
+                    lane_predict_s, version=lane.version
                 )
                 for row, i in enumerate(idxs):
                     token = set_trace_id(trace_ids[i])
+                    t_serve = time.perf_counter()
                     # candidate accounting is generation-scoped end to end:
                     # a stale batch must not add errorless requests to the
                     # denominator of the NEW candidate's error-rate gate
@@ -994,6 +1174,7 @@ class QueryServer:
                             )
                             outs[i] = exc
                     finally:
+                        timings["serve_s"] += time.perf_counter() - t_serve
                         reset_trace_id(token)
             if shadow:
                 pairs = [
@@ -1010,6 +1191,7 @@ class QueryServer:
                 self._sniffer_pool.submit(self._notify_sniffers, sniffed)
             return list(zip(outs, versions))
 
+        finalize.timings = timings
         return finalize
 
     def _lane_predictions(
@@ -1710,11 +1892,19 @@ class QueryServer:
         return web.json_response({"message": "Rolled back", "version": version})
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
-        """Prometheus text exposition: request latency histogram, queue
-        depth, shed/deadline/watchdog counters, breaker state, jit
-        recompile count — everything `pio top` and a Prometheus scrape
-        need."""
-        return metrics_response(self.metrics)
+        """Prometheus text exposition: request latency histogram, phase
+        waterfall, queue depth, shed/deadline/watchdog counters, breaker
+        state, jit recompile count — everything `pio top` and a Prometheus
+        scrape need. OpenMetrics negotiation (Accept header or
+        ``?exemplars=1``) adds per-bucket trace-id exemplars."""
+        return metrics_response(self.metrics, request)
+
+    async def handle_slo(self, request: web.Request) -> web.Response:
+        """Burn-rate report for the declared objectives plus the phase
+        waterfall summary — the JSON behind the `pio top` SLO line."""
+        body = self.slo.report()
+        body["phases"] = self.waterfall.snapshot()
+        return web.json_response(body)
 
     async def handle_traces_recent(self, request: web.Request) -> web.Response:
         return traces_response(self.tracer, request)
@@ -1734,6 +1924,7 @@ class QueryServer:
                 web.get("/", self.handle_status),
                 web.get("/healthz", self.handle_healthz),
                 web.get("/metrics", self.handle_metrics),
+                web.get("/slo", self.handle_slo),
                 web.get("/traces/recent", self.handle_traces_recent),
                 web.post("/queries.json", self.handle_queries),
                 # POST is the contract (CreateServer.scala:618-626); the GET

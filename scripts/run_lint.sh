@@ -2,7 +2,7 @@
 # Tier-1 lint gate: run the TPU-aware static analyzer over the package and
 # examples. Exits nonzero on any unsuppressed error-severity finding.
 # Usage: scripts/run_lint.sh [extra lint args...]
-#        scripts/run_lint.sh --ci   # CI entry point: lint + chaos suite
+#        scripts/run_lint.sh --ci   # CI entry point: lint + perf gate + chaos
 set -euo pipefail
 
 repo_root="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -11,6 +11,34 @@ cd "$repo_root"
 if [[ "${1:-}" == "--ci" ]]; then
   shift
   python -m predictionio_tpu.analysis.cli "$@"
+
+  # --- perf-regression gate (docs/observability.md, ROADMAP item 5) -------
+  # 1. the gate must PASS an unchanged run ...
+  baseline="tests/fixtures/bench_baseline.json"
+  python bench.py --compare "$baseline" --current "$baseline" \
+    > /tmp/pio_compare_same.json
+  # 2. ... and TRIP on an injected slowdown (latencies doubled, qps halved)
+  python - "$baseline" > /tmp/pio_bench_regressed.json <<'PYEOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+for k, v in list(d.items()):
+    if isinstance(v, (int, float)) and (k.endswith("_ms") or k.endswith("_qps")):
+        d[k] = v * 2.0 if k.endswith("_ms") else v / 2.0
+print(json.dumps(d))
+PYEOF
+  if python bench.py --compare "$baseline" --current /tmp/pio_bench_regressed.json \
+      > /tmp/pio_compare_regressed.json; then
+    echo "perf-regression gate FAILED to trip on an injected slowdown" >&2
+    exit 1
+  fi
+  echo "perf-regression gate: passes unchanged run, trips injected slowdown"
+  # 3. a CPU-only bench smoke: the serving_local phase drives the real
+  #    QueryServer over loopback and records the full phase waterfall —
+  #    proving the evidence chain end to end on every CI run
+  env JAX_PLATFORMS=cpu PIO_BENCH_SCALE=ml100k \
+    python bench.py --cpu-only --only serving_local > /tmp/pio_bench_smoke.json
+  echo "bench smoke: $(tail -c 300 /tmp/pio_bench_smoke.json)"
+
   # chaos gate includes the observability suite (tests/test_obs.py):
   # counters moving under faults + trace propagation are CI-asserted
   exec "$repo_root/scripts/run_chaos.sh"

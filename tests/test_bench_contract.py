@@ -444,3 +444,173 @@ class TestTTLCache:
         c.get_or_load("k", lambda: "v1")
         c.invalidate("k")
         assert c.get_or_load("k", lambda: "v2") == "v2"
+
+
+# ---------------------------------------------------------------------------
+# --compare: the perf-regression gate (ROADMAP item 5)
+# ---------------------------------------------------------------------------
+
+
+BASE = {
+    "value": 10.0,
+    "serving_local_e2e_p50_ms": 40.0,
+    "serving_local_e2e_p95_ms": 80.0,
+    "serving_local_e2e_qps": 500.0,
+    "serving_local_phase_dispatch_p95_ms": 20.0,
+    "serving_local_phase_fetch_p95_ms": 18.0,
+    "serving_local_heldout_rmse": 0.38,  # not a gated field
+}
+
+
+class TestCompareBench:
+    def test_unchanged_run_passes(self):
+        verdict = bench.compare_bench(dict(BASE), [dict(BASE)])
+        assert verdict["compare_ok"] is True
+        assert verdict["compare_regressions"] == []
+        assert verdict["compare_fields"] == 6
+
+    def test_latency_regression_trips(self):
+        cur = {**BASE, "serving_local_e2e_p50_ms": 60.0}  # +50% > 25% tol
+        verdict = bench.compare_bench(cur, [dict(BASE)])
+        assert verdict["compare_ok"] is False
+        [reg] = verdict["compare_regressions"]
+        assert reg["field"] == "serving_local_e2e_p50_ms"
+        assert reg["ratio"] == 1.5
+
+    def test_throughput_regression_trips(self):
+        cur = {**BASE, "serving_local_e2e_qps": 300.0}  # -40%
+        verdict = bench.compare_bench(cur, [dict(BASE)])
+        assert verdict["compare_ok"] is False
+        assert verdict["compare_regressions"][0]["field"] == "serving_local_e2e_qps"
+
+    def test_phase_percentiles_are_gated(self):
+        cur = {**BASE, "serving_local_phase_fetch_p95_ms": 30.0}
+        verdict = bench.compare_bench(cur, [dict(BASE)])
+        assert verdict["compare_ok"] is False
+        assert (
+            verdict["compare_regressions"][0]["field"]
+            == "serving_local_phase_fetch_p95_ms"
+        )
+
+    def test_sub_millisecond_noise_does_not_trip(self):
+        # a 3x ratio on a 0.1ms phase is scheduler jitter, not a regression
+        base = {**BASE, "serving_local_phase_serve_p50_ms": 0.1}
+        cur = {**base, "serving_local_phase_serve_p50_ms": 0.3}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is True
+
+    def test_best_prior_wins_across_rounds(self):
+        # round A was slower, round B faster: the gate compares against B
+        round_a = {**BASE, "serving_local_e2e_p50_ms": 100.0}
+        round_b = dict(BASE)
+        cur = {**BASE, "serving_local_e2e_p50_ms": 55.0}
+        verdict = bench.compare_bench(cur, [round_a, round_b])
+        assert verdict["compare_ok"] is False  # 55 vs best=40 is +37.5%
+        assert verdict["compare_regressions"][0]["best_prior"] == 40.0
+
+    def test_improvements_counted(self):
+        cur = {**BASE, "serving_local_e2e_p50_ms": 20.0}
+        verdict = bench.compare_bench(cur, [dict(BASE)])
+        assert verdict["compare_ok"] is True
+        assert verdict["compare_improvements"] == 1
+
+    def test_missing_fields_skipped(self):
+        verdict = bench.compare_bench(
+            {"serving_local_e2e_p50_ms": 40.0}, [{"value": 10.0}]
+        )
+        assert verdict["compare_ok"] is True
+        assert verdict["compare_fields"] == 0
+
+
+def _write_json(tmp_path, name, data):
+    p = tmp_path / name
+    p.write_text(json.dumps(data))
+    return str(p)
+
+
+class TestCompareCLI:
+    def test_pure_compare_mode_passes_unchanged(self, monkeypatch, capsys, tmp_path):
+        base = _write_json(tmp_path, "base.json", BASE)
+        monkeypatch.setattr(
+            "sys.argv", ["bench.py", "--compare", base, "--current", base]
+        )
+        rc = bench.main()
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0
+        assert out["metric"] == "bench_compare"
+        assert out["compare_ok"] is True
+
+    def test_pure_compare_mode_trips_on_regression(
+        self, monkeypatch, capsys, tmp_path
+    ):
+        base = _write_json(tmp_path, "base.json", BASE)
+        cur = _write_json(
+            tmp_path, "cur.json", {**BASE, "serving_local_e2e_p50_ms": 90.0}
+        )
+        monkeypatch.setattr(
+            "sys.argv", ["bench.py", "--compare", base, "--current", cur]
+        )
+        rc = bench.main()
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 1
+        assert out["compare_ok"] is False
+        assert out["compare_regressions"][0]["field"] == "serving_local_e2e_p50_ms"
+
+    def test_tolerance_flag_respected(self, monkeypatch, capsys, tmp_path):
+        base = _write_json(tmp_path, "base.json", BASE)
+        cur = _write_json(
+            tmp_path, "cur.json", {**BASE, "serving_local_e2e_p50_ms": 55.0}
+        )
+        monkeypatch.setattr(
+            "sys.argv",
+            ["bench.py", "--compare", base, "--current", cur,
+             "--compare-tolerance", "0.5"],
+        )
+        assert bench.main() == 0  # +37.5% within the 50% tolerance
+        capsys.readouterr()
+
+    def test_compare_after_run_records_verdict_in_evidence(
+        self, monkeypatch, capsys, tmp_path
+    ):
+        """A full bench run with --compare writes the verdict INTO the
+        evidence line and fails the run on regression."""
+        prior = _write_json(
+            tmp_path, "prior.json", {**BASE, "serving_e2e_p50_ms": 5.0}
+        )
+
+        def fake_run(name, timeout_s, retries=1, env=None):
+            if name == "probe":
+                return {"probe_platform": "stub"}, None
+            if name == "serving":
+                return {"serving_e2e_p50_ms": 9.0, "serving_e2e_qps": 100.0}, None
+            return {}, None
+
+        monkeypatch.setattr(bench, "_run_phase", fake_run)
+        monkeypatch.setattr("sys.argv", ["bench.py", "--compare", prior])
+        rc = bench.main()
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 1  # 9ms vs 5ms prior p50 = +80%
+        assert out["compare_ok"] is False
+        assert out["compare_baselines"] == [prior]
+        assert any(
+            r["field"] == "serving_e2e_p50_ms" for r in out["compare_regressions"]
+        )
+
+    def test_checked_in_baseline_fixture_is_loadable_and_self_consistent(self):
+        import os
+
+        fixture = os.path.join(
+            os.path.dirname(__file__), "fixtures", "bench_baseline.json"
+        )
+        base = bench._load_bench_json(fixture)
+        # the fixture must exercise the gate's main surfaces: e2e + phases
+        assert "serving_local_e2e_p50_ms" in base
+        assert any(k.startswith("serving_local_phase_") for k in base)
+        verdict = bench.compare_bench(base, [base])
+        assert verdict["compare_ok"] is True and verdict["compare_fields"] > 10
+
+    def test_current_without_compare_errors(self, monkeypatch, capsys):
+        monkeypatch.setattr("sys.argv", ["bench.py", "--current", "x.json"])
+        with pytest.raises(SystemExit):
+            bench.main()
+        capsys.readouterr()

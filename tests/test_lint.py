@@ -656,6 +656,91 @@ class TestObsRules:
         assert rule_ids(suppressed) == ["obs-unstructured-log"]
 
 
+class TestObsLabelCardinality:
+    """obs-label-cardinality: metric label values derived from per-request
+    data (query/user/entity ids) on the serving path mint one timeseries
+    per distinct value — the classic slow leak."""
+
+    SERVING_PATH = "pkg/data/api/handler.py"  # matches */data/api/*.py
+
+    def test_per_request_label_fires(self):
+        active, _ = lint_snippet(
+            """
+            def handle(counter, query):
+                counter.inc(user=query["user"])
+            """,
+            display_path=self.SERVING_PATH,
+        )
+        assert rule_ids(active) == ["obs-label-cardinality"]
+        assert active[0].severity == Severity.WARNING
+        assert "user" in active[0].message
+
+    def test_attribute_derived_label_fires(self):
+        active, _ = lint_snippet(
+            """
+            def handle(hist, event):
+                hist.observe(0.5, entity=event.entity_id)
+            """,
+            display_path=self.SERVING_PATH,
+        )
+        assert rule_ids(active) == ["obs-label-cardinality"]
+
+    def test_constant_and_bounded_labels_quiet(self):
+        active, _ = lint_snippet(
+            """
+            def handle(counter, status, endpoint):
+                counter.inc(endpoint="/queries.json", status=str(status))
+                counter.inc(endpoint=endpoint, status="200")
+            """,
+            display_path=self.SERVING_PATH,
+        )
+        assert active == []
+
+    def test_exemplar_kwarg_quiet(self):
+        # exemplars are DESIGNED to carry per-request trace ids (bounded:
+        # one per histogram bucket) — never a label
+        active, _ = lint_snippet(
+            """
+            def handle(hist, trace_id):
+                hist.observe(0.01, exemplar=trace_id, phase="fetch")
+            """,
+            display_path=self.SERVING_PATH,
+        )
+        assert active == []
+
+    def test_off_serving_path_quiet(self):
+        active, _ = lint_snippet(
+            """
+            def report(counter, query):
+                counter.inc(user=query["user"])
+            """,
+            display_path="pkg/tools/cli.py",
+        )
+        assert active == []
+
+    def test_positional_args_quiet(self):
+        # only keyword arguments are label values on the metric API
+        active, _ = lint_snippet(
+            """
+            def handle(hist, query_seconds):
+                hist.observe(query_seconds)
+            """,
+            display_path=self.SERVING_PATH,
+        )
+        assert active == []
+
+    def test_suppressible_with_reason(self):
+        active, suppressed = lint_snippet(
+            """
+            def handle(counter, event):
+                counter.inc(event=event.event)  # pio-lint: disable=obs-label-cardinality -- bounded by app schema
+            """,
+            display_path=self.SERVING_PATH,
+        )
+        assert active == []
+        assert rule_ids(suppressed) == ["obs-label-cardinality"]
+
+
 class TestEngine:
     def test_parse_error_reported_not_raised(self):
         active, _ = lint_snippet("def broken(:\n")

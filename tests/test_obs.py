@@ -842,3 +842,433 @@ class TestDashboardPanels:
                 await client.close()
 
         asyncio.run(outer())
+
+
+# ---------------------------------------------------------------------------
+# histogram exemplars
+# ---------------------------------------------------------------------------
+
+
+class TestExemplars:
+    def test_observe_with_exemplar_and_accessor(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("ex_seconds", labelnames=("phase",))
+        h.observe(0.0003, exemplar="aaaa000011112222", phase="fetch")
+        h.observe(0.2, exemplar="bbbb000011112222", phase="fetch")
+        ex = h.exemplars(phase="fetch")
+        assert ex["0.0005"]["exemplar"] == "aaaa000011112222"
+        assert ex["0.25"]["exemplar"] == "bbbb000011112222"
+        assert ex["0.25"]["value"] == pytest.approx(0.2)
+
+    def test_last_writer_wins_per_bucket(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("ex2_seconds")
+        h.observe(0.0003, exemplar="first000")
+        h.observe(0.0004, exemplar="second00")
+        assert h.exemplars()["0.0005"]["exemplar"] == "second00"
+
+    def test_render_plain_vs_openmetrics(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("ex3_seconds")
+        h.observe(0.0003, exemplar="cafe0000deadbeef")
+        plain = reg.render_prometheus()
+        assert "trace_id" not in plain  # strict v0.0.4 stays strict
+        assert "# EOF" not in plain
+        om = reg.render_prometheus(exemplars=True)
+        assert '# {trace_id="cafe0000deadbeef"} 0.0003' in om
+        assert om.rstrip().endswith("# EOF")
+
+    def test_top_parser_tolerates_exemplar_clauses(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("ex4_seconds")
+        for v in (0.0003, 0.002, 0.3):
+            h.observe(v, exemplar="feed0000feed0000")
+        parsed = parse_prometheus(reg.render_prometheus(exemplars=True))
+        # every bucket line still parses to its numeric value
+        assert sum(
+            v for l, v in parsed["ex4_seconds_bucket"] if l.get("le") == "+Inf"
+        ) == 3.0
+        assert parsed["ex4_seconds_count"] == [({}, 3.0)]
+
+
+# ---------------------------------------------------------------------------
+# phase waterfall end-to-end (the latency-attribution acceptance trail)
+# ---------------------------------------------------------------------------
+
+
+PHASE_NAMES = (
+    "ingress_parse",
+    "queue_wait",
+    "batch_assembly",
+    "dispatch",
+    "device_compute",
+    "fetch",
+    "serve",
+    "respond",
+)
+
+
+class TestWaterfallE2E:
+    def test_phases_tile_e2e_latency_within_tolerance(self):
+        """Acceptance: a serving round-trip produces a phase waterfall
+        whose per-phase means sum to within 10% of the measured e2e
+        latency (they tile the same wall clock by construction)."""
+
+        async def body(client, server):
+            for i in range(40):
+                resp = await client.post("/queries.json", json={"qid": i})
+                assert resp.status == 200
+            hist = server.waterfall.hist
+            counts = {p: hist.summary(phase=p).get("count") for p in PHASE_NAMES}
+            assert all(c == 40 for c in counts.values()), counts
+            phase_sum = sum(hist.summary(phase=p)["mean"] for p in PHASE_NAMES)
+            e2e = server._m_latency.summary(endpoint="/queries.json")["mean"]
+            assert phase_sum == pytest.approx(e2e, rel=0.10)
+
+        _run_query_server(body)
+
+    def test_phase_exemplar_resolves_to_trace(self):
+        """Acceptance: every phase is visible on /metrics with an exemplar
+        trace id resolvable in /traces/recent."""
+        import re as _re
+
+        async def body(client, server):
+            for i in range(5):
+                await client.post("/queries.json", json={"qid": i})
+            m = await client.get("/metrics?exemplars=1")
+            assert m.headers["Content-Type"].startswith(
+                "application/openmetrics-text"
+            )
+            text = await m.text()
+            by_phase: dict[str, set] = {}
+            for match in _re.finditer(
+                r'pio_phase_seconds_bucket\{phase="([a-z_]+)"[^}]*\}'
+                r' \d+ # \{trace_id="([0-9a-f]+)"\}',
+                text,
+            ):
+                by_phase.setdefault(match.group(1), set()).add(match.group(2))
+            assert set(by_phase) == set(PHASE_NAMES), sorted(by_phase)
+            served = (await (await client.get("/traces/recent?limit=500")).json())[
+                "spans"
+            ]
+            ring_ids = {s["traceId"] for s in served}
+            for phase, tids in by_phase.items():
+                assert tids & ring_ids, f"{phase} exemplars not in trace ring"
+
+        _run_query_server(body)
+
+    def test_batch_and_ingress_spans_carry_phase_tags(self):
+        tid = mint_trace_id()
+
+        async def body(client, server):
+            await client.post(
+                "/queries.json", json={"qid": 1}, headers={TRACE_HEADER: tid}
+            )
+            spans = get_tracer().find(tid)
+            batch = next(s for s in spans if s["kind"] == "batch")
+            for key in (
+                "queue_ms",
+                "dispatch_ms",
+                "fetch_ms",
+                "device_compute_ms",
+                "serve_ms",
+                "fetch_residual_ms",
+            ):
+                assert key in batch["tags"], batch["tags"]
+            ingress = next(s for s in spans if s["kind"] == "ingress")
+            assert "ingress_parse_ms" in ingress["tags"]
+            assert "respond_ms" in ingress["tags"]
+
+        _run_query_server(body)
+
+    def test_default_metrics_scrape_stays_plain_v004(self):
+        async def body(client, server):
+            await client.post("/queries.json", json={"qid": 1})
+            m = await client.get("/metrics")
+            assert m.headers["Content-Type"].startswith("text/plain")
+            assert "trace_id" not in await m.text()
+
+        _run_query_server(body)
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+
+class TestSLOEngine:
+    def _engine_with_counter(self, objective=0.999):
+        from predictionio_tpu.obs.slo import SLOEngine, counter_ratio_source
+
+        reg = MetricsRegistry()
+        c = reg.counter("t_total", labelnames=("status",))
+        engine = SLOEngine(reg)
+        engine.add(
+            "availability",
+            "non-5xx",
+            objective,
+            counter_ratio_source(
+                c, bad=lambda l: l.get("status", "").startswith("5")
+            ),
+        )
+        return reg, c, engine
+
+    def test_burn_rate_math_multi_window(self):
+        reg, c, engine = self._engine_with_counter(objective=0.999)
+        c.inc(100, status="200")
+        engine.tick(now=0.0)
+        c.inc(90, status="200")
+        c.inc(10, status="503")
+        engine.tick(now=100.0)
+        [report] = engine.evaluate(now=100.0)
+        fast, slow = report["windows"]
+        # 10 bad / 100 total over the window = 10% bad; budget 0.1% -> 100x
+        assert fast["bad_ratio"] == pytest.approx(0.1)
+        assert fast["burn_rate"] == pytest.approx(100.0)
+        assert slow["burn_rate"] == pytest.approx(100.0)
+        assert report["alerting"] is True
+        assert report["budget_remaining"] == 0.0
+        # gauges refreshed for pio top / Prometheus
+        parsed = parse_prometheus(reg.render_prometheus())
+        burns = {
+            l["window"]: v
+            for l, v in parsed["pio_slo_burn_rate"]
+            if l["slo"] == "availability"
+        }
+        assert burns["300"] == pytest.approx(100.0)
+        assert ({"slo": "availability"}, 1.0) in parsed["pio_slo_alerting"]
+
+    def test_healthy_traffic_not_alerting(self):
+        reg, c, engine = self._engine_with_counter(objective=0.5)
+        c.inc(100, status="200")
+        engine.tick(now=0.0)
+        c.inc(100, status="200")
+        c.inc(10, status="503")
+        engine.tick(now=60.0)
+        [report] = engine.evaluate(now=60.0)
+        # ~9% bad against a 50% budget: burn ~0.18, nowhere near threshold
+        assert report["windows"][0]["burn_rate"] < 1.0
+        assert report["alerting"] is False
+        assert report["budget_remaining"] > 0.5
+
+    def test_single_sample_is_no_data_not_alert(self):
+        reg, c, engine = self._engine_with_counter()
+        c.inc(5, status="500")
+        engine.tick(now=0.0)
+        [report] = engine.evaluate(now=0.0)
+        assert report["alerting"] is False
+        assert all(w["burn_rate"] == 0.0 for w in report["windows"])
+
+    def test_histogram_threshold_source_counts_over_threshold(self):
+        from predictionio_tpu.obs.slo import histogram_threshold_source
+
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", labelnames=("endpoint",))
+        for _ in range(9):
+            h.observe(0.005, endpoint="/q")
+        h.observe(0.05, endpoint="/q")
+        src = histogram_threshold_source(h, 0.010, endpoint="/q")
+        total, bad = src()
+        assert (total, bad) == (10, 1)
+
+    def test_loose_objective_can_still_alert(self):
+        """Burn is bounded by 1/budget, so the SRE-default thresholds
+        (14.4/6) are unreachable for a p50-style objective of 0.50 —
+        thresholds must clamp to the achievable ceiling or the flagship
+        latency SLO could structurally never alert."""
+        reg, c, engine = self._engine_with_counter(objective=0.50)
+        c.inc(10, status="200")
+        engine.tick(now=0.0)
+        c.inc(100, status="503")  # every event bad: burn = 1/0.5 = 2.0
+        engine.tick(now=100.0)
+        [report] = engine.evaluate(now=100.0)
+        assert report["windows"][0]["burn_rate"] == pytest.approx(2.0)
+        # clamped threshold: min(14.4, 0.9 * 2.0) = 1.8 < 2.0 -> alert
+        assert report["windows"][0]["max_burn"] == pytest.approx(1.8)
+        assert report["alerting"] is True
+
+    def test_event_server_availability_rates_collection_routes_only(self):
+        """A 100% ingestion outage must alert even while health checks
+        and scrapes (counted by the same middleware) keep succeeding."""
+
+        async def body(client, server, injector, key):
+            # monitoring traffic: healthy non-collection requests
+            for _ in range(20):
+                server._m_requests.inc(endpoint="/healthz", status="200")
+            server.slo.tick(now=0.0)
+            # the entire collection API fails
+            for _ in range(10):
+                server._m_requests.inc(endpoint="/events.json", status="503")
+            for _ in range(20):
+                server._m_requests.inc(endpoint="/healthz", status="200")
+            server.slo.tick(now=100.0)
+            [report] = server.slo.evaluate(now=100.0)
+            fast = report["windows"][0]
+            assert fast["total"] == 10.0  # /healthz not in the denominator
+            assert fast["bad_ratio"] == pytest.approx(1.0)
+            assert report["alerting"] is True
+
+        _run_event_server(body)
+
+    def test_duplicate_and_invalid_objectives_rejected(self):
+        reg, c, engine = self._engine_with_counter()
+        with pytest.raises(ValueError):
+            engine.add("availability", "dup", 0.9, lambda: (0, 0))
+        with pytest.raises(ValueError):
+            engine.add("impossible", "no budget", 1.0, lambda: (0, 0))
+
+    def test_slo_endpoint_on_live_server(self):
+        async def body(client, server):
+            for i in range(3):
+                await client.post("/queries.json", json={"qid": i})
+            resp = await client.get("/slo")
+            assert resp.status == 200
+            data = await resp.json()
+            names = {s["name"] for s in data["slos"]}
+            assert names == {"latency", "availability", "shed"}
+            for s in data["slos"]:
+                assert {"objective", "windows", "alerting"} <= set(s)
+            # the /slo report embeds the phase waterfall summary
+            assert set(data["phases"]) == set(PHASE_NAMES)
+
+        _run_query_server(body)
+
+    def test_event_server_slo_endpoint(self):
+        async def body(client, server, injector, key):
+            await client.post(f"/events.json?accessKey={key}", json=EVENT)
+            data = await (await client.get("/slo")).json()
+            assert [s["name"] for s in data["slos"]] == ["availability"]
+
+        _run_event_server(body)
+
+
+# ---------------------------------------------------------------------------
+# pio top: waterfall + SLO + --json
+# ---------------------------------------------------------------------------
+
+
+def _waterfall_metrics_text() -> str:
+    reg = MetricsRegistry()
+    h = reg.histogram("pio_phase_seconds", labelnames=("phase",))
+    for phase, v in (
+        ("ingress_parse", 0.0002),
+        ("queue_wait", 0.0001),
+        ("dispatch", 0.002),
+        ("fetch", 0.004),
+    ):
+        h.observe(v, phase=phase)
+    reg.gauge("pio_slo_objective", labelnames=("slo",)).set(0.5, slo="latency")
+    g = reg.gauge("pio_slo_burn_rate", labelnames=("slo", "window"))
+    g.set(0.4, slo="latency", window="300")
+    g.set(0.2, slo="latency", window="3600")
+    reg.gauge("pio_slo_alerting", labelnames=("slo",)).set(1.0, slo="latency")
+    return _fake_metrics_text() + reg.render_prometheus()
+
+
+class TestTopWaterfallSLO:
+    def test_phases_and_slo_summarized(self):
+        s = summarize(parse_prometheus(_waterfall_metrics_text()))
+        assert list(s["phases"]) == [
+            "ingress_parse",
+            "queue_wait",
+            "dispatch",
+            "fetch",
+        ]  # request order, not alphabetical
+        assert s["phases"]["fetch"]["count"] == 1
+        assert s["phases"]["fetch"]["p50_ms"] > s["phases"]["queue_wait"]["p50_ms"]
+        assert s["slo"]["latency"]["objective"] == 0.5
+        assert s["slo"]["latency"]["burn"] == {"300": 0.4, "3600": 0.2}
+        assert s["slo"]["latency"]["alerting"] is True
+
+    def test_render_waterfall_and_slo_lines(self):
+        s = summarize(parse_prometheus(_waterfall_metrics_text()))
+        screen = render(s, "http://x")
+        assert "waterfall" in screen
+        assert "ingress parse" in screen and "fetch" in screen
+        assert "slo" in screen
+        assert "latency burn 0.40/0.20 ALERT" in screen
+
+    def test_absent_without_waterfall_metrics(self):
+        s = summarize(parse_prometheus(_fake_metrics_text()))
+        assert s["phases"] is None and s["slo"] is None
+        screen = render(s, "http://x")
+        assert "waterfall" not in screen and "slo" not in screen
+
+    def test_json_mode_one_object_per_snapshot(self):
+        outs: list[str] = []
+        rc = run_top(
+            "http://fake:1",
+            interval_s=0.0,
+            iterations=3,
+            fetch=lambda url: _waterfall_metrics_text(),
+            out=outs.append,
+            sleep=lambda s: None,
+            json_mode=True,
+        )
+        assert rc == 0
+        assert len(outs) == 3
+        for line in outs:
+            snap = json.loads(line)  # every snapshot is one valid JSON line
+            assert snap["url"] == "http://fake:1"
+            assert snap["phases"]["dispatch"]["count"] == 1
+            assert snap["slo"]["latency"]["alerting"] is True
+            assert "\x1b" not in line  # no screen control codes
+
+    def test_json_mode_unreachable_is_json_too(self):
+        outs: list[str] = []
+
+        def fetch(url):
+            raise ConnectionError("nope")
+
+        run_top(
+            "http://down:1",
+            iterations=1,
+            fetch=fetch,
+            out=outs.append,
+            json_mode=True,
+        )
+        assert json.loads(outs[0])["error"] == "nope"
+
+    def test_cli_top_json_flag(self):
+        from predictionio_tpu.tools.cli import build_parser
+
+        args = build_parser().parse_args(["top", "--json", "--once"])
+        assert args.json and args.once
+
+
+# ---------------------------------------------------------------------------
+# metrics contract: every documented pio_* metric is actually registered
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsContract:
+    def test_documented_metrics_all_registered(self):
+        """Every `pio_*` metric named in the docs/observability.md tables
+        must be registered (and therefore exported with a # TYPE line) by
+        the surface that owns it — docs that drift from the exporters are
+        worse than no docs."""
+        import os
+        import re as _re
+        import sys
+
+        sys.path.insert(0, "tests") if "tests" not in sys.path else None
+        from predictionio_tpu.stream.pipeline import StreamInstruments
+        from tests.test_resilience import _make_event_server, _make_query_server
+
+        doc = open(
+            os.path.join(os.path.dirname(__file__), "..", "docs", "observability.md")
+        ).read()
+        documented = set()
+        for line in doc.splitlines():
+            if line.lstrip().startswith("|"):
+                documented.update(_re.findall(r"`(pio_[a-z0-9_]+)`", line))
+        assert len(documented) > 30, "doc tables went missing?"
+
+        registered: set[str] = set()
+        qs = _make_query_server()
+        registered.update(qs.metrics._metrics)
+        es, _, _ = _make_event_server()
+        registered.update(es.metrics._metrics)
+        registered.update(StreamInstruments().registry._metrics)
+        missing = documented - registered
+        assert not missing, f"documented but not registered: {sorted(missing)}"
