@@ -42,6 +42,7 @@ from predictionio_tpu.analysis import (  # noqa: F401  (registration side effect
     rules_storage,
     rules_stream,
     rules_tracer,
+    rules_train,
 )
 
 __all__ = [

@@ -77,6 +77,17 @@ class LintConfig:
     # modules on the stream (speed-layer) path: event-store reads here
     # must be bounded (rule stream-unbounded-drain)
     stream_globs: tuple[str, ...] = ("*/stream/*.py",)
+    # modules containing training loops: bare device->host syncs here must
+    # go through timed_block_until_ready / obs.xray device accounting so
+    # device time can't leak out of the train profile (rule
+    # train-unaccounted-sync)
+    train_globs: tuple[str, ...] = (
+        "*/ops/als.py",
+        "*/ops/als_sharded.py",
+        "*/ops/spd_solve.py",
+        "*/stream/trainers.py",
+        "*/stream/pipeline.py",
+    )
     # rule ids to run; None = all registered
     enabled: frozenset[str] | None = None
 

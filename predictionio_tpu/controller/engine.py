@@ -34,6 +34,7 @@ from predictionio_tpu.controller.base import (
 )
 from predictionio_tpu.controller.base import BaseAlgorithm
 from predictionio_tpu.controller.params import Params, params_from_dict
+from predictionio_tpu.obs import xray
 from predictionio_tpu.workflow.context import WorkflowContext
 
 logger = logging.getLogger(__name__)
@@ -142,18 +143,25 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
     ) -> list[Any]:
         """ref Engine.train static (Engine.scala:623-710): read -> sanity ->
         prepare -> sanity -> train each algo -> sanity. Returns one model per
-        algorithm."""
+        algorithm.
+
+        Step-profiler phases (obs/xray — no-ops without an active
+        profile): read+prepare account as ``host_etl``, each algorithm's
+        train as ``solve`` (algorithms that iterate internally, e.g. ALS,
+        carve their own ``sweep`` steps out of it — exclusive nesting
+        keeps the tiling contract exact)."""
         options = options or TrainOptions()
-        data_source, preparator, algorithms, _ = self.make_components(engine_params)
-
-        td = data_source.read_training(ctx)
-        _maybe_sanity_check(td, "training data", options.skip_sanity_check)
-        if options.stop_after_read:
-            logger.info("stopping after read_training")
-            return []
-
-        pd = preparator.prepare(ctx, td)
-        _maybe_sanity_check(pd, "prepared data", options.skip_sanity_check)
+        with xray.phase(xray.PHASE_HOST_ETL):
+            data_source, preparator, algorithms, _ = self.make_components(
+                engine_params
+            )
+            td = data_source.read_training(ctx)
+            _maybe_sanity_check(td, "training data", options.skip_sanity_check)
+            if options.stop_after_read:
+                logger.info("stopping after read_training")
+                return []
+            pd = preparator.prepare(ctx, td)
+            _maybe_sanity_check(pd, "prepared data", options.skip_sanity_check)
         if options.stop_after_prepare:
             logger.info("stopping after prepare")
             return []
@@ -161,8 +169,9 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
         models: list[Any] = []
         for i, algo in enumerate(algorithms):
             logger.info("training algorithm %d: %s", i, type(algo).__name__)
-            model = algo.train(ctx, pd)
-            _maybe_sanity_check(model, f"model {i}", options.skip_sanity_check)
+            with xray.phase(xray.PHASE_SOLVE):
+                model = algo.train(ctx, pd)
+                _maybe_sanity_check(model, f"model {i}", options.skip_sanity_check)
             models.append(model)
         return models
 

@@ -354,6 +354,25 @@ class ALSAlgorithm(JaxAlgorithm):
             gather_dtype=self.params.gather_dtype,
             solver=self.params.solver,
         )
+        from predictionio_tpu.obs import xray
+
+        prof = xray.current_profile()
+        if prof is not None:
+            # capacity planner prediction recorded BEFORE the allocation
+            # happens; the profile's live-memory samples are the runtime
+            # cross-check (pio doctor --capacity answers this preflight)
+            import jax
+
+            prof.set_estimate(
+                xray.estimate_factors(
+                    len(pd.user_vocab),
+                    len(pd.item_vocab),
+                    self.params.rank,
+                    mesh=jax.device_count() if self.params.distributed else 1,
+                    nnz=int(pd.user_idx.shape[0]),
+                    gather_dtype=self.params.gather_dtype,
+                )
+            )
         if self.params.distributed:
             from predictionio_tpu.ops.als_sharded import als_train_sharded
 

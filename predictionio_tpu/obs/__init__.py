@@ -19,11 +19,15 @@ be *operable* at fleet scale (see ``docs/observability.md``):
 - :mod:`predictionio_tpu.obs.slo` — declarative objectives (latency,
   availability, shed rate) evaluated as multi-window burn rates from
   registry counter snapshots; ``/slo`` + ``pio_slo_*`` gauges.
+- :mod:`predictionio_tpu.obs.xray` — training observability: the
+  per-iteration step profiler (``pio_train_*`` metrics, ``train.step``
+  spans, profiles attached to registry manifests), the HBM capacity
+  planner behind ``pio doctor --capacity``, and the sharding inspector.
 
 ``metrics``, ``tracing``, ``waterfall``, and ``slo`` are stdlib-only;
-``jaxprof`` imports jax lazily — so the event server, ``pio top``, and
-the lint CLI can use this package without dragging in an accelerator
-runtime.
+``jaxprof`` and ``xray`` import jax lazily — so the event server,
+``pio top``, and the lint CLI can use this package without dragging in
+an accelerator runtime.
 """
 
 from predictionio_tpu.obs.jaxprof import (
@@ -45,6 +49,7 @@ from predictionio_tpu.obs.slo import (
     paired_counter_source,
 )
 from predictionio_tpu.obs.waterfall import PHASES, PhaseWaterfall, phase_tags_ms
+from predictionio_tpu.obs import xray
 from predictionio_tpu.obs.tracing import (
     TRACE_HEADER,
     Span,
@@ -82,4 +87,5 @@ __all__ = [
     "reset_trace_id",
     "set_trace_id",
     "timed_block_until_ready",
+    "xray",
 ]

@@ -240,13 +240,22 @@ def timed_block_until_ready(
     through here (and suppress the host-sync lint with a reason): the
     stall lands in ``pio_device_stall_seconds_total{where=...}`` and the
     ``pio_device_fetch_seconds`` histogram instead of disappearing into
-    the request wall time.
+    the request wall time. On the *training* path the same call is what
+    the ``train-unaccounted-sync`` lint demands: when a train profile is
+    recording (``obs.xray``), the stall is additionally attributed to the
+    profile's current phase so device time can't leak out of the step
+    timeline.
     """
     import jax
 
     t0 = time.perf_counter()
     out = jax.block_until_ready(x)
     elapsed = time.perf_counter() - t0
+    from predictionio_tpu.obs import xray
+
+    prof = xray.current_profile()
+    if prof is not None:
+        prof.note_device_time(elapsed, where)
     registry.counter(
         "pio_device_stall_seconds_total",
         "cumulative seconds spent blocked on device->host synchronization",

@@ -635,6 +635,7 @@ def fetch_barrier(*arrays) -> float:
     value does not exist until every input array has been materialized.
     Returns the checksum so callers can keep the fetch from being elided.
     """
+    # pio-lint: disable=train-unaccounted-sync -- this IS the timing instrument; callers time around it
     return float(np.asarray(_barrier_checksum(*arrays)))
 
 
@@ -658,88 +659,130 @@ def als_train(
     instrumentation barriers make the decomposition sum to the call's wall
     clock; the un-instrumented path keeps the fully-async dispatch
     pipeline.
+
+    With an active train profile (obs/xray): the host pack/upload/build
+    accounts as ``host_etl``, each iteration becomes one profiled
+    ``sweep`` step closed by a true device barrier (the barrier per
+    iteration serializes the at-most-one-deep dispatch overlap — that is
+    the price of per-iteration device time, paid only when profiling),
+    the per-iteration factor checksum rides as the step's convergence
+    metric, and live-memory peaks are sampled per step.
     """
     import time
 
-    user_idx = np.asarray(user_idx, np.int32)
-    item_idx = np.asarray(item_idx, np.int32)
-    ratings = np.asarray(ratings, np.float32)
-    valid = (user_idx >= 0) & (item_idx >= 0)
-    user_idx, item_idx, ratings = user_idx[valid], item_idx[valid], ratings[valid]
-    if user_idx.shape[0]:
-        for name, idx, bound in (
-            ("user", user_idx, n_users),
-            ("item", item_idx, n_items),
-        ):
-            mx = int(idx.max())
-            if mx >= bound:
-                raise ValueError(
-                    f"{name} index {mx} out of range for n_{name}s={bound}"
-                )
-    d = max(8, min(config.block_d, config.chunk))
-    block_chunk = max(8, config.chunk // d)
-    use_device_pack = config.pack != "host" and user_idx.shape[0] > 0
+    from predictionio_tpu.obs import xray
 
-    t0 = time.perf_counter()
-    if use_device_pack:
-        cols_u, vals_u, deg_u = _host_group_by(user_idx, item_idx, ratings, n_users)
-        deg_i = np.bincount(item_idx, minlength=n_items).astype(np.int32)
-        nb_u = _pad_blocks(int((-(-deg_u // d)).sum()), block_chunk)
-        nb_i = _pad_blocks(int((-(-deg_i // d)).sum()), block_chunk)
-        # wire compression, all LOSSLESS: opposite ids as int16 when the
-        # vocab fits; ratings in their smallest exact form (uint8
-        # dictionary codes / f16 / f32 — see _compress_ratings_wire).
-        # H2D rides a ~33MB/s tunnel here — bytes are wall-clock.
-        if n_items <= np.iinfo(np.int16).max:
-            cols_u = cols_u.astype(np.int16)
-        vals_u, val_table = _compress_ratings_wire(vals_u)
-        t_pack = time.perf_counter()
-        wire = [jax.device_put(a) for a in (cols_u, vals_u, deg_u, deg_i)]
-        table_dev = jax.device_put(val_table) if val_table is not None else None
-        if timings is not None:
-            fetch_barrier(*wire)
-        t_upload = time.perf_counter()
-        dev = list(
-            _device_pack(
-                *wire, val_table=table_dev,
-                d=d, nb_u=nb_u, nb_i=nb_i, n_users=n_users, n_items=n_items,
+    prof = xray.current_profile()
+    with xray.phase(xray.PHASE_HOST_ETL):
+        user_idx = np.asarray(user_idx, np.int32)
+        item_idx = np.asarray(item_idx, np.int32)
+        ratings = np.asarray(ratings, np.float32)
+        valid = (user_idx >= 0) & (item_idx >= 0)
+        user_idx, item_idx, ratings = (
+            user_idx[valid], item_idx[valid], ratings[valid]
+        )
+        if user_idx.shape[0]:
+            for name, idx, bound in (
+                ("user", user_idx, n_users),
+                ("item", item_idx, n_items),
+            ):
+                mx = int(idx.max())
+                if mx >= bound:
+                    raise ValueError(
+                        f"{name} index {mx} out of range for n_{name}s={bound}"
+                    )
+        d = max(8, min(config.block_d, config.chunk))
+        block_chunk = max(8, config.chunk // d)
+        use_device_pack = config.pack != "host" and user_idx.shape[0] > 0
+
+        t0 = time.perf_counter()
+        if use_device_pack:
+            cols_u, vals_u, deg_u = _host_group_by(
+                user_idx, item_idx, ratings, n_users
             )
+            deg_i = np.bincount(item_idx, minlength=n_items).astype(np.int32)
+            nb_u = _pad_blocks(int((-(-deg_u // d)).sum()), block_chunk)
+            nb_i = _pad_blocks(int((-(-deg_i // d)).sum()), block_chunk)
+            # wire compression, all LOSSLESS: opposite ids as int16 when the
+            # vocab fits; ratings in their smallest exact form (uint8
+            # dictionary codes / f16 / f32 — see _compress_ratings_wire).
+            # H2D rides a ~33MB/s tunnel here — bytes are wall-clock.
+            if n_items <= np.iinfo(np.int16).max:
+                cols_u = cols_u.astype(np.int16)
+            vals_u, val_table = _compress_ratings_wire(vals_u)
+            t_pack = time.perf_counter()
+            wire = [jax.device_put(a) for a in (cols_u, vals_u, deg_u, deg_i)]
+            table_dev = (
+                jax.device_put(val_table) if val_table is not None else None
+            )
+            if timings is not None:
+                fetch_barrier(*wire)
+            t_upload = time.perf_counter()
+            dev = list(
+                _device_pack(
+                    *wire, val_table=table_dev,
+                    d=d, nb_u=nb_u, nb_i=nb_i, n_users=n_users, n_items=n_items,
+                )
+            )
+            if timings is not None:
+                # device-side table build (sort + gather expansion) attributed
+                # to its own bucket: device_s means SOLVER iterations only, on
+                # both pack paths, or per-iteration figures aren't comparable
+                fetch_barrier(dev[0], dev[4])
+            t_build = time.perf_counter()
+        else:
+            u_blocks = _block_coo(
+                user_idx, item_idx, ratings, d, block_chunk, n_users
+            )
+            i_blocks = _block_coo(
+                item_idx, user_idx, ratings, d, block_chunk, n_items
+            )
+            t_pack = time.perf_counter()
+            # block tables cross host->device ONCE; the per-iteration
+            # launches reuse the same device buffers
+            dev = [jax.device_put(a) for a in (*u_blocks, *i_blocks)]
+            if timings is not None:
+                fetch_barrier(*dev)
+            t_upload = time.perf_counter()
+            t_build = t_upload  # tables arrive pre-built on the host path
+        user_f, item_f = _als_init(
+            n_users=n_users, n_items=n_items, rank=config.rank, seed=config.seed
         )
-        if timings is not None:
-            # device-side table build (sort + gather expansion) attributed
-            # to its own bucket: device_s means SOLVER iterations only, on
-            # both pack paths, or per-iteration figures aren't comparable
-            fetch_barrier(dev[0], dev[4])
-        t_build = time.perf_counter()
-    else:
-        u_blocks = _block_coo(user_idx, item_idx, ratings, d, block_chunk, n_users)
-        i_blocks = _block_coo(item_idx, user_idx, ratings, d, block_chunk, n_items)
-        t_pack = time.perf_counter()
-        # block tables cross host->device ONCE; the per-iteration launches
-        # reuse the same device buffers
-        dev = [jax.device_put(a) for a in (*u_blocks, *i_blocks)]
-        if timings is not None:
-            fetch_barrier(*dev)
-        t_upload = time.perf_counter()
-        t_build = t_upload  # tables arrive pre-built on the host path
-    user_f, item_f = _als_init(
-        n_users=n_users, n_items=n_items, rank=config.rank, seed=config.seed
-    )
+    import contextlib
+
+    nnz = int(user_idx.shape[0])
     for _ in range(config.iterations):
-        user_f, item_f = _als_step(
-            user_f,
-            item_f,
-            *dev,
-            n_users=n_users,
-            n_items=n_items,
-            reg=config.reg,
-            implicit=config.implicit,
-            alpha=config.alpha,
-            block_chunk=block_chunk,
-            degree_scaled_reg=config.degree_scaled_reg,
-            solver=config.solver,
-            gather_dtype=config.gather_dtype,
-        )
+        with contextlib.ExitStack() as stack:
+            rec = (
+                stack.enter_context(prof.step(nnz=nnz))
+                if prof is not None
+                else None
+            )
+            with xray.phase(xray.PHASE_SWEEP):
+                user_f, item_f = _als_step(
+                    user_f,
+                    item_f,
+                    *dev,
+                    n_users=n_users,
+                    n_items=n_items,
+                    reg=config.reg,
+                    implicit=config.implicit,
+                    alpha=config.alpha,
+                    block_chunk=block_chunk,
+                    degree_scaled_reg=config.degree_scaled_reg,
+                    solver=config.solver,
+                    gather_dtype=config.gather_dtype,
+                )
+                if rec is not None:
+                    rec["metric"] = prof.device_barrier(
+                        user_f, item_f, where="als-sweep"
+                    )
+        if prof is not None:
+            # profiler's own bookkeeping (live-array walk) accounts as
+            # host_etl so it cannot open a hole in the tiling contract
+            with prof.phase(xray.PHASE_HOST_ETL):
+                prof.add_rows(nnz)
+                prof.sample_memory()
     if timings is not None:
         fetch_barrier(user_f, item_f)
         timings["pack_s"] = t_pack - t0
@@ -865,6 +908,7 @@ def top_k_items(
     path — it also keeps the user table resident."""
     if mask is None:
         mask = jnp.ones((item_factors.shape[0],), bool)
+    # pio-lint: disable=train-unaccounted-sync -- serving-path fetch, accounted by the request waterfall
     packed = np.asarray(_topk_scores_packed(user_vec, item_factors, mask, k))
     return _unpack(packed)
 
@@ -900,6 +944,7 @@ class ServingIndex:
         return self.item_factors.shape[0]
 
     def warmup(self, k: int) -> None:
+        # pio-lint: disable=train-unaccounted-sync -- deploy-time warmup, deliberately synchronous
         jax.block_until_ready(
             _serve_by_index(
                 jnp.int32(0), self.user_factors, self.item_factors, self._full_mask, k
@@ -928,6 +973,7 @@ class ServingIndex:
                 )
             )
             b *= 2
+        # pio-lint: disable=train-unaccounted-sync -- deploy-time warmup, deliberately synchronous
         jax.block_until_ready(handles)
 
     def serve(
@@ -935,6 +981,7 @@ class ServingIndex:
     ) -> tuple[np.ndarray, np.ndarray]:
         """Top-k (scores, item indices) for one user index."""
         m = self._full_mask if mask is None else jnp.asarray(mask)
+        # pio-lint: disable=train-unaccounted-sync -- serving-path fetch, accounted by the request waterfall
         packed = np.asarray(
             _serve_by_index(
                 jnp.int32(user_index), self.user_factors, self.item_factors, m, k
@@ -951,6 +998,7 @@ class ServingIndex:
         """Micro-batched serve: [B] indices -> ([B,k] scores, [B,k] items).
         This is the throughput path an async query server batches into."""
         return self.unpack_batch(
+            # pio-lint: disable=train-unaccounted-sync -- serving-path fetch, accounted by the request waterfall
             np.asarray(self.serve_batch_async(user_indices, k, mask))
         )
 

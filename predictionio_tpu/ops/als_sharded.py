@@ -143,87 +143,121 @@ def als_train_sharded(
     ``mesh`` defaults to a 1-D mesh over all visible devices. With one
     device this degrades gracefully to the single-chip schedule.
     """
+    from predictionio_tpu.obs import xray
+
+    prof = xray.current_profile()
     if mesh is None:
+        # pio-lint: disable=train-unaccounted-sync -- host-side device list, not a device fetch
         mesh = Mesh(np.asarray(jax.devices()), (axis,))
     n_dev = mesh.shape[axis]
 
-    user_idx = np.asarray(user_idx, np.int32)
-    item_idx = np.asarray(item_idx, np.int32)
-    ratings = np.asarray(ratings, np.float32)
-    valid = (user_idx >= 0) & (item_idx >= 0)
-    user_idx, item_idx, ratings = user_idx[valid], item_idx[valid], ratings[valid]
-
-    bu = max(1, -(-n_users // n_dev))  # users per device block
-    bi = max(1, -(-n_items // n_dev))
-    d = max(8, min(config.block_d, config.chunk))
-    block_chunk = max(8, config.chunk // d)
-
-    u_blocks = _block_partition_blocked(
-        user_idx, item_idx, ratings, bu, n_dev, d, block_chunk
-    )
-    i_blocks = _block_partition_blocked(
-        item_idx, user_idx, ratings, bi, n_dev, d, block_chunk
-    )
-
-    spec = P(axis)
-    sharded = NamedSharding(mesh, spec)
-    put = lambda x: jax.device_put(x, sharded)
-
-    statics = dict(
-        mesh=mesh,
-        axis=axis,
-        bu=bu,
-        bi=bi,
-        rank=config.rank,
-        reg=config.reg,
-        implicit=config.implicit,
-        alpha=config.alpha,
-        block_chunk=block_chunk,
-        degree_scaled_reg=config.degree_scaled_reg,
-        solver=config.solver,
-        gather_dtype=config.gather_dtype,
-    )
-    def put_vals(v: np.ndarray):
-        """Upload a [n_dev, nb, d] ratings table in its smallest LOSSLESS
-        form: uint8 dictionary codes + a tiny replicated value table,
-        decoded once on device by a sharded gather (same contract as the
-        single-chip wire — every star-rating dataset fits; pad zeros join
-        the dictionary). Falls back to the full f32 table otherwise."""
-        codes, table = _compress_ratings_wire(v.reshape(-1))
-        if table is None or codes.dtype != np.uint8:
-            return put(v)
-        return _decode_ratings(
-            put(codes.reshape(v.shape)), jax.device_put(table), sharded
+    with xray.phase(xray.PHASE_HOST_ETL):
+        user_idx = np.asarray(user_idx, np.int32)
+        item_idx = np.asarray(item_idx, np.int32)
+        ratings = np.asarray(ratings, np.float32)
+        valid = (user_idx >= 0) & (item_idx >= 0)
+        user_idx, item_idx, ratings = (
+            user_idx[valid], item_idx[valid], ratings[valid]
         )
 
-    u_br, u_cols, u_v, u_w = u_blocks
-    i_br, i_cols, i_v, i_w = i_blocks
-    dev = (
-        put(u_br), put(u_cols), put_vals(u_v), put(u_w),
-        put(i_br), put(i_cols), put_vals(i_v), put(i_w),
-    )
-    # one iteration per launch — same watchdog/compile rationale as
-    # ops/als.py:_als_step; collectives still ride ICI inside each launch
-    uf, vf = _als_sharded_init(
-        mesh=mesh, axis=axis, bu=bu, bi=bi, rank=config.rank,
-        seed=config.seed, n_items=n_items,
-    )
+        bu = max(1, -(-n_users // n_dev))  # users per device block
+        bi = max(1, -(-n_items // n_dev))
+        d = max(8, min(config.block_d, config.chunk))
+        block_chunk = max(8, config.chunk // d)
+
+        u_blocks = _block_partition_blocked(
+            user_idx, item_idx, ratings, bu, n_dev, d, block_chunk
+        )
+        i_blocks = _block_partition_blocked(
+            item_idx, user_idx, ratings, bi, n_dev, d, block_chunk
+        )
+
+        spec = P(axis)
+        sharded = NamedSharding(mesh, spec)
+        put = lambda x: jax.device_put(x, sharded)
+
+        statics = dict(
+            mesh=mesh,
+            axis=axis,
+            bu=bu,
+            bi=bi,
+            rank=config.rank,
+            reg=config.reg,
+            implicit=config.implicit,
+            alpha=config.alpha,
+            block_chunk=block_chunk,
+            degree_scaled_reg=config.degree_scaled_reg,
+            solver=config.solver,
+            gather_dtype=config.gather_dtype,
+        )
+        def put_vals(v: np.ndarray):
+            """Upload a [n_dev, nb, d] ratings table in its smallest LOSSLESS
+            form: uint8 dictionary codes + a tiny replicated value table,
+            decoded once on device by a sharded gather (same contract as the
+            single-chip wire — every star-rating dataset fits; pad zeros join
+            the dictionary). Falls back to the full f32 table otherwise."""
+            codes, table = _compress_ratings_wire(v.reshape(-1))
+            if table is None or codes.dtype != np.uint8:
+                return put(v)
+            return _decode_ratings(
+                put(codes.reshape(v.shape)), jax.device_put(table), sharded
+            )
+
+        u_br, u_cols, u_v, u_w = u_blocks
+        i_br, i_cols, i_v, i_w = i_blocks
+        dev = (
+            put(u_br), put(u_cols), put_vals(u_v), put(u_w),
+            put(i_br), put(i_cols), put_vals(i_v), put(i_w),
+        )
+        # one iteration per launch — same watchdog/compile rationale as
+        # ops/als.py:_als_step; collectives still ride ICI inside each launch
+        uf, vf = _als_sharded_init(
+            mesh=mesh, axis=axis, bu=bu, bi=bi, rank=config.rank,
+            seed=config.seed, n_items=n_items,
+        )
+    import contextlib
+
+    nnz = int(user_idx.shape[0])
     for _ in range(config.iterations):
-        uf, vf = _als_sharded_step(uf, vf, *dev, **statics)
+        with contextlib.ExitStack() as stack:
+            rec = (
+                stack.enter_context(prof.step(nnz=nnz, mesh=str(dict(mesh.shape))))
+                if prof is not None
+                else None
+            )
+            with xray.phase(xray.PHASE_SWEEP):
+                uf, vf = _als_sharded_step(uf, vf, *dev, **statics)
+                if rec is not None:
+                    rec["metric"] = prof.device_barrier(
+                        uf, vf, where="als-sharded-sweep"
+                    )
+        if prof is not None:
+            with prof.phase(xray.PHASE_HOST_ETL):
+                prof.add_rows(nnz)
+                prof.sample_memory()
     # [n_dev, b+1, f] -> drop per-block dummy row, concatenate, trim padding
-    uf = _fetch(uf).reshape(n_dev, bu + 1, config.rank)[:, :bu].reshape(-1, config.rank)
-    vf = _fetch(vf).reshape(n_dev, bi + 1, config.rank)[:, :bi].reshape(-1, config.rank)
+    with xray.phase(xray.PHASE_HOST_ETL):
+        uf = _fetch(uf).reshape(n_dev, bu + 1, config.rank)[:, :bu].reshape(
+            -1, config.rank
+        )
+        vf = _fetch(vf).reshape(n_dev, bi + 1, config.rank)[:, :bi].reshape(
+            -1, config.rank
+        )
     return uf[:n_users], vf[:n_items]
 
 
 def _fetch(a) -> np.ndarray:
     """Device -> host, gathering across processes when the mesh spans hosts
-    (a multi-host sharded array is not addressable from any single host)."""
+    (a multi-host sharded array is not addressable from any single host).
+    The final fetch rides ``obs.xray.device_fetch`` so a profiled sharded
+    train accounts its readback stall like every other device wait."""
     if isinstance(a, jax.Array) and not a.is_fully_addressable:
         from jax.experimental import multihost_utils
 
         a = multihost_utils.process_allgather(a, tiled=True)
-    return np.asarray(a)
+    from predictionio_tpu.obs import xray
+
+    return xray.device_fetch(a, where="als-sharded-fetch")
 
 
 @functools.partial(

@@ -46,6 +46,12 @@ class ModelManifest:
     created_at: str = ""  # ISO-8601 UTC
     data_span: dict[str, Any] = dataclasses.field(default_factory=dict)
     metrics: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # training evidence: the obs/xray TrainProfile JSON of the run that
+    # produced this blob (step timeline, phase/device timings, memory
+    # peaks, capacity estimate) — `pio models show` renders it, `diff`
+    # compares wall clock and memory between versions. Empty for versions
+    # published before the profiler existed (or with PIO_XRAY=0).
+    train_profile: dict[str, Any] = dataclasses.field(default_factory=dict)
     blob_sha256: str = ""  # filled by the store on publish
     blob_size: int = 0
 

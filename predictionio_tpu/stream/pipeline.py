@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Any, Callable
 
+from predictionio_tpu.obs import xray
+from predictionio_tpu.obs.jaxprof import CompileWatcher
 from predictionio_tpu.obs.metrics import MetricsRegistry
 from predictionio_tpu.obs.tracing import get_tracer
 from predictionio_tpu.registry import ArtifactStore, ModelManifest
@@ -101,6 +103,18 @@ class StreamInstruments:
         self.drain_seconds = r.histogram(
             "pio_stream_drain_seconds", "drain wall time per micro-batch"
         )
+        # jit cache-miss watching for the fold-in loop: vocab growth in a
+        # stream trainer re-shapes the batched solve and retriggers XLA
+        # compiles — invisible until now because only serving processes
+        # carried a CompileWatcher. Sampled at every scrape (collector)
+        # and after every pipeline cycle; `pio top` renders the count on
+        # the stream line.
+        self.compile_watcher = CompileWatcher(r)
+        r.register_collector(self.compile_watcher.sample)
+        # the pio_train_* family exists (zero series) from process start:
+        # the fold-in profiler fills it, scrapers and the docs contract
+        # see it immediately
+        xray.register_train_metrics(r)
 
 
 class StreamPipeline:
@@ -153,18 +167,47 @@ class StreamPipeline:
         self._span_from = self.cursor.pos()
         self._pending_events = 0
         self._pending_absorbed = 0
+        # the fold-in step profiler: one TrainProfile per publish span
+        # (created at the first drain after a publish, finished into the
+        # candidate's manifest) — wall accumulates only inside run_once,
+        # so run_forever's sleeps never dilute the tiling contract
+        self._profile: xray.TrainProfile | None = None
         self._stop = threading.Event()
 
     # ------------------------------------------------------------------ run
+    def _ensure_profile(self) -> xray.TrainProfile:
+        if self._profile is None or self._profile.finished:
+            self._profile = xray.TrainProfile(
+                trainer=self.trainer.name,
+                registry=self.instruments.registry,
+                tracer=self.tracer,
+            )
+        return self._profile
+
     def run_once(self) -> dict[str, Any]:
         """One cycle: drain until caught up (bounded), fold, maybe publish.
-        Returns a JSON-ready summary."""
+        Returns a JSON-ready summary.
+
+        Profiler phases (obs/xray): drains, checkpoints, and the lag
+        probe account as ``host_etl``; each fold-in is one ``sweep``
+        step; the drift guard is ``eval``; snapshot+serialize is
+        ``host_etl`` again — together they tile the cycle's wall clock
+        (the stream half of the tiling contract test)."""
+        ins = self.instruments
+        prof = self._ensure_profile()
+        with xray.use_profile(prof), prof.measure():
+            summary = self._cycle(prof)
+        ins.compile_watcher.sample()
+        return summary
+
+    def _cycle(self, prof: xray.TrainProfile) -> dict[str, Any]:
         ins = self.instruments
         drained = 0
         backlog = False
         for _ in range(self.config.max_batches_per_cycle):
             t0 = time.perf_counter()
-            result = self.tailer.drain(self.cursor.pos())
+            with prof.phase(xray.PHASE_HOST_ETL):
+                result = self.tailer.drain(self.cursor.pos())
             if not result.events:
                 break
             # empty polls don't count: drains_total means batches that
@@ -173,12 +216,23 @@ class StreamPipeline:
             ins.drains.inc()
             with self.tracer.span(
                 "stream.foldin", kind="stream", trainer=self.trainer.name
-            ) as sp:
+            ) as sp, prof.step(events=len(result.events)) as rec:
                 t1 = time.perf_counter()
-                absorbed = self.trainer.absorb(result.events)
+                with prof.phase(xray.PHASE_SWEEP):
+                    absorbed = self.trainer.absorb(result.events)
                 ins.foldin_seconds.observe(time.perf_counter() - t1)
                 sp.tags["events"] = len(result.events)
                 sp.tags["absorbed"] = absorbed
+                stats = getattr(self.trainer, "last_absorb_stats", None)
+                if stats:
+                    # row/entity-touched cardinality from the trainer —
+                    # the fold's solve size scales with entities touched,
+                    # not with the raw event count
+                    sp.tags["rows"] = stats.get("rows")
+                    sp.tags["entities"] = stats.get("entities")
+                    rec["entities"] = stats.get("entities")
+                rec["metric"] = absorbed
+                prof.add_rows(absorbed)
             drained += len(result.events)
             ins.events.inc(len(result.events))
             self._pending_events += len(result.events)
@@ -186,12 +240,17 @@ class StreamPipeline:
             # checkpoint AFTER the fold: a crash between fold and save
             # re-reads this drain (at-least-once); a crash before the fold
             # loses nothing
-            self.cursor.advance(result.position, len(result.events))
-            self.cursors.save(self.cursor)
+            with prof.phase(xray.PHASE_HOST_ETL):
+                self.cursor.advance(result.position, len(result.events))
+                self.cursors.save(self.cursor)
             backlog = result.more
             if not result.more:
                 break
-        lag_n, lag_s = self.tailer.lag(self.cursor.pos(), assume_backlog=backlog)
+        with prof.phase(xray.PHASE_HOST_ETL):
+            lag_n, lag_s = self.tailer.lag(
+                self.cursor.pos(), assume_backlog=backlog
+            )
+            prof.sample_memory()
         ins.lag_events.set(lag_n)
         ins.lag_seconds.set(lag_s)
         published, suppressed = None, False
@@ -261,11 +320,13 @@ class StreamPipeline:
         cfg = self.config
         span_to = self.cursor.pos()
         span_id = span_id_of(self._span_from, span_to)
+        prof = self._profile
         with self.tracer.span(
             "stream.publish", kind="stream", engine_id=cfg.engine_id
         ) as sp:
             sp.tags["spanId"] = span_id
-            report = self.trainer.drift()
+            with xray.phase(xray.PHASE_EVAL):
+                report = self.trainer.drift()
             if not report.ok:
                 sp.status = "drift-suppressed"
                 sp.tags["reason"] = report.reason
@@ -280,12 +341,27 @@ class StreamPipeline:
                 # recognize it instead of minting a duplicate candidate —
                 # but DO re-stage it (the crash may have landed between
                 # publish and stage; _stage is a no-op for the auto-stable
-                # first publish and tolerates an already-staged version)
+                # first publish and tolerates an already-staged version).
+                # The replayed span keeps the manifest's original profile;
+                # this run's re-fold evidence is discarded with its span.
                 sp.tags["deduped"] = True
                 version = existing.version
+                if prof is not None:
+                    prof.finish()
+                    self._profile = None
                 self._stage(version)
             else:
-                blob = model_io.serialize_models(self.trainer.snapshot())
+                with xray.phase(xray.PHASE_HOST_ETL):
+                    blob = model_io.serialize_models(self.trainer.snapshot())
+                # the fold-in profile is this candidate's training
+                # evidence: finished here (publish I/O is outside it by
+                # causality — the manifest must embed a closed profile),
+                # attached both as the manifest's train_profile and under
+                # data_span.stream for parity with the batch path
+                profile_json: dict[str, Any] = {}
+                if prof is not None:
+                    profile_json = prof.finish().to_json_dict()
+                    self._profile = None
                 state = self.store.get_state(cfg.engine_id)
                 manifest = self.store.publish(
                     ModelManifest(
@@ -305,9 +381,11 @@ class StreamPipeline:
                                 "events": self._pending_events,
                                 "trainer": self.trainer.name,
                                 "drift": report.to_json_dict(),
+                                "profile": profile_json,
                             }
                         },
                         metrics={"driftMetric": report.metric},
+                        train_profile=profile_json,
                     ),
                     blob,
                     keep_last=cfg.keep_versions,

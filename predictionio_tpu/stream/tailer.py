@@ -123,6 +123,17 @@ class EventTailer:
                     events = events[:kept]
                     full = False
             sp.tags["events"] = len(events)
+            # row/entity cardinality of the drain: how many distinct
+            # entities this batch will touch downstream (the fold-in's
+            # solve size is proportional to it, not to the event count)
+            sp.tags["entities"] = len(
+                {e.entity_id for e in events}
+                | {
+                    e.target_entity_id
+                    for e in events
+                    if e.target_entity_id is not None
+                }
+            )
         if not events:
             return DrainResult([], position, False)
         return DrainResult(events, event_seq_key(events[-1]), full)

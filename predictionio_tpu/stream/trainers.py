@@ -88,7 +88,13 @@ class IncrementalTrainer(Protocol):
 
     def absorb(self, events: Sequence[Event]) -> int:
         """Fold a micro-batch in; returns the number of examples absorbed
-        (held-out and malformed events don't count)."""
+        (held-out and malformed events don't count).
+
+        Implementations may also set ``last_absorb_stats`` — a
+        ``{"rows": n, "entities": m}`` dict describing the batch just
+        folded (rows = examples absorbed, entities = distinct model
+        entities touched) — which the pipeline copies onto the
+        ``stream.foldin`` span tags."""
 
     def snapshot(self) -> list[Any]:
         """The serializable models list (what model_io persists)."""
@@ -187,6 +193,7 @@ class FoldInALSTrainer:
         self.drift_norm_ratio = drift_norm_ratio
         self.drift_min_samples = max(1, drift_min_samples)
         self.examples_absorbed = 0
+        self.last_absorb_stats: dict[str, int] = {"rows": 0, "entities": 0}
 
     # ---------------------------------------------------------------- absorb
     @staticmethod
@@ -251,6 +258,10 @@ class FoldInALSTrainer:
         if touched_items:
             self._fold(touched_items, self._item_ratings, "item_factors", "user_factors")
         self.examples_absorbed += absorbed
+        self.last_absorb_stats = {
+            "rows": absorbed,
+            "entities": len(touched_users) + len(touched_items),
+        }
         return absorbed
 
     def _fold(
@@ -261,11 +272,26 @@ class FoldInALSTrainer:
         fixed_attr: str,
     ) -> None:
         """Batched rank-f normal-equation solves for the touched entities
-        (one jit-compiled SPD solve for the whole set)."""
+        (one jit-compiled SPD solve for the whole set). The result fetch
+        rides ``obs.xray.device_fetch`` so a profiled fold-in accounts
+        its device stall into the step timeline."""
+        from predictionio_tpu.obs import xray
         from predictionio_tpu.ops.spd_solve import batched_spd_solve_auto
 
         fixed = getattr(self, fixed_attr)
         f = fixed.shape[1]
+        prof = xray.current_profile()
+        if prof is not None and prof.estimate is None:
+            # capacity-planner prediction for the factor tables this
+            # fold-in maintains — `pio top`'s est-vs-peak pair (parity
+            # with the batch trainer's preflight estimate)
+            prof.set_estimate(
+                xray.estimate_factors(
+                    int(self.user_factors.shape[0]),
+                    int(self.item_factors.shape[0]),
+                    int(f),
+                )
+            )
         order = sorted(touched)
         A = np.zeros((len(order), f, f), np.float32)
         b = np.zeros((len(order), f), np.float32)
@@ -279,7 +305,10 @@ class FoldInALSTrainer:
             V = fixed[opp]  # [n, f] gather against the FIXED side
             A[k] = V.T @ V + self.reg * max(1.0, len(pairs)) * eye
             b[k] = V.T @ r
-        solved = np.asarray(batched_spd_solve_auto(A, b), np.float32)
+        solved = np.asarray(
+            xray.device_fetch(batched_spd_solve_auto(A, b), where="foldin-solve"),
+            np.float32,
+        )
         table = getattr(self, solve_attr)
         table[order] = solved
         setattr(self, solve_attr, table)
@@ -410,6 +439,7 @@ class StreamingNaiveBayesTrainer:
         self._seed_model = seed_model
         self._stable_seeded = seed_model is not None
         self.examples_absorbed = 0
+        self.last_absorb_stats: dict[str, int] = {"rows": 0, "entities": 0}
 
     def _extract(self, e: Event):
         from predictionio_tpu.e2.naive_bayes import LabeledPoint
@@ -422,6 +452,7 @@ class StreamingNaiveBayesTrainer:
 
     def absorb(self, events: Sequence[Event]) -> int:
         absorbed = 0
+        touched_labels: set[str] = set()
         for e in events:
             p = self._extract(e)
             if p is None:
@@ -436,7 +467,12 @@ class StreamingNaiveBayesTrainer:
                 per_pos.append(Counter())
             for pos, v in enumerate(p.features):
                 per_pos[pos][v] += 1
+            touched_labels.add(p.label)
             absorbed += 1
+        self.last_absorb_stats = {
+            "rows": absorbed,
+            "entities": len(touched_labels),
+        }
         self.examples_absorbed += absorbed
         if self._seed_model is None and self._n:
             # baseline = the model after the FIRST absorbed batch: later
@@ -560,9 +596,11 @@ class StreamingCooccurrenceTrainer:
         self._baseline_hit_rate: float | None = None
         self._top_cache: dict[str, list[tuple[str, int]]] | None = None
         self.examples_absorbed = 0
+        self.last_absorb_stats: dict[str, int] = {"rows": 0, "entities": 0}
 
     def absorb(self, events: Sequence[Event]) -> int:
         absorbed = 0
+        touched: set[str] = set()
         for e in events:
             item = e.target_entity_id
             if item is None:
@@ -577,8 +615,10 @@ class StreamingCooccurrenceTrainer:
                 self._pair_counts[(item, other)] += 1
                 self._pair_counts[(other, item)] += 1
             items.add(item)
+            touched.add(item)
             self._top_cache = None  # counts changed; recompute on demand
             absorbed += 1
+        self.last_absorb_stats = {"rows": absorbed, "entities": len(touched)}
         self.examples_absorbed += absorbed
         if self._baseline_hit_rate is None and (
             len(self.holdout.held) >= self.drift_min_samples

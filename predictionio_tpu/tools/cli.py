@@ -647,6 +647,82 @@ def cmd_status(args) -> int:
     return 0
 
 
+def _parse_bytes(text: str) -> int:
+    """'16e9', '16000000000', '16GB', '16GiB' -> bytes."""
+    t = text.strip().lower()
+    for suffix, mult in (
+        ("gib", 1 << 30), ("mib", 1 << 20), ("kib", 1 << 10),
+        ("gb", 10**9), ("mb", 10**6), ("kb", 10**3), ("b", 1),
+    ):
+        if t.endswith(suffix):
+            return int(float(t[: -len(suffix)]) * mult)
+    return int(float(t))
+
+
+def cmd_doctor(args) -> int:
+    """Preflight diagnostics. ``--capacity USERS ITEMS K`` runs the HBM
+    capacity planner (obs/xray.estimate_factors): will this ALS train fit
+    per-device HBM? Exits nonzero when the estimate exceeds
+    ``--hbm-bytes`` — ROADMAP item 1's memory target as a gate instead of
+    an OOM. Without ``--capacity``: device inventory + live memory."""
+    from predictionio_tpu.obs import xray
+
+    if args.capacity:
+        users, items, k = (int(v) for v in args.capacity)
+        est = xray.estimate_factors(
+            users,
+            items,
+            k,
+            dtype=args.dtype,
+            mesh=args.mesh or None,
+            nnz=args.nnz,
+            gather_dtype=args.gather_dtype,
+        )
+        budget = _parse_bytes(args.hbm_bytes) if args.hbm_bytes else None
+        out = {
+            "capacity": est.to_json_dict(),
+            "hbmBudgetBytes": budget,
+            "fits": est.fits(budget) if budget is not None else None,
+        }
+        print(json.dumps(out, indent=2))
+        if budget is not None:
+            gb = est.per_device_bytes / 1e9
+            if not est.fits(budget):
+                print(
+                    f"EXCEEDS BUDGET: {gb:.2f} GB/device needed vs "
+                    f"{budget / 1e9:.2f} GB budget — shard wider (--mesh), "
+                    f"lower k, or bf16 the tables",
+                    file=sys.stderr,
+                )
+                return 1
+            print(
+                f"fits: {gb:.2f} GB/device of {budget / 1e9:.2f} GB budget "
+                f"({100.0 * est.per_device_bytes / budget:.1f}%)"
+            )
+        return 0
+    # inventory mode: what does this host actually have
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        print(f"backend: {jax.default_backend()}  devices: {len(devices)}")
+        per = xray.live_bytes_per_device()
+        for d in devices:
+            stats = getattr(d, "memory_stats", lambda: None)() or {}
+            live = per.get(str(d), 0)
+            line = f"  {d}  live {live} B"
+            if stats:
+                line += (
+                    f"  in_use {stats.get('bytes_in_use', 0)}"
+                    f"  peak {stats.get('peak_bytes_in_use', 0)}"
+                    f"  limit {stats.get('bytes_limit', 0)}"
+                )
+            print(line)
+    except Exception as exc:  # noqa: BLE001 - doctor reports, never crashes
+        print(f"devices unavailable: {exc}")
+    return 0
+
+
 def cmd_import(args) -> int:
     from predictionio_tpu.tools.import_export import import_events
 
@@ -827,6 +903,34 @@ def cmd_models_stage(args) -> int:
     return 0
 
 
+def _profile_delta_lines(label_a, label_b, pa: dict, pb: dict) -> list[str]:
+    """Human train-profile comparison: wall clock, device share, memory —
+    "did this version get slower or bigger to train" at a glance."""
+
+    def fmt_delta(va, vb, unit=""):
+        if not isinstance(va, (int, float)) or not isinstance(vb, (int, float)):
+            return f"{va} -> {vb}"
+        pct = f" ({(vb - va) / va * 100.0:+.1f}%)" if va else ""
+        return f"{va:g}{unit} -> {vb:g}{unit}{pct}"
+
+    lines = [f"train_profile ({label_a} -> {label_b}):"]
+    rows = (
+        ("wall clock", "wallClockS", "s"),
+        ("device time", "deviceS", "s"),
+        ("steps", "steps", ""),
+        ("rows/s", "rowsPerS", ""),
+    )
+    for title, key, unit in rows:
+        va, vb = pa.get(key), pb.get(key)
+        if va is not None or vb is not None:
+            lines.append(f"  {title}: {fmt_delta(va, vb, unit)}")
+    ma = (pa.get("memory") or {}).get("peakBytesPerDevice")
+    mb = (pb.get("memory") or {}).get("peakBytesPerDevice")
+    if ma is not None or mb is not None:
+        lines.append(f"  peak bytes/device: {fmt_delta(ma, mb, ' B')}")
+    return lines
+
+
 def cmd_models_diff(args) -> int:
     store = _models_store(args)
     engine_id = _models_engine_id(args)
@@ -836,6 +940,14 @@ def cmd_models_diff(args) -> int:
         missing = args.version_a if a is None else args.version_b
         return _die(f"unknown version {missing}; see `pio models list`")
     da, db = a.to_json_dict(), b.to_json_dict()
+    # the train profiles are compared as a wall/memory delta, not dumped
+    # raw (a step timeline in a field diff is unreadable); strip the copy
+    # embedded under data_span.stream for the same reason
+    pa, pb = da.pop("train_profile", None) or {}, db.pop("train_profile", None) or {}
+    for d in (da, db):
+        stream = d.get("data_span", {}).get("stream")
+        if isinstance(stream, dict):
+            stream.pop("profile", None)
     same = True
     for key in sorted(set(da) | set(db)):
         va, vb = da.get(key), db.get(key)
@@ -844,6 +956,11 @@ def cmd_models_diff(args) -> int:
             print(f"{key}:")
             print(f"  - {args.version_a}: {va}")
             print(f"  + {args.version_b}: {vb}")
+    if pa or pb:
+        for line in _profile_delta_lines(args.version_a, args.version_b, pa, pb):
+            print(line)
+        if pa != pb:
+            same = False
     if same:
         print(f"{args.version_a} and {args.version_b} are identical.")
     elif a.params_hash == b.params_hash:
@@ -1384,6 +1501,36 @@ def build_parser() -> argparse.ArgumentParser:
         "of the terminal screen (for CI and fleet tooling)",
     )
     x.set_defaults(fn=cmd_top)
+
+    x = sub.add_parser(
+        "doctor",
+        help="preflight diagnostics: HBM capacity planning "
+        "(--capacity USERS ITEMS K) and device/memory inventory",
+    )
+    x.add_argument(
+        "--capacity",
+        nargs=3,
+        metavar=("USERS", "ITEMS", "K"),
+        help="predict per-device bytes for an ALS train of this shape",
+    )
+    x.add_argument("--dtype", choices=["f32", "bf16"], default="f32")
+    x.add_argument(
+        "--gather-dtype",
+        choices=["f32", "bf16"],
+        default="f32",
+        help="solver gather dtype (bf16 adds half-size table copies)",
+    )
+    x.add_argument(
+        "--mesh",
+        help="mesh axis sizes, e.g. data=8,model=2 (explicit sizes only)",
+    )
+    x.add_argument("--nnz", type=int, help="rating count (adds wire bytes)")
+    x.add_argument(
+        "--hbm-bytes",
+        help="per-device HBM budget (accepts 16e9 / 16GB / 16GiB); "
+        "exit 1 when the estimate exceeds it",
+    )
+    x.set_defaults(fn=cmd_doctor)
 
     # data
     x = sub.add_parser("import")

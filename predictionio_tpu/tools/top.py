@@ -94,6 +94,10 @@ def _total(metrics: Metrics, name: str, **match: str) -> float:
     )
 
 
+def _max(metrics: Metrics, name: str) -> float:
+    return max((v for _labels, v in metrics.get(name, ())), default=0.0)
+
+
 def _histogram_quantile(
     metrics: Metrics, name: str, q: float, **match: str
 ) -> float:
@@ -171,9 +175,11 @@ def summarize(
     out["phases"] = _phase_summary(metrics)
     out["slo"] = _slo_summary(metrics)
     out["stream"] = _stream_summary(metrics, now)
+    out["train"] = _train_summary(metrics)
     out["qps"] = None
     out["shed_rate"] = None
     out["stream_drain_rate"] = None
+    out["train_step_rate"] = None
     if prev is not None and interval_s and interval_s > 0:
         d_req = requests - _total(prev, "pio_requests_total")
         d_shed = out["shed_total"] - _total(prev, "pio_load_shed_total")
@@ -184,6 +190,11 @@ def summarize(
                 prev, "pio_stream_drains_total"
             )
             out["stream_drain_rate"] = max(0.0, d_drain) / interval_s
+        if out["train"] is not None:
+            d_step = out["train"]["steps_total"] - _total(
+                prev, "pio_train_steps_total"
+            )
+            out["train_step_rate"] = max(0.0, d_step) / interval_s
     return out
 
 
@@ -262,6 +273,40 @@ def _stream_summary(metrics: Metrics, now: float | None) -> dict[str, Any] | Non
     }
 
 
+def _train_summary(metrics: Metrics) -> dict[str, Any] | None:
+    """The training screen, from the ``pio_train_*`` family (obs/xray):
+    which trainer is in which phase, iterations done, device-time share,
+    and the estimated-vs-measured HBM picture. None when no train
+    profiler exports into this endpoint."""
+    if "pio_train_steps_total" not in metrics:
+        return None
+    active: dict[str, str] = {}
+    for labels, v in metrics.get("pio_train_active", ()):
+        if v > 0 and labels.get("trainer"):
+            active[labels["trainer"]] = ""
+    for labels, v in metrics.get("pio_train_phase", ()):
+        trainer, ph = labels.get("trainer"), labels.get("phase")
+        if v > 0 and trainer in active and ph:
+            active[trainer] = ph
+    phase_wall = _total(metrics, "pio_train_phase_seconds_sum")
+    device = _total(metrics, "pio_train_device_seconds_total")
+    return {
+        "steps_total": _total(metrics, "pio_train_steps_total"),
+        "rows_total": _total(metrics, "pio_train_rows_total"),
+        "active": active,
+        "device_time_frac": (device / phase_wall) if phase_wall > 0 else 0.0,
+        # busiest trainer, not the sum: per-trainer peaks are independent
+        # samples of the same device pool — summing two 6 GB peaks would
+        # render an HBM picture no device ever had
+        "peak_bytes_per_device": _max(
+            metrics, "pio_train_peak_bytes_per_device"
+        ),
+        "est_bytes_per_device": _max(
+            metrics, "pio_train_est_bytes_per_device"
+        ),
+    }
+
+
 def _model_versions(metrics: Metrics) -> dict[str, dict[str, Any]]:
     """Per-model-version request/error totals and the lanes each version
     serves on, from the ``pio_model_*`` rollout counters."""
@@ -293,6 +338,18 @@ def format_number(v: Any, suffix: str = "") -> str:
     if isinstance(v, float) and not v.is_integer():
         return f"{v:.1f}{suffix}"
     return f"{int(v)}{suffix}"
+
+
+def format_bytes(v: Any) -> str:
+    """'-' for missing/zero; 1.2GB-style otherwise (decimal units — HBM
+    capacities are quoted decimal)."""
+    if not v:
+        return "-"
+    v = float(v)
+    for unit, div in (("GB", 1e9), ("MB", 1e6), ("KB", 1e3)):
+        if v >= div:
+            return f"{v / div:.1f}{unit}"
+    return f"{int(v)}B"
 
 
 def render(summary: dict[str, Any], url: str) -> str:
@@ -372,10 +429,36 @@ def render(summary: dict[str, Any], url: str) -> str:
         drains = f"drains {num(stream['drains_total'])}"
         if drain_rate is not None:
             drains = f"drains {num(drain_rate, '/s')} ({num(stream['drains_total'])})"
+        # the fold-in loop's jit cache misses ride the same endpoint: a
+        # vocab-growth recompile storm is a stream incident, so it shows
+        # on the stream line, not only in the recompiles row
         lines.append(
             f"  stream     lag {num(stream['lag_events'])} ev / "
             f"{num(round(stream['lag_seconds'], 1), 's')}   {drains}   "
             f"{published}   drift-suppressed {num(stream['drift_suppressed'])}"
+            f"   recompiles {num(summary.get('recompiles'))}"
+        )
+    train = summary.get("train")
+    if train is not None:
+        active = train.get("active") or {}
+        who = (
+            "  ".join(
+                f"{name}[{ph or 'idle'}]" for name, ph in sorted(active.items())
+            )
+            or "(idle)"
+        )
+        steps = f"steps {num(train['steps_total'])}"
+        rate = summary.get("train_step_rate")
+        if rate is not None:
+            steps += f" ({num(rate, '/s')})"
+        frac = train.get("device_time_frac") or 0.0
+        hbm = (
+            f"hbm peak {format_bytes(train.get('peak_bytes_per_device'))}"
+            f" / est {format_bytes(train.get('est_bytes_per_device'))}"
+        )
+        lines.append(
+            f"  train      {who}   {steps}   device {frac * 100.0:.0f}%   "
+            f"rows {num(train['rows_total'])}   {hbm}"
         )
     if summary.get("events_ingested"):
         lines.append(f"  ingested   {num(summary['events_ingested']):>12}")

@@ -28,6 +28,7 @@ from predictionio_tpu.data.storage.base import (
     Model,
 )
 from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.obs import xray
 from predictionio_tpu.workflow import model_io
 from predictionio_tpu.workflow.cleanup import CleanupFunctions
 from predictionio_tpu.workflow.context import WorkflowContext
@@ -155,19 +156,37 @@ def run_train(
     )
     instance_id = instances.insert(instance)
     logger.info("engine instance %s created", instance_id)
+    # the step profiler (obs/xray): phases tile the train wall clock,
+    # every iteration becomes a train.step span, and the finished profile
+    # rides the registry manifest as this version's training evidence.
+    # PIO_XRAY=0 opts out (restores the fully-async unprofiled dispatch).
+    profile: xray.TrainProfile | None = None
+    if os.environ.get("PIO_XRAY", "1").lower() not in ("0", "false", "off"):
+        profile = xray.TrainProfile(trainer=f"{manifest.engine_id}:batch")
     t0 = time.perf_counter()
     try:
         instance.status = EngineInstanceStatus.TRAINING
         instances.update(instance)
-        with _maybe_profile():
-            models = engine.train(ctx, engine_params, options)
-        if options and (options.stop_after_read or options.stop_after_prepare):
-            instance.status = EngineInstanceStatus.COMPLETED
-            instance.end_time = _dt.datetime.now(tz=UTC)
-            instances.update(instance)
-            return instance_id
-        persistable = engine.make_serializable_models(ctx, engine_params, models)
-        blob = model_io.serialize_models(persistable)
+        with contextlib.ExitStack() as scope:
+            if profile is not None:
+                scope.enter_context(xray.use_profile(profile))
+                scope.enter_context(profile.measure())
+            with _maybe_profile():
+                models = engine.train(ctx, engine_params, options)
+            if options and (
+                options.stop_after_read or options.stop_after_prepare
+            ):
+                instance.status = EngineInstanceStatus.COMPLETED
+                instance.end_time = _dt.datetime.now(tz=UTC)
+                instances.update(instance)
+                return instance_id
+            with xray.phase(xray.PHASE_HOST_ETL):
+                persistable = engine.make_serializable_models(
+                    ctx, engine_params, models
+                )
+                blob = model_io.serialize_models(persistable)
+        if profile is not None:
+            profile.finish()
         storage.get_model_data_models().insert(Model(instance_id, blob))
         wall = time.perf_counter() - t0
         instance.status = EngineInstanceStatus.COMPLETED
@@ -183,6 +202,7 @@ def run_train(
             batch,
             registry_dir,
             keep_versions,
+            train_profile=profile.to_json_dict() if profile is not None else {},
         )
         logger.info(
             "training completed: instance %s, %.2fs, %d model(s), %d byte blob",
@@ -210,10 +230,14 @@ def _publish_to_registry(
     batch: str,
     registry_dir: str | None,
     keep_versions: int,
+    train_profile: dict | None = None,
 ) -> None:
     """Write the trained blob into the artifact registry with its lineage
-    manifest. Atomic (tmp+rename inside the store); best-effort by
-    contract — a broken registry disk must not fail a completed train."""
+    manifest — including the train profile, so every version carries its
+    training evidence (`pio models show` answers "how was this trained,
+    how long, how big"). Atomic (tmp+rename inside the store);
+    best-effort by contract — a broken registry disk must not fail a
+    completed train."""
     registry_dir = registry_dir or os.environ.get("PIO_REGISTRY_DIR")
     if not registry_dir:
         return
@@ -238,6 +262,7 @@ def _publish_to_registry(
                     "batch": batch,
                     "trainWallClockSec": round(wall_s, 3),
                 },
+                train_profile=train_profile or {},
             ),
             blob,
             keep_last=keep_versions,

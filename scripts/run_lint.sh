@@ -32,6 +32,44 @@ PYEOF
     exit 1
   fi
   echo "perf-regression gate: passes unchanged run, trips injected slowdown"
+
+  # --- capacity-planner self-check (pio doctor; docs/observability.md) ----
+  # the planner must PASS a plan that fits ...
+  ./pio doctor --capacity 100000 50000 16 --hbm-bytes 16GB \
+    > /tmp/pio_doctor_fit.json
+  # ... and EXIT NONZERO on one that exceeds the budget
+  if ./pio doctor --capacity 10000000 1000000 128 --hbm-bytes 1MB \
+      > /tmp/pio_doctor_over.json 2>/dev/null; then
+    echo "pio doctor --capacity FAILED to flag an over-budget plan" >&2
+    exit 1
+  fi
+  echo "capacity planner: fits within budget, trips over budget"
+
+  # --- profiled CPU train smoke: the xray tiling contract end to end ------
+  env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+from predictionio_tpu.obs import xray
+from predictionio_tpu.ops.als import ALSConfig, als_train
+
+rng = np.random.default_rng(0)
+u = rng.integers(0, 300, 4000).astype(np.int32)
+i = rng.integers(0, 200, 4000).astype(np.int32)
+r = rng.normal(3.0, 1.0, 4000).astype(np.float32)
+prof = xray.TrainProfile("ci-smoke")
+with xray.use_profile(prof), prof.measure():
+    als_train(u, i, r, 300, 200, ALSConfig(rank=8, iterations=3, chunk=1024))
+pj = prof.finish().to_json_dict()
+assert pj["steps"] == 3, pj["steps"]
+ratio = pj["attributedS"] / pj["wallClockS"]
+assert 0.9 <= ratio <= 1.001, f"tiling contract broken in CI: {ratio:.3f}"
+assert pj["deviceS"] > 0.0
+print(
+    f"profiled train smoke: wall {pj['wallClockS']:.2f}s, "
+    f"attributed {100*ratio:.1f}%, device frac {pj['deviceTimeFrac']:.2f}, "
+    f"peak/dev {pj['memory']['peakBytesPerDevice']} B"
+)
+PYEOF
+
   # 3. a CPU-only bench smoke: the serving_local phase drives the real
   #    QueryServer over loopback and records the full phase waterfall —
   #    proving the evidence chain end to end on every CI run

@@ -492,6 +492,31 @@ class TestCompareBench:
             == "serving_local_phase_fetch_p95_ms"
         )
 
+    def test_train_step_phases_are_gated(self):
+        base = {**BASE, "train_step_sweep_ms": 100.0}
+        cur = {**base, "train_step_sweep_ms": 150.0}  # +50% > 25% tol
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is False
+        assert verdict["compare_regressions"][0]["field"] == "train_step_sweep_ms"
+
+    def test_train_memory_peak_is_gated(self):
+        base = {**BASE, "train_peak_bytes_per_device": 1_000_000.0}
+        cur = {**base, "train_peak_bytes_per_device": 2_000_000.0}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is False
+        assert (
+            verdict["compare_regressions"][0]["field"]
+            == "train_peak_bytes_per_device"
+        )
+
+    def test_train_device_frac_not_gated(self):
+        # the device-time share is recorded evidence, not a gate: on CPU
+        # backends it is tiny and ratio-noisy
+        base = {**BASE, "train_device_time_frac": 0.5}
+        cur = {**base, "train_device_time_frac": 0.1}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is True
+
     def test_sub_millisecond_noise_does_not_trip(self):
         # a 3x ratio on a 0.1ms phase is scheduler jitter, not a regression
         base = {**BASE, "serving_local_phase_serve_p50_ms": 0.1}

@@ -517,6 +517,78 @@ class TestStreamRules:
         assert rule_ids(active) == ["stream-unbounded-drain"]
 
 
+class TestTrainSyncRule:
+    def test_bare_syncs_fire_in_train_module(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+            import numpy as np
+
+            def train_loop(dev_arrays, x):
+                jax.block_until_ready(x)
+                host = np.asarray(x)
+                scalar = x.item()
+                return host, scalar
+            """,
+            display_path="pkg/ops/als.py",
+        )
+        assert rule_ids(active) == ["train-unaccounted-sync"] * 3
+
+    def test_two_arg_asarray_is_host_conversion_quiet(self):
+        # np.asarray(x, np.float32) is this codebase's HOST-input
+        # conversion idiom; the bare one-arg form is the device readback
+        active, _ = lint_snippet(
+            """
+            import numpy as np
+
+            def prep(ratings):
+                return np.asarray(ratings, np.float32)
+            """,
+            display_path="pkg/ops/als.py",
+        )
+        assert active == []
+
+    def test_sanctioned_forms_quiet(self):
+        active, _ = lint_snippet(
+            """
+            from predictionio_tpu.obs import xray
+            from predictionio_tpu.obs.jaxprof import timed_block_until_ready
+
+            def train_loop(x, registry):
+                timed_block_until_ready(x, registry, where="sweep")
+                return xray.device_fetch(x, where="sweep")
+            """,
+            display_path="pkg/stream/trainers.py",
+        )
+        assert active == []
+
+    def test_same_code_off_train_path_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import jax
+
+            def bench(x):
+                jax.block_until_ready(x)
+            """,
+            display_path="pkg/eval/fast_eval.py",
+        )
+        assert active == []
+
+    def test_suppression_with_reason_works(self):
+        active, suppressed = lint_snippet(
+            """
+            import numpy as np
+
+            def barrier(checksum):
+                # pio-lint: disable=train-unaccounted-sync -- this IS the instrument
+                return float(np.asarray(checksum))
+            """,
+            display_path="pkg/ops/als.py",
+        )
+        assert active == []
+        assert rule_ids(suppressed) == ["train-unaccounted-sync"]
+
+
 # ---------------------------------------------------------------------------
 # engine mechanics: suppression, severity, parse errors
 # ---------------------------------------------------------------------------

@@ -559,6 +559,16 @@ class TestEndToEndRollout:
         assert m.params_hash  # canonical hash of the engine params json
         assert m.blob_sha256 and m.blob_size > 0
         assert "trainWallClockSec" in m.data_span
+        # every batch publish carries its training evidence: the xray
+        # step-profiler JSON (phases tiling the wall clock) rides the
+        # manifest so `pio models show` answers "how was this trained"
+        assert m.train_profile, "run_train must attach a train_profile"
+        assert m.train_profile["wallClockS"] > 0
+        assert "host_etl" in m.train_profile["phases"]
+        assert "solve" in m.train_profile["phases"]
+        assert (
+            m.train_profile["attributedS"] <= m.train_profile["wallClockS"] * 1.001
+        )
         # the registry blob IS the deployable artifact
         blob = store.load_blob("regtest", "v000001")
         assert model_io.deserialize_models(blob)

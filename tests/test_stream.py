@@ -515,6 +515,12 @@ class TestStreamPipeline:
         assert span["events"] == 7
         assert span["trainer"] == "recording"
         assert span["spanId"].startswith("start..")
+        # every stream publish carries its fold-in evidence too — both as
+        # the manifest's train_profile (parity with the batch path) and
+        # embedded in the stream span
+        assert m.train_profile and m.train_profile["steps"] >= 1
+        assert span["profile"] == m.train_profile
+        assert "sweep" in m.train_profile["phases"]
         # staged as a candidate on the existing rollout path
         state = store.get_state("streameng")
         assert state.stable == "v000001"

@@ -1,0 +1,480 @@
+"""Tests for `pio xray` (obs/xray): training observability.
+
+The two acceptance rails:
+
+- **Tiling contract** — the step profiler's attributed phase time sums to
+  within 10% of the measured train wall clock, for both a batch ALS train
+  and a stream fold-in drain (CPU backend) — same contract style as the
+  PR-6 serving waterfall.
+- **Capacity planner** — `estimate_factors` lands within 15% of measured
+  live-array bytes for a small ALS train, and `pio doctor --capacity`
+  exits nonzero over an `--hbm-bytes` budget.
+
+Plus: profile mechanics (exclusive phase nesting, pause/resume wall
+accounting, metric export), the sharding inspector, and the `pio top`
+train line.
+"""
+
+import gc
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import xray
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tracing import Tracer
+
+
+# ---------------------------------------------------------------------------
+# TrainProfile mechanics (fake clock; no jax needed)
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+class TestTrainProfile:
+    def test_phases_nest_with_exclusive_time(self):
+        clock = FakeClock()
+        prof = xray.TrainProfile("t", clock=clock)
+        with prof.measure():
+            with prof.phase("solve"):
+                clock.tick(1.0)
+                with prof.phase("host_etl"):
+                    clock.tick(3.0)
+                clock.tick(0.5)
+        pj = prof.finish().to_json_dict()
+        assert pj["phases"]["solve"]["wallS"] == pytest.approx(1.5)
+        assert pj["phases"]["host_etl"]["wallS"] == pytest.approx(3.0)
+        # exclusive accounting: attributed == wall, nothing double-counted
+        assert pj["attributedS"] == pytest.approx(4.5)
+        assert pj["wallClockS"] == pytest.approx(4.5)
+
+    def test_wall_accumulates_only_inside_measure(self):
+        clock = FakeClock()
+        prof = xray.TrainProfile("t", clock=clock)
+        with prof.measure():
+            clock.tick(2.0)
+        clock.tick(100.0)  # the run_forever sleep — must not count
+        with prof.measure():
+            clock.tick(1.0)
+        assert prof.finish().wall_s == pytest.approx(3.0)
+
+    def test_steps_record_timeline_and_metrics_export(self):
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        prof = xray.TrainProfile("als", registry=reg, tracer=Tracer(), clock=clock)
+        with prof.measure():
+            for i in range(3):
+                with prof.step(nnz=10) as rec:
+                    with prof.phase("sweep"):
+                        clock.tick(0.5)
+                    rec["metric"] = float(i)
+                prof.add_rows(10)
+        pj = prof.finish().to_json_dict()
+        assert pj["steps"] == 3
+        assert pj["rowsTotal"] == 30
+        assert [r["metric"] for r in pj["timeline"]] == [0.0, 1.0, 2.0]
+        assert pj["timeline"][0]["phases"]["sweep"] == pytest.approx(0.5)
+        assert reg.get("pio_train_steps_total").value(trainer="als") == 3
+        assert reg.get("pio_train_rows_total").value(trainer="als") == 30
+        hist = reg.get("pio_train_phase_seconds")
+        assert hist.summary(trainer="als", phase="sweep")["count"] == 3
+
+    def test_timeline_bounded_aggregates_exact(self):
+        clock = FakeClock()
+        prof = xray.TrainProfile("t", timeline_cap=4, clock=clock)
+        with prof.measure():
+            for _ in range(10):
+                with prof.step():
+                    with prof.phase("sweep"):
+                        clock.tick(0.1)
+        pj = prof.finish().to_json_dict()
+        assert pj["steps"] == 10
+        assert len(pj["timeline"]) == 4
+        assert pj["timelineTruncated"] is True
+        assert pj["phases"]["sweep"]["count"] == 10
+
+    def test_device_time_attributes_to_current_phase(self):
+        clock = FakeClock()
+        prof = xray.TrainProfile("t", clock=clock)
+        with prof.measure(), prof.phase("sweep"):
+            prof.note_device_time(0.25, where="x")
+            clock.tick(1.0)
+        pj = prof.finish().to_json_dict()
+        assert pj["deviceS"] == pytest.approx(0.25)
+        assert pj["phases"]["sweep"]["deviceS"] == pytest.approx(0.25)
+
+    def test_module_helpers_noop_without_profile(self):
+        # no current profile: phase() must be a transparent no-op and
+        # device_fetch a plain asarray
+        with xray.phase("sweep"):
+            pass
+        out = xray.device_fetch([1, 2, 3])
+        assert list(out) == [1, 2, 3]
+
+    def test_timed_block_until_ready_feeds_profile(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from predictionio_tpu.obs.jaxprof import timed_block_until_ready
+
+        reg = MetricsRegistry()
+        prof = xray.TrainProfile("t")
+        with xray.use_profile(prof), prof.measure(), prof.phase("sweep"):
+            timed_block_until_ready(jnp.ones((8,)) * 2, reg, where="test")
+        pj = prof.finish().to_json_dict()
+        assert pj["phases"]["sweep"]["deviceS"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# tiling contract — batch ALS (acceptance)
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_ratings(n_users, n_items, nnz, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.integers(0, n_users, nnz).astype(np.int32),
+        rng.integers(0, n_items, nnz).astype(np.int32),
+        np.clip(rng.normal(3.0, 1.0, nnz), 1.0, 5.0).astype(np.float32),
+    )
+
+
+class TestBatchTilingContract:
+    def test_als_train_phases_tile_wall_clock(self):
+        from predictionio_tpu.ops.als import ALSConfig, als_train
+
+        u, i, r = _synthetic_ratings(300, 200, 4000)
+        prof = xray.TrainProfile("als-contract")
+        with xray.use_profile(prof), prof.measure():
+            als_train(u, i, r, 300, 200, ALSConfig(rank=8, iterations=3, chunk=1024))
+        pj = prof.finish().to_json_dict()
+        assert pj["steps"] == 3
+        assert pj["phases"]["sweep"]["count"] == 3
+        assert "host_etl" in pj["phases"]
+        # THE CONTRACT: attributed phase time tiles the wall clock
+        ratio = pj["attributedS"] / pj["wallClockS"]
+        assert 0.9 <= ratio <= 1.001, f"tiling broken: {ratio:.3f}"
+        # device time was accounted (the per-step barrier) and the
+        # convergence metric rode every step
+        assert pj["deviceS"] > 0.0
+        assert all(rec["metric"] is not None for rec in pj["timeline"])
+        assert pj["rowsTotal"] == 3 * 4000
+
+    def test_run_train_attaches_profile_to_registry_manifest(self, tmp_path):
+        # the batch half of acceptance #3 rides the real run_train path in
+        # tests/test_registry.py::test_train_publishes_lineage; this is
+        # the direct unit: profile JSON lands on the manifest
+        from predictionio_tpu.registry import ArtifactStore, ModelManifest
+
+        prof = xray.TrainProfile("unit")
+        with prof.measure(), prof.phase("solve"):
+            pass
+        store = ArtifactStore(str(tmp_path))
+        m = store.publish(
+            ModelManifest(
+                version="", engine_id="e", engine_version="1",
+                engine_variant="v", train_profile=prof.finish().to_json_dict(),
+            ),
+            b"blob",
+        )
+        loaded = store.get_manifest("e", m.version)
+        assert loaded.train_profile["trainer"] == "unit"
+        assert "solve" in loaded.train_profile["phases"]
+
+
+# ---------------------------------------------------------------------------
+# tiling contract — stream fold-in drain (acceptance)
+# ---------------------------------------------------------------------------
+
+
+class TestStreamTilingContract:
+    def test_foldin_drain_phases_tile_wall_clock(self, tmp_path):
+        from predictionio_tpu.models.recommendation.engine import ALSModel
+        from predictionio_tpu.stream import FoldInALSTrainer
+        from tests.test_stream import APP, _levents, _pipeline, rate_event
+
+        rng = np.random.default_rng(1)
+        seed_model = ALSModel(
+            rng.normal(size=(6, 4)).astype(np.float32),
+            rng.normal(size=(5, 4)).astype(np.float32),
+            [f"u{i}" for i in range(6)],
+            [f"i{i}" for i in range(5)],
+        )
+        l = _levents()
+        l.init(APP)
+        for n in range(40):
+            l.insert(rate_event(f"u{n % 6}", f"i{n % 5}", 3.0 + (n % 3), n), APP)
+        trainer = FoldInALSTrainer([seed_model])
+        pipeline, store, ins = _pipeline(tmp_path, l, trainer, batch_limit=10)
+        summary = pipeline.run_once()
+        assert summary["published"] is not None
+        m = store.list_versions("streameng")[-1]
+        pj = m.train_profile
+        assert pj, "stream publish must carry a train profile"
+        # parity: the same profile is embedded under data_span.stream
+        assert m.data_span["stream"]["profile"] == pj
+        assert pj["steps"] >= 1  # one step per drained batch
+        assert pj["phases"]["sweep"]["count"] >= 1
+        assert "eval" in pj["phases"]  # the drift guard
+        assert "host_etl" in pj["phases"]  # drain + checkpoint + serialize
+        ratio = pj["attributedS"] / pj["wallClockS"]
+        assert 0.9 <= ratio <= 1.001, f"stream tiling broken: {ratio:.3f}"
+        # foldin span carries the row/entity cardinality tags
+        spans = [
+            s
+            for s in pipeline.tracer.recent()
+            if s["name"] == "stream.foldin"
+        ]
+        assert spans and "entities" in spans[0]["tags"]
+        assert "rows" in spans[0]["tags"]
+
+    def test_profile_resets_per_publish_span(self, tmp_path):
+        from tests.test_stream import APP, RecordingTrainer, _levents, _pipeline, rate_event
+
+        l = _levents()
+        l.init(APP)
+        for n in range(3):
+            l.insert(rate_event(f"u{n}", "i0", 3.0, n), APP)
+        pipeline, store, _ = _pipeline(tmp_path, l, RecordingTrainer())
+        assert pipeline.run_once()["published"] == "v000002"
+        first = store.get_manifest("streameng", "v000002").train_profile
+        assert first["steps"] >= 1
+        for n in range(3, 6):
+            l.insert(rate_event(f"u{n}", "i0", 3.0, n), APP)
+        assert pipeline.run_once()["published"] == "v000003"
+        second = store.get_manifest("streameng", "v000003").train_profile
+        # a fresh profile per span: step counts don't accumulate across
+        # publishes, and the second span's evidence is its own
+        assert second["steps"] >= 1
+        assert second["steps"] <= first["steps"] + 1
+
+
+# ---------------------------------------------------------------------------
+# capacity planner (acceptance: 15% + doctor exit codes)
+# ---------------------------------------------------------------------------
+
+
+class TestCapacityPlanner:
+    def test_mesh_parsing_forms(self):
+        for mesh, n in (
+            (None, 1),
+            (4, 4),
+            ("8", 8),  # bare device count
+            ("data=4,model=2", 8),
+            ({"data": 2}, 2),
+        ):
+            assert xray.estimate_factors(10, 10, 4, mesh=mesh).n_devices == n
+
+    def test_malformed_mesh_raises_instead_of_silent_one_device(self):
+        # a size-less axis must NOT silently mean 1 device — that turns
+        # "fits on 8 chips" into a spurious EXCEEDS BUDGET verdict
+        for bad in ("data", "data=,model=2", {"data": 0}):
+            with pytest.raises(ValueError):
+                xray.estimate_factors(10, 10, 4, mesh=bad)
+
+    def test_sharding_divides_and_gather_transient_adds(self):
+        one = xray.estimate_factors(10_000, 5_000, 32)
+        eight = xray.estimate_factors(10_000, 5_000, 32, mesh=8)
+        assert eight.per_device_bytes < one.per_device_bytes
+        # the gathered opposite table is resident in full per device
+        assert eight.per_device_bytes > one.total_bytes // 8
+
+    def test_estimate_within_15pct_of_measured_live_bytes(self):
+        pytest.importorskip("jax")
+        from predictionio_tpu.ops.als import ALSConfig, als_train, fetch_barrier
+
+        n_users, n_items, k = 4000, 2000, 16
+        u, i, r = _synthetic_ratings(n_users, n_items, 20_000, seed=2)
+        # warm the jit caches so compiled-constant allocation (paid once
+        # per process) doesn't ride the measured delta
+        als_train(u, i, r, n_users, n_items, ALSConfig(rank=k, iterations=1))
+        gc.collect()
+        base = xray.live_array_bytes()
+        uf, vf = als_train(
+            u, i, r, n_users, n_items, ALSConfig(rank=k, iterations=2)
+        )
+        fetch_barrier(uf, vf)
+        gc.collect()
+        measured = xray.live_array_bytes() - base
+        est = xray.estimate_factors(n_users, n_items, k)
+        assert measured > 0
+        err = abs(measured - est.factor_bytes) / est.factor_bytes
+        assert err <= 0.15, (
+            f"estimate {est.factor_bytes} vs measured {measured} "
+            f"({err:.1%} off)"
+        )
+        del uf, vf
+
+    def test_doctor_capacity_exit_codes(self, capsys):
+        from predictionio_tpu.tools.cli import main
+
+        rc = main(
+            [
+                "doctor", "--capacity", "100000", "50000", "16",
+                "--hbm-bytes", "16GB",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert json.loads(out.split("fits:")[0])["fits"] is True
+        rc = main(
+            [
+                "doctor", "--capacity", "10000000", "1000000", "128",
+                "--hbm-bytes", "1MB",
+            ]
+        )
+        assert rc == 1
+
+    def test_doctor_mesh_and_nnz_flags(self, capsys):
+        from predictionio_tpu.tools.cli import main
+
+        rc = main(
+            [
+                "doctor", "--capacity", "1000", "500", "8",
+                "--mesh", "data=4", "--nnz", "100000",
+            ]
+        )
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["capacity"]["n_devices"] == 4
+        assert report["capacity"]["wire_bytes"] == 2 * 100000 * 9
+
+
+# ---------------------------------------------------------------------------
+# sharding inspector
+# ---------------------------------------------------------------------------
+
+
+class TestShardingInspector:
+    def test_count_collectives_on_hlo_text(self):
+        text = "\n".join(
+            [
+                "  %ag = f32[8]{0} all-gather(f32[2]{0} %x), dimensions={0}",
+                "  %ar = f32[8]{0} all-reduce(f32[8]{0} %y), to_apply=%sum",
+                "  %ar2 = f32[8]{0} all-reduce(f32[8]{0} %z), to_apply=%sum",
+                "  %rs = f32[2]{0} reduce-scatter(f32[8]{0} %w)",
+                "  not_a_collective = f32[] constant(0)",
+            ]
+        )
+        assert xray.count_collectives(text) == {
+            "all_gather": 1,
+            "all_reduce": 2,
+            "reduce_scatter": 1,
+        }
+
+    def test_count_collectives_async_tpu_spellings(self):
+        # TPU optimized HLO emits async start/done pairs: count the start
+        # (one op), never the matching done (would double-count)
+        text = "\n".join(
+            [
+                "  %ags = (f32[2]{0}, f32[8]{0}) all-gather-start(f32[2]{0} %x), dimensions={0}",
+                "  %agd = f32[8]{0} all-gather-done((f32[2]{0}, f32[8]{0}) %ags)",
+                "  %ars = f32[8]{0} all-reduce-start(f32[8]{0} %y), to_apply=%sum",
+                "  %ard = f32[8]{0} all-reduce-done(f32[8]{0} %ars)",
+            ]
+        )
+        assert xray.count_collectives(text) == {
+            "all_gather": 1,
+            "all_reduce": 1,
+        }
+
+    def test_describe_and_inspect_single_device(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        x = jnp.ones((16, 4))
+        entries = xray.describe_shardings({"table": x})
+        assert len(entries) == 1
+        e = entries[0]
+        assert e["bytes"] == 16 * 4 * 4
+        assert e["devices"] == 1
+        # single-device arrays are NOT flagged replicated (trivially true)
+        assert e["replicated"] is False
+
+        fn = jax.jit(lambda a: a * 2)
+        report = xray.inspect_train_step(fn, x)
+        assert report["arrays"] and report["flags"] == []
+        assert "error" not in report or report["error"] is None
+
+    def test_find_replicated_thresholds(self):
+        entries = [
+            {"name": "big", "replicated": True, "bytes": 2 << 20, "devices": 8},
+            {"name": "small", "replicated": True, "bytes": 128, "devices": 8},
+            {"name": "sharded", "replicated": False, "bytes": 4 << 20, "devices": 8},
+        ]
+        assert [e["name"] for e in xray.find_replicated(entries)] == ["big"]
+
+
+# ---------------------------------------------------------------------------
+# pio top train line
+# ---------------------------------------------------------------------------
+
+TRAIN_METRICS_TEXT = """
+pio_train_steps_total{trainer="als-foldin"} 42
+pio_train_rows_total{trainer="als-foldin"} 1234
+pio_train_active{trainer="als-foldin"} 1
+pio_train_phase{trainer="als-foldin",phase="sweep"} 1
+pio_train_phase_seconds_sum{trainer="als-foldin",phase="sweep"} 8.0
+pio_train_phase_seconds_count{trainer="als-foldin",phase="sweep"} 42
+pio_train_phase_seconds_bucket{trainer="als-foldin",phase="sweep",le="+Inf"} 42
+pio_train_device_seconds_total{trainer="als-foldin",phase="sweep"} 2.0
+pio_train_peak_bytes_per_device{trainer="als-foldin"} 1200000
+pio_train_est_bytes_per_device{trainer="als-foldin"} 1500000
+pio_stream_drains_total 10
+pio_stream_lag_events 3
+pio_stream_lag_seconds 0.5
+pio_stream_publishes_total 2
+pio_stream_drift_suppressed_total 0
+pio_jit_cache_misses_total{fn="spd_solve"} 7
+"""
+
+
+class TestTopTrainLine:
+    def test_train_summary_fields(self):
+        from predictionio_tpu.tools.top import parse_prometheus, summarize
+
+        m = parse_prometheus(TRAIN_METRICS_TEXT)
+        s = summarize(m, now=100.0)
+        t = s["train"]
+        assert t["steps_total"] == 42
+        assert t["rows_total"] == 1234
+        assert t["active"] == {"als-foldin": "sweep"}
+        assert t["device_time_frac"] == pytest.approx(0.25)
+        assert t["peak_bytes_per_device"] == 1200000
+
+    def test_step_rate_from_two_samples(self):
+        from predictionio_tpu.tools.top import parse_prometheus, summarize
+
+        prev = parse_prometheus('pio_train_steps_total{trainer="t"} 40')
+        cur = parse_prometheus('pio_train_steps_total{trainer="t"} 44')
+        s = summarize(cur, prev=prev, interval_s=2.0)
+        assert s["train_step_rate"] == pytest.approx(2.0)
+
+    def test_render_shows_train_and_stream_recompiles(self):
+        from predictionio_tpu.tools.top import parse_prometheus, render, summarize
+
+        m = parse_prometheus(TRAIN_METRICS_TEXT)
+        screen = render(summarize(m, now=100.0), "http://x")
+        assert "train      als-foldin[sweep]" in screen
+        assert "device 25%" in screen
+        assert "hbm peak 1.2MB / est 1.5MB" in screen
+        # the fold-in recompile count rides the stream line
+        assert "drift-suppressed 0   recompiles 7" in screen
+
+    def test_absent_family_renders_no_train_line(self):
+        from predictionio_tpu.tools.top import parse_prometheus, render, summarize
+
+        s = summarize(parse_prometheus("pio_requests_total 5"))
+        assert s["train"] is None
+        assert "train " not in render(s, "http://x")
