@@ -44,6 +44,7 @@ elsewhere (CPU dev boxes) or when PIO_BENCH_SCALE=ml100k.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import re
@@ -558,7 +559,12 @@ def phase_serving(ck: _Checkpoint) -> None:
     # (aiohttp + micro-batch dispatcher coalescing into batched device calls).
     # This is what a user of `pio deploy` experiences under load.
     server_stats = _bench_server_e2e(uf, vf, k)
-    ck.save(**{kk: round(vv, 3) for kk, vv in server_stats.items()})
+    ck.save(
+        **{
+            kk: (vv if isinstance(vv, bool) else round(vv, 3))
+            for kk, vv in server_stats.items()
+        }
+    )
 
     ec_p50, ec_reads = _bench_ecommerce_serving()
     ck.save(
@@ -624,7 +630,9 @@ def phase_serving_local(ck: _Checkpoint) -> None:
     stats = _bench_server_e2e(uf, vf, k=10)
     ck.save(
         **{
-            kk.replace("serving_", "serving_local_"): round(vv, 3)
+            kk.replace("serving_", "serving_local_"): (
+                vv if isinstance(vv, bool) else round(vv, 3)
+            )
             for kk, vv in stats.items()
         }
     )
@@ -800,7 +808,15 @@ def _bench_server_e2e(
                 ),
                 instance_id="bench",
                 storage=storage,
-                config=ServerConfig(ip="127.0.0.1", port=port, max_batch_size=32),
+                # result cache sized for the bench's zipf-free uniform user
+                # draw: repeats within a pass hit; the dedicated hit pass
+                # below measures the cached path in isolation
+                config=ServerConfig(
+                    ip="127.0.0.1",
+                    port=port,
+                    max_batch_size=32,
+                    result_cache_size=4096,
+                ),
             )
             await server.start()
             server_box["server"] = server
@@ -820,53 +836,152 @@ def _bench_server_e2e(
     rng = np.random.default_rng(7)
     users = [f"u{int(u)}" for u in rng.integers(0, n_users, n_requests)]
 
-    # warm the [B]-shaped programs the dispatcher will hit
-    warm_conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
-    for u in users[:4]:
+    import socket as _socket
+
+    def _post_one(conn, u: str) -> None:
         body = json.dumps({"user": u, "num": k})
-        warm_conn.request(
+        conn.request(
             "POST", "/queries.json", body, {"Content-Type": "application/json"}
         )
-        resp = warm_conn.getresponse()
+        resp = conn.getresponse()
         resp.read()
         if resp.status != 200:
-            raise RuntimeError("serving bench warmup failed")
+            raise RuntimeError(f"serving bench request failed ({resp.status})")
+
+    # warm the [B]-shaped programs the dispatcher will hit; the warm conn
+    # also pins TCP_NODELAY on the query socket (the client half — aiohttp
+    # applies it to every accepted server connection) and records it
+    warm_conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    warm_conn.connect()
+    warm_conn.sock.setsockopt(
+        _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+    )
+    tcp_nodelay = bool(
+        warm_conn.sock.getsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY)
+    )
+    for u in users[:4]:
+        _post_one(warm_conn, u)
     warm_conn.close()
 
+    # cold-connection pass: a fresh TCP connection per request, so
+    # transport wins (keep-alive) are attributed separately from kernel or
+    # host-glue wins instead of conflated into one e2e number. Starts from
+    # a flushed cache — a sampled-with-replacement duplicate answering
+    # from the cache would under-price the full-dispatch cost this field
+    # exists to attribute
+    _cold_cache = server_box["server"]._result_cache
+    if _cold_cache is not None:
+        _cold_cache.clear()
+    cold_lat = []
+    for u in users[-32:]:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        t0 = time.perf_counter()
+        _post_one(conn, u)
+        cold_lat.append(time.perf_counter() - t0)
+        conn.close()
+
     # load generators are separate *processes* (an in-process client would
-    # share the GIL/event loop with the server and measure itself instead)
+    # share the GIL/event loop with the server and measure itself instead).
+    # The client itself is deliberately thin — threaded raw-socket HTTP/1.1
+    # over persistent keep-alive connections, ONE sendall and a minimal
+    # recv-parse per request: an async-framework client costs multiple ms
+    # of CPU and several syscalls per request on a small host, which
+    # saturates the GENERATOR and reports its own queueing as server
+    # latency. Blocking sockets release the GIL, so `conc` threads overlap.
     client_src = r"""
-import asyncio, json, sys, time
-import aiohttp
+import json, socket, sys, threading, time
 
 port, conc, k = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
 users = sys.stdin.read().split()
 
-async def main():
-    lat = []
-    errors = 0
-    async with aiohttp.ClientSession() as s:
-        sem = asyncio.Semaphore(conc)
-        async def one(u):
-            nonlocal errors
-            async with sem:
-                t0 = time.perf_counter()
-                async with s.post(
-                    f"http://127.0.0.1:{port}/queries.json",
-                    json={"user": u, "num": k},
-                ) as r:
-                    await r.read()
-                    if r.status != 200:
-                        errors += 1
-                lat.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        await asyncio.gather(*(one(u) for u in users))
-        elapsed = time.perf_counter() - t0
-    print(json.dumps({"elapsed": elapsed, "lat": lat, "errors": errors}))
+lat, errors, conns = [], 0, 0
+lock = threading.Lock()
 
-asyncio.run(main())
+REQ = (
+    "POST /queries.json HTTP/1.1\r\nHost: 127.0.0.1\r\n"
+    "Content-Type: application/json\r\nContent-Length: %d\r\n\r\n"
+)
+
+
+def _connect():
+    s = socket.create_connection(("127.0.0.1", port), timeout=60)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def _one(sock, wire: bytes) -> int:
+    sock.sendall(wire)  # headers+body in one syscall (and one packet)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise OSError("connection closed")
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    status = int(head.split(None, 2)[1])
+    clen = 0
+    for line in head.split(b"\r\n")[1:]:
+        name, _, value = line.partition(b":")
+        if name.strip().lower() == b"content-length":
+            clen = int(value)
+            break
+    while len(rest) < clen:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise OSError("connection closed")
+        rest += chunk
+    return status
+
+
+def worker(chunk):
+    # one persistent connection per worker; a server-side close shows up
+    # as a reconnect in `conns` (keep-alive regressions become visible)
+    global errors, conns
+    my_lat, my_errors, my_conns = [], 0, 1
+    sock = _connect()
+    try:
+        for u in chunk:
+            body = json.dumps({"user": u, "num": k}).encode()
+            wire = (REQ % len(body)).encode() + body
+            t0 = time.perf_counter()
+            for attempt in (0, 1):
+                try:
+                    if _one(sock, wire) != 200:
+                        my_errors += 1
+                    break
+                except OSError:
+                    # stale keep-alive connection: reconnect once, retry
+                    sock.close()
+                    sock = _connect()
+                    my_conns += 1
+                    if attempt:
+                        my_errors += 1
+            my_lat.append(time.perf_counter() - t0)
+    finally:
+        sock.close()
+    with lock:
+        lat.extend(my_lat)
+        errors += my_errors
+        conns += my_conns
+
+
+chunks = [users[i::conc] for i in range(conc)]
+threads = [
+    threading.Thread(target=worker, args=(ch,)) for ch in chunks if ch
+]
+t0 = time.perf_counter()
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+elapsed = time.perf_counter() - t0
+print(json.dumps(
+    {"elapsed": elapsed, "lat": lat, "errors": errors, "conns": conns}
+))
 """
-    def run_load(load_users: list[str], concurrency: int) -> tuple[list[float], float]:
+    def run_load(
+        load_users: list[str], concurrency: int
+    ) -> tuple[list[float], float, int]:
         n_procs = 2
         per_proc_conc = max(1, concurrency // n_procs)
         chunks = [load_users[i::n_procs] for i in range(n_procs)]
@@ -890,21 +1005,62 @@ asyncio.run(main())
         lat: list[float] = []
         n_errors = 0
         elapsed = 0.0
+        conns = 0
         for out in outs:
             stats = json.loads(out)
             lat.extend(stats["lat"])
             n_errors += stats["errors"]
             elapsed = max(elapsed, stats["elapsed"])
+            conns += stats.get("conns", 0)
         if n_errors:
             raise RuntimeError(f"serving bench saw {n_errors} non-200 responses")
-        return lat, elapsed
+        return lat, elapsed, conns
 
-    lat_pass, _ = run_load(users[: n_requests // 2], latency_concurrency)
+    # each timed pass gets an INDEPENDENT user sample and starts from a
+    # flushed result cache: repeats *within* a pass hit (representative of
+    # the sampled query distribution), but the latency pass must not
+    # pre-populate the cache for the throughput pass — a cache-inflated
+    # qps could hide a dispatch-path regression from the --compare gate
+    _cache = server_box["server"]._result_cache
+    if _cache is not None:
+        _cache.clear()
+    lat_pass, _, lat_conns = run_load(users[: n_requests // 2], latency_concurrency)
     # snapshot counters so avg_batch reflects the throughput pass only (the
     # latency pass batches at its concurrency, by design)
     _b2 = server_box["server"]._batcher
     warm_queries, warm_batches = _b2.queries_dispatched, _b2.batches_dispatched
-    tput_pass, tput_elapsed = run_load(users, throughput_concurrency)
+    tput_users = [f"u{int(u)}" for u in rng.integers(0, n_users, n_requests)]
+    if _cache is not None:
+        _cache.clear()
+    tput_pass, tput_elapsed, tput_conns = run_load(tput_users, throughput_concurrency)
+    # keep-alive attribution: with connection reuse each generator holds at
+    # most its concurrency in the pool; anything near one-conn-per-request
+    # means the transport win is NOT being measured
+    keepalive = bool(
+        lat_conns <= 2 * latency_concurrency
+        and tput_conns <= 2 * throughput_concurrency
+    )
+
+    # snapshot the cache counters NOW, while they reflect only the timed
+    # load passes: the synthetic 64-hit pass below would inflate the
+    # recorded hit ratio far past the sampled query mix's real one
+    cache = server_box["server"]._result_cache
+    cache_stats = cache.stats() if cache is not None else {}
+    cache_lookups = cache_stats.get("hits", 0.0) + cache_stats.get("misses", 0.0)
+
+    # cached-hit pass: one already-answered query repeated on a warm
+    # keep-alive connection — the pure result-cache path (never enters the
+    # micro-batch queue); sequential so each sample is one clean RTT
+    hit_conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    hit_conn.connect()
+    hit_conn.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    _post_one(hit_conn, users[0])  # prime the entry
+    hit_lat = []
+    for _ in range(64):
+        t0 = time.perf_counter()
+        _post_one(hit_conn, users[0])
+        hit_lat.append(time.perf_counter() - t0)
+    hit_conn.close()
 
     batcher = server_box["server"]._batcher
     # snapshot the server's own metrics registry before shutdown: the
@@ -924,6 +1080,8 @@ asyncio.run(main())
     loop.call_soon_threadsafe(loop.stop)
     thread.join(timeout=10)
     lat_ms = np.asarray(lat_pass) * 1000.0
+    cold_ms = np.asarray(cold_lat) * 1000.0
+    hit_ms = np.asarray(hit_lat) * 1000.0
     return {
         "serving_e2e_p50_ms": float(np.percentile(lat_ms, 50)),
         "serving_e2e_p95_ms": float(np.percentile(lat_ms, 95)),
@@ -932,6 +1090,21 @@ asyncio.run(main())
             (batcher.queries_dispatched - warm_queries)
             / max(1, batcher.batches_dispatched - warm_batches)
         ),
+        # transport attribution (ISSUE 8): keep-alive verified by counting
+        # real TCP connects in the load generators; the cold-connection
+        # pair is the per-request price of NOT reusing connections
+        "serving_keepalive": keepalive,
+        "serving_tcp_nodelay": tcp_nodelay,
+        "serving_cold_conn_p50_ms": float(np.percentile(cold_ms, 50)),
+        "serving_cold_conn_p95_ms": float(np.percentile(cold_ms, 95)),
+        # version-keyed result cache: hit ratio over the whole run + the
+        # e2e latency of the pure cached path (one repeated query)
+        "serving_cache_hit_ratio": (
+            float(cache_stats.get("hits", 0.0) / cache_lookups)
+            if cache_lookups
+            else 0.0
+        ),
+        "serving_cache_hit_p50_ms": float(np.percentile(hit_ms, 50)),
         **obs,
     }
 
@@ -1762,10 +1935,30 @@ def main() -> int:
         default=0.25,
         help="relative regression tolerance for --compare (default 0.25)",
     )
+    parser.add_argument(
+        "--no-compare",
+        action="store_true",
+        help="skip the automatic perf-regression gate against the "
+        "checked-in BENCH_r*.json rounds",
+    )
     args = parser.parse_args()
 
     if args.current and not args.compare:
+        # --current is CI fixture mode: the caller must name its baseline
+        # explicitly — the checked-in-rounds auto-default below is only for
+        # full measurement runs
         parser.error("--current requires --compare")
+
+    if not args.compare and not args.no_compare:
+        # default gate: every full run is compared against the checked-in
+        # prior rounds, so the perf trajectory is held (not just recorded)
+        # even when the orchestrator invokes a bare `python bench.py`
+        auto_priors = sorted(
+            glob.glob(os.path.join(os.path.dirname(__file__) or ".", "BENCH_r*.json"))
+        )
+        if auto_priors:
+            args.compare = auto_priors
+
     if args.compare and args.current:
         # pure compare mode: no phases, no jax — gate file against file(s)
         current = _load_bench_json(args.current)

@@ -88,6 +88,21 @@ class LintConfig:
         "*/stream/trainers.py",
         "*/stream/pipeline.py",
     )
+    # engine modules whose predict paths must keep score+select fused on
+    # device (rule serving-host-roundtrip): a full-array device fetch or a
+    # host argsort there ships O(corpus) floats over the wire per query
+    # instead of O(k) through the fused helper (ops/topk)
+    serving_predict_globs: tuple[str, ...] = ("*/models/*/engine.py",)
+    # function names that make up the predict path inside those modules
+    # (nested helpers like a dispatch's `finalize` are covered implicitly)
+    serving_predict_functions: tuple[str, ...] = (
+        "predict",
+        "predict_batch",
+        "predict_batch_dispatch",
+        "predict_with_context",
+        "batch_predict",
+        "serve",
+    )
     # rule ids to run; None = all registered
     enabled: frozenset[str] | None = None
 

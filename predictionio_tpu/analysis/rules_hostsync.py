@@ -64,6 +64,94 @@ def _sync_call_label(call: ast.Call) -> str | None:
     return None
 
 
+register_rule(
+    "serving-host-roundtrip",
+    "hostsync",
+    Severity.ERROR,
+    "corpus-sized device fetch (one-arg np.asarray / jax.device_get / "
+    ".block_until_ready) or host argsort/argpartition on an engine "
+    "predict path; fuse score+select on device via ops/topk (host-born "
+    "scores end through topk.host_top_k)",
+)
+
+# one-arg np.asarray(x) on a predict path is the materialize-a-device-array
+# smell; the two-arg np.asarray(x, dtype) host idiom (converting a Python
+# list with an explicit dtype) is exempt — same contract as the
+# train-unaccounted-sync rule.
+_ROUNDTRIP_ASARRAY_LAST2 = frozenset(
+    {("np", "asarray"), ("numpy", "asarray"), ("onp", "asarray")}
+)
+_ROUNDTRIP_ALWAYS_LAST2 = frozenset(
+    {
+        ("np", "argsort"),
+        ("numpy", "argsort"),
+        ("np", "argpartition"),
+        ("numpy", "argpartition"),
+        ("jax", "device_get"),
+    }
+)
+
+
+def _roundtrip_label(call: ast.Call) -> str | None:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "block_until_ready":
+            return ".block_until_ready()"
+        d = astutil.dotted(func)
+        if d:
+            parts = tuple(d.split("."))
+            if len(parts) >= 2:
+                last2 = parts[-2:]
+                if last2 in _ROUNDTRIP_ALWAYS_LAST2:
+                    return d + "()"
+                if (
+                    last2 in _ROUNDTRIP_ASARRAY_LAST2
+                    and len(call.args) == 1
+                    and not call.keywords
+                ):
+                    return d + "()"
+    elif isinstance(func, ast.Name) and func.id == "device_get":
+        return "device_get()"
+    return None
+
+
+@register_checker
+def check_serving_roundtrip(ctx: FileContext):
+    """The engines' predict paths must route score+select through the
+    fused top-k helper: flag the full-fetch/host-sort endings inside the
+    predict-path functions (LintConfig.serving_predict_functions),
+    including their nested helpers (a dispatch's ``finalize``)."""
+    cfg = ctx.config
+    if not matches_any_glob(
+        ctx.path or ctx.display_path, cfg.serving_predict_globs
+    ):
+        return []
+    predict_names = set(cfg.serving_predict_functions)
+    findings: list[Finding] = []
+    seen: set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in predict_names:
+            continue
+        for sub in ast.walk(node):  # includes nested functions by design
+            if not isinstance(sub, ast.Call) or id(sub) in seen:
+                continue
+            seen.add(id(sub))
+            label = _roundtrip_label(sub)
+            if label:
+                findings.append(
+                    ctx.finding(
+                        "serving-host-roundtrip",
+                        sub,
+                        f"{label} in {node.name!r} round-trips host-side; "
+                        "route score+select through ops/topk "
+                        "(fused top-k / host_top_k)",
+                    )
+                )
+    return findings
+
+
 @register_checker
 def check_hostsync(ctx: FileContext):
     cfg = ctx.config

@@ -16,8 +16,11 @@ Reference parity (behavioral), all from
     from the user's live ``seenEvents``; the ``unavailableItems`` constraint
     entity ($set on entityType "constraint") is re-read per query.
 
-TPU design: factor tables live on device; each query is one jitted
-matvec + masked top-k; the live lookups stay host-side (row-store reads).
+TPU design: factor tables live on device; scoring, business-rule masking
+and selection run as ONE fused jitted program (ops/topk) with only the
+(k scores, k indices) pairs fetched; the live lookups stay host-side
+(row-store reads) and a micro-batch of known-user queries is a single
+batched device call.
 """
 
 from __future__ import annotations
@@ -37,6 +40,7 @@ from predictionio_tpu.controller import (
     Params,
     SanityCheck,
 )
+from predictionio_tpu.ops import topk
 from predictionio_tpu.ops.als import ALSConfig, als_train
 from predictionio_tpu.workflow.context import WorkflowContext
 
@@ -410,6 +414,62 @@ class ECommAlgorithm(JaxAlgorithm):
                     out.append(idx)
         return out
 
+    def _candidate_mask(
+        self,
+        ctx: WorkflowContext,
+        model: ECommModel,
+        query: Query,
+        out: np.ndarray,
+    ) -> None:
+        """Business-rule + query filters written into a preallocated [n]
+        mask row (seen items, unavailable constraint, white/black lists,
+        category overlap — ref ECommAlgorithm.scala:243-330)."""
+        n = len(model.item_vocab)
+        out[...] = True
+        if self.params.unseen_only:
+            for it in self._seen_items(ctx, query.user):
+                idx = model.item_index(it)
+                if idx is not None:
+                    out[idx] = False
+        for it in self._unavailable_items(ctx):
+            idx = model.item_index(it)
+            if idx is not None:
+                out[idx] = False
+        if query.white_list is not None:
+            wl = np.zeros(n, bool)
+            for it in query.white_list:
+                idx = model.item_index(it)
+                if idx is not None:
+                    wl[idx] = True
+            out &= wl
+        if query.black_list is not None:
+            for it in query.black_list:
+                idx = model.item_index(it)
+                if idx is not None:
+                    out[idx] = False
+        if query.categories is not None:
+            for i in range(n):
+                cats = model.item_categories[i]
+                if cats is None or not (cats & query.categories):
+                    out[i] = False
+
+    def _weights(self, ctx: WorkflowContext, model: ECommModel):
+        if not self.params.adjust_score:
+            return None
+        return self._item_weights(ctx, model)
+
+    @staticmethod
+    def _result_rows(
+        model: ECommModel, scores: np.ndarray, idx: np.ndarray, num: int
+    ) -> PredictedResult:
+        return PredictedResult(
+            tuple(
+                ItemScore(model.item_vocab[int(i)], float(s))
+                for s, i in zip(scores[:num], idx[:num])
+                if np.isfinite(s)
+            )
+        )
+
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         return self.predict_with_context(
             WorkflowContext(mode="serving"), model, query
@@ -418,66 +478,130 @@ class ECommAlgorithm(JaxAlgorithm):
     def predict_with_context(
         self, ctx: WorkflowContext, model: ECommModel, query: Query
     ) -> PredictedResult:
-        import jax.numpy as jnp
-
         n = len(model.item_vocab)
+        pool = topk.scratch()
+        mask = pool.get("ecomm.mask1", (1, n), np.bool_)
+        self._candidate_mask(ctx, model, query, mask[0])
+        weights = self._weights(ctx, model)
+        kk = min(topk.next_pow2(min(query.num, n)), n)
         uidx = model.user_index(query.user)
         if uidx is not None:
-            scores = np.asarray(
-                model.device_items() @ jnp.asarray(model.user_factors[uidx])
+            handle = topk.dot_top_k_async(
+                model.device_items(),
+                model.user_factors[uidx][None],
+                mask,
+                kk,
+                weights=weights,
             )
         else:
             recent = self._recent_item_indices(ctx, model, query.user)
             if recent:
-                q = model.device_items()[jnp.asarray(recent, jnp.int32)]
-                scores = np.asarray(jnp.sum(model.device_items() @ q.T, axis=1))
+                handle = topk.gather_sum_top_k_async(
+                    model.device_items(),
+                    np.asarray(recent, np.int32)[None],
+                    np.ones((1, len(recent)), np.float32),
+                    mask,
+                    kk,
+                    weights=weights,
+                )
             else:
+                # popularity fallback: the scores are host-born counts —
+                # nothing device-resident to fuse with, so this is the
+                # sanctioned host ending (ops/topk.host_top_k)
                 scores = model.popular_counts.astype(np.float64)
-
-        if self.params.adjust_score:
-            weights = self._item_weights(ctx, model)
-            if weights is not None:
-                scores = scores * weights
-
-        mask = np.ones(n, bool)
-        if self.params.unseen_only:
-            for it in self._seen_items(ctx, query.user):
-                idx = model.item_index(it)
-                if idx is not None:
-                    mask[idx] = False
-        for it in self._unavailable_items(ctx):
-            idx = model.item_index(it)
-            if idx is not None:
-                mask[idx] = False
-        if query.white_list is not None:
-            wl = np.zeros(n, bool)
-            for it in query.white_list:
-                idx = model.item_index(it)
-                if idx is not None:
-                    wl[idx] = True
-            mask &= wl
-        if query.black_list is not None:
-            for it in query.black_list:
-                idx = model.item_index(it)
-                if idx is not None:
-                    mask[idx] = False
-        if query.categories is not None:
-            for i in range(n):
-                cats = model.item_categories[i]
-                if cats is None or not (cats & query.categories):
-                    mask[i] = False
-
-        masked = np.where(mask, scores, -np.inf)
-        k = min(query.num, n)
-        idx = np.argpartition(-masked, max(k - 1, 0))[:k]
-        idx = idx[np.argsort(-masked[idx])]
-        return PredictedResult(
-            tuple(
-                ItemScore(model.item_vocab[int(i)], float(masked[i]))
-                for i in idx
-                if np.isfinite(masked[i])
-            )
+                if weights is not None:
+                    scores = scores * weights
+                sk, si = topk.host_top_k(scores, mask[0], min(query.num, n))
+                return self._result_rows(model, sk, si, len(si))
+        scores, idx = topk.fetch_topk(handle)
+        return self._result_rows(
+            model, scores[0], idx[0], min(query.num, kk)
         )
+
+    def predict_batch(
+        self, model: ECommModel, queries: Sequence[Query]
+    ) -> list[PredictedResult]:
+        return self.predict_batch_dispatch(model, queries)()
+
+    def predict_batch_dispatch(self, model: ECommModel, queries: Sequence[Query]):
+        """Micro-batch path: every known-user query rides ONE fused
+        batched matvec+mask+top-k (user vectors and mask rows assembled
+        into reusable staging buffers); cold users (recent-similarity or
+        popularity fallback) answer per query in the finalize."""
+        ctx = WorkflowContext(mode="serving")
+        n = len(model.item_vocab)
+        results: list[PredictedResult | None] = [None] * len(queries)
+        rows: list[int] = []
+        row_uidx: list[int] = []
+        cold: list[int] = []
+        max_num = 1
+        for i, q in enumerate(queries):
+            if q.num <= 0:
+                results[i] = PredictedResult(())
+                continue
+            uidx = model.user_index(q.user)
+            if uidx is None:
+                cold.append(i)
+                continue
+            rows.append(i)
+            row_uidx.append(uidx)
+            max_num = max(max_num, q.num)
+        handle = None
+        kk = 0
+        if rows:
+            weights = self._weights(ctx, model)
+            f = model.user_factors.shape[1]
+            b = topk.next_pow2(len(rows))
+            pool = topk.scratch()
+            vec_buf = pool.zeros("ecomm.vecs", (b, f), np.float32)
+            np.take(
+                model.user_factors, np.asarray(row_uidx, np.int64), axis=0,
+                out=vec_buf[: len(rows)],
+            )
+            mask_buf = pool.get("ecomm.mask", (b, n), np.bool_)
+            mask_buf[len(rows):] = True
+            for row, i in enumerate(rows):
+                self._candidate_mask(ctx, model, queries[i], mask_buf[row])
+            kk = min(topk.next_pow2(max_num), n)
+            handle = topk.dot_top_k_async(
+                model.device_items(), vec_buf, mask_buf, kk, weights=weights
+            )
+
+        def finalize() -> list[PredictedResult]:
+            for i in cold:
+                results[i] = self.predict_with_context(ctx, model, queries[i])
+            if handle is not None:
+                scores, idx = topk.fetch_topk(handle)
+                for row, i in enumerate(rows):
+                    results[i] = self._result_rows(
+                        model, scores[row], idx[row], min(queries[i].num, kk)
+                    )
+            return results  # type: ignore[return-value]
+
+        return finalize
+
+    def warmup_serving(self, model: ECommModel, max_batch: int) -> None:
+        n = len(model.item_vocab)
+        f = model.user_factors.shape[1]
+        kk = min(topk.next_pow2(10), n)
+        # with adjust_score the serving path routes to the WEIGHTED kernel
+        # only while a weightedItems constraint is actually set (a live
+        # event-store lookup — unknowable here), so warm BOTH variants:
+        # whichever one serves, its programs are compiled
+        variants: list[np.ndarray | None] = [None]
+        if self.params.adjust_score:
+            variants.append(np.ones(n, np.float32))
+        for weights in variants:
+            topk.warmup_pow2_buckets(
+                max_batch,
+                lambda b: topk.dot_top_k_async(
+                    model.device_items(),
+                    np.zeros((b, f), np.float32),
+                    np.ones((b, n), bool),
+                    kk,
+                    weights=weights,
+                ),
+            )
 
 
 class Serving(BaseServing):

@@ -447,8 +447,11 @@ class ALSAlgorithm(JaxAlgorithm):
     ):
         """Pipelined serving: dispatch the batched top-k kernel now, fetch in
         the returned finalize — the query server overlaps batch n's transport
-        with batch n+1's dispatch (ops.als.ServingIndex.serve_batch_async)."""
-        from predictionio_tpu.ops.als import ServingIndex, next_pow2
+        with batch n+1's dispatch (ops.als.ServingIndex.serve_batch_async).
+        User indices are assembled into a reusable staging buffer
+        (ops.topk.scratch) and only the packed [B,2,k] result is fetched."""
+        from predictionio_tpu.ops import topk
+        from predictionio_tpu.ops.als import next_pow2
 
         results: list[PredictedResult | None] = [None] * len(queries)
         batch_pos: list[int] = []
@@ -477,7 +480,8 @@ class ALSAlgorithm(JaxAlgorithm):
             k = min(max(queries[i].num for i in batch_pos), n_items)
             kk = min(next_pow2(k), n_items)
             bucket = next_pow2(len(batch_pos))
-            idxs = np.zeros(bucket, np.int32)  # pad rows serve user 0, dropped
+            # pad rows serve user 0, dropped on unpack
+            idxs = topk.scratch().zeros("rec.uidx", (bucket,), np.int32)
             idxs[: len(batch_pos)] = batch_idx
             handle = model.serving_index().serve_batch_async(idxs, kk)
 
@@ -485,7 +489,7 @@ class ALSAlgorithm(JaxAlgorithm):
             for i in masked_pos:
                 results[i] = self.predict(model, queries[i])
             if handle is not None:
-                scores, idx = ServingIndex.unpack_batch(np.asarray(handle))
+                scores, idx = topk.fetch_topk(handle)
                 for row, i in enumerate(batch_pos):
                     num = min(queries[i].num, n_items)
                     results[i] = PredictedResult(

@@ -11,8 +11,9 @@ Reference parity (behavioral, re-designed for TPU):
     users' factors, excluding the query users themselves.
 
 TPU design: identical serving shape to the similar-product engine — the
-followed-user factor table is L2-normalised, landed on device once, and each
-query is one matmul + top-k.
+followed-user factor table is L2-normalised, landed on device once, and a
+micro-batch of queries is ONE fused gather->sum-cosine->mask->top-k
+program (ops/topk); only (k scores, k indices) per query cross the wire.
 """
 
 from __future__ import annotations
@@ -31,6 +32,7 @@ from predictionio_tpu.controller import (
     Params,
     SanityCheck,
 )
+from predictionio_tpu.ops import topk
 from predictionio_tpu.ops.als import ALSConfig, als_train
 from predictionio_tpu.workflow.context import WorkflowContext
 
@@ -199,44 +201,107 @@ class ALSAlgorithm(JaxAlgorithm):
         vf = vf / np.where(norms == 0, 1.0, norms)
         return SimilarUserModel(vf, list(pd.followed_vocab))
 
-    def predict(self, model: SimilarUserModel, query: Query) -> PredictedResult:
-        import jax.numpy as jnp
-
-        query_idx = [
-            i for u in query.users if (i := model.user_index(u)) is not None
-        ]
-        if not query_idx:
-            return PredictedResult(())
-        factors = model.device_factors()
-        q = factors[jnp.asarray(query_idx, jnp.int32)]
-        scores = np.asarray(jnp.sum(factors @ q.T, axis=1))
-        n = len(model.followed_vocab)
-        mask = np.ones(n, bool)
-        mask[query_idx] = False  # never recommend the query users back
+    @staticmethod
+    def _candidate_mask(
+        model: SimilarUserModel, query: Query, query_idx: list[int], out: np.ndarray
+    ) -> None:
+        """Whitelist/blacklist/self-exclusion mask written into a
+        preallocated [n] row of the batch staging buffer."""
+        out[...] = True
+        out[query_idx] = False  # never recommend the query users back
         if query.white_list is not None:
-            wl = np.zeros(n, bool)
+            wl = np.zeros(out.shape[0], bool)
             for u in query.white_list:
                 idx = model.user_index(u)
                 if idx is not None:
                     wl[idx] = True
-            mask &= wl
+            out &= wl
         if query.black_list is not None:
             for u in query.black_list:
                 idx = model.user_index(u)
                 if idx is not None:
-                    mask[idx] = False
-        masked = np.where(mask, scores, -np.inf)
-        k = min(query.num, n)
-        if k <= 0:
-            return PredictedResult(())
-        idx = np.argpartition(-masked, k - 1)[:k]
-        idx = idx[np.argsort(-masked[idx])]
-        return PredictedResult(
-            tuple(
-                SimilarUserScore(model.followed_vocab[int(i)], float(masked[i]))
-                for i in idx
-                if np.isfinite(masked[i])
+                    out[idx] = False
+
+    def predict(self, model: SimilarUserModel, query: Query) -> PredictedResult:
+        return self.predict_batch(model, [query])[0]
+
+    def predict_batch(
+        self, model: SimilarUserModel, queries: Sequence[Query]
+    ) -> list[PredictedResult]:
+        return self.predict_batch_dispatch(model, queries)()
+
+    def predict_batch_dispatch(
+        self, model: SimilarUserModel, queries: Sequence[Query]
+    ):
+        """One fused device call per micro-batch (see ops/topk): queries
+        are assembled into reusable staging buffers, scoring + masking +
+        selection run on device, and the finalize fetches only [B, k]."""
+        n = len(model.followed_vocab)
+        results: list[PredictedResult | None] = [None] * len(queries)
+        rows: list[int] = []
+        row_qidx: list[list[int]] = []
+        max_q = 1
+        max_num = 1
+        for i, q in enumerate(queries):
+            qidx = [
+                j for u in q.users if (j := model.user_index(u)) is not None
+            ]
+            if not qidx or q.num <= 0:
+                results[i] = PredictedResult(())
+                continue
+            rows.append(i)
+            row_qidx.append(qidx)
+            max_q = max(max_q, len(qidx))
+            max_num = max(max_num, q.num)
+        handle = None
+        kk = 0
+        if rows:
+            b = topk.next_pow2(len(rows))
+            qcap = topk.next_pow2(max_q)
+            pool = topk.scratch()
+            qidx_buf = pool.zeros("recuser.qidx", (b, qcap), np.int32)
+            qw_buf = pool.zeros("recuser.qw", (b, qcap), np.float32)
+            mask_buf = pool.get("recuser.mask", (b, n), np.bool_)
+            mask_buf[len(rows):] = True
+            for row, (i, qidx) in enumerate(zip(rows, row_qidx)):
+                qidx_buf[row, : len(qidx)] = qidx
+                qw_buf[row, : len(qidx)] = 1.0
+                self._candidate_mask(model, queries[i], qidx, mask_buf[row])
+            kk = min(topk.next_pow2(max_num), n)
+            handle = topk.gather_sum_top_k_async(
+                model.device_factors(), qidx_buf, qw_buf, mask_buf, kk
             )
+
+        def finalize() -> list[PredictedResult]:
+            if handle is not None:
+                scores, idx = topk.fetch_topk(handle)
+                for row, i in enumerate(rows):
+                    num = min(queries[i].num, kk)
+                    results[i] = PredictedResult(
+                        tuple(
+                            SimilarUserScore(
+                                model.followed_vocab[int(u)], float(s)
+                            )
+                            for s, u in zip(scores[row, :num], idx[row, :num])
+                            if np.isfinite(s)
+                        )
+                    )
+            return results  # type: ignore[return-value]
+
+        return finalize
+
+    def warmup_serving(self, model: SimilarUserModel, max_batch: int) -> None:
+        n = len(model.followed_vocab)
+        kk = min(topk.next_pow2(10), n)
+        topk.warmup_pow2_buckets(
+            max_batch,
+            lambda b: topk.gather_sum_top_k_async(
+                model.device_factors(),
+                np.zeros((b, 1), np.int32),
+                np.zeros((b, 1), np.float32),
+                np.ones((b, n), bool),
+                kk,
+            ),
         )
 
 

@@ -15,8 +15,10 @@ Reference parity (behavioral):
   - isCandidateItem filters: whitelist, blacklist, query-item exclusion,
     category overlap, category blacklist — ``ALSAlgorithm.scala:236-260``.
 
-TPU design: cosine scoring is one jitted matmul over the full normalized
-item-factor table; filters are boolean masks fused into the top-k.
+TPU design: cosine scoring, candidate masking and selection are ONE fused
+jitted program (ops/topk.gather_sum_top_k_async) over the resident
+normalized item-factor table; a micro-batch of queries is one device call
+and only the (k scores, k indices) pairs ever cross the wire.
 """
 
 from __future__ import annotations
@@ -36,6 +38,7 @@ from predictionio_tpu.controller import (
     Params,
     SanityCheck,
 )
+from predictionio_tpu.ops import topk
 from predictionio_tpu.ops.als import ALSConfig, als_train
 from predictionio_tpu.ops.cooccurrence import cooccurrence_top_n, score_by_cooccurrence
 from predictionio_tpu.workflow.context import WorkflowContext
@@ -270,10 +273,21 @@ class SimilarModel(SanityCheck):
         self._device_factors = None
 
 
-def candidate_mask(model: SimilarModel, query: Query, query_idx: list[int]) -> np.ndarray:
-    """ref isCandidateItem (ALSAlgorithm.scala:236-260)."""
+def candidate_mask(
+    model: SimilarModel,
+    query: Query,
+    query_idx: list[int],
+    out: np.ndarray | None = None,
+) -> np.ndarray:
+    """ref isCandidateItem (ALSAlgorithm.scala:236-260). ``out`` writes the
+    mask into a preallocated row (the batch path assembles query masks
+    directly into its reusable [B, n] staging buffer)."""
     n = len(model.item_vocab)
-    mask = np.ones(n, bool)
+    if out is None:
+        mask = np.ones(n, bool)
+    else:
+        mask = out
+        mask[...] = True
     mask[query_idx] = False  # exclude query items
     if query.white_list is not None:
         wl = np.zeros(n, bool)
@@ -299,24 +313,6 @@ def candidate_mask(model: SimilarModel, query: Query, query_idx: list[int]) -> n
             if cats is not None and (cats & query.category_black_list):
                 mask[i] = False
     return mask
-
-
-def _topk_filtered(scores: np.ndarray, mask: np.ndarray, k: int) -> list[tuple[int, float]]:
-    scores = np.where(mask, scores, -np.inf)
-    k = min(k, len(scores))
-    if k <= 0:
-        return []
-    idx = np.argpartition(-scores, k - 1)[:k]
-    idx = idx[np.argsort(-scores[idx])]
-    return [(int(i), float(scores[i])) for i in idx if np.isfinite(scores[i])]
-
-
-def _cosine_scores(model: SimilarModel, query_idx: list[int]) -> np.ndarray:
-    import jax.numpy as jnp
-
-    factors = model.device_factors()  # [n, f] normalized
-    q = factors[jnp.asarray(query_idx, jnp.int32)]  # [Q, f]
-    return np.asarray(jnp.sum(factors @ q.T, axis=1))  # summed cosine per item
 
 
 # ---------------------------------------------------------------------------
@@ -387,19 +383,93 @@ class _ALSBase(JaxAlgorithm):
         return self._build_model(item_factors, pd)
 
     def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
-        query_idx = [
-            i for it in query.items if (i := model.item_index(it)) is not None
-        ]
-        if not query_idx:
-            return PredictedResult(())
-        scores = _cosine_scores(model, query_idx)
-        mask = candidate_mask(model, query, query_idx)
-        top = _topk_filtered(scores, mask, query.num)
-        return PredictedResult(
-            tuple(
-                ItemScore(model.item_vocab[i], s, model.properties_of(i))
-                for i, s in top
+        return self.predict_batch(model, [query])[0]
+
+    def predict_batch(
+        self, model: SimilarModel, queries: Sequence[Query]
+    ) -> list[PredictedResult]:
+        return self.predict_batch_dispatch(model, queries)()
+
+    def predict_batch_dispatch(self, model: SimilarModel, queries: Sequence[Query]):
+        """One fused device call for the whole micro-batch: query-item
+        indices and per-query candidate masks are assembled directly into
+        reusable staging buffers, the gather->sum-cosine->mask->top-k runs
+        as one jitted program, and only [B, k] score/index pairs are
+        fetched (in the returned finalize, so the query server overlaps
+        transport with the next batch's dispatch)."""
+        n = len(model.item_vocab)
+        results: list[PredictedResult | None] = [None] * len(queries)
+        rows: list[int] = []
+        row_qidx: list[list[int]] = []
+        max_q = 1
+        max_num = 1
+        for i, q in enumerate(queries):
+            qidx = [
+                j for it in q.items if (j := model.item_index(it)) is not None
+            ]
+            if not qidx or q.num <= 0:
+                results[i] = PredictedResult(())
+                continue
+            rows.append(i)
+            row_qidx.append(qidx)
+            max_q = max(max_q, len(qidx))
+            max_num = max(max_num, q.num)
+        handle = None
+        kk = 0
+        if rows:
+            # pow2 buckets on batch/query-width/k keep the compile universe
+            # at ~log^3 programs (same discipline as ops/als warmup_buckets)
+            b = topk.next_pow2(len(rows))
+            qcap = topk.next_pow2(max_q)
+            pool = topk.scratch()
+            qidx_buf = pool.zeros("similar.qidx", (b, qcap), np.int32)
+            qw_buf = pool.zeros("similar.qw", (b, qcap), np.float32)
+            mask_buf = pool.get("similar.mask", (b, n), np.bool_)
+            mask_buf[len(rows):] = True  # pad rows: harmless full mask
+            for row, (i, qidx) in enumerate(zip(rows, row_qidx)):
+                qidx_buf[row, : len(qidx)] = qidx
+                qw_buf[row, : len(qidx)] = 1.0
+                candidate_mask(model, queries[i], qidx, out=mask_buf[row])
+            kk = min(topk.next_pow2(max_num), n)
+            handle = topk.gather_sum_top_k_async(
+                model.device_factors(), qidx_buf, qw_buf, mask_buf, kk
             )
+
+        def finalize() -> list[PredictedResult]:
+            if handle is not None:
+                scores, idx = topk.fetch_topk(handle)
+                for row, i in enumerate(rows):
+                    num = min(queries[i].num, kk)
+                    results[i] = PredictedResult(
+                        tuple(
+                            ItemScore(
+                                model.item_vocab[int(it)],
+                                float(s),
+                                model.properties_of(int(it)),
+                            )
+                            for s, it in zip(scores[row, :num], idx[row, :num])
+                            if np.isfinite(s)
+                        )
+                    )
+            return results  # type: ignore[return-value]
+
+        return finalize
+
+    def warmup_serving(self, model: SimilarModel, max_batch: int) -> None:
+        """Pre-compile the single-item-query program for every pow2 batch
+        bucket at the default k, so the first burst after deploy/reload
+        pays no XLA compiles on the common shape."""
+        n = len(model.item_vocab)
+        kk = min(topk.next_pow2(10), n)
+        topk.warmup_pow2_buckets(
+            max_batch,
+            lambda b: topk.gather_sum_top_k_async(
+                model.device_factors(),
+                np.zeros((b, 1), np.int32),
+                np.zeros((b, 1), np.float32),
+                np.ones((b, n), bool),
+                kk,
+            ),
         )
 
 
@@ -508,11 +578,13 @@ class CooccurrenceAlgorithm(LocalAlgorithm):
         scores = np.full(len(model.item_vocab), -np.inf)
         for i, s in score_map.items():
             scores[i] = s
-        top = _topk_filtered(scores, mask, query.num)
+        # cooccurrence scores are host-born (a sparse count map) — the
+        # sanctioned host ending lives in the fused-top-k helper
+        sk, si = topk.host_top_k(scores, mask, query.num)
         return PredictedResult(
             tuple(
-                ItemScore(model.item_vocab[i], s, model.properties_of(i))
-                for i, s in top
+                ItemScore(model.item_vocab[int(i)], float(s), model.properties_of(int(i)))
+                for s, i in zip(sk, si)
             )
         )
 

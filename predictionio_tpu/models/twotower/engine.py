@@ -25,7 +25,6 @@ from predictionio_tpu.models.twotower.model import (
     TwoTower,
     TwoTowerConfig,
     train_two_tower,
-    user_embedding,
 )
 from predictionio_tpu.workflow.context import WorkflowContext
 
@@ -139,6 +138,8 @@ class TwoTowerModelState(SanityCheck):
     def __post_init__(self):
         self._user_index: dict[str, int] | None = None
         self._device_items = None
+        self._device_params = None
+        self._serve_fn = None
         self._model: TwoTower | None = None
 
     def sanity_check(self) -> None:
@@ -162,6 +163,57 @@ class TwoTowerModelState(SanityCheck):
             self._device_items = jnp.asarray(self.item_embeddings)
         return self._device_items
 
+    def device_params(self):
+        """Tower params re-landed on device once (the checkpoint form is
+        host numpy); serving must never re-upload them per query."""
+        if self._device_params is None:
+            import jax
+            import jax.numpy as jnp
+
+            self._device_params = jax.tree_util.tree_map(
+                jnp.asarray, self.params
+            )
+        return self._device_params
+
+    def serve_topk(self, uidx, hist, k: int):
+        """Dispatch the fused user-tower -> dot-products -> top-k program
+        for a [B] batch of user indices ([B,T] histories when the sequence
+        encoder is on). One compiled program per (B, k) bucket; returns
+        the packed [B,2,k] handle (decode with ``ops.topk.fetch_topk``)."""
+        if self._serve_fn is None:
+            import functools
+
+            import jax
+            import jax.numpy as jnp
+
+            from predictionio_tpu.models.twotower.model import TwoTower as _TT
+            from predictionio_tpu.ops.topk import pack_batch
+
+            mdl = self.model()
+
+            @functools.partial(
+                jax.jit, static_argnames=("k",), donate_argnums=(2, 3)
+            )
+            def _serve(params, items, uidx, hist, k: int):
+                u = mdl.apply(
+                    {"params": params}, uidx, hist, method=_TT.embed_users
+                )
+                scores = u @ items.T  # [B, n_items] on the MXU
+                s, i = jax.lax.top_k(scores, k)
+                return pack_batch(s, i)
+
+            self._serve_fn = _serve
+        import jax.numpy as jnp
+
+        hist_d = jnp.asarray(hist) if hist is not None else None
+        return self._serve_fn(
+            self.device_params(),
+            self.device_items(),
+            jnp.asarray(uidx),
+            hist_d,
+            k,
+        )
+
     def __getstate__(self):
         return {
             "config": self.config,
@@ -178,6 +230,8 @@ class TwoTowerModelState(SanityCheck):
         self.__dict__.setdefault("history", None)  # pre-encoder blobs
         self._user_index = None
         self._device_items = None
+        self._device_params = None
+        self._serve_fn = None
         self._model = None
 
 
@@ -232,31 +286,88 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         )
 
     def predict(self, model: TwoTowerModelState, query: Query) -> PredictedResult:
-        import jax.numpy as jnp
+        return self.predict_batch(model, [query])[0]
 
-        uidx = model.user_index(query.user)
-        if uidx is None:
-            return PredictedResult(())
-        hist = (
-            jnp.asarray(model.history[uidx : uidx + 1])
-            if model.history is not None
-            else None
-        )
-        u = user_embedding(
-            model.model(), model.params, jnp.asarray([uidx], jnp.int32), hist
-        )[0]
-        from predictionio_tpu.ops.als import top_k_items
+    def predict_batch(
+        self, model: TwoTowerModelState, queries: Sequence[Query]
+    ) -> list[PredictedResult]:
+        return self.predict_batch_dispatch(model, queries)()
 
-        scores, idx = top_k_items(
-            u, model.device_items(), min(query.num, len(model.item_vocab))
-        )
-        return PredictedResult(
-            tuple(
-                ItemScore(model.item_vocab[int(i)], float(s))
-                for s, i in zip(scores, idx)
-                if np.isfinite(s)
+    def predict_batch_dispatch(
+        self, model: TwoTowerModelState, queries: Sequence[Query]
+    ):
+        """Serving micro-batch as ONE fused device program: user-tower
+        forward -> dot products against the resident item table -> top-k,
+        with user indices (and histories) assembled into reusable staging
+        buffers and only [B, k] results fetched in the finalize. Unknown
+        users answer empty without touching the device."""
+        from predictionio_tpu.ops import topk
+
+        n = len(model.item_vocab)
+        results: list[PredictedResult | None] = [None] * len(queries)
+        rows: list[int] = []
+        uidxs: list[int] = []
+        max_num = 1
+        for i, q in enumerate(queries):
+            uidx = model.user_index(q.user)
+            if uidx is None or q.num <= 0:
+                results[i] = PredictedResult(())
+                continue
+            rows.append(i)
+            uidxs.append(uidx)
+            max_num = max(max_num, q.num)
+        handle = None
+        kk = 0
+        if rows:
+            b = topk.next_pow2(len(rows))
+            pool = topk.scratch()
+            uidx_buf = pool.zeros("twotower.uidx", (b,), np.int32)
+            uidx_buf[: len(rows)] = uidxs  # pad rows serve user 0, dropped
+            hist_buf = None
+            if model.history is not None:
+                hist_buf = pool.get(
+                    "twotower.hist", (b, model.history.shape[1]),
+                    model.history.dtype,
+                )
+                np.take(model.history, uidx_buf, axis=0, out=hist_buf)
+            kk = min(topk.next_pow2(max_num), n)
+            handle = model.serve_topk(uidx_buf, hist_buf, kk)
+
+        def finalize() -> list[PredictedResult]:
+            if handle is not None:
+                from predictionio_tpu.ops.topk import fetch_topk
+
+                scores, idx = fetch_topk(handle)
+                for row, i in enumerate(rows):
+                    num = min(queries[i].num, kk)
+                    results[i] = PredictedResult(
+                        tuple(
+                            ItemScore(model.item_vocab[int(it)], float(s))
+                            for s, it in zip(scores[row, :num], idx[row, :num])
+                            if np.isfinite(s)
+                        )
+                    )
+            return results  # type: ignore[return-value]
+
+        return finalize
+
+    def warmup_serving(self, model: TwoTowerModelState, max_batch: int) -> None:
+        """Pre-compile the fused tower->score->top-k program for every
+        pow2 batch bucket at the default k."""
+        from predictionio_tpu.ops import topk
+
+        n = len(model.item_vocab)
+        kk = min(topk.next_pow2(10), n)
+
+        def dispatch(b: int):
+            hist = (
+                np.zeros((b, model.history.shape[1]), model.history.dtype)
+                if model.history is not None
+                else None
             )
-        )
+            return model.serve_topk(np.zeros(b, np.int32), hist, kk)
+
+        topk.warmup_pow2_buckets(max_batch, dispatch)
 
 
 class Serving(BaseServing):

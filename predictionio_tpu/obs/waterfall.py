@@ -13,6 +13,10 @@ Phases, in request order (see docs/observability.md for the precise
 boundaries):
 
 - ``ingress_parse``   auth check, payload read, JSON decode
+- ``cache``           version-keyed result-cache lookup (every request
+                      observes its lookup cost; a HIT ends the request
+                      here — its waterfall is parse -> cache -> respond,
+                      no queue/dispatch/device phases at all)
 - ``queue_wait``      micro-batch admission queue (incl. in-flight
                       backpressure while earlier batches occupy the
                       dispatch pipeline)
@@ -47,6 +51,7 @@ from predictionio_tpu.obs.metrics import Histogram, MetricsRegistry
 
 # request-ordered phase vocabulary; label values of pio_phase_seconds
 PHASE_INGRESS_PARSE = "ingress_parse"
+PHASE_CACHE = "cache"
 PHASE_QUEUE_WAIT = "queue_wait"
 PHASE_BATCH_ASSEMBLY = "batch_assembly"
 PHASE_DISPATCH = "dispatch"
@@ -57,6 +62,7 @@ PHASE_RESPOND = "respond"
 
 PHASES: tuple[str, ...] = (
     PHASE_INGRESS_PARSE,
+    PHASE_CACHE,
     PHASE_QUEUE_WAIT,
     PHASE_BATCH_ASSEMBLY,
     PHASE_DISPATCH,
@@ -82,7 +88,7 @@ class PhaseWaterfall:
         self.hist: Histogram = registry.histogram(
             PHASE_METRIC,
             "per-request latency by serving phase "
-            "(ingress_parse|queue_wait|batch_assembly|dispatch|"
+            "(ingress_parse|cache|queue_wait|batch_assembly|dispatch|"
             "device_compute|fetch|serve|respond); bucket exemplars carry "
             "the trace id of the most recent observation",
             labelnames=("phase",),
@@ -122,6 +128,7 @@ __all__ = [
     "PHASES",
     "PHASE_METRIC",
     "PHASE_INGRESS_PARSE",
+    "PHASE_CACHE",
     "PHASE_QUEUE_WAIT",
     "PHASE_BATCH_ASSEMBLY",
     "PHASE_DISPATCH",

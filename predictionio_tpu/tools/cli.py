@@ -484,6 +484,8 @@ def cmd_deploy(args) -> int:
         bake_window_s=args.bake_window,
         bake_min_requests=args.bake_min_requests,
         auto_promote=not args.no_auto_promote,
+        result_cache_size=args.result_cache_size,
+        result_cache_ttl_s=args.result_cache_ttl,
     )
     print(f"Engine server starting on {args.ip}:{args.port} ...")
     run_query_server(args.engine_dir, args.variant, config=config)
@@ -1405,6 +1407,20 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="gates report 'ready' instead of promoting; an operator "
         "promotes via `pio models promote --url ...`",
+    )
+    x.add_argument(
+        "--result-cache-size",
+        type=int,
+        default=1024,
+        help="version-keyed result cache entries (0 disables); hits "
+        "answer before micro-batch admission (docs/PERF.md)",
+    )
+    x.add_argument(
+        "--result-cache-ttl",
+        type=float,
+        default=10.0,
+        help="result-cache entry TTL seconds — the staleness bound for "
+        "serving components reading live state outside the model",
     )
     x.set_defaults(fn=cmd_deploy)
 

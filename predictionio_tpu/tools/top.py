@@ -173,6 +173,7 @@ def summarize(
         "model_versions": _model_versions(metrics),
     }
     out["phases"] = _phase_summary(metrics)
+    out["cache_hit_ratio"] = _cache_hit_ratio(metrics)
     out["slo"] = _slo_summary(metrics)
     out["stream"] = _stream_summary(metrics, now)
     out["train"] = _train_summary(metrics)
@@ -227,6 +228,19 @@ def _phase_summary(metrics: Metrics) -> dict[str, dict[str, float]] | None:
             * 1e3,
         }
     return out or None
+
+
+def _cache_hit_ratio(metrics: Metrics) -> float | None:
+    """Result-cache hit ratio from the pio_cache_* counters; None when
+    the endpoint has no result cache (an event server) or the cache has
+    seen no lookups yet — a disabled cache never moves either counter,
+    so a 0/0 endpoint gets no misleading ``cache hit 0%`` column."""
+    if "pio_cache_hits_total" not in metrics:
+        return None
+    hits = _total(metrics, "pio_cache_hits_total")
+    misses = _total(metrics, "pio_cache_misses_total")
+    total = hits + misses
+    return (hits / total) if total else None
 
 
 def _slo_summary(metrics: Metrics) -> dict[str, dict[str, Any]] | None:
@@ -389,9 +403,13 @@ def render(summary: dict[str, Any], url: str) -> str:
             for phase, info in phases.items()
         ]
         total_p50 = sum(info["p50_ms"] for info in phases.values())
-        lines.append(
-            "  waterfall  " + " | ".join(parts) + f"   (p50 ms, Σ {total_p50:.2f})"
-        )
+        tail = f"   (p50 ms, Σ {total_p50:.2f})"
+        hit_ratio = summary.get("cache_hit_ratio")
+        if hit_ratio is not None:
+            # the hit-ratio column rides the waterfall line: a high ratio
+            # explains a Σ well under the e2e p50 (hits skip most phases)
+            tail += f"   cache hit {hit_ratio * 100.0:.0f}%"
+        lines.append("  waterfall  " + " | ".join(parts) + tail)
     slos = summary.get("slo") or {}
     if slos:
         parts = []

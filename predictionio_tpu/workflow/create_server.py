@@ -61,6 +61,7 @@ from predictionio_tpu.obs.slo import (
 )
 from predictionio_tpu.obs.waterfall import (
     PHASE_BATCH_ASSEMBLY,
+    PHASE_CACHE,
     PHASE_DEVICE_COMPUTE,
     PHASE_DISPATCH,
     PHASE_FETCH,
@@ -94,6 +95,7 @@ from predictionio_tpu.registry.router import (
     choose_lane,
     routing_key,
 )
+from predictionio_tpu.registry.result_cache import ResultCache
 from predictionio_tpu.registry.store import (
     MODE_CANARY,
     MODE_SHADOW,
@@ -215,6 +217,17 @@ class ServerConfig:
     slo_availability_objective: float = 0.999
     # shed objective: fraction of arrivals NOT rejected by admission control
     slo_shed_objective: float = 0.99
+    # -- version-keyed result cache (registry/result_cache.py) -------------
+    # repeat queries answer from an LRU keyed (model_version, canonical
+    # query bytes) BEFORE micro-batch admission — and even while the
+    # dispatch breaker is open. 0 disables. Bypassed while a rollout is
+    # active (bake gates need dispatched traffic; a canary answer is never
+    # cached, so it can never be served from a stale lane).
+    result_cache_size: int = 1024
+    # staleness bound for serving components that read live state outside
+    # the immutable model artifact (disabled-items files, constraint
+    # entities); the model itself can't go stale under a version key
+    result_cache_ttl_s: float = 10.0
 
     def ssl_context(self):
         from predictionio_tpu.utils.tls import server_ssl_context
@@ -222,12 +235,36 @@ class ServerConfig:
         return server_ssl_context(self.ssl_certfile, self.ssl_keyfile)
 
 
+# Precompiled encoders, split by contract (the hot respond path must not
+# pay for canonicalization it doesn't need):
+#  - _fast_dumps: compact, insertion-ordered — response serialization.
+#    json.dumps re-parses its kwargs into a fresh encoder per call; a
+#    prebuilt JSONEncoder skips that per-request setup.
+#  - _CANONICAL: sort_keys — ONLY for paths that need order-independent
+#    bytes (shadow divergence comparison, result-cache keys).
+# No default= on _FAST: a non-JSON-serializable value in a response body
+# (a numpy scalar leaking from an engine) must raise like web.json_response
+# always did, not silently reach clients as a string.
+_FAST = json.JSONEncoder(separators=(",", ":"))
+_fast_dumps = _FAST.encode
+_CANONICAL = json.JSONEncoder(
+    sort_keys=True, separators=(",", ":"), default=str
+)
+
+
 def _canonical_json(value: Any) -> str:
     """Order-independent JSON for shadow divergence comparison."""
     try:
-        return json.dumps(value, sort_keys=True, default=str)
+        return _CANONICAL.encode(value)
     except (TypeError, ValueError):
         return repr(value)
+
+
+def _canonical_query_bytes(payload: Any) -> bytes:
+    """The result-cache key: canonical bytes of the raw query payload, so
+    ``{"user": "u1", "num": 10}`` and ``{"num": 10, "user": "u1"}`` share
+    one entry."""
+    return _CANONICAL.encode(payload).encode()
 
 
 def _swallow_result(fut) -> None:
@@ -254,6 +291,10 @@ class _QItem:
     trace_id: str | None
     t_submit: float
     phases: dict[str, float] = dataclasses.field(default_factory=dict)
+    # canonical query bytes when this answer is result-cacheable (miss on
+    # a quiesced stable lane); the batcher inserts the encoded body under
+    # (answered version, key) once the batch resolves
+    cache_key: bytes | None = None
 
 
 class _MicroBatcher:
@@ -315,6 +356,7 @@ class _MicroBatcher:
         deadline: Deadline | None = None,
         phases: dict[str, float] | None = None,
         t_submit: float | None = None,
+        cache_key: bytes | None = None,
     ) -> Any:
         """Enqueue one query payload; returns the encoded result body or
         raises the per-query error. Fails fast when the server is shutting
@@ -345,6 +387,7 @@ class _MicroBatcher:
                 current_trace_id(),
                 t_submit if t_submit is not None else time.perf_counter(),
                 phases if phases is not None else {},
+                cache_key,
             )
         )
         if self._task is None or self._task.done():
@@ -356,6 +399,30 @@ class _MicroBatcher:
         for item in batch:
             if not item.fut.done():
                 item.fut.set_exception(exc)
+
+    def _dispatch_combined(self, items: list[_QItem]):
+        """Idle fast path: dispatch AND finalize in ONE executor hop.
+
+        The dispatch->fetch pipeline exists to overlap batch n's transport
+        with batch n+1's dispatch — but a solo request on an idle server
+        has nothing to overlap with, and pays two thread wakes + two
+        watchdog waits for it. When the collect loop sees a batch of one
+        with nothing queued and nothing in flight, the whole
+        decode->dispatch->fetch->serve chain runs inside the single
+        dispatch-pool call; the returned finalize is already resolved
+        (``resolved`` attribute), so ``_finish`` skips the fetch executor
+        entirely. Arrivals during the combined call simply form the next
+        batch — exactly what adaptive batching does while a dispatch is
+        busy."""
+        fin = self._server._dispatch_query_batch(items)
+        results = fin()
+
+        def resolved():
+            return results
+
+        resolved.resolved = True
+        resolved.timings = getattr(fin, "timings", None)
+        return resolved
 
     def _replace_dispatch_pool(self) -> None:
         """Abandon a dispatch thread stuck past its batch's deadline: the
@@ -430,6 +497,18 @@ class _MicroBatcher:
                 continue
             batch = live
             batch_deadline = Deadline.min_of([it.deadline for it in batch])
+            # idle fast path: a batch of ONE with nothing queued behind it
+            # and no finalize in flight has nothing to pipeline against —
+            # run dispatch AND finalize in one executor hop (see
+            # _dispatch_combined); the dispatch watchdog below still bounds
+            # the whole combined call. Any larger batch means the server is
+            # under load, where occupying the dispatch thread through the
+            # fetch would serialize the pipeline it exists to overlap.
+            combined = (
+                len(batch) == 1
+                and self._queue.empty()
+                and not self._finish_tasks
+            )
             # dispatch under a watchdog. NOT wait_for(): cancelling an
             # executor future whose fn is already running blocks until the
             # fn returns — the exact hang the watchdog exists to escape.
@@ -437,10 +516,15 @@ class _MicroBatcher:
             # is then abandoned and its pool replaced.
             dispatch_t0 = time.perf_counter()
             try:
+                # the batch list itself is the handoff — the dispatch
+                # thread reads payload/trace_id straight off the queued
+                # items (no per-batch tuple-list materialization)
                 exec_fut = loop.run_in_executor(
                     self._dispatch_pool,
-                    self._server._dispatch_query_batch,
-                    [(it.payload, it.trace_id) for it in batch],
+                    self._dispatch_combined
+                    if combined
+                    else self._server._dispatch_query_batch,
+                    batch,
                 )
                 exec_fut.add_done_callback(_swallow_result)
                 done, pending = await asyncio.wait(
@@ -466,6 +550,26 @@ class _MicroBatcher:
                 )
                 continue
             dispatch_s = time.perf_counter() - dispatch_t0
+            try:
+                finalize = exec_fut.result()
+            except BaseException as exc:
+                self._inflight.release()
+                self._server.dispatch_breaker.record_failure()
+                for item in batch:
+                    if not item.fut.done():
+                        item.fut.set_exception(exc)
+                continue
+            if getattr(finalize, "resolved", False):
+                # combined fast path: the measured dispatch window swallowed
+                # device compute + serve; carve them back out so _finish's
+                # device/serve observations keep the waterfall tiling
+                t = getattr(finalize, "timings", None) or {}
+                dispatch_s = max(
+                    0.0,
+                    dispatch_s
+                    - t.get("device_s", 0.0)
+                    - t.get("serve_s", 0.0),
+                )
             self._server._m_dispatch.observe(dispatch_s)
             # batch-scoped waterfall phases: every rider waits out the whole
             # batch, so each query is accounted the batch's duration
@@ -477,15 +581,6 @@ class _MicroBatcher:
                 self._server.waterfall.observe(
                     PHASE_DISPATCH, dispatch_s, item.trace_id
                 )
-            try:
-                finalize = exec_fut.result()
-            except BaseException as exc:
-                self._inflight.release()
-                self._server.dispatch_breaker.record_failure()
-                for item in batch:
-                    if not item.fut.done():
-                        item.fut.set_exception(exc)
-                continue
             self.batches_dispatched += 1
             self.queries_dispatched += len(batch)
             # finish asynchronously: the collect loop immediately forms and
@@ -512,48 +607,71 @@ class _MicroBatcher:
     ) -> None:
         loop = asyncio.get_running_loop()
         fetch_t0 = time.perf_counter()
-        exec_fut = loop.run_in_executor(self._fetch_pool, finalize)
-        exec_fut.add_done_callback(_swallow_result)
-        try:
-            done, pending = await asyncio.wait(
-                [exec_fut], timeout=deadline.remaining()
+        if getattr(finalize, "resolved", False):
+            # combined fast path (_dispatch_combined): the dispatch call
+            # already ran finalize on the dispatch thread under the dispatch
+            # watchdog — results are in hand, no fetch hop. The device
+            # transport DID block inside that call (the finalize's device_s
+            # window), so it still counts as stall time: an idle-but-serving
+            # instance, where every solo request takes this path, must not
+            # read as zero-stall
+            results = finalize()
+            fetch_s = time.perf_counter() - fetch_t0
+            self._server._m_fetch.observe(fetch_s)
+            device_s = (getattr(finalize, "timings", None) or {}).get(
+                "device_s", 0.0
             )
-        except asyncio.CancelledError:
-            self._inflight.release()
-            # shutdown: resolve the batch's futures (handlers awaiting them
-            # would otherwise hang for aiohttp's whole shutdown timeout)
-            self._fail_batch(batch, ShuttingDownError())
-            raise
-        if pending:
-            # fetch watchdog: same walk-away as dispatch (see _run); other
-            # finalizes in flight on the old pool still run to completion
-            self._inflight.release()
-            self.watchdog_trips += 1
-            self._server._m_watchdog.inc()
-            self._replace_fetch_pool()
-            self._server.dispatch_breaker.record_failure()
-            self._fail_batch(
-                batch, DeadlineExceeded("micro-batch fetch: deadline exceeded")
-            )
-            return
-        fetch_s = time.perf_counter() - fetch_t0
-        self._server._m_fetch.observe(fetch_s)
-        # the fetch phase is where the host blocks on the device transport:
-        # account it as stall time (see obs/jaxprof.py)
-        self._server._m_stall.inc(fetch_s, where="micro-batch-fetch")
-        try:
-            results = exec_fut.result()
-        except BaseException as exc:
-            # a finalize that raised wholesale is a dispatch-path failure
-            # (per-query errors are isolated inside finalize and arrive as
-            # entries in the results) — it must count against the breaker
-            # exactly like a failed dispatch, not close a half-open circuit
-            results = [(exc, "")] * len(batch)
-            self._server.dispatch_breaker.record_failure()
-        else:
+            if device_s > 0.0:
+                self._server._m_stall.inc(device_s, where="micro-batch-fetch")
             self._server.dispatch_breaker.record_success()
-        finally:
             self._inflight.release()
+        else:
+            exec_fut = loop.run_in_executor(self._fetch_pool, finalize)
+            exec_fut.add_done_callback(_swallow_result)
+            try:
+                done, pending = await asyncio.wait(
+                    [exec_fut], timeout=deadline.remaining()
+                )
+            except asyncio.CancelledError:
+                self._inflight.release()
+                # shutdown: resolve the batch's futures (handlers awaiting
+                # them would otherwise hang for aiohttp's whole shutdown
+                # timeout)
+                self._fail_batch(batch, ShuttingDownError())
+                raise
+            if pending:
+                # fetch watchdog: same walk-away as dispatch (see _run);
+                # other finalizes in flight on the old pool still run to
+                # completion
+                self._inflight.release()
+                self.watchdog_trips += 1
+                self._server._m_watchdog.inc()
+                self._replace_fetch_pool()
+                self._server.dispatch_breaker.record_failure()
+                self._fail_batch(
+                    batch,
+                    DeadlineExceeded("micro-batch fetch: deadline exceeded"),
+                )
+                return
+            fetch_s = time.perf_counter() - fetch_t0
+            self._server._m_fetch.observe(fetch_s)
+            # the fetch phase is where the host blocks on the device
+            # transport: account it as stall time (see obs/jaxprof.py)
+            self._server._m_stall.inc(fetch_s, where="micro-batch-fetch")
+            try:
+                results = exec_fut.result()
+            except BaseException as exc:
+                # a finalize that raised wholesale is a dispatch-path
+                # failure (per-query errors are isolated inside finalize and
+                # arrive as entries in the results) — it must count against
+                # the breaker exactly like a failed dispatch, not close a
+                # half-open circuit
+                results = [(exc, "")] * len(batch)
+                self._server.dispatch_breaker.record_failure()
+            else:
+                self._server.dispatch_breaker.record_success()
+            finally:
+                self._inflight.release()
         done_t = time.perf_counter()
         # waterfall decomposition of the dispatch-end -> results-distributed
         # window: device compute and serve are measured inside finalize (it
@@ -570,6 +688,8 @@ class _MicroBatcher:
             wf.observe(PHASE_DEVICE_COMPUTE, device_s, item.trace_id)
             wf.observe(PHASE_FETCH, fetch_resid_s, item.trace_id)
             wf.observe(PHASE_SERVE, serve_s, item.trace_id)
+            if item.cache_key is not None and not isinstance(out, BaseException):
+                self._server._cache_store(version, item.cache_key, out)
             item.phases["t_done"] = done_t
             queue_s = max(
                 0.0, item.phases.get("t_collect", item.t_submit) - item.t_submit
@@ -753,6 +873,37 @@ class QueryServer:
         # phase waterfall (pio_phase_seconds{phase=...}) with trace-id
         # exemplars — see obs/waterfall.py for the phase boundaries
         self.waterfall = PhaseWaterfall(m)
+        # version-keyed result cache (registry/result_cache.py): repeat
+        # queries on a quiesced stable lane answer BEFORE batch admission.
+        # The pio_cache_* counters mirror the cache's own monotonic stats
+        # at scrape time (same set_total pattern as the batcher counters).
+        self._result_cache: ResultCache | None = (
+            ResultCache(
+                self.config.result_cache_size, self.config.result_cache_ttl_s
+            )
+            if self.config.result_cache_size > 0
+            else None
+        )
+        self._m_cache_hits = m.counter(
+            "pio_cache_hits_total",
+            "queries answered from the version-keyed result cache "
+            "(never entered the micro-batch queue)",
+        )
+        self._m_cache_misses = m.counter(
+            "pio_cache_misses_total",
+            "cacheable queries that missed and went through dispatch",
+        )
+        self._m_cache_evictions = m.counter(
+            "pio_cache_evictions_total",
+            "result-cache entries dropped by LRU pressure or TTL expiry",
+        )
+        self._m_cache_invalidations = m.counter(
+            "pio_cache_invalidations_total",
+            "result-cache entries flushed by model swap/promote/rollback/"
+            "stage/reload",
+        )
+        if self._result_cache is not None:
+            m.register_collector(self._collect_cache)
         # declarative SLOs evaluated as multi-window burn rates from the
         # instruments above (obs/slo.py): /slo + pio_slo_* gauges
         self.slo = SLOEngine(m)
@@ -939,6 +1090,58 @@ class QueryServer:
             payload = await request.json()
         except Exception as exc:
             return web.json_response({"message": str(exc)}, status=400)
+        # ingress parse complete (auth + size check + JSON decode) — the
+        # first waterfall phase. The same timestamp anchors the cache
+        # phase so the two tile exactly.
+        t_parse_end = time.perf_counter()
+        parse_s = t_parse_end - phases.get("t_start", t0)
+        phases["parse_s"] = parse_s
+        self.waterfall.observe(PHASE_INGRESS_PARSE, parse_s, current_trace_id())
+        # ---- version-keyed result cache, consulted BEFORE admission ----
+        # (and before the breaker check: a wedged device must not block
+        # answers the cache already holds). A hit's waterfall is
+        # parse -> cache -> respond; a miss pays the lookup in the cache
+        # phase and carries its canonical key so the batcher can insert
+        # the answer under the version that actually served it.
+        cache = self._result_cache
+        cache_key: bytes | None = None
+        t_anchor = t_parse_end
+        if cache is not None:
+            entry = None
+            version = self._cache_lookup_version()
+            if version is not None:
+                try:
+                    cache_key = _canonical_query_bytes(payload)
+                except (TypeError, ValueError):
+                    cache_key = None
+                if cache_key is not None:
+                    entry = cache.get(version, cache_key)
+            t_cache_end = time.perf_counter()
+            cache_s = t_cache_end - t_parse_end
+            phases["cache_s"] = cache_s
+            self.waterfall.observe(PHASE_CACHE, cache_s, current_trace_id())
+            t_anchor = t_cache_end
+            if entry is not None:
+                self._m_cache_hits.inc()
+                phases["t_done"] = t_cache_end
+                text = entry.text
+                if text is None:
+                    # serialize once per entry; every later hit's respond
+                    # phase is a prebuilt-string write
+                    text = entry.text = _fast_dumps(entry.body)
+                elapsed = time.perf_counter() - t0
+                self.request_count += 1
+                self.last_serving_sec = elapsed
+                self.avg_serving_sec += (
+                    elapsed - self.avg_serving_sec
+                ) / self.request_count
+                if self.config.feedback:
+                    self._spawn_bg(self._send_feedback(payload, entry.body))
+                return web.Response(
+                    text=text, content_type="application/json"
+                )
+            if cache_key is not None:
+                self._m_cache_misses.inc()
         try:
             # a wedged device has tripped the dispatch breaker: shed at the
             # door with a Retry-After instead of queueing doomed work
@@ -949,23 +1152,20 @@ class QueryServer:
                 "serving temporarily unavailable (dispatch circuit open)",
                 exc.retry_after_s,
             )
-        # ingress parse complete (auth + size check + JSON decode +
-        # breaker admission) — the first waterfall phase. The same
-        # timestamp anchors the queue-wait phase so the two tile exactly
-        # (the observation cost itself lands in queue_wait).
-        t_parse_end = time.perf_counter()
-        parse_s = t_parse_end - phases.get("t_start", t0)
-        phases["parse_s"] = parse_s
-        self.waterfall.observe(PHASE_INGRESS_PARSE, parse_s, current_trace_id())
         deadline = Deadline.after(self.config.request_timeout_s)
         try:
             # the batcher runs decode -> supplement -> predict_batch -> serve
             # on its worker thread, so the event loop never blocks on device
             # or storage work and concurrent requests coalesce into one
             # batched device call; the deadline rides along and bounds every
-            # stage (queue wait, dispatch, result fetch)
+            # stage (queue wait, dispatch, result fetch — the breaker
+            # admission above is accounted into queue_wait via the anchor)
             body = await self._batcher.submit(
-                payload, deadline, phases=phases, t_submit=t_parse_end
+                payload,
+                deadline,
+                phases=phases,
+                t_submit=t_anchor,
+                cache_key=cache_key,
             )
         except LoadShedError as exc:
             # this request died before any dispatch could record against the
@@ -999,10 +1199,11 @@ class QueryServer:
         # the respond phase (results distributed -> future resumed ->
         # response serialized) is observed by the envelope in
         # handle_queries, anchored on the same end timestamp as the e2e
-        # latency histogram
-        return web.json_response(body)
+        # latency histogram; the precompiled compact encoder keeps it off
+        # the sort_keys canonical path
+        return web.json_response(body, dumps=_fast_dumps)
 
-    def _dispatch_query_batch(self, items: list[tuple[Any, str | None]]):
+    def _dispatch_query_batch(self, items: list[_QItem]):
         """Dispatch-phase of one micro-batch (runs on the dispatch thread):
         decode and supplement each query, then *dispatch* every algorithm's
         device work via ``predict_batch_dispatch`` without blocking on
@@ -1010,11 +1211,12 @@ class QueryServer:
         blocks on the transport, serves, and encodes — so the dispatcher can
         start batch n+1 while batch n's results are in flight.
 
-        ``items`` pairs each payload with its ingress trace id; the id is
-        re-installed around the per-query stages (decode/supplement here,
-        serve in finalize) so spans those stages record — a serving
-        component fetching user features from storage, say — join the
-        request's trace across the thread hop.
+        ``items`` is the batcher's queued-item list itself (payload +
+        ingress trace id read in place — zero per-batch re-packing); the
+        trace id is re-installed around the per-query stages
+        (decode/supplement here, serve in finalize) so spans those stages
+        record — a serving component fetching user features from storage,
+        say — join the request's trace across the thread hop.
 
         Rollout routing happens here: ONE read each of ``_active`` /
         ``_candidate`` / ``_plan`` means an in-flight batch is immune to
@@ -1038,8 +1240,8 @@ class QueryServer:
             cand is not None and plan.mode == MODE_CANARY and plan.fraction > 0
         )
         shadow = cand is not None and plan.mode == MODE_SHADOW
-        payloads = [p for p, _ in items]
-        trace_ids = [t for _, t in items]
+        payloads = [it.payload for it in items]
+        trace_ids = [it.trace_id for it in items]
         n = len(payloads)
         outs: list[Any] = [None] * n
         versions: list[str] = [stable.version] * n
@@ -1430,6 +1632,11 @@ class QueryServer:
                 "requestCount": self.request_count,
                 "avgServingSec": self.avg_serving_sec,
                 "lastServingSec": self.last_serving_sec,
+                "resultCache": (
+                    self._result_cache.stats()
+                    if self._result_cache is not None
+                    else None
+                ),
                 "latency": self._latency_summary_ms(),
                 "batching": {
                     "batches": self._batcher.batches_dispatched,
@@ -1550,6 +1757,7 @@ class QueryServer:
             # commit: one consistent swap, nothing mutated on any failure path
             self.engine_params = engine_params
             with self._rollout_mutex:
+                retired = self._active.version
                 self._active = Lane(  # atomic swap
                     algorithms,
                     serving,
@@ -1569,6 +1777,9 @@ class QueryServer:
                     self.rollout_controller.begin(
                         new_version, cand.version, self._plan.mode
                     )
+            # the registry-swap invalidation hook: the version that just
+            # stopped serving must not answer another query from cache
+            self._cache_flush(retired, f"reload -> {new_version}")
         logger.info("reloaded engine instance %s", latest.id)
         return web.json_response(
             {"message": "Reload successful", "instanceId": latest.id}
@@ -1576,6 +1787,55 @@ class QueryServer:
 
     def _engine_params_of(self, instance: EngineInstance) -> EngineParams:
         return _engine_params_of_instance(self.engine, instance)
+
+    # ------------------------------------------------- result cache plumbing
+    def _cache_lookup_version(self) -> str | None:
+        """The version whose cache lane may answer right now: the stable
+        version when no rollout is active, None (= bypass) while one is.
+        Canary users must exercise the candidate for the bake gates to
+        mean anything, shadow mode needs dispatched stable answers to
+        sample — and because candidate answers are never cached, a canary
+        answer can never be served from a stale lane."""
+        if self._candidate is not None or self._plan is not PLAN_OFF:
+            return None
+        return self._active.version
+
+    def _cache_store(self, version: str, key: bytes, body: Any) -> None:
+        """Insert one answered body (called by the batcher's finish path).
+        Guarded at store time: only the CURRENT stable version's answers
+        are cacheable — a swap or stage between dispatch and store
+        orphans the write instead of caching across the boundary."""
+        cache = self._result_cache
+        if cache is None:
+            return
+        if self._candidate is not None or version != self._active.version:
+            return
+        cache.put(version, key, body)
+
+    def _cache_flush(self, version: str | None, why: str) -> None:
+        """Invalidate the affected lane's entries on a rollout transition
+        (stage/promote/rollback) or reload. ``version=None`` clears all."""
+        cache = self._result_cache
+        if cache is None:
+            return
+        n = cache.clear() if version is None else cache.flush_version(version)
+        if n:
+            logger.info("result cache: flushed %d entries (%s)", n, why)
+
+    def _collect_cache(self) -> None:
+        """Scrape-time mirror of the cache's monotonic stats into the
+        pio_cache_* counters (hits are also inc'd inline on the hot path;
+        set_total clamps monotonic so the two sources can't fight)."""
+        stats = self._result_cache.stats()
+        self._m_cache_hits.set_total(stats["hits"])
+        self._m_cache_misses.set_total(stats["misses"])
+        self._m_cache_evictions.set_total(stats["evictions"])
+        self._m_cache_invalidations.set_total(stats["invalidations"])
+
+    @property
+    def cache_hit_ratio(self) -> float:
+        cache = self._result_cache
+        return cache.hit_ratio if cache is not None else 0.0
 
     # ------------------------------------------------- progressive rollout
     def _version_for_instance(self, instance_id: str) -> str:
@@ -1621,6 +1881,11 @@ class QueryServer:
                 mode, fraction if mode == MODE_CANARY else 0.0, lane.version
             )
             self.rollout_controller.begin(self._active.version, lane.version, mode)
+        # a RE-staged candidate must not inherit entries from any earlier
+        # life of its version (e.g. a prior bake followed by rollback);
+        # lookups are bypassed for the whole bake anyway — this flush
+        # guarantees the lane starts empty
+        self._cache_flush(lane.version, f"stage {lane.version}")
         self._rollout_instruments.set_plan(self._plan)
         if persist and self.registry_store is not None:
             try:
@@ -1644,6 +1909,7 @@ class QueryServer:
             if cand is None:
                 return None
             self._rollout_gen += 1
+            retired = self._active.version
             self._active = cand
             if cand.instance_id:
                 self.instance_id = cand.instance_id
@@ -1652,6 +1918,10 @@ class QueryServer:
             self._candidate = None
             self._plan = PLAN_OFF
             self.rollout_controller.end()
+        # the retired stable's lane is the affected one: its entries stop
+        # being addressable (lookups key on the NEW stable) — flush them
+        # so nothing lingers in memory either
+        self._cache_flush(retired, f"promote {cand.version}")
         self._rollout_instruments.set_plan(PLAN_OFF)
         self._rollout_instruments.promotions.inc()
         if self.registry_store is not None:
@@ -1675,6 +1945,11 @@ class QueryServer:
             self._candidate = None
             self._plan = PLAN_OFF
             self.rollout_controller.end()
+        # the candidate lane is the affected one (stable entries stay
+        # valid — stable never changed); candidate answers are never
+        # cached, so this is belt-and-braces against any future path that
+        # would put them there
+        self._cache_flush(cand.version, f"rollback {cand.version} ({reason})")
         self._rollout_instruments.set_plan(PLAN_OFF)
         self._rollout_instruments.rollbacks.inc(reason=reason)
         if self.registry_store is not None:
@@ -2016,8 +2291,11 @@ class QueryServer:
         last_error: Exception | None = None
         for attempt in range(retries):
             # fresh runner+site per attempt: a TCPSite cannot be re-started
-            # after a failed bind (it stays registered with the runner)
-            self._runner = web.AppRunner(self.make_app())
+            # after a failed bind (it stays registered with the runner).
+            # access_log=None: per-request access-line formatting is host
+            # glue on the respond phase; request accounting is owned by
+            # the metrics registry + waterfall instead
+            self._runner = web.AppRunner(self.make_app(), access_log=None)
             await self._runner.setup()
             site = web.TCPSite(
                 self._runner,
@@ -2177,11 +2455,31 @@ def _query_server_from_registry(
     )
 
 
+def _maybe_install_uvloop() -> bool:
+    """Swap in uvloop when available (PIO_UVLOOP=0 opts out): the query
+    hot path is event-loop-bound once device work is micro-batched, and
+    uvloop's C event loop shaves the per-request asyncio overhead. A
+    missing uvloop is silently fine — it is optional by contract (the
+    container image must not need it)."""
+    import os
+
+    if os.environ.get("PIO_UVLOOP", "1").lower() in ("0", "false", "no"):
+        return False
+    try:
+        import uvloop  # type: ignore[import-not-found]
+    except ImportError:
+        return False
+    uvloop.install()
+    logger.info("uvloop installed for the query server event loop")
+    return True
+
+
 def run_query_server(
     engine_dir: str,
     variant_path: str | None = None,
     config: ServerConfig | None = None,
 ) -> None:
+    _maybe_install_uvloop()
     server = create_query_server(engine_dir, variant_path, config=config)
 
     async def main():

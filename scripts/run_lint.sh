@@ -72,10 +72,37 @@ PYEOF
 
   # 3. a CPU-only bench smoke: the serving_local phase drives the real
   #    QueryServer over loopback and records the full phase waterfall —
-  #    proving the evidence chain end to end on every CI run
+  #    proving the evidence chain end to end on every CI run.
+  #    --no-compare: the smoke's own gate (next step) runs with a
+  #    noise-tolerant threshold, not the strict full-round default
   env JAX_PLATFORMS=cpu PIO_BENCH_SCALE=ml100k \
-    python bench.py --cpu-only --only serving_local > /tmp/pio_bench_smoke.json
+    python bench.py --cpu-only --no-compare --only serving_local \
+    > /tmp/pio_bench_smoke.json
   echo "bench smoke: $(tail -c 300 /tmp/pio_bench_smoke.json)"
+
+  # 4. the device-bound-serving gate (ISSUE 8): the smoke's fetch-phase
+  #    p50 (and the other p50/qps fields it shares with the fixture) must
+  #    stay under the checked-in pre-fused-top-k baseline — the O(batch*k)
+  #    fetch contract is held by measurement on every CI run. p95s are
+  #    excluded (shared-CI-host tail noise) and the tolerance is wide: the
+  #    full-fetch regression this guards is a step change, not jitter.
+  python - "$baseline" > /tmp/pio_smoke_baseline.json <<'PYEOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+keep = {
+    k: v for k, v in d.items()
+    if k.endswith("_p50_ms") or k.endswith("_qps")
+}
+print(json.dumps(keep))
+PYEOF
+  if ! python bench.py --compare /tmp/pio_smoke_baseline.json \
+      --current /tmp/pio_bench_smoke.json --compare-tolerance 1.0 \
+      > /tmp/pio_compare_smoke.json; then
+    echo "serving smoke regressed vs checked-in baseline:" >&2
+    tail -c 600 /tmp/pio_compare_smoke.json >&2
+    exit 1
+  fi
+  echo "serving smoke vs baseline: $(tail -c 240 /tmp/pio_compare_smoke.json)"
 
   # chaos gate includes the observability suite (tests/test_obs.py):
   # counters moving under faults + trace propagation are CI-asserted

@@ -589,6 +589,104 @@ class TestTrainSyncRule:
         assert rule_ids(suppressed) == ["train-unaccounted-sync"]
 
 
+class TestServingRoundtripRule:
+    def test_host_argsort_and_full_fetch_fire_on_predict_path(self):
+        active, _ = lint_snippet(
+            """
+            import numpy as np
+
+            def predict(model, query):
+                scores = np.asarray(model.device_scores)
+                idx = np.argsort(-scores)
+                return idx[: query.num]
+            """,
+            display_path="pkg/models/foo/engine.py",
+        )
+        assert rule_ids(active) == ["serving-host-roundtrip"] * 2
+        assert all(f.severity == Severity.ERROR for f in active)
+
+    def test_nested_finalize_is_covered(self):
+        # the dispatch pattern hides the fetch inside a closure — the rule
+        # must walk nested functions of the predict-path entry points
+        active, _ = lint_snippet(
+            """
+            import numpy as np
+
+            def predict_batch_dispatch(model, queries):
+                handle = model.dispatch(queries)
+
+                def finalize():
+                    return np.argpartition(-np.asarray(handle), 10)
+
+                return finalize
+            """,
+            display_path="pkg/models/foo/engine.py",
+        )
+        assert rule_ids(active) == ["serving-host-roundtrip"] * 2
+
+    def test_fused_helper_and_host_topk_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import numpy as np
+            from predictionio_tpu.ops import topk
+
+            def predict_batch_dispatch(model, queries):
+                handle = topk.dot_top_k_async(
+                    model.table, model.vecs, None, 10
+                )
+
+                def finalize():
+                    scores, idx = topk.fetch_topk(handle)
+                    sk, si = topk.host_top_k(model.counts, None, 10)
+                    return scores, idx, sk, si
+
+                return finalize
+            """,
+            display_path="pkg/models/foo/engine.py",
+        )
+        assert active == []
+
+    def test_two_arg_asarray_host_idiom_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import numpy as np
+
+            def predict(model, query):
+                vec = np.asarray(query.features, np.float32)
+                return model.score(vec)
+            """,
+            display_path="pkg/models/foo/engine.py",
+        )
+        assert active == []
+
+    def test_training_code_in_engine_module_quiet(self):
+        # the rule scopes to the predict path, not the whole module: a
+        # trainer materializing factors host-side is the train rule's
+        # business (different globs), not a serving roundtrip
+        active, _ = lint_snippet(
+            """
+            import numpy as np
+
+            def train(ctx, data):
+                return np.asarray(data.factors)
+            """,
+            display_path="pkg/models/foo/engine.py",
+        )
+        assert active == []
+
+    def test_same_code_outside_engine_globs_quiet(self):
+        active, _ = lint_snippet(
+            """
+            import numpy as np
+
+            def predict(model, query):
+                return np.argsort(-np.asarray(model.scores))
+            """,
+            display_path="pkg/eval/fast_eval.py",
+        )
+        assert active == []
+
+
 # ---------------------------------------------------------------------------
 # engine mechanics: suppression, severity, parse errors
 # ---------------------------------------------------------------------------

@@ -898,6 +898,7 @@ class TestExemplars:
 
 PHASE_NAMES = (
     "ingress_parse",
+    "cache",  # version-keyed result-cache lookup (PR 8)
     "queue_wait",
     "batch_assembly",
     "dispatch",

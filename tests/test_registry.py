@@ -37,6 +37,7 @@ from predictionio_tpu.registry.controller import (
     VERDICT_ROLLBACK,
     VERDICT_WAIT,
 )
+from predictionio_tpu.registry.result_cache import ResultCache
 from predictionio_tpu.registry.router import (
     LANE_CANDIDATE,
     LANE_STABLE,
@@ -1027,6 +1028,191 @@ class TestSwapConsistencyUnderTraffic:
                         assert span["tags"]["version"] == version
                         checked += 1
             assert checked >= 40  # ring keeps the recent ones at minimum
+
+        _run_server(body, server)
+
+
+# ---------------------------------------------------------------------------
+# version-keyed result cache (registry/result_cache.py + serving wiring)
+# ---------------------------------------------------------------------------
+
+
+class TestResultCacheUnit:
+    def test_lru_eviction_and_counters(self):
+        clock = FakeClock()
+        cache = ResultCache(max_entries=2, ttl_s=0.0, clock=clock)
+        cache.put("v1", b"a", {"n": 1})
+        cache.put("v1", b"b", {"n": 2})
+        assert cache.get("v1", b"a").body == {"n": 1}  # refreshes a's recency
+        cache.put("v1", b"c", {"n": 3})  # evicts b (LRU)
+        assert cache.get("v1", b"b") is None
+        assert cache.get("v1", b"a") is not None
+        assert cache.evictions == 1
+        assert cache.hits == 2 and cache.misses == 1
+
+    def test_ttl_expiry_counts_as_eviction(self):
+        clock = FakeClock()
+        cache = ResultCache(max_entries=8, ttl_s=5.0, clock=clock)
+        cache.put("v1", b"a", {"n": 1})
+        clock.advance(4.9)
+        assert cache.get("v1", b"a") is not None
+        clock.advance(0.2)
+        assert cache.get("v1", b"a") is None
+        assert cache.evictions == 1 and cache.misses == 1
+
+    def test_version_key_isolates_lanes_and_flush_is_scoped(self):
+        cache = ResultCache(max_entries=8, ttl_s=0.0)
+        cache.put("v1", b"q", {"from": "v1"})
+        cache.put("v2", b"q", {"from": "v2"})
+        assert cache.get("v1", b"q").body == {"from": "v1"}
+        assert cache.get("v2", b"q").body == {"from": "v2"}
+        assert cache.flush_version("v1") == 1  # exactly v1's entries
+        assert cache.get("v1", b"q") is None
+        assert cache.get("v2", b"q") is not None
+        assert cache.invalidations == 1
+
+    def test_disabled_cache_is_inert(self):
+        cache = ResultCache(max_entries=0)
+        cache.put("v1", b"a", {})
+        assert cache.get("v1", b"a") is None
+        assert len(cache) == 0 and cache.misses == 0
+
+
+class TestResultCacheServing:
+    def test_hit_answers_without_entering_batch_queue(self):
+        """The acceptance rail: a repeat query answers from the cache
+        BEFORE micro-batch admission — the batcher never sees it."""
+        server = _tag_server()
+
+        async def body(client):
+            r1 = await client.post("/queries.json", json={"qid": 7})
+            assert r1.status == 200
+            body1 = await r1.json()
+            dispatched = server._batcher.queries_dispatched
+            # same canonical payload, different key order: one cache entry
+            r2 = await client.post(
+                "/queries.json",
+                data=json.dumps({"qid": 7}),
+                headers={"Content-Type": "application/json"},
+            )
+            assert r2.status == 200
+            assert await r2.json() == body1
+            assert server._batcher.queries_dispatched == dispatched
+            assert server._result_cache.hits == 1
+            text = await (await client.get("/metrics")).text()
+            assert "pio_cache_hits_total 1" in text
+            assert 'pio_phase_seconds_count{phase="cache"}' in text
+
+        _run_server(body, server)
+
+    def test_active_rollout_bypasses_cache_entirely(self):
+        """Canary users must exercise the candidate for the bake gates to
+        mean anything: while a rollout is staged, lookups AND stores are
+        bypassed — a canary answer can never be cached, so it can never
+        be served from a stale lane."""
+        server = _tag_server()
+        server.stage_candidate_lane(_tag_lane("v2"), fraction=0.5, persist=False)
+
+        async def body(client):
+            for _ in range(2):
+                resp = await client.post("/queries.json", json={"qid": 1, "user": "u1"})
+                assert resp.status == 200
+            cache = server._result_cache
+            assert len(cache) == 0  # nothing stored
+            assert cache.hits == 0 and cache.misses == 0  # nothing looked up
+
+        _run_server(body, server)
+
+    def test_promote_swap_serves_no_stale_answer(self):
+        """The registry swap test: an answer cached under the old stable
+        must not survive a promote — the next query is answered by the
+        new version, and the retired lane's entries are flushed."""
+        server = _tag_server()
+
+        async def body(client):
+            r1 = await client.post("/queries.json", json={"qid": 3})
+            assert (await r1.json())["model"] == "v1"
+            assert len(server._result_cache) == 1
+            server.stage_candidate_lane(
+                _tag_lane("v2"), fraction=0.0, persist=False
+            )
+            assert server._promote_candidate() == "v2"
+            # the version boundary: same payload, NEW answer
+            r2 = await client.post("/queries.json", json={"qid": 3})
+            assert (await r2.json())["model"] == "v2"
+            cache = server._result_cache
+            assert cache.invalidations >= 1  # retired v1 lane flushed
+            assert all(k[0] != "v1" for k in cache._entries)
+
+        _run_server(body, server)
+
+    def test_rollback_flushes_exactly_the_candidate_lane(self):
+        server = _tag_server()
+        cache = server._result_cache
+        cache.put("v1", b"q1", {"from": "v1"})
+        server.stage_candidate_lane(_tag_lane("v2"), persist=False)
+        # belt-and-braces seeding: no real path caches candidate answers
+        cache.put("v2", b"q2", {"from": "v2"})
+        assert server._rollback_candidate("manual") == "v2"
+        assert cache.get("v2", b"q2") is None
+        # stable never changed: its entries stay valid and addressable
+        assert cache.get("v1", b"q1").body == {"from": "v1"}
+
+    def test_breaker_trip_auto_rollback_flushes_candidate_lane(self):
+        """The chaos-stage contract: a breaker-trip INSTANT rollback runs
+        the same flush as a manual one — zero stale candidate entries."""
+        server = _tag_server(candidate_breaker_threshold=1)
+        cache = server._result_cache
+        server.stage_candidate_lane(
+            _tag_lane("v2", fail=True), fraction=1.0, persist=False
+        )
+        cache.put("v2", b"q", {"from": "v2"})
+
+        async def body(client):
+            resp = await client.post("/queries.json", json={"qid": 5, "user": "u5"})
+            assert resp.status == 200  # re-answered on stable, zero 5xx
+            assert (await resp.json())["model"] == "v1"
+            deadline = time.monotonic() + 5.0
+            while server._candidate is not None:
+                assert time.monotonic() < deadline, "auto-rollback never fired"
+                await asyncio.sleep(0.01)
+            assert cache.get("v2", b"q") is None
+
+        _run_server(body, server)
+
+    def test_restaged_candidate_lane_starts_empty(self):
+        """A RE-staged candidate must not inherit entries from an earlier
+        life of its version (prior bake + rollback)."""
+        server = _tag_server()
+        cache = server._result_cache
+        server.stage_candidate_lane(_tag_lane("v2"), persist=False)
+        cache.put("v2", b"old-bake", {"from": "v2-old"})
+        server._rollback_candidate("manual")
+        server.stage_candidate_lane(_tag_lane("v2"), persist=False)
+        assert cache.get("v2", b"old-bake") is None
+
+    def test_store_guard_orphans_write_across_swap(self):
+        """A swap between dispatch and store must orphan the write: the
+        batcher hands _cache_store the version that ANSWERED, and the
+        guard drops it when that is no longer the current stable."""
+        server = _tag_server()
+        server._cache_store("v1", b"q", {"from": "v1"})
+        assert len(server._result_cache) == 1
+        server._result_cache.clear()
+        server._active = _tag_lane("v2")  # swapped while batch in flight
+        server._cache_store("v1", b"q", {"from": "v1"})
+        assert len(server._result_cache) == 0
+
+    def test_cache_disabled_by_config(self):
+        server = _tag_server(result_cache_size=0)
+
+        async def body(client):
+            for _ in range(2):
+                resp = await client.post("/queries.json", json={"qid": 1})
+                assert resp.status == 200
+            assert server._result_cache is None
+            text = await (await client.get("/metrics")).text()
+            assert "pio_cache_hits_total 0" in text  # registered, inert
 
         _run_server(body, server)
 

@@ -199,6 +199,18 @@ class TestSimilarProduct:
         _, algo, model = self.make(memory_storage)
         assert algo.predict(model, Query(items=("ghost",), num=5)).item_scores == ()
 
+    def test_batch_nonpositive_num_returns_empty(self, memory_storage):
+        from predictionio_tpu.models.similarproduct.engine import Query
+
+        _, algo, model = self.make(memory_storage)
+        # a num<=0 query sharing a batch with a real one must come back
+        # empty, not sliced as scores[:, :num] with a negative num
+        rs = algo.predict_batch(
+            model, [Query(items=("i1",), num=-1), Query(items=("i2",), num=5)]
+        )
+        assert rs[0].item_scores == ()
+        assert len(rs[1].item_scores) == 5
+
     def test_cooccurrence_algorithm(self, memory_storage):
         from predictionio_tpu.models.similarproduct.engine import Query
 
@@ -287,6 +299,18 @@ class TestECommerce:
         c, algo, model, _ = self.make(memory_storage)
         r = algo.predict_with_context(c, model, Query(user="stranger", num=3))
         assert r.item_scores[0].item == "i0"  # most-bought item first
+
+    def test_batch_nonpositive_num_returns_empty(self, memory_storage):
+        from predictionio_tpu.models.ecommerce.engine import Query
+
+        c, algo, model, _ = self.make(memory_storage)
+        # a num<=0 query sharing a batch with a real one must come back
+        # empty, not sliced as scores[:num] with a negative num
+        rs = algo.predict_batch(
+            model, [Query(user="u0", num=-1), Query(user="u1", num=4)]
+        )
+        assert rs[0].item_scores == ()
+        assert len(rs[1].item_scores) == 4
 
     def test_cold_user_recent_views(self, memory_storage):
         from predictionio_tpu.models.ecommerce.engine import Query
