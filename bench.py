@@ -1062,6 +1062,15 @@ print(json.dumps(
         hit_lat.append(time.perf_counter() - t0)
     hit_conn.close()
 
+    # fleet gateway hop (ISSUE 9): the SAME cached query through a
+    # one-replica fleet Gateway on loopback — two hops where the direct
+    # pass paid one. The p50 delta is the pure proxy overhead a fleet
+    # deploy adds per request; --compare gates it (<1 ms contract,
+    # serving_gateway_hop_p50_ms in the baseline fixture)
+    gw_stats = _bench_gateway_hop(
+        port, users[0], k, float(np.percentile(np.asarray(hit_lat) * 1e3, 50))
+    )
+
     batcher = server_box["server"]._batcher
     # snapshot the server's own metrics registry before shutdown: the
     # BENCH_*.json perf trajectory carries the server-side latency
@@ -1105,8 +1114,107 @@ print(json.dumps(
             else 0.0
         ),
         "serving_cache_hit_p50_ms": float(np.percentile(hit_ms, 50)),
+        **gw_stats,
         **obs,
     }
+
+
+def _bench_gateway_hop(
+    server_port: int, user: str, k: int, direct_p50_ms: float, n: int = 64
+) -> dict:
+    """Measure the fleet gateway's per-request overhead: a one-replica
+    :class:`~predictionio_tpu.fleet.gateway.Gateway` in front of the
+    already-running bench server, hit sequentially with the same cached
+    query the direct pass timed. Records the replica count of the
+    measured topology, the through-gateway p50, and the hop delta
+    (clamped at 0 — scheduling jitter must not record a negative cost)."""
+    import asyncio
+    import http.client
+    import socket as _socket
+    import threading
+
+    import numpy as np
+
+    from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+
+    gw_port = _free_port()
+    loop = asyncio.new_event_loop()
+    box: dict = {}
+
+    def serve() -> None:
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            gw = Gateway(
+                GatewayConfig(
+                    ip="127.0.0.1",
+                    port=gw_port,
+                    replica_urls=(f"http://127.0.0.1:{server_port}",),
+                    probe_interval_s=5.0,
+                )
+            )
+            await gw.start()
+            box["gw"] = gw
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    started = False
+    for _ in range(100):
+        if "gw" in box:
+            started = True
+            break
+        time.sleep(0.05)
+    try:
+        if not started:
+            raise RuntimeError("gateway failed to start")
+        conn = http.client.HTTPConnection("127.0.0.1", gw_port, timeout=60)
+        conn.connect()
+        conn.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        body = json.dumps({"user": user, "num": k})
+
+        def post_once() -> None:
+            conn.request(
+                "POST",
+                "/queries.json",
+                body,
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"gateway bench request failed ({resp.status})")
+
+        for _ in range(4):  # warm the gateway->replica keep-alive session
+            post_once()
+        lat = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            post_once()
+            lat.append(time.perf_counter() - t0)
+        conn.close()
+        gw_p50 = float(np.percentile(np.asarray(lat) * 1e3, 50))
+        return {
+            "serving_fleet_replicas": 1.0,
+            "serving_gateway_p50_ms": gw_p50,
+            "serving_gateway_hop_p50_ms": max(0.0, gw_p50 - direct_p50_ms),
+        }
+    except Exception as exc:  # noqa: BLE001 - missing hop evidence, never fatal
+        # no string fields in the stats dict: every non-bool value is
+        # round()ed on save, so the failure is reported, not recorded
+        print(f"[bench] gateway hop probe failed: {exc}", file=sys.stderr)
+        return {}
+    finally:
+        gw = box.get("gw")
+        if gw is not None:
+            try:
+                asyncio.run_coroutine_threadsafe(gw.stop(), loop).result(10)
+            except Exception:
+                pass
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
 
 
 def _registry_serving_summary(server) -> dict[str, float]:
@@ -1708,6 +1816,12 @@ _COMPARE_LOWER_IS_BETTER = frozenset(
         "serving_device_p50_ms",
         "serving_seq_p50_ms",
         "serving_colocated_p50_est_ms",
+        # fleet gateway proxy overhead (ISSUE 9): regression-gated against
+        # the checked-in baseline (the sandbox HTTP floor is ~2 ms, so the
+        # paper's <1 ms production hop target is held as no-worse-than-
+        # baseline here, not as an absolute bound)
+        "serving_gateway_hop_p50_ms",
+        "serving_local_gateway_hop_p50_ms",
         "als_device_s_per_iter",
         "ecommerce_p50_ms",
         "naive_bayes_train_ms",

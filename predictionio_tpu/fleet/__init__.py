@@ -1,0 +1,41 @@
+"""Serving fleet: N supervised QueryServer replicas behind one gateway.
+
+The single-process QueryServer is both the scaling ceiling and the
+single point of failure; this package makes replica count a deployment
+knob (``pio deploy --fleet N``) instead of a rewrite:
+
+- :mod:`.supervisor` — spawns and watches the worker processes,
+  restarting crashes with exponential backoff and a crash-loop budget;
+- :mod:`.gateway` — routes queries (least-loaded + consistent-hash
+  tie-break), ejects/readmits replicas from ``/healthz`` probes and
+  per-replica circuit breakers, retries idempotent queries once on a
+  different replica, and drains gracefully on SIGTERM;
+- :mod:`.federation` — merges the replicas' Prometheus scrapes into the
+  gateway's ``/metrics`` (the ``pio top --fleet`` endpoint);
+- :mod:`.launch` — the ``pio deploy --fleet N`` glue that runs
+  supervisor + gateway in one process.
+
+Replicas coordinate ONLY through the model registry: its rollout state
+carries a monotonic ``state_generation`` every worker polls, so a
+promote/rollback issued through any replica (or the gateway) propagates
+fleet-wide and flushes each per-process result cache. See
+``docs/fleet.md``.
+"""
+
+from predictionio_tpu.fleet.federation import federate_metrics
+from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig, Replica
+from predictionio_tpu.fleet.supervisor import (
+    Supervisor,
+    SupervisorConfig,
+    WorkerSpec,
+)
+
+__all__ = [
+    "Gateway",
+    "GatewayConfig",
+    "Replica",
+    "Supervisor",
+    "SupervisorConfig",
+    "WorkerSpec",
+    "federate_metrics",
+]

@@ -1,0 +1,667 @@
+"""The fleet gateway: one ingress, N QueryServer replicas, zero-downtime.
+
+Same stack as the servers (aiohttp) so a fleet deploy adds one moving
+part, not a new runtime. Responsibilities:
+
+- **Routing.** ``POST /queries.json`` goes to the least-loaded routable
+  replica (fewest in-flight proxied requests); ties break on a
+  consistent hash of the query's sticky key, so equal-load fleets still
+  route a user deterministically and per-replica caches see repeat
+  traffic. A replica is *routable* when its ``/healthz`` probe passes
+  and its circuit breaker admits traffic. When EVERY replica has failed
+  its last probe, routing goes *panic mode* — health is ignored
+  (breakers still apply), because a fleet-wide probe blackout is more
+  often a probe artifact than a dead fleet.
+- **Ejection / readmission.** A background probe loop GETs every
+  replica's ``/healthz`` each ``probe_interval_s``; a failing or
+  unreachable replica is ejected (counted) and readmitted when the
+  probe passes again. Independently, each replica has a
+  :class:`~predictionio_tpu.resilience.CircuitBreaker` fed by proxy
+  outcomes — consecutive forward failures stop traffic within the
+  breaker threshold, faster than the next probe.
+- **Retry.** /queries.json is idempotent (pure reads), so a forward
+  that dies (connection error or replica 5xx) is retried ONCE on a
+  different replica — never on a 4xx (the client's error follows them
+  to any replica), never for the non-idempotent admin proxies, and
+  bounded by the PR-2 :class:`~predictionio_tpu.resilience.RetryBudget`
+  so a dying fleet sees load drop, not double.
+- **Drain.** SIGTERM stops the listener (new connections refused at
+  TCP), keeps answering requests that arrive on established keep-alive
+  connections — with ``Connection: close`` so clients migrate — waits
+  for in-flight proxies to finish (bounded by ``drain_grace_s``), then
+  exits. A gateway restart under a process supervisor is 5xx-free.
+- **Federation.** ``GET /metrics`` merges every replica's scrape with
+  the gateway's own ``pio_fleet_*``/``pio_gateway_*`` instruments
+  (:mod:`.federation`) — the endpoint ``pio top --fleet`` reads.
+
+Model-rollout admin (``GET /models``, ``POST /models/*``) proxies to one
+healthy replica; the change lands in the shared registry and every other
+replica adopts it through its registry-sync loop (``docs/fleet.md``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import time
+from typing import Any
+from urllib.parse import urlsplit
+
+import aiohttp
+from aiohttp import web
+
+from predictionio_tpu.fleet.federation import federate_metrics
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tracing import TRACE_HEADER, mint_trace_id
+from predictionio_tpu.obs.web import (
+    BreakerInstruments,
+    PROMETHEUS_CONTENT_TYPE,
+)
+from predictionio_tpu.registry.router import routing_key, sticky_bucket
+from predictionio_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryBudget,
+)
+
+logger = logging.getLogger(__name__)
+
+# forward outcomes that justify trying a different replica: transport
+# failures and replica-side 5xx. 4xx is the CLIENT's problem — it would
+# fail identically everywhere, and re-dispatching it doubles load for
+# nothing.
+RETRIABLE_STATUSES = frozenset((500, 502, 503, 504))
+
+
+@dataclasses.dataclass
+class GatewayConfig:
+    ip: str = "0.0.0.0"
+    port: int = 8000
+    replica_urls: tuple[str, ...] = ()
+    # /healthz probe cadence and per-probe timeout (ejection latency is
+    # bounded by interval + timeout)
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 2.0
+    # per-forward total timeout (connect + response)
+    request_timeout_s: float = 10.0
+    # one-retry budget: each first attempt earns `ratio` tokens, each
+    # retry spends 1 (resilience.RetryBudget semantics)
+    retry_budget_ratio: float = 0.2
+    # per-replica breaker: consecutive forward failures before the
+    # gateway stops routing there without waiting for the next probe
+    breaker_threshold: int = 3
+    breaker_recovery_s: float = 5.0
+    # consistent-hash tie-break key (same field the servers use for
+    # sticky canary routing)
+    sticky_key_field: str = "user"
+    max_payload_bytes: int = 1 << 20
+    shed_retry_after_s: float = 1.0
+    drain_grace_s: float = 15.0
+
+
+class Replica:
+    """Gateway-side state for one backend QueryServer."""
+
+    def __init__(self, url: str, breaker: CircuitBreaker):
+        self.url = url.rstrip("/")
+        split = urlsplit(self.url)
+        self.name = split.netloc or self.url
+        self.breaker = breaker
+        # healthy-until-proven-otherwise: the first probe fires
+        # immediately at startup, and the breaker bounds the damage of
+        # routing to a replica that was never up
+        self.healthy = True
+        # a replica that has never passed a probe is "not up yet", not
+        # "ejected": startup must not inflate the ejection counter
+        self.ever_ready = False
+        self.inflight = 0
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "url": self.url,
+            "healthy": self.healthy,
+            "inflight": self.inflight,
+            "breaker": self.breaker.snapshot(),
+        }
+
+
+class Gateway:
+    def __init__(
+        self,
+        config: GatewayConfig,
+        metrics: MetricsRegistry | None = None,
+    ):
+        if not config.replica_urls:
+            raise ValueError("gateway needs at least one replica URL")
+        self.config = config
+        self.metrics = metrics or MetricsRegistry()
+        m = self.metrics
+        self._breaker_instruments = BreakerInstruments(m)
+        self.replicas = [
+            Replica(
+                url,
+                self._breaker_instruments.watch(
+                    CircuitBreaker(
+                        name=f"replica:{urlsplit(url.rstrip('/')).netloc or url}",
+                        failure_threshold=config.breaker_threshold,
+                        recovery_timeout_s=config.breaker_recovery_s,
+                    )
+                ),
+            )
+            for url in config.replica_urls
+        ]
+        self.retry_budget = RetryBudget(ratio=config.retry_budget_ratio)
+        self._m_replicas = m.gauge(
+            "pio_fleet_replicas", "replicas configured behind this gateway"
+        )
+        self._m_replicas.set(len(self.replicas))
+        self._m_up = m.gauge(
+            "pio_fleet_replica_up",
+            "1 when the replica's last /healthz probe passed",
+            labelnames=("replica",),
+        )
+        self._m_inflight = m.gauge(
+            "pio_fleet_replica_inflight",
+            "queries currently proxied to the replica",
+            labelnames=("replica",),
+        )
+        self._m_requests = m.counter(
+            "pio_fleet_requests_total",
+            "queries proxied, by replica and upstream status class",
+            labelnames=("replica", "status"),
+        )
+        self._m_ejections = m.counter(
+            "pio_fleet_ejections_total",
+            "replicas ejected on a failed /healthz probe",
+            labelnames=("replica",),
+        )
+        self._m_readmissions = m.counter(
+            "pio_fleet_readmissions_total",
+            "ejected replicas readmitted on a passing /healthz probe",
+            labelnames=("replica",),
+        )
+        self._m_retries = m.counter(
+            "pio_fleet_retries_total",
+            "queries retried on a different replica after a forward failure",
+        )
+        self._m_no_replica = m.counter(
+            "pio_fleet_no_replica_total",
+            "queries shed because no routable replica existed",
+        )
+        self._m_panic = m.counter(
+            "pio_fleet_panic_picks_total",
+            "queries routed in panic mode: every replica failed its last "
+            "probe, so health was ignored (breakers still applied)",
+        )
+        self._m_latency = m.histogram(
+            "pio_gateway_request_seconds",
+            "gateway e2e proxy wall time (ingress to upstream answer relayed)",
+            labelnames=("endpoint",),
+        )
+        m.register_collector(self._collect)
+        self._session: aiohttp.ClientSession | None = None
+        self._probe_task: asyncio.Task | None = None
+        self._runner: web.AppRunner | None = None
+        self._draining = False
+        self._inflight_requests = 0
+        self._stop_event = asyncio.Event()
+        self._drain_task: asyncio.Task | None = None
+
+    # ------------------------------------------------------------- plumbing
+    def _collect(self) -> None:
+        for r in self.replicas:
+            self._m_up.set(1.0 if r.healthy else 0.0, replica=r.name)
+            self._m_inflight.set(float(r.inflight), replica=r.name)
+
+    def _http(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(
+                    total=self.config.request_timeout_s
+                )
+            )
+        return self._session
+
+    # -------------------------------------------------------------- routing
+    def pick_replica(
+        self, key: str, exclude: frozenset[str] = frozenset()
+    ) -> Replica | None:
+        """Least-loaded routable replica; consistent-hash tie-break.
+
+        Claims a breaker slot (``allow()``) on the winner — the caller
+        MUST pair the pick with ``record_success``/``record_failure``.
+        """
+        pool = [r for r in self.replicas if r.name not in exclude]
+        candidates = [r for r in pool if r.healthy]
+        if not candidates and pool:
+            # panic routing: EVERY replica failed its last probe. Probes
+            # are advisory — one can time out against a loaded-but-alive
+            # worker — and when the whole fleet looks down at once, the
+            # probes being wrong is likelier than the fleet being dead.
+            # Route across all of them; the per-replica breakers still
+            # gate backends that are truly gone.
+            candidates = pool
+            self._m_panic.inc()
+        if not candidates:
+            return None
+        low = min(r.inflight for r in candidates)
+        tied = sorted(
+            (r for r in candidates if r.inflight == low),
+            key=lambda r: r.name,
+        )
+        # rotate the tie list by the sticky hash: same key -> same replica
+        # while loads stay equal, different keys spread uniformly
+        start = int(sticky_bucket(key) * len(tied)) % len(tied)
+        for i in range(len(tied)):
+            r = tied[(start + i) % len(tied)]
+            try:
+                r.breaker.allow()
+            except CircuitOpenError:
+                continue
+            return r
+        # every tied replica's breaker refused; try the rest by load
+        rest = sorted(
+            (r for r in candidates if r.inflight != low),
+            key=lambda r: (r.inflight, r.name),
+        )
+        for r in rest:
+            try:
+                r.breaker.allow()
+            except CircuitOpenError:
+                continue
+            return r
+        return None
+
+    async def _forward(
+        self,
+        replica: Replica,
+        method: str,
+        path: str,
+        body: bytes | None,
+        headers: dict[str, str],
+    ) -> tuple[int, bytes, str]:
+        """One proxied request. Returns (status, body, content_type);
+        raises on transport failure. Replica accounting (inflight,
+        breaker, counters) is the caller's job — retry logic needs to
+        see the raw outcome."""
+        replica.inflight += 1
+        try:
+            async with self._http().request(
+                method, f"{replica.url}{path}", data=body, headers=headers
+            ) as resp:
+                payload = await resp.read()
+                return (
+                    resp.status,
+                    payload,
+                    resp.headers.get("Content-Type", "application/json"),
+                )
+        finally:
+            replica.inflight -= 1
+
+    @staticmethod
+    def _status_class(status: int) -> str:
+        return f"{status // 100}xx"
+
+    def _record_outcome(self, replica: Replica, status: int) -> None:
+        self._m_requests.inc(
+            replica=replica.name, status=self._status_class(status)
+        )
+        if status in RETRIABLE_STATUSES:
+            # replica-side trouble: feeds the breaker like a transport
+            # failure (a 503-shedding replica needs backing off from too)
+            replica.breaker.record_failure()
+        else:
+            # 2xx obviously; 4xx too — the *replica* answered fine, the
+            # client's request was bad. 4xx must not trip a breaker.
+            replica.breaker.record_success()
+
+    # --------------------------------------------------------------- routes
+    async def handle_queries(self, request: web.Request) -> web.Response:
+        t0 = time.perf_counter()
+        try:
+            return await self._handle_queries_inner(request)
+        finally:
+            self._m_latency.observe(
+                time.perf_counter() - t0, endpoint="/queries.json"
+            )
+
+    async def _handle_queries_inner(self, request: web.Request) -> web.Response:
+        if (
+            self.config.max_payload_bytes
+            and request.content_length is not None
+            and request.content_length > self.config.max_payload_bytes
+        ):
+            return web.json_response(
+                {"message": "query payload too large"}, status=413
+            )
+        body = await request.read()
+        # sticky key for the consistent-hash tie-break; a non-JSON body
+        # still routes (the replica will 400 it properly)
+        try:
+            key = routing_key(json.loads(body), self.config.sticky_key_field)
+        except (ValueError, TypeError):
+            key = body.decode("utf-8", errors="replace")
+        trace_id = request.headers.get(TRACE_HEADER) or mint_trace_id()
+        headers = {
+            "Content-Type": "application/json",
+            TRACE_HEADER: trace_id,
+        }
+        self._inflight_requests += 1
+        try:
+            resp = await self._route_query(key, body, headers)
+        finally:
+            self._inflight_requests -= 1
+        resp.headers[TRACE_HEADER] = trace_id
+        if self._draining:
+            # drain keeps ANSWERING: the listener is closed (new
+            # connections refused at TCP), but a request arriving on an
+            # established keep-alive connection is served — 503ing it
+            # would be the 5xx the drain exists to avoid. Connection:
+            # close winds the keep-alive down so the client reconnects
+            # elsewhere and the drain converges.
+            resp.force_close()
+        return resp
+
+    async def _route_query(
+        self, key: str, body: bytes, headers: dict[str, str]
+    ) -> web.Response:
+        self.retry_budget.record_attempt()
+        first = self.pick_replica(key)
+        if first is None:
+            self._m_no_replica.inc()
+            return self._unavailable(
+                "no healthy replica available", self.config.shed_retry_after_s
+            )
+        failure: tuple[int, bytes, str] | None = None
+        try:
+            status, payload, ctype = await self._forward(
+                first, "POST", "/queries.json", body, headers
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+            first.breaker.record_failure()
+            self._m_requests.inc(replica=first.name, status="error")
+            logger.warning("forward to %s failed: %s", first.name, exc)
+        else:
+            self._record_outcome(first, status)
+            if status not in RETRIABLE_STATUSES:
+                return web.Response(
+                    body=payload, status=status, content_type=_bare(ctype)
+                )
+            failure = (status, payload, ctype)
+        # one retry on a DIFFERENT replica — /queries.json is idempotent
+        # (pure read), so re-dispatch cannot double-apply anything
+        if self.retry_budget.try_spend():
+            second = self.pick_replica(key, exclude=frozenset((first.name,)))
+            if second is not None:
+                self._m_retries.inc()
+                try:
+                    status, payload, ctype = await self._forward(
+                        second, "POST", "/queries.json", body, headers
+                    )
+                except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+                    second.breaker.record_failure()
+                    self._m_requests.inc(replica=second.name, status="error")
+                    logger.warning(
+                        "retry forward to %s failed: %s", second.name, exc
+                    )
+                else:
+                    self._record_outcome(second, status)
+                    return web.Response(
+                        body=payload, status=status, content_type=_bare(ctype)
+                    )
+        if failure is not None:
+            # relay the replica's own 5xx rather than masking it
+            status, payload, ctype = failure
+            return web.Response(
+                body=payload, status=status, content_type=_bare(ctype)
+            )
+        return self._unavailable(
+            "replica unavailable and retry failed",
+            self.config.shed_retry_after_s,
+        )
+
+    async def _proxy_admin(
+        self, request: web.Request, method: str, path: str
+    ) -> web.Response:
+        """Single-dispatch proxy for the non-idempotent rollout admin
+        surface: exactly ONE replica sees the request (the registry is
+        the fan-out — every other replica adopts the state change via
+        its sync loop). Never retried: a promote that timed out may
+        still have landed."""
+        replica = self.pick_replica(path)
+        if replica is None:
+            return self._unavailable(
+                "no healthy replica available", self.config.shed_retry_after_s
+            )
+        body = await request.read() if request.can_read_body else None
+        try:
+            status, payload, ctype = await self._forward(
+                replica,
+                method,
+                path,
+                body,
+                {"Content-Type": "application/json"},
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+            replica.breaker.record_failure()
+            self._m_requests.inc(replica=replica.name, status="error")
+            return self._unavailable(
+                f"replica {replica.name} unreachable: {exc}",
+                self.config.shed_retry_after_s,
+            )
+        self._record_outcome(replica, status)
+        return web.Response(body=payload, status=status, content_type=_bare(ctype))
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        return await self._proxy_admin(request, "GET", "/models")
+
+    async def handle_models_post(self, request: web.Request) -> web.Response:
+        action = request.match_info["action"]
+        if action not in ("candidate", "promote", "rollback"):
+            return web.json_response({"message": "unknown action"}, status=404)
+        return await self._proxy_admin(request, "POST", f"/models/{action}")
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """Federated fleet scrape: every reachable replica's /metrics
+        merged (counters summed, histogram buckets added) plus the
+        gateway's own pio_fleet_* instruments."""
+        texts = [self.metrics.render_prometheus()]
+        results = await asyncio.gather(
+            *(self._fetch_metrics(r) for r in self.replicas)
+        )
+        texts.extend(t for t in results if t is not None)
+        return web.Response(
+            text=federate_metrics(texts),
+            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+        )
+
+    async def _fetch_metrics(self, replica: Replica) -> str | None:
+        try:
+            async with self._http().get(
+                f"{replica.url}/metrics",
+                timeout=aiohttp.ClientTimeout(total=self.config.probe_timeout_s),
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                return await resp.text()
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            return None
+
+    async def handle_healthz(self, request: web.Request) -> web.Response:
+        healthy = sum(1 for r in self.replicas if r.healthy)
+        ready = healthy > 0 and not self._draining
+        return web.json_response(
+            {
+                "ready": ready,
+                "draining": self._draining,
+                "replicasHealthy": healthy,
+                "replicasTotal": len(self.replicas),
+            },
+            status=200 if ready else 503,
+        )
+
+    async def handle_status(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "status": "alive",
+                "role": "gateway",
+                "draining": self._draining,
+                "replicas": [r.snapshot() for r in self.replicas],
+                "retryBudgetTokens": self.retry_budget.tokens,
+            }
+        )
+
+    async def handle_stop(self, request: web.Request) -> web.Response:
+        self._stop_event.set()
+        return web.json_response({"message": "Stopping."})
+
+    @staticmethod
+    def _unavailable(message: str, retry_after_s: float) -> web.Response:
+        return web.json_response(
+            {"message": message},
+            status=503,
+            headers={"Retry-After": str(max(1, round(retry_after_s)))},
+        )
+
+    # ---------------------------------------------------------------- probes
+    async def _probe_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.gather(
+                    *(self._probe(r) for r in self.replicas)
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("probe pass failed")
+            await asyncio.sleep(self.config.probe_interval_s)
+
+    async def _probe(self, replica: Replica) -> None:
+        try:
+            async with self._http().get(
+                f"{replica.url}/healthz",
+                timeout=aiohttp.ClientTimeout(total=self.config.probe_timeout_s),
+            ) as resp:
+                ok = resp.status == 200
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            ok = False
+        if ok:
+            if not replica.healthy:
+                replica.healthy = True
+                if replica.ever_ready:
+                    self._m_readmissions.inc(replica=replica.name)
+                    logger.info("replica %s readmitted", replica.name)
+                else:
+                    logger.info("replica %s up", replica.name)
+            replica.ever_ready = True
+        elif replica.healthy:
+            replica.healthy = False
+            if replica.ever_ready:
+                self._m_ejections.inc(replica=replica.name)
+                logger.warning(
+                    "replica %s ejected (failed /healthz)", replica.name
+                )
+            else:
+                logger.info("replica %s not ready yet", replica.name)
+
+    # ------------------------------------------------------------- lifecycle
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/", self.handle_status),
+                web.get("/healthz", self.handle_healthz),
+                web.get("/metrics", self.handle_metrics),
+                web.post("/queries.json", self.handle_queries),
+                web.get("/models", self.handle_models),
+                web.post("/models/{action}", self.handle_models_post),
+                web.post("/stop", self.handle_stop),
+            ]
+        )
+
+        async def _start_probes(app: web.Application) -> None:
+            self._probe_task = asyncio.ensure_future(self._probe_loop())
+
+        async def _cleanup(app: web.Application) -> None:
+            task = self._probe_task
+            self._probe_task = None
+            if task is not None:
+                task.cancel()
+                await asyncio.gather(task, return_exceptions=True)
+            if self._session is not None and not self._session.closed:
+                await self._session.close()
+            self._session = None
+
+        app.on_startup.append(_start_probes)
+        app.on_cleanup.append(_cleanup)
+        return app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.make_app(), access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.config.ip, self.config.port)
+        await site.start()
+        logger.info(
+            "fleet gateway on %s:%d (%d replicas)",
+            self.config.ip,
+            self.config.port,
+            len(self.replicas),
+        )
+
+    async def drain(self) -> None:
+        """Stop accepting, answer in-flight, then return. Idempotent."""
+        if self._draining:
+            return
+        self._draining = True
+        logger.info(
+            "gateway drain: listener closing, %d in flight",
+            self._inflight_requests,
+        )
+        if self._runner is not None:
+            for site in list(self._runner.sites):
+                try:
+                    await site.stop()
+                except Exception:
+                    pass
+        deadline = time.monotonic() + max(0.0, self.config.drain_grace_s)
+        while self._inflight_requests > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if self._inflight_requests:
+            logger.warning(
+                "gateway drain grace expired with %d requests in flight",
+                self._inflight_requests,
+            )
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def run_until_stopped(self) -> None:
+        await self.start()
+        await self._stop_event.wait()
+        await self.drain()
+        await self.stop()
+
+    def begin_drain(self) -> None:
+        """Signal-handler entry: drain, then release run_until_stopped.
+        The task is held on its own attribute — the event loop keeps only
+        a weak reference, and a GC'd drain task would leave SIGTERM
+        hanging forever."""
+
+        async def _go() -> None:
+            await self.drain()
+            self._stop_event.set()
+
+        self._drain_task = asyncio.ensure_future(_go())
+
+
+def _bare(content_type: str) -> str:
+    """aiohttp's Response(content_type=...) rejects parameters; strip
+    ``; charset=...`` from a proxied upstream header."""
+    return content_type.split(";", 1)[0].strip() or "application/json"
+
+
+__all__ = ["Gateway", "GatewayConfig", "Replica", "RETRIABLE_STATUSES"]

@@ -1,0 +1,147 @@
+"""``pio deploy --fleet N`` glue: supervisor + gateway in one process.
+
+Topology: the gateway binds the requested ``--port``; worker i is a
+child ``pio deploy`` process on ``port + 1 + i`` bound to localhost
+(only the gateway faces traffic). Workers inherit every deploy flag the
+operator passed except ``--fleet`` and ``--port``, and get a registry
+sync interval so rollout state changes propagate fleet-wide.
+
+SIGTERM to the parent is a zero-downtime stop: the gateway drains
+(listener closed, in-flight answered), then the supervisor SIGTERMs the
+workers — which drain too (``create_server`` drain path) — escalating
+to SIGKILL only past the grace window.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import subprocess
+import sys
+
+from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+from predictionio_tpu.fleet.supervisor import (
+    Supervisor,
+    SupervisorConfig,
+    WorkerSpec,
+)
+from predictionio_tpu.obs.metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+# flags that must not leak from the operator's command line into worker
+# argv: the fleet topology flags (value-taking unless noted)
+_STRIP_FLAGS = {
+    "--fleet": True,
+    "--port": True,
+    "--ip": True,
+    "--fleet-probe-interval": True,
+    "--registry-sync-interval": True,
+}
+
+
+def worker_argv(
+    cli_argv: list[str],
+    port: int,
+    sync_interval_s: float,
+) -> list[str]:
+    """Child process argv for one worker, derived from the parent's CLI
+    argv (everything after the program name, i.e. starting at the
+    ``deploy`` subcommand). Strips the fleet/port flags (both
+    ``--flag value`` and ``--flag=value`` spellings) and appends the
+    worker's own port + registry sync cadence."""
+    out: list[str] = [sys.executable, "-m", "predictionio_tpu.tools.cli"]
+    skip = False
+    for arg in cli_argv:
+        if skip:
+            skip = False
+            continue
+        flag = arg.split("=", 1)[0]
+        if flag in _STRIP_FLAGS:
+            skip = _STRIP_FLAGS[flag] and "=" not in arg
+            continue
+        out.append(arg)
+    out += [
+        "--ip",
+        "127.0.0.1",  # workers face only the gateway
+        "--port",
+        str(port),
+        "--registry-sync-interval",
+        str(sync_interval_s),
+    ]
+    return out
+
+
+def run_fleet(args, cli_argv: list[str]) -> int:
+    """Blocking fleet entry point for ``cmd_deploy``. ``cli_argv`` is the
+    raw CLI argument vector (sys.argv[1:]) the workers are derived from."""
+    n = int(args.fleet)
+    if n < 1:
+        raise ValueError("--fleet needs at least 1 replica")
+    if getattr(args, "ssl_certfile", None) or getattr(args, "ssl_keyfile", None):
+        # workers would inherit the TLS flags and serve HTTPS, but the
+        # gateway probes/forwards plain HTTP on loopback — every replica
+        # would fail its handshake and the fleet would serve nothing.
+        # Terminate TLS in front of the gateway instead.
+        raise ValueError(
+            "--fleet does not support --ssl-certfile/--ssl-keyfile: workers "
+            "bind loopback behind the plain-HTTP gateway; terminate TLS at a "
+            "front proxy"
+        )
+    # None = flag unset (fleet workers default to 1 s); an EXPLICIT 0
+    # disables the sync loop, exactly as the help text promises
+    sync_arg = getattr(args, "registry_sync_interval", None)
+    sync_s = 1.0 if sync_arg is None else float(sync_arg)
+    specs = [
+        WorkerSpec(name=f"w{i}", port=args.port + 1 + i) for i in range(n)
+    ]
+    metrics = MetricsRegistry()
+    supervisor = Supervisor(
+        spawn=lambda spec: subprocess.Popen(
+            worker_argv(cli_argv, spec.port, sync_s)
+        ),
+        specs=specs,
+        config=SupervisorConfig(),
+        metrics=metrics,
+    )
+    gateway = Gateway(
+        GatewayConfig(
+            ip=args.ip,
+            port=args.port,
+            replica_urls=tuple(s.url for s in specs),
+            probe_interval_s=getattr(args, "fleet_probe_interval", 1.0),
+            request_timeout_s=args.request_timeout,
+            breaker_threshold=args.breaker_threshold,
+            breaker_recovery_s=args.breaker_recovery,
+            sticky_key_field=args.sticky_key,
+        ),
+        metrics=metrics,  # one registry: supervisor counters federate too
+    )
+
+    async def main() -> None:
+        supervisor.start()
+        loop = asyncio.get_running_loop()
+        sup_task = asyncio.ensure_future(supervisor.run())
+        try:
+            loop.add_signal_handler(signal.SIGTERM, gateway.begin_drain)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-POSIX loop: Ctrl-C still stops via KeyboardInterrupt
+        try:
+            await gateway.run_until_stopped()
+        finally:
+            sup_task.cancel()
+            await asyncio.gather(sup_task, return_exceptions=True)
+            # workers drain on SIGTERM (create_server drain path); the
+            # supervisor escalates to SIGKILL only past the grace window
+            await loop.run_in_executor(None, supervisor.stop)
+
+    print(
+        f"Fleet gateway starting on {args.ip}:{args.port} "
+        f"({n} workers on ports {specs[0].port}-{specs[-1].port}) ..."
+    )
+    asyncio.run(main())
+    return 0
+
+
+__all__ = ["run_fleet", "worker_argv"]

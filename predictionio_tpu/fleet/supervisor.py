@@ -1,0 +1,299 @@
+"""Worker supervision: spawn N QueryServer processes, restart crashes.
+
+The reference PredictionIO leans on Spark's driver/executor supervision
+for fault tolerance; here the serving fleet gets the same property
+directly: a :class:`Supervisor` owns N worker processes on a port range,
+polls their liveness, and restarts a crashed worker with exponential
+backoff. A worker that crash-loops (more than ``crash_loop_budget``
+exits inside ``crash_loop_window_s``) is *parked* — restarting a worker
+that dies on startup forever only burns CPU and log volume; the parked
+state is visible in metrics (``pio_fleet_worker_parked``) and the
+gateway simply keeps routing around the missing replica.
+
+The process handle and the clock are injectable so the restart policy is
+unit-testable without real processes or real sleeping; production use
+passes a ``subprocess.Popen`` factory (see ``fleet/launch.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Protocol
+
+from predictionio_tpu.obs.metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+
+class ProcessHandle(Protocol):
+    """The slice of ``subprocess.Popen`` the supervisor needs."""
+
+    pid: int
+
+    def poll(self) -> int | None: ...
+
+    def terminate(self) -> None: ...
+
+    def kill(self) -> None: ...
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerSpec:
+    """One replica slot: a stable name (metric label, restart identity)
+    and the port its QueryServer binds."""
+
+    name: str
+    port: int
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+@dataclasses.dataclass
+class SupervisorConfig:
+    poll_interval_s: float = 0.5
+    # exponential restart backoff: crash k (consecutive) waits
+    # min(base * mult**k, max) before the respawn
+    backoff_base_s: float = 0.5
+    backoff_multiplier: float = 2.0
+    backoff_max_s: float = 30.0
+    # a worker alive this long resets its consecutive-crash count (the
+    # backoff ladder is for crash *loops*, not for a crash a week apart)
+    healthy_reset_s: float = 30.0
+    # crash-loop budget: more than this many exits inside the window
+    # parks the worker instead of restarting it again
+    crash_loop_window_s: float = 60.0
+    crash_loop_budget: int = 5
+    # graceful stop: SIGTERM (workers drain), wait this long, then SIGKILL
+    term_grace_s: float = 15.0
+
+
+class _Worker:
+    __slots__ = (
+        "spec",
+        "proc",
+        "started_at",
+        "consecutive_crashes",
+        "crash_times",
+        "next_restart_at",
+        "parked",
+        "restarts",
+    )
+
+    def __init__(self, spec: WorkerSpec):
+        self.spec = spec
+        self.proc: ProcessHandle | None = None
+        self.started_at = 0.0
+        self.consecutive_crashes = 0
+        self.crash_times: list[float] = []
+        self.next_restart_at = 0.0
+        self.parked = False
+        self.restarts = 0  # respawns after a crash (not the initial spawn)
+
+
+class Supervisor:
+    """Spawn, watch, restart. ``tick()`` is the whole policy — drive it
+    from an asyncio loop (:meth:`run`) or directly from tests with a
+    fake clock."""
+
+    def __init__(
+        self,
+        spawn: Callable[[WorkerSpec], ProcessHandle],
+        specs: list[WorkerSpec],
+        config: SupervisorConfig | None = None,
+        metrics: MetricsRegistry | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._spawn = spawn
+        self.config = config or SupervisorConfig()
+        self._clock = clock
+        self._workers = [_Worker(spec) for spec in specs]
+        self._stopping = False
+        m = metrics or MetricsRegistry()
+        self.metrics = m
+        self._m_restarts = m.counter(
+            "pio_fleet_restarts_total",
+            "supervisor respawns of crashed workers, by replica",
+            labelnames=("replica",),
+        )
+        self._m_crash_loops = m.counter(
+            "pio_fleet_crash_loops_total",
+            "workers parked for exceeding the crash-loop budget",
+            labelnames=("replica",),
+        )
+        self._m_up = m.gauge(
+            "pio_fleet_worker_up",
+            "1 when the supervised worker process is running",
+            labelnames=("replica",),
+        )
+        self._m_parked = m.gauge(
+            "pio_fleet_worker_parked",
+            "1 when the worker exceeded its crash-loop budget and was parked",
+            labelnames=("replica",),
+        )
+        m.register_collector(self._collect)
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        """Initial spawn of every worker."""
+        for w in self._workers:
+            self._start_worker(w)
+
+    def _start_worker(self, w: _Worker) -> None:
+        try:
+            w.proc = self._spawn(w.spec)
+        except Exception:
+            # a failed spawn is accounted exactly like an instant crash so
+            # the backoff/park machinery bounds it too
+            logger.exception("spawn failed for worker %s", w.spec.name)
+            w.proc = None
+            self._record_crash(w)
+            return
+        w.started_at = self._clock()
+        logger.info(
+            "worker %s up (pid %s, port %d)",
+            w.spec.name,
+            getattr(w.proc, "pid", "?"),
+            w.spec.port,
+        )
+
+    def tick(self) -> None:
+        """One supervision pass: reap exits, schedule/execute restarts."""
+        if self._stopping:
+            return
+        now = self._clock()
+        for w in self._workers:
+            if w.parked:
+                continue
+            if w.proc is None:
+                if now >= w.next_restart_at:
+                    w.restarts += 1
+                    self._m_restarts.inc(replica=w.spec.name)
+                    self._start_worker(w)
+                continue
+            rc = w.proc.poll()
+            if rc is None:
+                if (
+                    w.consecutive_crashes
+                    and now - w.started_at >= self.config.healthy_reset_s
+                ):
+                    w.consecutive_crashes = 0
+                continue
+            logger.warning(
+                "worker %s (port %d) exited rc=%s", w.spec.name, w.spec.port, rc
+            )
+            w.proc = None
+            self._record_crash(w)
+
+    def _record_crash(self, w: _Worker) -> None:
+        now = self._clock()
+        w.crash_times.append(now)
+        cutoff = now - self.config.crash_loop_window_s
+        w.crash_times = [t for t in w.crash_times if t >= cutoff]
+        if len(w.crash_times) > self.config.crash_loop_budget:
+            w.parked = True
+            self._m_crash_loops.inc(replica=w.spec.name)
+            logger.error(
+                "worker %s parked: %d exits inside %.0fs (budget %d) — "
+                "not restarting; fix the crash and redeploy",
+                w.spec.name,
+                len(w.crash_times),
+                self.config.crash_loop_window_s,
+                self.config.crash_loop_budget,
+            )
+            return
+        backoff = min(
+            self.config.backoff_max_s,
+            self.config.backoff_base_s
+            * self.config.backoff_multiplier**w.consecutive_crashes,
+        )
+        w.consecutive_crashes += 1
+        w.next_restart_at = now + backoff
+        logger.info(
+            "worker %s restart in %.2fs (consecutive crash %d)",
+            w.spec.name,
+            backoff,
+            w.consecutive_crashes,
+        )
+
+    async def run(self) -> None:
+        """Asyncio driver for :meth:`tick` (process polls are non-blocking,
+        so ticking on the event loop is fine)."""
+        import asyncio
+
+        while not self._stopping:
+            self.tick()
+            await asyncio.sleep(self.config.poll_interval_s)
+
+    def stop(self) -> None:
+        """Graceful fleet stop: SIGTERM every worker (they drain), wait
+        ``term_grace_s``, SIGKILL stragglers. Blocking — call from a
+        thread/executor when on an event loop."""
+        self._stopping = True
+        live = [w for w in self._workers if w.proc is not None]
+        for w in live:
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        deadline = self._clock() + self.config.term_grace_s
+        while self._clock() < deadline:
+            if all(w.proc is None or w.proc.poll() is not None for w in live):
+                break
+            time.sleep(0.05)
+        for w in live:
+            if w.proc is not None and w.proc.poll() is None:
+                logger.warning(
+                    "worker %s ignored SIGTERM for %.0fs; killing",
+                    w.spec.name,
+                    self.config.term_grace_s,
+                )
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------- queries
+    def _collect(self) -> None:
+        for w in self._workers:
+            up = w.proc is not None and w.proc.poll() is None
+            self._m_up.set(1.0 if up else 0.0, replica=w.spec.name)
+            self._m_parked.set(1.0 if w.parked else 0.0, replica=w.spec.name)
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        return [
+            {
+                "name": w.spec.name,
+                "port": w.spec.port,
+                "pid": getattr(w.proc, "pid", None) if w.proc else None,
+                "up": w.proc is not None and w.proc.poll() is None,
+                "parked": w.parked,
+                "restarts": w.restarts,
+                "consecutiveCrashes": w.consecutive_crashes,
+            }
+            for w in self._workers
+        ]
+
+    @property
+    def workers(self) -> list[WorkerSpec]:
+        return [w.spec for w in self._workers]
+
+
+def terminate_gracefully(proc: ProcessHandle) -> None:
+    """SIGTERM spelled portably (Popen.terminate is SIGTERM on POSIX)."""
+    try:
+        proc.terminate()
+    except (OSError, ValueError):
+        pass
+
+
+__all__ = [
+    "ProcessHandle",
+    "Supervisor",
+    "SupervisorConfig",
+    "WorkerSpec",
+    "terminate_gracefully",
+]

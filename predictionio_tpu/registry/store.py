@@ -27,6 +27,7 @@ point back into (docs/DECISIONS.md).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import json
@@ -36,6 +37,11 @@ import re
 import tempfile
 import threading
 from typing import Any
+
+try:
+    import fcntl
+except ImportError:  # non-POSIX: in-process serialization only
+    fcntl = None  # type: ignore[assignment]
 
 from predictionio_tpu.registry.manifest import ModelManifest
 
@@ -83,6 +89,11 @@ class RolloutState:
     previous_stable: str = ""  # rollback target after a promote
     staged_at: str = ""  # when the current candidate was staged
     updated_at: str = ""
+    # monotonic change counter, bumped on EVERY persisted transition
+    # (publish/stage/promote/unstage/rollback). Fleet replicas poll
+    # :meth:`ArtifactStore.state_generation` and reconcile only when it
+    # moved — one small-file read instead of a manifest-directory scan.
+    generation: int = 0
     history: list[dict[str, Any]] = dataclasses.field(default_factory=list)
 
     def to_json_dict(self) -> dict[str, Any]:
@@ -118,14 +129,21 @@ class ArtifactStore:
     """Versioned model artifacts + rollout state for any number of engines.
 
     Thread-safe within one process (one lock serializes version allocation
-    and state transitions); cross-process publishers are serialized by the
-    training workflow itself (one coordinator persists — see
-    ``core_workflow.run_train``).
+    and state transitions) AND across processes: every state transition is
+    a read-modify-write held under an advisory ``flock`` on the engine's
+    ``state.lock``, because a serving fleet makes every worker a registry
+    writer (each runs its own bake gate and candidate breaker — two
+    simultaneous transitions must not lose one or collide on the same
+    generation number).
     """
 
     def __init__(self, base_dir: str | None = None):
         self.base_dir = os.path.abspath(base_dir or default_registry_dir())
         self._lock = threading.RLock()
+        # reentrancy bookkeeping for the cross-process transition lock
+        # (rollback nests unstage); guarded by self._lock
+        self._flock_depth: dict[str, int] = {}
+        self._flock_fd: dict[str, int] = {}
 
     # ------------------------------------------------------------- layout
     @staticmethod
@@ -149,6 +167,45 @@ class ArtifactStore:
 
     def _state_path(self, engine_id: str) -> str:
         return os.path.join(self._engine_dir(engine_id), "state.json")
+
+    @contextlib.contextmanager
+    def _state_mutex(self, engine_id: str):
+        """Cross-PROCESS transition lock: an advisory ``flock`` on the
+        engine's ``state.lock``, held for the whole read-modify-write.
+        Fleet workers are concurrent registry writers (bake gates,
+        breaker rollbacks, the CLI); without this, two simultaneous
+        transitions read the same state, one write is lost, and both
+        land on the same generation number — a replica that already saw
+        that generation never adopts the surviving write. The in-process
+        RLock (always held around this) serializes threads; the flock
+        serializes processes and releases automatically if one dies.
+        Reentrant per store (``rollback`` nests ``unstage``)."""
+        if fcntl is None:
+            yield
+            return
+        key = self.engine_key(engine_id)
+        with self._lock:
+            depth = self._flock_depth.get(key, 0)
+            if depth == 0:
+                path = os.path.join(self._engine_dir(engine_id), "state.lock")
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+                # blocking acquire: transitions are millisecond-scale
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                self._flock_fd[key] = fd
+            self._flock_depth[key] = depth + 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._flock_depth[key] -= 1
+                if self._flock_depth[key] == 0:
+                    del self._flock_depth[key]
+                    fd = self._flock_fd.pop(key)
+                    try:
+                        fcntl.flock(fd, fcntl.LOCK_UN)
+                    finally:
+                        os.close(fd)
 
     def engines(self) -> list[str]:
         """Engine keys present in the registry (directory names; the
@@ -208,7 +265,7 @@ class ArtifactStore:
         assign the next version id if the manifest doesn't carry one. The
         first published version becomes stable automatically — there is
         nothing to canary against yet."""
-        with self._lock:
+        with self._lock, self._state_mutex(manifest.engine_id):
             engine_id = manifest.engine_id
             state = self.get_state(engine_id)
             if not manifest.version:
@@ -274,7 +331,7 @@ class ArtifactStore:
         """Drop all but the newest ``keep_last`` versions, never dropping
         a version the rollout state still references. Returns the removed
         version ids."""
-        with self._lock:
+        with self._lock, self._state_mutex(engine_id):
             state = self.get_state(engine_id)
             pinned = {state.stable, state.candidate, state.previous_stable} - {""}
             versions = self.list_versions(engine_id)
@@ -322,8 +379,16 @@ class ArtifactStore:
             )
             return RolloutState()
 
+    def state_generation(self, engine_id: str) -> int:
+        """Cheap monotonic change detector for cross-process coordination:
+        the ``generation`` counter of the persisted rollout state (0 when
+        no state exists yet). One state-file read — callers poll this and
+        only pay :meth:`get_state` + reconciliation when it moved."""
+        return self.get_state(engine_id).generation
+
     def _save_state(self, engine_id: str, state: RolloutState) -> None:
         state.updated_at = ModelManifest.now_iso()
+        state.generation += 1
         state.history = state.history[-_HISTORY_LIMIT:]
         _atomic_write(
             self._state_path(engine_id),
@@ -349,7 +414,7 @@ class ArtifactStore:
             raise ValueError(f"mode must be canary|shadow, got {mode!r}")
         if self.get_manifest(engine_id, version) is None:
             raise ValueError(f"unknown version {version!r}")
-        with self._lock:
+        with self._lock, self._state_mutex(engine_id):
             state = self.get_state(engine_id)
             if version == state.stable:
                 raise ValueError(f"{version} is already stable")
@@ -366,7 +431,7 @@ class ArtifactStore:
     def promote(self, engine_id: str, version: str | None = None) -> RolloutState:
         """Candidate (or an explicit version) becomes stable; the old
         stable is retained as the rollback target."""
-        with self._lock:
+        with self._lock, self._state_mutex(engine_id):
             state = self.get_state(engine_id)
             target = version or state.candidate
             if not target:
@@ -400,7 +465,7 @@ class ArtifactStore:
         previous-stable revert, or a breaker trip after a swallowed stage
         write would silently flip the registry to an older model than the
         one actually serving."""
-        with self._lock:
+        with self._lock, self._state_mutex(engine_id):
             state = self.get_state(engine_id)
             if state.candidate:
                 dropped = state.candidate
@@ -414,7 +479,7 @@ class ArtifactStore:
     def rollback(self, engine_id: str, reason: str = "manual") -> RolloutState:
         """Back out: drop a staged candidate if one exists, else revert
         stable to the previous stable (post-promote regret)."""
-        with self._lock:
+        with self._lock, self._state_mutex(engine_id):
             state = self.get_state(engine_id)
             if state.candidate:
                 return self.unstage(engine_id, reason=reason)

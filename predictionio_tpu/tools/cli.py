@@ -460,6 +460,17 @@ def cmd_deploy(args) -> int:
         run_query_server,
     )
 
+    if args.fleet:
+        # N supervised worker processes behind a gateway (docs/fleet.md):
+        # the gateway takes --port, workers take port+1..port+N and get a
+        # registry sync interval so rollouts propagate fleet-wide
+        from predictionio_tpu.fleet.launch import run_fleet
+
+        try:
+            return run_fleet(args, sys.argv[1:])
+        except ValueError as exc:
+            return _die(str(exc))
+
     from predictionio_tpu.parallel.distributed import maybe_initialize_distributed
 
     maybe_initialize_distributed()
@@ -486,6 +497,8 @@ def cmd_deploy(args) -> int:
         auto_promote=not args.no_auto_promote,
         result_cache_size=args.result_cache_size,
         result_cache_ttl_s=args.result_cache_ttl,
+        registry_sync_interval_s=args.registry_sync_interval or 0.0,
+        drain_grace_s=args.drain_grace,
     )
     print(f"Engine server starting on {args.ip}:{args.port} ...")
     run_query_server(args.engine_dir, args.variant, config=config)
@@ -566,18 +579,36 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+_TOP_DEFAULT_URL = "http://127.0.0.1:8000"
+
+
 def cmd_top(args) -> int:
     """Live one-screen summary of a running server's /metrics (qps, p95,
-    waterfall, SLO burn, shed rate, breaker states, recompile count)."""
+    waterfall, SLO burn, shed rate, breaker states, recompile count).
+    ``--fleet`` points it at a fleet gateway's federated /metrics (the
+    fleet line renders automatically when pio_fleet_* metrics exist);
+    repeated ``--metrics-url`` polls several endpoints per refresh —
+    with ``--json``, one object per endpoint per refresh."""
     from predictionio_tpu.tools.top import run_top
 
     iterations = 1 if args.once else args.iterations
+    # --metrics-url endpoints poll IN ADDITION to a --url the operator
+    # actually pointed somewhere (the flag's "too"): replicas scrape
+    # directly alongside the gateway's federated view, which stays first
+    # in the refresh. An untouched default --url is not silently polled.
+    urls = list(args.metrics_url or [])
+    url_given = args.fleet or args.url != _TOP_DEFAULT_URL
+    if urls and url_given and args.url not in urls:
+        urls.insert(0, args.url)
+    elif args.fleet and not urls:
+        urls = [args.url]  # the gateway IS the fleet view
     return run_top(
         args.url,
         interval_s=args.interval,
         iterations=iterations,
         clear_screen=False if args.once else None,
         json_mode=args.json,
+        urls=urls or None,
     )
 
 
@@ -1422,6 +1453,38 @@ def build_parser() -> argparse.ArgumentParser:
         help="result-cache entry TTL seconds — the staleness bound for "
         "serving components reading live state outside the model",
     )
+    x.add_argument(
+        "--fleet",
+        type=int,
+        default=0,
+        metavar="N",
+        help="deploy N supervised QueryServer worker processes (ports "
+        "PORT+1..PORT+N) behind a gateway on PORT: least-loaded routing, "
+        "/healthz ejection, crash restart, one-retry failover, federated "
+        "/metrics (docs/fleet.md)",
+    )
+    x.add_argument(
+        "--fleet-probe-interval",
+        type=float,
+        default=1.0,
+        help="gateway /healthz probe cadence in seconds (bounds how fast "
+        "a dead replica is ejected)",
+    )
+    x.add_argument(
+        "--registry-sync-interval",
+        type=float,
+        default=None,
+        help="poll the registry's state generation on this cadence and "
+        "adopt stage/promote/rollback made by other processes (fleet "
+        "workers default to 1.0; 0 disables; needs --registry-dir)",
+    )
+    x.add_argument(
+        "--drain-grace",
+        type=float,
+        default=15.0,
+        help="seconds a SIGTERM'd server waits for in-flight queries to "
+        "answer after closing its listener (graceful drain)",
+    )
     x.set_defaults(fn=cmd_deploy)
 
     x = sub.add_parser("undeploy")
@@ -1493,7 +1556,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     x.add_argument(
         "--url",
-        default="http://127.0.0.1:8000",
+        default=_TOP_DEFAULT_URL,
         help="server base URL (QueryServer or EventServer)",
     )
     x.add_argument("--interval", type=float, default=2.0)
@@ -1515,6 +1578,21 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="machine-readable output: one JSON snapshot per line instead "
         "of the terminal screen (for CI and fleet tooling)",
+    )
+    x.add_argument(
+        "--metrics-url",
+        action="append",
+        help="poll this endpoint (repeatable); an explicitly-set --url "
+        "(or --fleet gateway) is polled too, first in each refresh — an "
+        "untouched default --url is not. Fleet dashboards scrape replicas "
+        "directly alongside the gateway's federated view; with --json, "
+        "one object per endpoint per refresh",
+    )
+    x.add_argument(
+        "--fleet",
+        action="store_true",
+        help="fleet mode: point --url at a `pio deploy --fleet` gateway; "
+        "the per-replica fleet line renders from its federated /metrics",
     )
     x.set_defaults(fn=cmd_top)
 

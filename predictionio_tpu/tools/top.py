@@ -177,6 +177,7 @@ def summarize(
     out["slo"] = _slo_summary(metrics)
     out["stream"] = _stream_summary(metrics, now)
     out["train"] = _train_summary(metrics)
+    out["fleet"] = _fleet_summary(metrics)
     out["qps"] = None
     out["shed_rate"] = None
     out["stream_drain_rate"] = None
@@ -318,6 +319,46 @@ def _train_summary(metrics: Metrics) -> dict[str, Any] | None:
         "est_bytes_per_device": _max(
             metrics, "pio_train_est_bytes_per_device"
         ),
+    }
+
+
+def _fleet_summary(metrics: Metrics) -> dict[str, Any] | None:
+    """The fleet line, from the gateway's federated ``pio_fleet_*``
+    family: replica up/inflight states, ejection/readmission/restart
+    counters, retry volume, and the gateway-hop p50. None when the
+    endpoint isn't a fleet gateway."""
+    if (
+        "pio_fleet_replicas" not in metrics
+        and "pio_fleet_replica_up" not in metrics
+    ):
+        return None
+    replicas: dict[str, dict[str, Any]] = {}
+    for name, field, cast in (
+        ("pio_fleet_replica_up", "up", lambda v: bool(v)),
+        ("pio_fleet_replica_inflight", "inflight", float),
+        ("pio_fleet_ejections_total", "ejections", float),
+        ("pio_fleet_readmissions_total", "readmissions", float),
+    ):
+        for labels, v in metrics.get(name, ()):
+            rep = labels.get("replica")
+            if rep:
+                replicas.setdefault(rep, {})[field] = cast(v)
+    up = sum(1 for info in replicas.values() if info.get("up"))
+    return {
+        "replicas_total": _total(metrics, "pio_fleet_replicas")
+        or float(len(replicas)),
+        "replicas_up": float(up),
+        "replicas": replicas,
+        "retries_total": _total(metrics, "pio_fleet_retries_total"),
+        "no_replica_total": _total(metrics, "pio_fleet_no_replica_total"),
+        "ejections_total": _total(metrics, "pio_fleet_ejections_total"),
+        "readmissions_total": _total(metrics, "pio_fleet_readmissions_total"),
+        "restarts_total": _total(metrics, "pio_fleet_restarts_total"),
+        "crash_loops_total": _total(metrics, "pio_fleet_crash_loops_total"),
+        "gateway_p50_ms": _histogram_quantile(
+            metrics, "pio_gateway_request_seconds", 0.50
+        )
+        * 1e3,
     }
 
 
@@ -478,6 +519,33 @@ def render(summary: dict[str, Any], url: str) -> str:
             f"  train      {who}   {steps}   device {frac * 100.0:.0f}%   "
             f"rows {num(train['rows_total'])}   {hbm}"
         )
+    fleet = summary.get("fleet")
+    if fleet is not None:
+        parts = []
+        for rep, info in sorted((fleet.get("replicas") or {}).items()):
+            state = "up" if info.get("up") else "DOWN"
+            inflight = info.get("inflight")
+            tag = f"{rep}[{state}"
+            if inflight is not None:
+                tag += f" {num(inflight)}"
+            parts.append(tag + "]")
+        line = (
+            f"  fleet      {num(fleet['replicas_up'])}/"
+            f"{num(fleet['replicas_total'])} up   "
+            + ("  ".join(parts) or "(no replicas)")
+        )
+        line += (
+            f"   retries {num(fleet['retries_total'])}"
+            f"   ejected {num(fleet['ejections_total'])}"
+            f"   readmitted {num(fleet['readmissions_total'])}"
+        )
+        if fleet.get("restarts_total"):
+            line += f"   restarts {num(fleet['restarts_total'])}"
+        if fleet.get("crash_loops_total"):
+            line += f"   CRASH-LOOPED {num(fleet['crash_loops_total'])}"
+        if fleet.get("gateway_p50_ms"):
+            line += f"   gw p50 {fleet['gateway_p50_ms']:.2f} ms"
+        lines.append(line)
     if summary.get("events_ingested"):
         lines.append(f"  ingested   {num(summary['events_ingested']):>12}")
     return "\n".join(lines)
@@ -497,47 +565,66 @@ def run_top(
     clear_screen: bool | None = None,
     sleep: Callable[[float], None] = time.sleep,
     json_mode: bool = False,
+    urls: list[str] | None = None,
 ) -> int:
     """Poll-and-render loop. ``iterations=None`` runs until interrupted;
     fetch/out/sleep are injectable so tests drive it without a network.
-    ``json_mode`` emits one machine-readable JSON object per snapshot
-    (one per line, no screen control codes) so CI and fleet tooling can
-    consume the same digest the terminal screen renders."""
+    ``json_mode`` emits one machine-readable JSON object per snapshot —
+    one per line — so CI and fleet tooling can consume the same digest
+    the terminal screen renders. ``urls`` polls SEVERAL endpoints per
+    refresh (``--metrics-url`` repeated): fleet dashboards scrape every
+    replica directly as well as the gateway's federated view, and each
+    endpoint gets its own JSON object (or screen block) per refresh with
+    per-endpoint rate state — one unreachable replica degrades only its
+    own line, never the whole refresh."""
     import json as _json
 
     fetch = fetch or fetch_metrics
+    endpoints = [u for u in (urls or []) if u] or [url]
     if clear_screen is None:
         clear_screen = sys.stdout.isatty() and not json_mode
-    prev: Metrics | None = None
-    prev_t: float | None = None
+    prev: dict[str, Metrics] = {}
+    prev_t: dict[str, float] = {}
     n = 0
     # Ctrl-C is a clean exit wherever it lands — mid-fetch (urllib can
     # block up to its timeout against a hung server), mid-render, or in
     # the sleep — never a stack trace
     try:
         while iterations is None or n < iterations:
-            try:
-                text = fetch(url)
-            except Exception as exc:
-                if json_mode:
-                    out(_json.dumps({"url": url, "error": str(exc)}))
-                else:
-                    out(f"pio top — {url}: unreachable ({exc})")
-                prev, prev_t = None, None
-            else:
-                metrics = parse_prometheus(text)
-                now = time.monotonic()
-                dt = (now - prev_t) if prev_t is not None else None
-                summary = summarize(metrics, prev=prev, interval_s=dt)
-                if json_mode:
-                    out(_json.dumps({"url": url, "time": time.time(), **summary}))
-                else:
-                    screen = render(summary, url)
-                    if clear_screen:
-                        out("\x1b[2J\x1b[H" + screen)
+            screens: list[str] = []
+            for u in endpoints:
+                try:
+                    text = fetch(u)
+                except Exception as exc:
+                    if json_mode:
+                        out(_json.dumps({"url": u, "error": str(exc)}))
                     else:
-                        out(screen)
-                prev, prev_t = metrics, now
+                        screens.append(f"pio top — {u}: unreachable ({exc})")
+                    prev.pop(u, None)
+                    prev_t.pop(u, None)
+                else:
+                    metrics = parse_prometheus(text)
+                    now = time.monotonic()
+                    last_t = prev_t.get(u)
+                    dt = (now - last_t) if last_t is not None else None
+                    summary = summarize(
+                        metrics, prev=prev.get(u), interval_s=dt
+                    )
+                    if json_mode:
+                        out(
+                            _json.dumps(
+                                {"url": u, "time": time.time(), **summary}
+                            )
+                        )
+                    else:
+                        screens.append(render(summary, u))
+                    prev[u], prev_t[u] = metrics, now
+            if screens:
+                screen = "\n\n".join(screens)
+                if clear_screen:
+                    out("\x1b[2J\x1b[H" + screen)
+                else:
+                    out(screen)
             n += 1
             if iterations is None or n < iterations:
                 sleep(interval_s)

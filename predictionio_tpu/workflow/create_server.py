@@ -228,6 +228,15 @@ class ServerConfig:
     # the immutable model artifact (disabled-items files, constraint
     # entities); the model itself can't go stale under a version key
     result_cache_ttl_s: float = 10.0
+    # -- fleet coordination (docs/fleet.md) --------------------------------
+    # poll the registry's state_generation() on this cadence and adopt
+    # stage/promote/rollback/stable-pin changes made by OTHER processes
+    # (fleet replicas, the CLI, another replica's bake gate); 0 disables.
+    # Requires a registry_dir.
+    registry_sync_interval_s: float = 0.0
+    # graceful drain (SIGTERM / supervised restart): how long to wait for
+    # queued + in-flight queries to answer after the listener closes
+    drain_grace_s: float = 15.0
 
     def ssl_context(self):
         from predictionio_tpu.utils.tls import server_ssl_context
@@ -807,6 +816,15 @@ class QueryServer:
         # controller tick) and dispatch threads (breaker-trip rollback)
         self._rollout_mutex = threading.Lock()
         self._rollout_task: asyncio.Task | None = None
+        # fleet coordination: the registry state generation this process
+        # last reconciled against (None = never; first tick reconciles,
+        # which is exactly right after a crash-restart mid-bake)
+        self._registry_sync_task: asyncio.Task | None = None
+        self._seen_state_gen: int | None = None
+        # graceful drain: listener closed, in-flight answered, then exit
+        self._draining = False
+        self._inflight_requests = 0
+        self._drain_task: asyncio.Task | None = None
         # rollout generation: bumped on every stage/promote/rollback so
         # in-flight shadow work (queued behind a slow candidate) can tell
         # it belongs to a PREVIOUS rollout and must not feed the breaker
@@ -1027,6 +1045,10 @@ class QueryServer:
         token = set_trace_id(trace_id)
         status = 500
         t0 = time.perf_counter()
+        # drain accounting: the SIGTERM drain path waits for this count to
+        # reach zero before the process exits, so a supervised restart
+        # answers everything it already accepted
+        self._inflight_requests += 1
         # per-request waterfall channel: the inner handler and the batcher
         # fill it with phase timestamps; the ingress span carries the
         # handler-side phases as tags
@@ -1048,6 +1070,7 @@ class QueryServer:
                 )
         finally:
             reset_trace_id(token)
+            self._inflight_requests -= 1
             # ONE end timestamp anchors both the e2e histogram and the
             # respond phase, so the waterfall tiles the same wall clock the
             # latency histogram reports (the reconciliation contract)
@@ -1689,12 +1712,14 @@ class QueryServer:
             and snap["queueDepth"] >= snap["queueHighWater"]
         )
         ready = (
-            not self._batcher._closed
+            not self._draining
+            and not self._batcher._closed
             and not shedding
             and snap["breakers"]["dispatch"]["state"] != OPEN
         )
         return web.json_response(
-            {"ready": ready, **snap}, status=200 if ready else 503
+            {"ready": ready, "draining": self._draining, **snap},
+            status=200 if ready else 503,
         )
 
     async def handle_reload_get(self, request: web.Request) -> web.Response:
@@ -1901,9 +1926,12 @@ class QueryServer:
             "staged candidate %s (%s, fraction %.3f)", lane.version, mode, fraction
         )
 
-    def _promote_candidate(self) -> str | None:
+    def _promote_candidate(self, persist: bool = True) -> str | None:
         """Candidate becomes stable (atomic Lane swap). Returns the
-        promoted version, or None when no candidate is staged."""
+        promoted version, or None when no candidate is staged.
+        ``persist=False`` skips the registry write — the fleet-sync path
+        uses it when the registry ALREADY records the promote (another
+        replica's bake gate or the CLI did it first)."""
         with self._rollout_mutex:
             cand = self._candidate
             if cand is None:
@@ -1924,7 +1952,7 @@ class QueryServer:
         self._cache_flush(retired, f"promote {cand.version}")
         self._rollout_instruments.set_plan(PLAN_OFF)
         self._rollout_instruments.promotions.inc()
-        if self.registry_store is not None:
+        if persist and self.registry_store is not None:
             try:
                 self.registry_store.promote(self.manifest.engine_id, cand.version)
             except Exception:
@@ -1932,11 +1960,15 @@ class QueryServer:
         logger.info("promoted candidate %s to stable", cand.version)
         return cand.version
 
-    def _rollback_candidate(self, reason: str, detail: str = "") -> str | None:
+    def _rollback_candidate(
+        self, reason: str, detail: str = "", persist: bool = True
+    ) -> str | None:
         """Drop the candidate lane; stable keeps serving untouched.
         ``reason`` is a short label (breaker-trip/manual/error-rate/
-        latency/divergence — bounded metric cardinality), ``detail`` the
-        human sentence for logs and registry history."""
+        latency/divergence/fleet-sync — bounded metric cardinality),
+        ``detail`` the human sentence for logs and registry history.
+        ``persist=False``: registry already reflects the rollback (the
+        fleet-sync path reacting to another process's unstage)."""
         with self._rollout_mutex:
             cand = self._candidate
             if cand is None:
@@ -1952,7 +1984,7 @@ class QueryServer:
         self._cache_flush(cand.version, f"rollback {cand.version} ({reason})")
         self._rollout_instruments.set_plan(PLAN_OFF)
         self._rollout_instruments.rollbacks.inc(reason=reason)
-        if self.registry_store is not None:
+        if persist and self.registry_store is not None:
             try:
                 # unstage, never rollback: the store's rollback falls back
                 # to reverting the stable pin when no candidate is recorded
@@ -2029,6 +2061,151 @@ class QueryServer:
                 None, self._rollback_candidate, reason.split(" ")[0], reason
             )
 
+    # ------------------------------------------- fleet registry coordination
+    async def _registry_sync_loop(self) -> None:
+        """Fleet heartbeat (docs/fleet.md): poll the registry's cheap
+        ``state_generation()`` and reconcile local lanes whenever another
+        process moved it — a promote/rollback/stage issued through ANY
+        replica, the gateway, or the CLI propagates to every worker, and
+        each per-process result cache flushes on the transition."""
+        while True:
+            await asyncio.sleep(self.config.registry_sync_interval_s)
+            try:
+                await self._registry_sync_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("registry sync tick failed")
+
+    async def _registry_sync_tick(self) -> None:
+        store = self.registry_store
+        if store is None:
+            return
+        loop = asyncio.get_running_loop()
+        # generation probe is ONE small-file read — the cadence can be
+        # aggressive without scanning manifests every tick
+        gen = await loop.run_in_executor(
+            None, store.state_generation, self.manifest.engine_id
+        )
+        if gen == self._seen_state_gen:
+            return
+        # the reload lock serializes against HTTP-driven stage/promote/
+        # rollback and /reload, so reconciliation never interleaves with a
+        # locally-initiated transition half-way through its own commit
+        async with self._reload_lock:
+            state = await loop.run_in_executor(
+                None, store.get_state, self.manifest.engine_id
+            )
+            if await self._reconcile_registry_state(state):
+                self._seen_state_gen = state.generation
+            # else: a lane failed to load (transient I/O, artifact not yet
+            # visible) — leave the seen generation behind so the NEXT tick
+            # retries instead of never adopting this transition
+
+    async def _reconcile_registry_state(self, state) -> bool:
+        """Make local serving lanes match the registry's rollout state.
+        Local transitions (which wrote that state themselves) reconcile to
+        a no-op; remote ones are adopted without re-persisting. Returns
+        False when a referenced version could not be loaded — the caller
+        must retry the same generation on its next tick."""
+        loop = asyncio.get_running_loop()
+        # 1) the stable pin moved
+        if state.stable and state.stable != self._active.version:
+            cand = self._candidate
+            if cand is not None and cand.version == state.stable:
+                # another replica's bake gate promoted the candidate we
+                # are baking: same lane objects, just swap locally
+                await loop.run_in_executor(None, self._promote_candidate, False)
+                logger.info("fleet-sync: adopted promote of %s", state.stable)
+            else:
+                try:
+                    lane = await loop.run_in_executor(
+                        None, self._load_lane_from_registry, state.stable
+                    )
+                except Exception:
+                    logger.exception(
+                        "fleet-sync: pinned stable %s unloadable; still "
+                        "serving %s",
+                        state.stable,
+                        self._active.version,
+                    )
+                    return False
+                self._adopt_stable(lane)
+        # 2) the candidate changed
+        cand = self._candidate
+        if state.candidate and state.candidate != self._active.version:
+            plan_changed = cand is not None and (
+                self._plan.mode != state.mode
+                or (
+                    state.mode == MODE_CANARY
+                    and abs(self._plan.fraction - state.fraction) > 1e-9
+                )
+            )
+            if cand is not None and cand.version == state.candidate and not plan_changed:
+                return True  # already baking exactly this rollout
+            if cand is not None and cand.version == state.candidate:
+                lane = cand  # plan change only: reuse the loaded lane
+            else:
+                try:
+                    lane = await loop.run_in_executor(
+                        None, self._load_lane_from_registry, state.candidate
+                    )
+                except Exception:
+                    logger.exception(
+                        "fleet-sync: staged candidate %s unloadable",
+                        state.candidate,
+                    )
+                    return False
+            await loop.run_in_executor(
+                None,
+                lambda: self.stage_candidate_lane(
+                    lane,
+                    mode=state.mode,
+                    fraction=state.fraction,
+                    persist=False,
+                ),
+            )
+            logger.info(
+                "fleet-sync: adopted staged candidate %s (%s)",
+                state.candidate,
+                state.mode,
+            )
+        elif not state.candidate and cand is not None:
+            # unstaged/rolled back elsewhere (possibly by a peer's breaker
+            # trip): drop the local lane too, without re-persisting
+            await loop.run_in_executor(
+                None,
+                lambda: self._rollback_candidate(
+                    "fleet-sync",
+                    "registry candidate cleared by another process",
+                    persist=False,
+                ),
+            )
+        return True
+
+    def _adopt_stable(self, lane: Lane) -> None:
+        """Swap in a stable version pinned by another process — /reload's
+        commit semantics (atomic Lane swap, retired lane's cache entries
+        flushed) without the metadata-store resolution. A local bake in
+        flight is rebased on the new stable, exactly like /reload."""
+        with self._rollout_mutex:
+            self._rollout_gen += 1
+            retired = self._active.version
+            self._active = lane
+            if lane.instance_id:
+                self.instance_id = lane.instance_id
+            if lane.engine_params is not None:
+                self.engine_params = lane.engine_params
+            cand = self._candidate
+            if cand is not None:
+                self.rollout_controller.begin(
+                    lane.version, cand.version, self._plan.mode
+                )
+        self._cache_flush(retired, f"fleet-sync stable -> {lane.version}")
+        logger.info(
+            "fleet-sync: adopted stable %s (was %s)", lane.version, retired
+        )
+
     def _models_snapshot(self) -> dict[str, Any]:
         stable = self._active
         cand = self._candidate
@@ -2056,6 +2233,10 @@ class QueryServer:
             state = self.registry_store.get_state(self.manifest.engine_id)
             out["registry"] = {
                 "dir": self.registry_store.base_dir,
+                # the fleet-coordination change detector, surfaced so
+                # dashboards and peers can watch for cross-process moves
+                # without reading the whole state
+                "stateGeneration": state.generation,
                 "state": state.to_json_dict(),
                 "versions": [
                     m.summary_row()
@@ -2219,13 +2400,24 @@ class QueryServer:
 
         async def _start_rollout_loop(app: web.Application) -> None:
             self._rollout_task = asyncio.ensure_future(self._rollout_loop())
+            if (
+                self.registry_store is not None
+                and self.config.registry_sync_interval_s > 0
+            ):
+                self._registry_sync_task = asyncio.ensure_future(
+                    self._registry_sync_loop()
+                )
 
         async def _close_batcher(app: web.Application) -> None:
-            task = self._rollout_task
+            tasks = [self._rollout_task, self._registry_sync_task]
             self._rollout_task = None
-            if task is not None:
-                task.cancel()
-                await asyncio.gather(task, return_exceptions=True)
+            self._registry_sync_task = None
+            for task in tasks:
+                if task is not None:
+                    task.cancel()
+            live = [t for t in tasks if t is not None]
+            if live:
+                await asyncio.gather(*live, return_exceptions=True)
             # cancel the collect loop while its event loop is still alive
             # (otherwise the pending task leaks a "loop is closed" warning)
             self._batcher.close()
@@ -2323,6 +2515,62 @@ class QueryServer:
         else:
             raise last_error  # type: ignore[misc]
         logger.info("engine server on %s:%d", self.config.ip, self.config.port)
+
+    async def drain(self) -> None:
+        """Graceful drain (the SIGTERM path): stop accepting, let the
+        micro-batcher flush and every in-flight request answer, then
+        return — so a supervised restart or rolling redeploy is 5xx-free
+        even without a gateway in front.
+
+        Order matters: (1) mark draining so /healthz goes unready and a
+        load balancer routes around us; (2) close the listener — NEW
+        connections are refused at the TCP level (the client/gateway
+        retries elsewhere), which is not a 5xx; (3) wait out the
+        admission queue + dispatch pipeline + handler tail, bounded by
+        ``drain_grace_s``. The batcher keeps running the whole time, so
+        queued queries dispatch and answer normally. Idempotent."""
+        if self._draining:
+            return
+        self._draining = True
+        logger.info("drain: listener closing, answering in-flight requests")
+        if self._runner is not None:
+            for site in list(self._runner.sites):
+                try:
+                    await site.stop()
+                except Exception:
+                    logger.exception("drain: site stop failed (continuing)")
+        deadline = time.perf_counter() + max(0.0, self.config.drain_grace_s)
+        b = self._batcher
+        while time.perf_counter() < deadline:
+            if (
+                self._inflight_requests == 0
+                and b.queue_depth == 0
+                and not b._finish_tasks
+            ):
+                break
+            await asyncio.sleep(0.02)
+        leftover = self._inflight_requests
+        if leftover:
+            logger.warning(
+                "drain grace (%.1fs) expired with %d requests in flight",
+                self.config.drain_grace_s,
+                leftover,
+            )
+        else:
+            logger.info("drain complete: zero requests in flight")
+
+    def begin_drain(self) -> None:
+        """Signal-handler entry (``loop.add_signal_handler`` callbacks
+        must not block): drain, then release ``run_until_stopped``. The
+        task is held on its own attribute — ``stop()``'s background-task
+        sweep only runs after the drain has already set the stop event,
+        so the drain can never be cancelled by the shutdown it causes."""
+
+        async def _go() -> None:
+            await self.drain()
+            self._stop_event.set()
+
+        self._drain_task = asyncio.ensure_future(_go())
 
     async def stop(self) -> None:
         self._batcher.close()
@@ -2483,6 +2731,18 @@ def run_query_server(
     server = create_query_server(engine_dir, variant_path, config=config)
 
     async def main():
+        import signal
+
+        # SIGTERM = graceful drain, not teardown-with-requests-in-flight:
+        # the listener closes, the micro-batcher flushes, in-flight
+        # queries answer, THEN the process exits — what a supervisor's
+        # rolling restart (fleet/supervisor.py) relies on for zero 5xx
+        try:
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGTERM, server.begin_drain
+            )
+        except (NotImplementedError, RuntimeError):
+            pass  # loop without signal support: default SIGTERM applies
         await server.run_until_stopped()
 
     asyncio.run(main())

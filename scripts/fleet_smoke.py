@@ -1,0 +1,253 @@
+"""CI fleet smoke (run_lint.sh --ci): 2 workers + gateway, kill one.
+
+Self-contained (no training, no shared storage): each worker is THIS
+script in ``--worker`` mode serving the recommendation engine over
+random factors — latency/availability smoke only, model quality is the
+bench's job. The orchestrator spawns the workers under the fleet
+supervisor, fronts them with the gateway, then:
+
+1. proves the fleet answers through the gateway;
+2. SIGKILLs one worker and asserts the gateway KEEPS answering
+   (ejection + failover, zero client-visible failures);
+3. asserts ``pio top --fleet`` renders the fleet line from the
+   gateway's federated /metrics;
+4. waits for the supervisor restart + gateway readmission.
+
+Exit 0 = all held; any assertion exits nonzero and fails CI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def worker_main(port: int) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.models.recommendation import engine_factory
+    from predictionio_tpu.models.recommendation.engine import ALSModel
+    from predictionio_tpu.workflow.create_server import (
+        QueryServer,
+        ServerConfig,
+    )
+    from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+    rng = np.random.default_rng(0)
+    n_users, n_items, rank = 2000, 1000, 16
+    model = ALSModel(
+        rng.normal(size=(n_users, rank)).astype("float32"),
+        rng.normal(size=(n_items, rank)).astype("float32"),
+        [f"u{i}" for i in range(n_users)],
+        [f"i{i}" for i in range(n_items)],
+    )
+    engine = engine_factory()
+    ep = engine.engine_params_from_variant(
+        {
+            "datasource": {"params": {"appName": "fleetsmoke"}},
+            "algorithms": [{"name": "als", "params": {}}],
+        }
+    )
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    server = QueryServer(
+        engine=engine,
+        engine_params=ep,
+        models=[model],
+        manifest=EngineManifest(
+            engine_id="fleetsmoke",
+            version="1",
+            variant="engine.json",
+            engine_factory="predictionio_tpu.models.recommendation.engine_factory",
+        ),
+        instance_id="fleetsmoke",
+        storage=storage,
+        config=ServerConfig(ip="127.0.0.1", port=port, max_batch_size=32),
+    )
+
+    async def run() -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(signal.SIGTERM, server.begin_drain)
+        except (NotImplementedError, RuntimeError):
+            pass
+        await server.run_until_stopped()
+
+    asyncio.run(run())
+    return 0
+
+
+async def orchestrate() -> int:
+    import aiohttp
+
+    from predictionio_tpu.fleet import (
+        Gateway,
+        GatewayConfig,
+        Supervisor,
+        SupervisorConfig,
+        WorkerSpec,
+    )
+    from predictionio_tpu.obs.metrics import MetricsRegistry
+
+    specs = [WorkerSpec(f"w{i}", _free_port()) for i in range(2)]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def spawn(spec):
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker", str(spec.port)],
+            env=env,
+            cwd=REPO,
+        )
+
+    metrics = MetricsRegistry()
+    sup = Supervisor(
+        spawn,
+        specs,
+        SupervisorConfig(poll_interval_s=0.1, backoff_base_s=0.2, term_grace_s=8.0),
+        metrics=metrics,
+    )
+    gw_port = _free_port()
+    gw = Gateway(
+        GatewayConfig(
+            ip="127.0.0.1",
+            port=gw_port,
+            replica_urls=tuple(s.url for s in specs),
+            probe_interval_s=0.2,
+            probe_timeout_s=1.0,
+            request_timeout_s=8.0,
+        ),
+        metrics=metrics,
+    )
+    gw_url = f"http://127.0.0.1:{gw_port}"
+    sup.start()
+    sup_task = asyncio.ensure_future(sup.run())
+    await gw.start()
+    session = aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(total=10))
+
+    async def healthy_count() -> int:
+        async with session.get(f"{gw_url}/healthz") as resp:
+            return (await resp.json()).get("replicasHealthy", 0)
+
+    async def wait_for(cond, message: str, deadline_s: float) -> None:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                if await cond():
+                    return
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, message
+            await asyncio.sleep(0.2)
+
+    async def query(i: int) -> int:
+        async with session.post(
+            f"{gw_url}/queries.json", json={"user": f"u{i % 50}", "num": 5}
+        ) as resp:
+            await resp.read()
+            return resp.status
+
+    try:
+        # 1. both workers come up (each pays the jax import)
+        await wait_for(
+            lambda: _is(healthy_count, 2), "workers never became ready", 120.0
+        )
+        for i in range(10):
+            assert await query(i) == 200, "fleet did not answer pre-kill"
+        # 2. SIGKILL one worker; the gateway must keep answering
+        victim = sup.snapshot()[1]
+        os.kill(victim["pid"], signal.SIGKILL)
+        await wait_for(
+            lambda: _is(healthy_count, 1), "dead replica never ejected", 10.0
+        )
+        failures = 0
+        for i in range(20):
+            if await query(100 + i) != 200:
+                failures += 1
+        assert failures == 0, f"{failures}/20 queries failed after replica kill"
+        # 3. pio top --fleet renders from the federated scrape (run OFF
+        # the event loop: the gateway serves /metrics on this very loop)
+        top = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: subprocess.run(
+                [
+                    os.path.join(REPO, "pio"),
+                    "top",
+                    "--fleet",
+                    "--once",
+                    "--url",
+                    gw_url,
+                ],
+                capture_output=True,
+                timeout=60,
+                env=env,
+            ),
+        )
+        screen = top.stdout.decode(errors="replace")
+        assert top.returncode == 0, top.stderr.decode(errors="replace")[-500:]
+        assert "fleet" in screen, f"no fleet line in pio top output:\n{screen}"
+        assert "1/2 up" in screen or "2/2 up" in screen, screen
+        # 4. supervisor restart + readmission closes the loop
+        await wait_for(
+            lambda: _is(healthy_count, 2),
+            "restarted replica never readmitted",
+            120.0,
+        )
+        print(
+            json.dumps(
+                {
+                    "fleet_smoke": "ok",
+                    "replicas": 2,
+                    "killed": victim["name"],
+                    "restarts": sup.snapshot()[1]["restarts"],
+                    "top_screen_has_fleet_line": True,
+                }
+            )
+        )
+        return 0
+    finally:
+        sup_task.cancel()
+        await asyncio.gather(sup_task, return_exceptions=True)
+        await session.close()
+        await gw.stop()
+        await asyncio.get_running_loop().run_in_executor(None, sup.stop)
+
+
+async def _is(fn, expect) -> bool:
+    return (await fn()) == expect
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        return worker_main(int(sys.argv[2]))
+    return asyncio.run(orchestrate())
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

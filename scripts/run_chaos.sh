@@ -11,8 +11,13 @@
 # pipeline mid-drain under fault injection, restarts it, and asserts the
 # cursor resumes with no skipped events and no duplicate registry publish
 # (plus the full e2e: ingest -> stream -> candidate -> bake -> promote).
+# The rollout-under-replica-loss stage (tests/test_fleet.py, incl. the
+# slow-marked e2e) runs REAL worker processes behind the fleet gateway
+# under load, SIGKILLs one mid-bake, and asserts zero 5xx on the stable
+# lane, ejection within the probe interval, supervisor restart +
+# readmission, and bake-gate convergence.
 # See docs/resilience.md, docs/observability.md, docs/model_registry.md,
-# docs/streaming.md.
+# docs/streaming.md, docs/fleet.md.
 # Usage: scripts/run_chaos.sh [extra pytest args...]
 set -euo pipefail
 
@@ -21,5 +26,5 @@ cd "$repo_root"
 
 exec env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_resilience.py tests/test_obs.py tests/test_registry.py \
-  tests/test_stream.py -q \
+  tests/test_stream.py tests/test_fleet.py -q \
   -p no:cacheprovider "$@"

@@ -104,6 +104,14 @@ PYEOF
   fi
   echo "serving smoke vs baseline: $(tail -c 240 /tmp/pio_compare_smoke.json)"
 
+  # --- fleet smoke (ISSUE 9, docs/fleet.md): 2 workers + gateway, kill
+  #     one — the gateway must keep answering (ejection + failover) and
+  #     `pio top --fleet` must render from the federated /metrics. The
+  #     full kill-mid-ROLLOUT chaos stage lives in tests/test_fleet.py
+  #     (run by the chaos gate below); this is the fast availability rail.
+  env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+  echo "fleet smoke: gateway survives replica kill, pio top --fleet renders"
+
   # chaos gate includes the observability suite (tests/test_obs.py):
   # counters moving under faults + trace propagation are CI-asserted
   exec "$repo_root/scripts/run_chaos.sh"

@@ -1,0 +1,72 @@
+"""Fleet e2e worker entry: one REAL QueryServer process for test_fleet.
+
+Serves the shared registry's pinned stable version of the sample engine,
+with registry sync (fleet coordination) and the SIGTERM drain path
+enabled — this is the process the kill-mid-rollout chaos stage SIGKILLs
+and the supervisor restarts.
+
+argv: REGISTRY_DIR PORT STORAGE_BASEDIR
+env:  FLEET_BAKE_WINDOW / FLEET_BAKE_MIN tune the bake gate cadence.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import sys
+
+
+def main() -> int:
+    registry_dir, port, basedir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.registry.store import ArtifactStore
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        _query_server_from_registry,
+    )
+    from tests.test_registry import _engine_manifest, _mk_engine
+
+    # the same zero-config sqlite-under-basedir store the publisher used,
+    # so the lineage manifest's engine instance (and its params) resolve
+    storage = Storage(env={"PIO_FS_BASEDIR": basedir})
+    store = ArtifactStore(registry_dir)
+    state = store.get_state("regtest")
+    if not state.stable:
+        print("no stable version pinned in the registry", file=sys.stderr)
+        return 2
+    config = ServerConfig(
+        ip="127.0.0.1",
+        port=port,
+        request_timeout_s=5.0,
+        # fleet coordination: adopt registry transitions fast so the test
+        # can assert propagation without long sleeps
+        registry_sync_interval_s=0.1,
+        bake_check_interval_s=0.1,
+        bake_window_s=float(os.environ.get("FLEET_BAKE_WINDOW", "1.0")),
+        bake_min_requests=int(os.environ.get("FLEET_BAKE_MIN", "5")),
+        auto_promote=True,
+        drain_grace_s=5.0,
+    )
+    server = _query_server_from_registry(
+        _mk_engine(), _engine_manifest(), store, state.stable, storage, config
+    )
+
+    async def run() -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(signal.SIGTERM, server.begin_drain)
+        except (NotImplementedError, RuntimeError):
+            pass
+        await server.run_until_stopped()
+
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

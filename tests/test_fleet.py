@@ -1,0 +1,1304 @@
+"""Serving fleet tests (docs/fleet.md): gateway, supervisor, coordination.
+
+Covers the subsystem at three tiers:
+
+- units — metrics federation math, routing/ejection/readmission with
+  fake replicas, retry semantics (once, different replica, never on 4xx,
+  never for non-idempotent admin posts, budget-bounded), supervisor
+  restart backoff and the crash-loop budget, worker argv derivation;
+- in-process integration — registry state-generation propagation between
+  two QueryServers sharing one registry (stage/promote/rollback adopted
+  cross-process), the graceful drain path answering in-flight queries;
+- e2e (slow, run by scripts/run_chaos.sh) — the kill-mid-rollout chaos
+  stage: real worker processes behind a real gateway under load, one
+  SIGKILLed mid-bake, asserting ZERO 5xx, ejection within the probe
+  interval, supervisor restart + readmission, and bake-gate convergence.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.fleet import (
+    Gateway,
+    GatewayConfig,
+    Supervisor,
+    SupervisorConfig,
+    WorkerSpec,
+    federate_metrics,
+)
+from predictionio_tpu.fleet.launch import worker_argv
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.registry import ArtifactStore, ModelManifest
+from predictionio_tpu.resilience import CLOSED, OPEN
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# metrics federation
+# ---------------------------------------------------------------------------
+
+
+class TestFederation:
+    def test_counters_sum_across_replicas(self):
+        a = 'pio_requests_total{endpoint="/q",status="200"} 3\n'
+        b = (
+            'pio_requests_total{endpoint="/q",status="200"} 4\n'
+            'pio_requests_total{endpoint="/q",status="503"} 1\n'
+        )
+        merged = federate_metrics([a, b])
+        from predictionio_tpu.tools.top import parse_prometheus
+
+        samples = dict(
+            (labels["status"], v)
+            for labels, v in parse_prometheus(merged)["pio_requests_total"]
+        )
+        assert samples == {"200": 7.0, "503": 1.0}
+
+    def test_histograms_merge_bucketwise(self):
+        """Two replicas' histograms merge by adding cumulative bucket
+        counts — the federated quantile is the fleet-wide quantile."""
+        regs = [MetricsRegistry(), MetricsRegistry()]
+        for i, reg in enumerate(regs):
+            h = reg.histogram("pio_gw_seconds", "t")
+            for _ in range(10):
+                h.observe(0.002 if i == 0 else 0.2)
+        merged = federate_metrics([r.render_prometheus() for r in regs])
+        from predictionio_tpu.tools.top import (
+            _histogram_quantile,
+            parse_prometheus,
+        )
+
+        metrics = parse_prometheus(merged)
+        count = metrics["pio_gw_seconds_count"][0][1]
+        assert count == 20.0
+        # 10 fast + 10 slow: the median sits between the two modes and the
+        # p95 lands in the slow mode — only true if buckets really merged
+        assert _histogram_quantile(metrics, "pio_gw_seconds", 0.95) > 0.1
+        assert _histogram_quantile(metrics, "pio_gw_seconds", 0.25) < 0.01
+        # TYPE declared exactly once
+        assert merged.count("# TYPE pio_gw_seconds histogram") == 1
+
+    def test_disjoint_series_pass_through(self):
+        merged = federate_metrics(["only_a 1\n", "only_b 2\n"])
+        assert "only_a 1" in merged and "only_b 2" in merged
+
+
+# ---------------------------------------------------------------------------
+# gateway: fake replicas over real sockets
+# ---------------------------------------------------------------------------
+
+
+class FakeReplica:
+    """A stand-in QueryServer: answers /queries.json with its own name,
+    exposes /healthz (toggleable), /metrics (its query count), and the
+    rollout admin posts (counted, optionally failing)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.queries = 0
+        self.ready = True
+        self.fail_status: int | None = None
+        self.delay_s = 0.0
+        self.admin_hits = 0
+        self.server: TestServer | None = None
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+
+        async def queries(request: web.Request) -> web.Response:
+            self.queries += 1
+            if self.delay_s:
+                await asyncio.sleep(self.delay_s)
+            if self.fail_status:
+                return web.json_response(
+                    {"message": "injected"}, status=self.fail_status
+                )
+            body = await request.json()
+            return web.json_response({"replica": self.name, "echo": body})
+
+        async def healthz(request: web.Request) -> web.Response:
+            return web.json_response(
+                {"ready": self.ready}, status=200 if self.ready else 503
+            )
+
+        async def metrics(request: web.Request) -> web.Response:
+            return web.Response(
+                text=(
+                    "pio_requests_total"
+                    f'{{endpoint="/queries.json",status="200"}} {self.queries}\n'
+                )
+            )
+
+        async def admin(request: web.Request) -> web.Response:
+            self.admin_hits += 1
+            if self.fail_status:
+                return web.json_response(
+                    {"message": "injected"}, status=self.fail_status
+                )
+            return web.json_response({"message": "ok", "replica": self.name})
+
+        app.add_routes(
+            [
+                web.post("/queries.json", queries),
+                web.get("/healthz", healthz),
+                web.get("/metrics", metrics),
+                web.get("/models", admin),
+                web.post("/models/{action}", admin),
+            ]
+        )
+        return app
+
+    async def start(self) -> str:
+        self.server = TestServer(self.make_app())
+        await self.server.start_server()
+        return f"http://127.0.0.1:{self.server.port}"
+
+    async def stop(self) -> None:
+        if self.server is not None:
+            await self.server.close()
+
+
+def _gateway_rig(n_replicas: int = 2, **cfg_kw):
+    """(replicas, start coroutine factory) — the start coroutine yields
+    (gateway, client) with everything running and probed once."""
+    replicas = [FakeReplica(f"r{i}") for i in range(n_replicas)]
+
+    async def start(body):
+        urls = [await r.start() for r in replicas]
+        cfg_kw.setdefault("probe_interval_s", 0.05)
+        cfg_kw.setdefault("probe_timeout_s", 1.0)
+        cfg_kw.setdefault("request_timeout_s", 5.0)
+        gw = Gateway(
+            GatewayConfig(replica_urls=tuple(urls), **cfg_kw)
+        )
+        client = TestClient(TestServer(gw.make_app()))
+        await client.start_server()
+        try:
+            await asyncio.sleep(0.1)  # first probe pass
+            await body(gw, client)
+        finally:
+            await client.close()
+            for r in replicas:
+                await r.stop()
+
+    def run(body):
+        asyncio.run(start(body))
+
+    return replicas, run
+
+
+class TestGatewayRouting:
+    def test_queries_spread_and_answer(self):
+        replicas, run = _gateway_rig(2)
+
+        async def body(gw, client):
+            for i in range(12):
+                resp = await client.post(
+                    "/queries.json", json={"user": f"u{i}", "num": 3}
+                )
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["echo"]["user"] == f"u{i}"
+            assert replicas[0].queries + replicas[1].queries == 12
+            # the consistent hash spreads distinct users over both
+            assert replicas[0].queries >= 1 and replicas[1].queries >= 1
+
+        run(body)
+
+    def test_same_user_sticks_while_loads_equal(self):
+        replicas, run = _gateway_rig(2)
+
+        async def body(gw, client):
+            for _ in range(6):
+                resp = await client.post(
+                    "/queries.json", json={"user": "sticky-user"}
+                )
+                assert resp.status == 200
+            counts = sorted((replicas[0].queries, replicas[1].queries))
+            assert counts == [0, 6]  # one replica took every request
+
+        run(body)
+
+    def test_least_loaded_beats_hash_under_load(self):
+        replicas, run = _gateway_rig(2)
+
+        async def body(gw, client):
+            for r in replicas:
+                r.delay_s = 0.25
+            t1 = asyncio.ensure_future(
+                client.post("/queries.json", json={"user": "same"})
+            )
+            await asyncio.sleep(0.1)  # t1 is in flight on its replica
+            t2 = asyncio.ensure_future(
+                client.post("/queries.json", json={"user": "same"})
+            )
+            r1, r2 = await asyncio.gather(t1, t2)
+            assert r1.status == 200 and r2.status == 200
+            # same sticky key, but the occupied replica was skipped
+            assert (replicas[0].queries, replicas[1].queries) == (1, 1)
+
+        run(body)
+
+    def test_ejection_and_readmission_via_probes(self):
+        replicas, run = _gateway_rig(2)
+
+        async def body(gw, client):
+            replicas[0].ready = False
+            await _poll(
+                lambda: not gw.replicas[0].healthy, "ejection never happened"
+            )
+            before = replicas[0].queries
+            for i in range(6):
+                resp = await client.post(
+                    "/queries.json", json={"user": f"u{i}"}
+                )
+                assert resp.status == 200
+            assert replicas[0].queries == before  # no traffic while ejected
+            health = await (await client.get("/healthz")).json()
+            assert health["replicasHealthy"] == 1
+            replicas[0].ready = True
+            await _poll(
+                lambda: gw.replicas[0].healthy, "readmission never happened"
+            )
+            assert gw._m_ejections.value(replica=gw.replicas[0].name) == 1
+            assert gw._m_readmissions.value(replica=gw.replicas[0].name) == 1
+
+        run(body)
+
+    def test_probe_blackout_routes_in_panic_mode(self):
+        """A replica that fails its probe but still answers (probe
+        timeout under load, not death) keeps serving: with EVERY replica
+        ejected, routing ignores health rather than shedding."""
+        replicas, run = _gateway_rig(1)
+
+        async def body(gw, client):
+            replicas[0].ready = False
+            await _poll(lambda: not gw.replicas[0].healthy, "no ejection")
+            resp = await client.post("/queries.json", json={"user": "u"})
+            assert resp.status == 200  # panic pick, not a 503 shed
+            assert gw._m_panic.value() >= 1
+            # /healthz still reports the fleet unready — panic routing
+            # serves traffic, it does not mask the outage signal
+            health = await client.get("/healthz")
+            assert health.status == 503
+
+        run(body)
+
+    def test_all_replicas_down_sheds_with_retry_after(self):
+        replicas, run = _gateway_rig(1)
+
+        async def body(gw, client):
+            replicas[0].ready = False
+            await _poll(lambda: not gw.replicas[0].healthy, "no ejection")
+            # breaker open too: the replica is truly gone, panic routing
+            # has nowhere left to try and the query is shed
+            for _ in range(gw.config.breaker_threshold):
+                gw.replicas[0].breaker.record_failure()
+            resp = await client.post("/queries.json", json={"user": "u"})
+            assert resp.status == 503
+            assert "Retry-After" in resp.headers
+            health = await client.get("/healthz")
+            assert health.status == 503
+            assert gw._m_no_replica.value() >= 1
+
+        run(body)
+
+
+class TestGatewayRetry:
+    def test_5xx_retries_once_on_a_different_replica(self):
+        replicas, run = _gateway_rig(2, breaker_threshold=3)
+
+        async def body(gw, client):
+            replicas[0].fail_status = 500
+            for i in range(10):
+                resp = await client.post(
+                    "/queries.json", json={"user": f"u{i}"}
+                )
+                assert resp.status == 200  # failures masked by failover
+                assert (await resp.json())["replica"] == "r1"
+            assert gw._m_retries.value() >= 1
+            # three consecutive 500s opened r0's breaker: traffic stopped
+            # reaching it long before the 10th request
+            assert gw.replicas[0].breaker.snapshot()["state"] == OPEN
+            assert replicas[0].queries <= 4
+
+        run(body)
+
+    def test_connection_error_retries_then_503_when_alone(self):
+        """Transport failure on the only replica: no second replica to
+        retry on -> clean 503, not a hang or a raw exception."""
+        replicas, run = _gateway_rig(1)
+
+        async def body(gw, client):
+            import aiohttp as _aiohttp
+
+            async def dead_forward(replica, method, path, body_b, headers):
+                raise _aiohttp.ClientConnectionError("replica vanished")
+
+            gw._forward = dead_forward
+            resp = await client.post("/queries.json", json={"user": "u"})
+            assert resp.status == 503
+
+        run(body)
+
+    def test_4xx_passes_through_untouched(self):
+        replicas, run = _gateway_rig(1)
+
+        async def body(gw, client):
+            replicas[0].fail_status = 400
+            for _ in range(5):
+                resp = await client.post("/queries.json", json={"user": "u"})
+                assert resp.status == 400  # the client's error, not ours
+            assert gw._m_retries.value() == 0
+            # a 4xx is a healthy replica doing its job: breaker untouched
+            assert gw.replicas[0].breaker.snapshot()["state"] == CLOSED
+            assert replicas[0].queries == 5
+
+        run(body)
+
+    def test_admin_posts_never_double_dispatch(self):
+        """Non-idempotent surface: a failing promote is relayed, not
+        retried — exactly ONE replica saw the request."""
+        replicas, run = _gateway_rig(2)
+
+        async def body(gw, client):
+            for r in replicas:
+                r.fail_status = 500
+            resp = await client.post("/models/promote", json={})
+            assert resp.status == 500  # the replica's own answer, relayed
+            assert replicas[0].admin_hits + replicas[1].admin_hits == 1
+            assert gw._m_retries.value() == 0
+
+        run(body)
+
+    def test_retry_budget_bounds_failover(self):
+        """With the budget drained, a forward failure surfaces instead of
+        doubling load on the survivors."""
+        replicas, run = _gateway_rig(2, breaker_threshold=100)
+
+        async def body(gw, client):
+            replicas[0].fail_status = 503
+            gw.retry_budget._tokens = 0.0  # drained (ratio tops it up slowly)
+            gw.retry_budget.ratio = 0.0
+            statuses = set()
+            for i in range(12):
+                resp = await client.post(
+                    "/queries.json", json={"user": f"u{i}"}
+                )
+                statuses.add(resp.status)
+            assert gw._m_retries.value() == 0
+            assert 503 in statuses  # r0's failures surfaced un-retried
+
+        run(body)
+
+
+class TestGatewayFederationAndDrain:
+    def test_metrics_federates_replicas_plus_gateway(self):
+        replicas, run = _gateway_rig(2)
+
+        async def body(gw, client):
+            for i in range(4):
+                await client.post("/queries.json", json={"user": f"u{i}"})
+            text = await (await client.get("/metrics")).text()
+            from predictionio_tpu.tools.top import parse_prometheus, _total
+
+            metrics = parse_prometheus(text)
+            # replicas' own request counters summed to the fleet total
+            assert (
+                _total(metrics, "pio_requests_total", endpoint="/queries.json")
+                == 4.0
+            )
+            # gateway-side instruments ride the same exposition
+            assert _total(metrics, "pio_fleet_replicas") == 2.0
+            up = {
+                labels["replica"]: v
+                for labels, v in metrics["pio_fleet_replica_up"]
+            }
+            assert len(up) == 2 and all(v == 1.0 for v in up.values())
+            assert "pio_gateway_request_seconds_bucket" in metrics
+
+        run(body)
+
+    def test_top_fleet_line_renders_from_federated_scrape(self):
+        replicas, run = _gateway_rig(2)
+
+        async def body(gw, client):
+            await client.post("/queries.json", json={"user": "u"})
+            text = await (await client.get("/metrics")).text()
+            from predictionio_tpu.tools.top import (
+                parse_prometheus,
+                render,
+                summarize,
+            )
+
+            summary = summarize(parse_prometheus(text))
+            assert summary["fleet"] is not None
+            assert summary["fleet"]["replicas_total"] == 2.0
+            assert summary["fleet"]["replicas_up"] == 2.0
+            screen = render(summary, "http://gw")
+            assert "fleet" in screen and "2/2 up" in screen
+
+        run(body)
+
+    def test_drain_answers_keepalive_and_inflight_5xx_free(self):
+        replicas, run = _gateway_rig(1, drain_grace_s=5.0)
+
+        async def body(gw, client):
+            replicas[0].delay_s = 0.3
+            inflight = asyncio.ensure_future(
+                client.post("/queries.json", json={"user": "u"})
+            )
+            await asyncio.sleep(0.1)
+            drain = asyncio.ensure_future(gw.drain())
+            await asyncio.sleep(0.05)
+            # a request arriving on an established keep-alive connection
+            # mid-drain is ANSWERED (the 5xx-free contract) with
+            # Connection: close so the client migrates
+            straggler = await client.post("/queries.json", json={"user": "v"})
+            assert straggler.status == 200
+            assert straggler.headers.get("Connection") == "close"
+            resp = await inflight
+            assert resp.status == 200  # ... and in-flight answered
+            await drain
+            assert gw._inflight_requests == 0
+            # /healthz signals not-ready the whole time, so load
+            # balancers route around the draining gateway
+            hz = await client.get("/healthz")
+            assert hz.status == 503
+
+        run(body)
+
+
+async def _poll(cond, message: str, deadline_s: float = 5.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while not cond():
+        assert time.monotonic() < deadline, message
+        await asyncio.sleep(0.02)
+
+
+class TestTopMultiEndpoint:
+    """Satellite: `pio top --json` over several --metrics-url endpoints
+    emits ONE object per endpoint per refresh, with per-endpoint rate
+    state and per-endpoint error isolation."""
+
+    def _fetch(self, texts: dict[str, str]):
+        def fetch(url: str) -> str:
+            result = texts[url]
+            if isinstance(result, Exception):
+                raise result
+            return result
+
+        return fetch
+
+    def test_one_json_object_per_endpoint_per_refresh(self):
+        from predictionio_tpu.tools.top import run_top
+
+        texts = {
+            "http://a": "pio_requests_total 5\n",
+            "http://b": "pio_requests_total 9\n",
+        }
+        out: list[str] = []
+        rc = run_top(
+            "http://ignored",
+            urls=["http://a", "http://b"],
+            iterations=2,
+            interval_s=0.0,
+            fetch=self._fetch(texts),
+            out=out.append,
+            sleep=lambda s: None,
+            json_mode=True,
+        )
+        assert rc == 0
+        objs = [json.loads(line) for line in out]
+        assert len(objs) == 4  # 2 endpoints x 2 refreshes
+        assert [o["url"] for o in objs] == [
+            "http://a",
+            "http://b",
+            "http://a",
+            "http://b",
+        ]
+        assert all(o["requests_total"] in (5.0, 9.0) for o in objs)
+        # second refresh has per-endpoint rate state (qps computed)
+        assert objs[2]["qps"] is not None and objs[3]["qps"] is not None
+
+    def test_unreachable_endpoint_degrades_only_its_own_line(self):
+        from predictionio_tpu.tools.top import run_top
+
+        texts = {
+            "http://a": "pio_requests_total 5\n",
+            "http://b": OSError("connection refused"),
+        }
+        out: list[str] = []
+        run_top(
+            "http://ignored",
+            urls=["http://a", "http://b"],
+            iterations=1,
+            fetch=self._fetch(texts),
+            out=out.append,
+            sleep=lambda s: None,
+            json_mode=True,
+        )
+        objs = [json.loads(line) for line in out]
+        assert len(objs) == 2
+        assert objs[0]["url"] == "http://a" and "requests_total" in objs[0]
+        assert objs[1]["url"] == "http://b" and "error" in objs[1]
+
+    def test_single_url_screen_mode_unchanged(self):
+        from predictionio_tpu.tools.top import run_top
+
+        out: list[str] = []
+        run_top(
+            "http://a",
+            iterations=1,
+            fetch=self._fetch({"http://a": "pio_requests_total 5\n"}),
+            out=out.append,
+            sleep=lambda s: None,
+            clear_screen=False,
+        )
+        assert len(out) == 1 and "pio top — http://a" in out[0]
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+
+class FakeProc:
+    _pid = 1000
+
+    def __init__(self, ignore_term: bool = False):
+        self.rc: int | None = None
+        FakeProc._pid += 1
+        self.pid = FakeProc._pid
+        self.terminated = False
+        self.killed = False
+        self.ignore_term = ignore_term
+
+    def poll(self):
+        return self.rc
+
+    def exit(self, rc: int = 1):
+        self.rc = rc
+
+    def terminate(self):
+        self.terminated = True
+        if not self.ignore_term:
+            self.rc = -15
+
+    def kill(self):
+        self.killed = True
+        self.rc = -9
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+def _supervisor(cfg: SupervisorConfig, n: int = 1, ignore_term: bool = False):
+    clock = FakeClock()
+    spawned: list[FakeProc] = []
+
+    def spawn(spec):
+        p = FakeProc(ignore_term=ignore_term)
+        spawned.append(p)
+        return p
+
+    sup = Supervisor(
+        spawn,
+        [WorkerSpec(f"w{i}", 9000 + i) for i in range(n)],
+        cfg,
+        clock=clock,
+    )
+    return sup, spawned, clock
+
+
+class TestSupervisor:
+    def test_restart_backoff_grows_exponentially(self):
+        sup, spawned, clock = _supervisor(
+            SupervisorConfig(
+                backoff_base_s=1.0,
+                backoff_multiplier=2.0,
+                backoff_max_s=8.0,
+                crash_loop_window_s=1e9,
+                crash_loop_budget=99,
+                healthy_reset_s=30.0,
+            )
+        )
+        sup.start()
+        assert len(spawned) == 1
+        spawned[-1].exit(1)
+        sup.tick()  # reap: schedules restart at +1.0
+        sup.tick()
+        assert len(spawned) == 1  # backoff not elapsed
+        clock.advance(1.0)
+        sup.tick()
+        assert len(spawned) == 2
+        spawned[-1].exit(1)
+        sup.tick()  # second consecutive crash: backoff now 2.0
+        clock.advance(1.0)
+        sup.tick()
+        assert len(spawned) == 2  # 1.0 < 2.0: still waiting
+        clock.advance(1.0)
+        sup.tick()
+        assert len(spawned) == 3
+        assert sup._m_restarts.value(replica="w0") == 2
+
+    def test_healthy_uptime_resets_the_backoff_ladder(self):
+        sup, spawned, clock = _supervisor(
+            SupervisorConfig(
+                backoff_base_s=1.0,
+                backoff_multiplier=2.0,
+                backoff_max_s=64.0,
+                crash_loop_window_s=1e9,
+                crash_loop_budget=99,
+                healthy_reset_s=10.0,
+            )
+        )
+        sup.start()
+        spawned[-1].exit(1)
+        sup.tick()
+        clock.advance(1.0)
+        sup.tick()  # restart #1 (next crash would back off 2.0)
+        clock.advance(10.0)
+        sup.tick()  # healthy long enough: ladder resets
+        spawned[-1].exit(1)
+        sup.tick()
+        clock.advance(1.0)  # base backoff again, NOT 2.0
+        sup.tick()
+        assert len(spawned) == 3
+
+    def test_crash_loop_budget_parks_the_worker(self):
+        sup, spawned, clock = _supervisor(
+            SupervisorConfig(
+                backoff_base_s=0.0,
+                crash_loop_window_s=100.0,
+                crash_loop_budget=2,
+                healthy_reset_s=1e9,
+            )
+        )
+        sup.start()
+        for _ in range(2):
+            spawned[-1].exit(1)
+            sup.tick()  # reap
+            sup.tick()  # respawn (zero backoff)
+        spawned[-1].exit(1)
+        sup.tick()  # third exit in the window: over budget
+        snap = sup.snapshot()[0]
+        assert snap["parked"] is True
+        clock.advance(1000.0)
+        sup.tick()
+        assert len(spawned) == 3  # parked: never respawned
+        assert sup._m_crash_loops.value(replica="w0") == 1
+
+    def test_stop_escalates_term_to_kill(self):
+        sup, spawned, clock = _supervisor(
+            SupervisorConfig(term_grace_s=0.0), ignore_term=True
+        )
+        sup.start()
+        sup.stop()
+        assert spawned[0].terminated and spawned[0].killed
+
+    def test_stop_graceful_when_term_honored(self):
+        sup, spawned, clock = _supervisor(SupervisorConfig(term_grace_s=5.0))
+        sup.start()
+        sup.stop()
+        assert spawned[0].terminated and not spawned[0].killed
+
+
+class TestWorkerArgv:
+    def test_strips_fleet_topology_flags_and_appends_worker_port(self):
+        argv = [
+            "deploy",
+            "--engine-dir",
+            "eng",
+            "--fleet",
+            "3",
+            "--ip",
+            "0.0.0.0",
+            "--port",
+            "8000",
+            "--registry-dir",
+            "reg",
+        ]
+        out = worker_argv(argv, 8001, 0.5)
+        assert out[:4] == [
+            sys.executable,
+            "-m",
+            "predictionio_tpu.tools.cli",
+            "deploy",
+        ]
+        assert "--fleet" not in out
+        assert "--registry-dir" in out and "reg" in out
+        assert out[out.index("--port") + 1] == "8001"
+        assert out[out.index("--ip") + 1] == "127.0.0.1"
+        assert out[out.index("--registry-sync-interval") + 1] == "0.5"
+
+    def test_handles_equals_spelling(self):
+        out = worker_argv(
+            ["deploy", "--fleet=3", "--port=8000", "--accesskey=k"], 9001, 1.0
+        )
+        assert not any(a.startswith("--fleet") for a in out)
+        assert "--accesskey=k" in out
+        assert out[out.index("--port") + 1] == "9001"
+
+
+# ---------------------------------------------------------------------------
+# registry state generation + cross-process coordination
+# ---------------------------------------------------------------------------
+
+
+class TestStateGeneration:
+    def _publish(self, store: ArtifactStore, engine_id: str = "e") -> str:
+        m = store.publish(
+            ModelManifest(
+                version="",
+                engine_id=engine_id,
+                engine_version="1",
+                engine_variant="v",
+            ),
+            b"blob-%d" % store.state_generation(engine_id),
+        )
+        return m.version
+
+    def test_bumps_on_every_state_transition(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        assert store.state_generation("e") == 0
+        v1 = self._publish(store)  # publish + auto-stable
+        g1 = store.state_generation("e")
+        assert g1 >= 1
+        v2 = self._publish(store)
+        g2 = store.state_generation("e")
+        assert g2 > g1
+        store.stage_candidate("e", v2, mode="canary", fraction=0.2)
+        g3 = store.state_generation("e")
+        assert g3 > g2
+        store.promote("e")
+        g4 = store.state_generation("e")
+        assert g4 > g3
+        store.rollback("e")  # previous-stable revert
+        assert store.state_generation("e") > g4
+        assert store.get_state("e").stable == v1
+
+    def test_transitions_serialized_across_store_instances(self, tmp_path):
+        """Fleet workers are concurrent registry writers; the flock-backed
+        state mutex must serialize a transition from one store instance
+        (= process: flock is per-open-file-description) against another's."""
+        import threading
+
+        a = ArtifactStore(str(tmp_path))
+        b = ArtifactStore(str(tmp_path))
+        v1 = self._publish(a)
+        v2 = self._publish(a)
+        entered = threading.Event()
+        release = threading.Event()
+        done = threading.Event()
+
+        def hold_lock():
+            with a._state_mutex("e"):
+                entered.set()
+                release.wait(5.0)
+            done.set()
+
+        t = threading.Thread(target=hold_lock)
+        t.start()
+        assert entered.wait(5.0)
+        # b's transition must BLOCK while a (another "process") holds the
+        # transition lock
+        result: dict = {}
+
+        def transition():
+            result["state"] = b.stage_candidate("e", v2, fraction=0.2)
+
+        t2 = threading.Thread(target=transition)
+        t2.start()
+        t2.join(0.3)
+        assert t2.is_alive(), "stage did not wait for the cross-process lock"
+        release.set()
+        t2.join(5.0)
+        t.join(5.0)
+        assert not t2.is_alive() and done.is_set()
+        assert result["state"].candidate == v2
+        assert a.get_state("e").candidate == v2 and a.get_state("e").stable == v1
+
+    def test_concurrent_writers_never_collide_on_a_generation(self, tmp_path):
+        """Read-modify-write hammer from two store instances: every
+        persisted save must land its own generation number (a lost update
+        shows up as final generation < number of saves)."""
+        import threading
+
+        a = ArtifactStore(str(tmp_path))
+        b = ArtifactStore(str(tmp_path))
+        v2 = (self._publish(a), self._publish(a))[1]
+        base_gen = a.state_generation("e")
+        saves = []
+        for store in (a, b):
+            orig = store._save_state
+
+            def counted(engine_id, state, _orig=orig):
+                _orig(engine_id, state)
+                saves.append(state.generation)
+
+            store._save_state = counted
+
+        def hammer(store):
+            for _ in range(25):
+                store.stage_candidate("e", v2, fraction=0.1)
+                store.unstage("e", reason="test")
+
+        threads = [threading.Thread(target=hammer, args=(s,)) for s in (a, b)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert a.state_generation("e") == base_gen + len(saves)
+        # and every save got a DISTINCT generation — no collisions
+        assert len(set(saves)) == len(saves)
+
+    def test_generation_survives_reload_from_disk(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        self._publish(store)
+        gen = store.state_generation("e")
+        assert ArtifactStore(str(tmp_path)).state_generation("e") == gen
+
+
+def _synced_pair(tmp_path, **cfg_kw):
+    """Two QueryServers sharing one registry (the fleet topology, in one
+    process): v000001 pinned stable, v000002 published and stageable."""
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        _query_server_from_registry,
+    )
+    from tests.test_registry import (
+        _engine_manifest,
+        _memory_storage,
+        _mk_engine,
+        _train_version,
+    )
+
+    storage = _memory_storage()
+    registry_dir = str(tmp_path / "registry")
+    _train_version(storage, registry_dir, algo_id=3)  # v000001, auto-stable
+    _train_version(storage, registry_dir, algo_id=5)  # v000002
+    store = ArtifactStore(registry_dir)
+    cfg_kw.setdefault("bake_check_interval_s", 30.0)
+    cfg_kw.setdefault("request_timeout_s", 5.0)
+
+    def mk():
+        return _query_server_from_registry(
+            _mk_engine(),
+            _engine_manifest(),
+            store,
+            "v000001",
+            storage,
+            ServerConfig(**cfg_kw),
+        )
+
+    return mk(), mk(), store
+
+
+class TestRegistrySync:
+    def test_stage_on_one_replica_propagates(self, tmp_path):
+        a, b, store = _synced_pair(tmp_path)
+
+        async def body():
+            lane = a._load_lane_from_registry("v000002")
+            a.stage_candidate_lane(lane, mode="canary", fraction=0.25)
+            await b._registry_sync_tick()
+            assert b._candidate is not None
+            assert b._candidate.version == "v000002"
+            assert b._plan.mode == "canary"
+            assert abs(b._plan.fraction - 0.25) < 1e-9
+            # controller is baking on B too
+            assert b.rollout_controller.snapshot()["active"] is True
+
+        asyncio.run(body())
+
+    def test_promote_on_one_replica_propagates(self, tmp_path):
+        a, b, store = _synced_pair(tmp_path)
+
+        async def body():
+            lane = a._load_lane_from_registry("v000002")
+            a.stage_candidate_lane(lane, mode="canary", fraction=0.5)
+            await b._registry_sync_tick()  # B bakes the same candidate
+            a._promote_candidate()
+            await b._registry_sync_tick()
+            assert b.model_version == "v000002"
+            assert b._candidate is None
+            assert a.model_version == "v000002"
+
+        asyncio.run(body())
+
+    def test_promote_propagates_even_without_prior_stage_sync(self, tmp_path):
+        """A replica that never saw the stage (e.g. it just restarted)
+        still converges: the stable pin moved, so it loads the new
+        stable from the registry wholesale."""
+        a, b, store = _synced_pair(tmp_path)
+
+        async def body():
+            lane = a._load_lane_from_registry("v000002")
+            a.stage_candidate_lane(lane, mode="canary", fraction=0.5)
+            a._promote_candidate()
+            await b._registry_sync_tick()
+            assert b.model_version == "v000002"
+            assert b._candidate is None
+
+        asyncio.run(body())
+
+    def test_rollback_on_one_replica_propagates(self, tmp_path):
+        a, b, store = _synced_pair(tmp_path)
+
+        async def body():
+            lane = a._load_lane_from_registry("v000002")
+            a.stage_candidate_lane(lane, mode="shadow")
+            await b._registry_sync_tick()
+            assert b._candidate is not None
+            a._rollback_candidate("manual")
+            await b._registry_sync_tick()
+            assert b._candidate is None
+            assert b.model_version == "v000001"
+            # adopted WITHOUT re-persisting: no double history entry
+            rollbacks = [
+                h
+                for h in store.get_state("regtest").history
+                if h["action"] == "rollback"
+            ]
+            assert len(rollbacks) == 1
+
+        asyncio.run(body())
+
+    def test_sync_flushes_the_result_cache_on_stable_swap(self, tmp_path):
+        a, b, store = _synced_pair(tmp_path, result_cache_size=64)
+
+        async def body():
+            cache = b._result_cache
+            cache.put("v000001", b"somekey", {"x": 1})
+            assert cache.stats()["entries"] == 1
+            lane = a._load_lane_from_registry("v000002")
+            a.stage_candidate_lane(lane, mode="canary", fraction=0.5)
+            a._promote_candidate()
+            await b._registry_sync_tick()
+            assert b.model_version == "v000002"
+            # PR-8 invariant fleet-wide: the retired version's entries are
+            # gone from every process, not just the one that promoted
+            assert cache.stats()["entries"] == 0
+
+        asyncio.run(body())
+
+    def test_local_transitions_reconcile_to_noop(self, tmp_path):
+        a, b, store = _synced_pair(tmp_path)
+
+        async def body():
+            lane = a._load_lane_from_registry("v000002")
+            a.stage_candidate_lane(lane, mode="canary", fraction=0.5)
+            gen = store.state_generation("regtest")
+            cand = a._candidate
+            await a._registry_sync_tick()  # A reconciling its own write
+            assert a._candidate is cand  # same lane object: no re-stage
+            assert store.state_generation("regtest") == gen  # no writes
+
+        asyncio.run(body())
+
+    def test_models_endpoint_reports_state_generation(self, tmp_path):
+        from tests.test_registry import _run_server
+
+        a, b, store = _synced_pair(tmp_path)
+
+        async def body(client):
+            data = await (await client.get("/models")).json()
+            assert data["registry"]["stateGeneration"] >= 1
+            assert (
+                data["registry"]["state"]["generation"]
+                == data["registry"]["stateGeneration"]
+            )
+
+        _run_server(body, a)
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (satellite: SIGTERM must not tear down in-flight work)
+# ---------------------------------------------------------------------------
+
+
+class TestGracefulDrain:
+    def test_drain_answers_inflight_and_unreadies_healthz(self):
+        from tests.test_registry import _run_server, _tag_lane, _tag_server
+
+        server = _tag_server(drain_grace_s=5.0)
+        server._active = _tag_lane("v1", delay_s=0.3)  # slow lane
+
+        async def body(client):
+            inflight = asyncio.ensure_future(
+                client.post("/queries.json", json={"qid": 1, "user": "u"})
+            )
+            await asyncio.sleep(0.1)  # the query is on the dispatch thread
+            drain = asyncio.ensure_future(server.drain())
+            await asyncio.sleep(0.05)
+            health = await client.get("/healthz")
+            assert health.status == 503
+            assert (await health.json())["draining"] is True
+            resp = await inflight
+            assert resp.status == 200  # answered, not torn down
+            assert (await resp.json())["model"] == "v1"
+            await drain
+            assert server._inflight_requests == 0
+
+        _run_server(body, server)
+
+    def test_drain_is_idempotent_and_bounded(self):
+        from tests.test_registry import _run_server, _tag_server
+
+        server = _tag_server(drain_grace_s=0.2)
+
+        async def body(client):
+            await server.drain()
+            await server.drain()  # second call returns immediately
+            assert server._draining
+
+        _run_server(body, server)
+
+
+# ---------------------------------------------------------------------------
+# e2e: kill a worker mid-rollout under load (the chaos stage)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestKillMidRolloutE2E:
+    """Real worker processes + real gateway + real load. SIGKILL one
+    worker while a canary bakes; the stable lane must never 5xx, the
+    dead replica must be ejected within the probe window, the supervisor
+    must restart and the gateway readmit it, and the bake gate must
+    still converge (promote) fleet-wide."""
+
+    def test_kill_worker_mid_rollout(self, tmp_path):
+        from predictionio_tpu.data.storage.registry import Storage
+        from tests.test_registry import _train_version
+
+        basedir = str(tmp_path / "store")
+        registry_dir = str(tmp_path / "registry")
+        storage = Storage(env={"PIO_FS_BASEDIR": basedir})
+        _train_version(storage, registry_dir, algo_id=3)  # v000001 stable
+        _train_version(storage, registry_dir, algo_id=5)  # v000002
+        store = ArtifactStore(registry_dir)
+
+        import socket
+
+        def free_port() -> int:
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        specs = [WorkerSpec(f"w{i}", free_port()) for i in range(2)]
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            # long enough that the SIGKILL lands MID-bake (stage -> kill is
+            # under a second; auto-promote waits out this window first)
+            "FLEET_BAKE_WINDOW": "6.0",
+            "FLEET_BAKE_MIN": "5",
+            "PIO_FS_BASEDIR": basedir,
+        }
+
+        def spawn(spec):
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    os.path.join(REPO, "tests", "fleet_worker.py"),
+                    registry_dir,
+                    str(spec.port),
+                    basedir,
+                ],
+                env=env,
+                cwd=REPO,
+            )
+
+        metrics = MetricsRegistry()
+        sup = Supervisor(
+            spawn,
+            specs,
+            SupervisorConfig(
+                poll_interval_s=0.1, backoff_base_s=0.2, term_grace_s=8.0
+            ),
+            metrics=metrics,
+        )
+        gw = Gateway(
+            GatewayConfig(
+                ip="127.0.0.1",
+                port=free_port(),
+                replica_urls=tuple(s.url for s in specs),
+                probe_interval_s=0.2,
+                probe_timeout_s=1.0,
+                request_timeout_s=8.0,
+            ),
+            metrics=metrics,
+        )
+        results: dict = {"statuses": [], "errors": [], "eject_s": None}
+        try:
+            asyncio.run(self._drive(sup, gw, store, results))
+        finally:
+            sup.stop()
+        fivexx = [s for s in results["statuses"] if s >= 500]
+        assert fivexx == [], (
+            f"{len(fivexx)} 5xx under replica loss "
+            f"(of {len(results['statuses'])} requests): "
+            f"{results.get('bodies_5xx', [])[:5]}"
+        )
+        assert results["errors"] == []
+        assert len(results["statuses"]) > 50
+        assert results["eject_s"] is not None and results["eject_s"] < 3.0
+        assert store.get_state("regtest").stable == "v000002"
+
+    async def _drive(self, sup, gw, store, results) -> None:
+        import aiohttp
+
+        sup.start()
+        sup_task = asyncio.ensure_future(sup.run())
+        await gw.start()
+        gw_url = f"http://127.0.0.1:{gw.config.port}"
+        session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=10)
+        )
+        stop_load = asyncio.Event()
+        load_task = None
+        try:
+            # both workers serving (worker start pays the jax import)
+            for spec in sup.workers:
+                await self._wait_ready(session, spec.url, 90.0)
+            load_task = asyncio.ensure_future(
+                self._load(session, gw_url, stop_load, results)
+            )
+            await asyncio.sleep(0.3)
+            # stage the canary THROUGH the gateway (one replica handles
+            # it; the other adopts via registry sync)
+            async with session.post(
+                f"{gw_url}/models/candidate",
+                json={"version": "v000002", "mode": "canary", "fraction": 0.4},
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+            for spec in sup.workers:
+                await self._poll_async(
+                    lambda spec=spec: self._worker_candidate(session, spec.url),
+                    "candidate never propagated to every worker",
+                    10.0,
+                )
+            # SIGKILL a worker mid-bake
+            victim = sup.snapshot()[1]
+            os.kill(victim["pid"], signal.SIGKILL)
+            t_kill = time.monotonic()
+            await self._poll_async(
+                lambda: self._gw_healthy_count(session, gw_url, 1),
+                "dead replica never ejected",
+                10.0,
+            )
+            results["eject_s"] = time.monotonic() - t_kill
+            # supervisor restarts it; gateway readmits
+            await self._poll_async(
+                lambda: self._gw_healthy_count(session, gw_url, 2),
+                "restarted replica never readmitted",
+                90.0,
+            )
+            # the bake gate converges fleet-wide: promote lands in the
+            # registry and every replica serves v2
+            deadline = time.monotonic() + 45.0
+            while store.get_state("regtest").stable != "v000002":
+                assert time.monotonic() < deadline, "bake gate never converged"
+                await asyncio.sleep(0.25)
+
+            async def _serves_v2() -> bool:
+                async with session.post(
+                    f"{gw_url}/queries.json",
+                    json={"qid": 1, "user": "convergence-check"},
+                ) as resp:
+                    if resp.status != 200:
+                        return False
+                    return (await resp.json()).get("algo_id") == 5
+
+            await self._poll_async(
+                _serves_v2, "fleet never served the promoted version", 15.0
+            )
+        finally:
+            stop_load.set()
+            if load_task is not None:
+                await asyncio.gather(load_task, return_exceptions=True)
+            sup_task.cancel()
+            await asyncio.gather(sup_task, return_exceptions=True)
+            await session.close()
+            await gw.stop()
+
+    async def _load(self, session, gw_url, stop, results) -> None:
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                async with session.post(
+                    f"{gw_url}/queries.json",
+                    json={"qid": i, "user": f"u{i % 40}"},
+                ) as resp:
+                    body = await resp.read()
+                    results["statuses"].append(resp.status)
+                    if resp.status >= 500:
+                        # keep the failure diagnosable: which 5xx was it
+                        results.setdefault("bodies_5xx", []).append(
+                            body[:120].decode("utf-8", "replace")
+                        )
+            except Exception as exc:  # gateway itself must never drop us
+                results["errors"].append(repr(exc))
+            await asyncio.sleep(0.01)
+
+    async def _wait_ready(self, session, url, deadline_s) -> None:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                async with session.get(f"{url}/healthz") as resp:
+                    if resp.status == 200:
+                        return
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, f"{url} never became ready"
+            await asyncio.sleep(0.25)
+
+    async def _poll_async(self, cond, message, deadline_s) -> None:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                ok = await cond()
+            except Exception:
+                ok = False
+            if ok:
+                return
+            assert time.monotonic() < deadline, message
+            await asyncio.sleep(0.1)
+
+    async def _worker_candidate(self, session, url) -> bool:
+        async with session.get(f"{url}/models") as resp:
+            if resp.status != 200:
+                return False
+            data = await resp.json()
+            cand = data.get("candidate")
+            return bool(cand and cand.get("version") == "v000002")
+
+    async def _gw_healthy_count(self, session, gw_url, expect) -> bool:
+        async with session.get(f"{gw_url}/healthz") as resp:
+            data = await resp.json()
+            return data.get("replicasHealthy") == expect

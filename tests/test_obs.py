@@ -1253,6 +1253,9 @@ class TestMetricsContract:
         import sys
 
         sys.path.insert(0, "tests") if "tests" not in sys.path else None
+        from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+        from predictionio_tpu.fleet.supervisor import Supervisor, WorkerSpec
+        from predictionio_tpu.obs.metrics import MetricsRegistry
         from predictionio_tpu.stream.pipeline import StreamInstruments
         from tests.test_resilience import _make_event_server, _make_query_server
 
@@ -1271,5 +1274,18 @@ class TestMetricsContract:
         es, _, _ = _make_event_server()
         registered.update(es.metrics._metrics)
         registered.update(StreamInstruments().registry._metrics)
+        # the fleet family lives on the gateway/supervisor registry (the
+        # `pio deploy --fleet` parent), not on any worker's
+        fleet_metrics = MetricsRegistry()
+        Gateway(
+            GatewayConfig(replica_urls=("http://127.0.0.1:1",)),
+            metrics=fleet_metrics,
+        )
+        Supervisor(
+            spawn=lambda spec: None,
+            specs=[WorkerSpec(name="w0", port=1)],
+            metrics=fleet_metrics,
+        )
+        registered.update(fleet_metrics._metrics)
         missing = documented - registered
         assert not missing, f"documented but not registered: {sorted(missing)}"
