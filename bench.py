@@ -67,6 +67,7 @@ PHASES: list[tuple[str, int]] = [
     ("serving", 900),
     ("serving_local", 600),
     ("twotower", 900),
+    ("ann", 600),
     ("secondary", 600),
 ]
 
@@ -84,7 +85,7 @@ PHASES: list[tuple[str, int]] = [
 # re-probes and re-runs any skipped phases if the device came back.
 # ``--cpu-only`` skips probing entirely; ``preflight_attempts`` in the
 # JSON records how many probes actually ran.
-_DEVICE_PHASES = {"als", "serving", "twotower", "secondary"}
+_DEVICE_PHASES = {"als", "serving", "twotower", "ann", "secondary"}
 _PREFLIGHT_TIMEOUT_S = 90  # first tunnel contact legitimately takes ~40s
 
 
@@ -1553,6 +1554,108 @@ def _bench_twotower_recall(
 
 
 # ---------------------------------------------------------------------------
+# Phase: ann — clustered MIPS retrieval vs exact at >=100k items
+# ---------------------------------------------------------------------------
+
+
+def phase_ann(ck: _Checkpoint) -> None:
+    """The million-item-retrieval evidence (ISSUE 10 / ROADMAP item 4b):
+    on a >=100k-item clustered synthetic corpus, measure (1) recall@10 of
+    the IVF index vs exact brute force, (2) the real candidate fraction
+    scored per query (must stay <=10% of the corpus), and (3) the
+    device+fetch p50 of the ANN path vs the exact path at the SAME corpus
+    size — the acceptance is a measured crossover, not a claim. Queries
+    are drawn from the corpus distribution (user embeddings live near the
+    item clusters they were trained against), batch 64, pow2-bucketed
+    like the serving dispatch. ``PIO_ANN_BENCH_ITEMS`` scales the corpus
+    (CI smoke uses a smaller one)."""
+    jax, platform = _jax_setup()
+    import numpy as np
+
+    from predictionio_tpu.ann import AnnConfig, build_index
+    from predictionio_tpu.ann.search import AnnSearcher
+    from predictionio_tpu.ops import topk
+
+    n = int(os.environ.get("PIO_ANN_BENCH_ITEMS", "100000"))
+    f = 32
+    modes_n = max(32, n // 512)
+    rng = np.random.default_rng(7)
+    modes = rng.normal(size=(modes_n, f))
+    modes /= np.linalg.norm(modes, axis=1, keepdims=True)
+    vecs = (
+        modes[rng.integers(0, modes_n, n)]
+        + 0.15 * rng.normal(size=(n, f))
+    ).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    ck.save(serving_ann_corpus_items=n, ann_platform=platform)
+
+    t0 = time.perf_counter()
+    index = build_index(vecs, AnnConfig(min_items=0), model_version="bench")
+    ck.save(
+        serving_ann_build_s=round(time.perf_counter() - t0, 3),
+        serving_ann_clusters=index.clusters,
+        serving_ann_bucket_cap=index.bucket_cap,
+        serving_ann_nprobe=index.nprobe,
+        serving_ann_hbm_bytes=index.hbm_bytes(),
+    )
+    searcher = AnnSearcher(index)
+
+    import jax.numpy as jnp
+
+    table = jnp.asarray(vecs)
+    B, k, batches = 64, 10, 40
+    kk = topk.next_pow2(k)
+    queries = (
+        modes[rng.integers(0, modes_n, (batches, B))]
+        + 0.15 * rng.normal(size=(batches, B, f))
+    ).astype(np.float32)
+    queries /= np.linalg.norm(queries, axis=2, keepdims=True)
+
+    # warm both paths, then time per-batch dispatch->fetch round trips
+    topk.fetch_topk(topk.dot_top_k_async(table, queries[0].copy(), None, kk))
+    AnnSearcher.fetch(searcher.search_async(queries[0].copy(), kk))
+
+    exact_ms, ann_ms = [], []
+    exact_idx_all, ann_idx_all, counts_all = [], [], []
+    for i in range(batches):
+        t = time.perf_counter()
+        _, eidx = topk.fetch_topk(
+            topk.dot_top_k_async(table, queries[i].copy(), None, kk)
+        )
+        exact_ms.append((time.perf_counter() - t) * 1e3)
+        exact_idx_all.append(eidx)
+    for i in range(batches):
+        t = time.perf_counter()
+        _, aidx, counts = AnnSearcher.fetch(
+            searcher.search_async(queries[i].copy(), kk)
+        )
+        ann_ms.append((time.perf_counter() - t) * 1e3)
+        ann_idx_all.append(aidx)
+        counts_all.append(counts)
+    hits = sum(
+        len(set(a[r, :k]) & set(e[r, :k]))
+        for a, e in zip(ann_idx_all, exact_idx_all)
+        for r in range(B)
+    )
+    recall = hits / float(batches * B * k)
+    cand_frac = float(np.concatenate(counts_all).mean()) / n
+    ck.save(
+        serving_ann_recall_at_10=round(recall, 4),
+        serving_ann_candidates_frac=round(cand_frac, 4),
+        serving_ann_p50_ms=round(float(np.percentile(ann_ms, 50)), 3),
+        serving_ann_p95_ms=round(float(np.percentile(ann_ms, 95)), 3),
+        serving_ann_exact_p50_ms=round(float(np.percentile(exact_ms, 50)), 3),
+        # the measured crossover the acceptance asks for: ANN device+fetch
+        # p50 at or below exact at the same corpus size
+        serving_ann_speedup=round(
+            float(np.percentile(exact_ms, 50))
+            / max(1e-9, float(np.percentile(ann_ms, 50))),
+            3,
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Phase: secondary — remaining BASELINE workloads, one measurement each
 # ---------------------------------------------------------------------------
 
@@ -1830,6 +1933,12 @@ _COMPARE_LOWER_IS_BETTER = frozenset(
         # the measured training memory peak gates like a latency — a
         # quietly-fatter train is a regression too (obs/xray profiler)
         "train_peak_bytes_per_device",
+        # the ANN path's device+fetch p50 and candidate fraction (ISSUE
+        # 10): candidate generation creeping back toward O(corpus) — more
+        # candidates scored per query — is a regression even when the
+        # wall clock hides it on fast hardware
+        "serving_ann_p50_ms",
+        "serving_ann_candidates_frac",
     }
 )
 # the per-phase waterfall percentiles ride the same gate, whatever phases
@@ -1847,6 +1956,8 @@ _COMPARE_HIGHER_IS_BETTER = frozenset(
         "serving_seq_qps",
         "twotower_examples_per_s",
         "event_ingest_eps",
+        # measured ANN quality: recall@10 vs exact must not silently decay
+        "serving_ann_recall_at_10",
     }
 )
 
@@ -1957,6 +2068,7 @@ _PHASE_FNS = {
     "serving": phase_serving,
     "serving_local": phase_serving_local,
     "twotower": phase_twotower,
+    "ann": phase_ann,
     "secondary": phase_secondary,
     "probe": phase_probe,
 }
@@ -2140,15 +2252,17 @@ def main() -> int:
     )
     for name, timeout_s in selected:
         if name in _DEVICE_PHASES and not device_ok:
-            if name == "secondary":
+            if name in ("secondary", "ann"):
                 # the secondary workloads (cooccurrence, ingest, snapshot,
-                # naive bayes) are mostly host+native measurements — a dead
-                # tunnel must not zero them; run on the CPU backend instead
+                # naive bayes) are mostly host+native measurements, and the
+                # ANN recall/candidate-fraction evidence is backend-
+                # independent — a dead tunnel must not zero them; run on
+                # the CPU backend instead
                 res, err = _run_phase(
                     name, timeout_s, env={"JAX_PLATFORMS": "cpu"}
                 )
                 fields.update(res)
-                fields["secondary_platform"] = "cpu_fallback"
+                fields[f"{name}_platform"] = "cpu_fallback"
                 if err:
                     errors[f"{name}_error"] = err
                 continue

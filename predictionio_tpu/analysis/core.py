@@ -91,8 +91,14 @@ class LintConfig:
     # engine modules whose predict paths must keep score+select fused on
     # device (rule serving-host-roundtrip): a full-array device fetch or a
     # host argsort there ships O(corpus) floats over the wire per query
-    # instead of O(k) through the fused helper (ops/topk)
-    serving_predict_globs: tuple[str, ...] = ("*/models/*/engine.py",)
+    # instead of O(k) through the fused helper (ops/topk). The ann/
+    # package is in scope too: the index search paths exist precisely to
+    # keep the fetch O(batch*k), so a host argsort or full-array fetch
+    # growing there would defeat the subsystem silently
+    serving_predict_globs: tuple[str, ...] = (
+        "*/models/*/engine.py",
+        "*/ann/*.py",
+    )
     # function names that make up the predict path inside those modules
     # (nested helpers like a dispatch's `finalize` are covered implicitly)
     serving_predict_functions: tuple[str, ...] = (
@@ -102,6 +108,10 @@ class LintConfig:
         "predict_with_context",
         "batch_predict",
         "serve",
+        # the ann search path (ann/search.py, ann/lifecycle.py)
+        "search_async",
+        "fetch",
+        "record_recall",
     )
     # rule ids to run; None = all registered
     enabled: frozenset[str] | None = None

@@ -1,0 +1,38 @@
+"""On-device ANN retrieval: a clustered (IVF) MIPS index as a registry
+artifact, so candidate generation stops being O(corpus) per query.
+
+- :mod:`predictionio_tpu.ann.index` — k-means build / incremental
+  refresh, padded-bucket layout, optional int8 quantization, the
+  pickle-free artifact wire format.
+- :mod:`predictionio_tpu.ann.search` — the two-stage jitted search
+  kernels (centroid probe -> gathered-bucket scoring -> fused top-k on
+  the shared ops/topk pack format).
+- :mod:`predictionio_tpu.ann.lifecycle` — registry integration (build at
+  train, stream refresh, serving attach) and the :class:`AnnServing`
+  wrapper the engines consult.
+- :mod:`predictionio_tpu.ann.metrics` — the ``pio_ann_*`` family.
+
+docs/ann.md walks the layout, lifecycle, and the recall/latency knobs.
+"""
+
+from predictionio_tpu.ann.index import (
+    AnnConfig,
+    AnnIndex,
+    build_index,
+    default_clusters,
+    default_nprobe,
+    deserialize_index,
+    refresh_index,
+    serialize_index,
+)
+
+__all__ = [
+    "AnnConfig",
+    "AnnIndex",
+    "build_index",
+    "default_clusters",
+    "default_nprobe",
+    "deserialize_index",
+    "refresh_index",
+    "serialize_index",
+]

@@ -1,0 +1,501 @@
+"""Clustered MIPS index: build, refresh, and a pickle-free wire format.
+
+The IVF layout (ALX-style: everything a dense batched matmul over
+device-resident tables, no host pointer-chasing):
+
+  - ``centroids``    [C, f] float32 — k-means cluster centers over the
+    item-embedding table.
+  - ``bucket_ids``   [C, cap] int32 — the item indices of each cluster,
+    padded to one shared power-of-two capacity with ``-1`` (pad slots are
+    masked to ``-inf`` inside the search kernel and never surface).
+  - ``bucket_vecs``  [C, cap, f] — each cluster's item vectors, gathered
+    into the padded layout so stage-2 scoring is ONE
+    ``einsum("bf,bpcf->bpc")`` over the probed buckets. float32, or int8
+    with a per-item ``bucket_scale`` [C, cap] when ``quantize_int8`` is
+    on (the int8 pass keeps HBM at a quarter and the exact f32 rescore of
+    the survivors restores the ranking).
+
+Build is batched Lloyd iterations: the O(n*C) assignment runs as a jitted
+chunked distance matmul on device; the centroid update is a deterministic
+host scatter-add (numpy, seeded init) so the same embeddings always build
+byte-identical indexes — content addressing in the registry then dedupes
+identical rebuilds for free.
+
+Serialization is a deliberate non-pickle framing (magic + json header +
+raw array bytes): index artifacts live in the registry blob store next to
+model blobs, and a corrupted index must surface as an integrity error,
+never as a pickle of garbage (same posture as ``registry/store.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+import numpy as np
+
+_MAGIC = b"PIOANN01"
+
+# capacity-planner padding model: buckets are padded to a shared pow2
+# capacity; a perfectly balanced build lands near next_pow2(n/C), skew
+# costs more. estimate_ann (obs/xray) prices 2x the balanced mean.
+PAD_SKEW_MODEL = 2
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+def default_clusters(n_items: int) -> int:
+    """k chosen from corpus size: ~4*sqrt(n) rounded to a power of two.
+    More clusters than the classic sqrt(n) because the padded gather
+    volume per probe is ``bucket_capacity ~ 2n/C`` — finer clusters keep
+    each probe slab small enough that the stage-2 gather stays cache- and
+    HBM-friendly (measured: the same 2% candidate fraction runs ~6x
+    faster at C=1024/cap=256 than at C=512/cap=512 on a 100k corpus).
+    Clamped so the balanced mean bucket keeps >= ~8 items."""
+    if n_items <= 0:
+        return 1
+    c = next_pow2(int(round(4.0 * float(n_items) ** 0.5)))
+    return max(1, min(c, 8192, next_pow2(n_items) // 8 or 1))
+
+
+def default_nprobe(clusters: int) -> int:
+    """Probe width at build time: clusters/128 with a floor of 16. The
+    floor carries small corpora (fewer clusters per data mode -> a higher
+    probe fraction is needed for the same recall: measured 0.936@8 vs
+    0.998@16 on an 8k corpus at C=512), while the 1/128 ratio keeps the
+    candidate set ~1-4% of large corpora. The recall harness in
+    tests/test_ann.py measures this across nprobe settings rather than
+    trusting it."""
+    return min(clusters, max(16, clusters // 128))
+
+
+@dataclasses.dataclass(frozen=True)
+class AnnConfig:
+    """Build/refresh knobs (docs/ann.md walks the tradeoffs)."""
+
+    clusters: int = 0  # 0 = default_clusters(corpus)
+    nprobe: int = 0  # 0 = default_nprobe(clusters); serve-time default
+    build_iters: int = 10  # Lloyd iterations
+    seed: int = 0
+    quantize_int8: bool = False
+    rescore: int = 4  # int8 path: exact-rescore pool = rescore * k
+    # corpus-size threshold: below it no index is built and exact serving
+    # stays the default (the fused O(corpus) matmul wins at small n)
+    min_items: int = 50_000
+    # stream refresh: fraction of items whose nearest centroid changed
+    # before the incremental rebucket is distrusted and a full k-means
+    # rebuild is triggered
+    refresh_drift: float = 0.25
+    assign_chunk: int = 16_384  # items per jitted assignment call
+
+    def resolved(self, n_items: int) -> "AnnConfig":
+        """Fill the auto (0) fields from the corpus size."""
+        clusters = self.clusters or default_clusters(n_items)
+        clusters = max(1, min(clusters, max(1, n_items)))
+        nprobe = self.nprobe or default_nprobe(clusters)
+        return dataclasses.replace(
+            self, clusters=clusters, nprobe=min(nprobe, clusters)
+        )
+
+
+@dataclasses.dataclass
+class AnnIndex:
+    """One built index + the metadata its manifest entry records."""
+
+    centroids: np.ndarray  # [C, f] f32
+    bucket_ids: np.ndarray  # [C, cap] int32, -1 padded
+    bucket_vecs: np.ndarray  # [C, cap, f] f32 (or int8 when quantized)
+    bucket_scale: np.ndarray | None  # [C, cap] f32, int8 mode only
+    # raw nearest-centroid assignment [n] int32 (BEFORE the balanced
+    # spill): the refresh drift guard compares against this, so overflow
+    # spill can't masquerade as drift
+    nearest_assign: np.ndarray | None
+    n_items: int
+    nprobe: int
+    model_version: str = ""  # registry version whose vectors built this
+    built_from: str = ""  # "train" | "refresh" | "rebuild"
+    config: AnnConfig = dataclasses.field(default_factory=AnnConfig)
+
+    @property
+    def clusters(self) -> int:
+        return int(self.centroids.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.centroids.shape[1])
+
+    @property
+    def bucket_cap(self) -> int:
+        return int(self.bucket_ids.shape[1])
+
+    @property
+    def quantized(self) -> bool:
+        return self.bucket_scale is not None
+
+    def assignments(self) -> np.ndarray:
+        """[n_items] nearest-centroid id per item — the drift baseline.
+        Falls back to bucket membership for pre-spill artifacts."""
+        if self.nearest_assign is not None:
+            return np.asarray(self.nearest_assign, np.int32)
+        out = np.full(self.n_items, -1, np.int32)
+        for c in range(self.clusters):
+            ids = self.bucket_ids[c]
+            ids = ids[ids >= 0]
+            out[ids] = c
+        return out
+
+    def hbm_bytes(self) -> int:
+        """Resident device footprint (what the capacity planner prices)."""
+        total = self.centroids.nbytes + self.bucket_ids.nbytes
+        total += self.bucket_vecs.nbytes
+        if self.bucket_scale is not None:
+            total += self.bucket_scale.nbytes
+        return int(total)
+
+    def manifest_meta(self) -> dict[str, Any]:
+        """The ``ann_index`` manifest entry (minus the store-owned
+        sha256/bytes fields)."""
+        return {
+            "items": self.n_items,
+            "dim": self.dim,
+            "clusters": self.clusters,
+            "bucketCap": self.bucket_cap,
+            "nprobe": self.nprobe,
+            "quantized": self.quantized,
+            "hbmBytes": self.hbm_bytes(),
+            "modelVersion": self.model_version,
+            "builtFrom": self.built_from,
+        }
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+
+def _assign(vecs: np.ndarray, centroids, chunk: int) -> np.ndarray:
+    """Nearest-centroid assignment for every row of ``vecs`` — the O(n*C)
+    half of Lloyd, chunked through one jitted distance matmul per slab so
+    the [chunk, C] score matrix never outgrows device memory."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def nearest(x, c):
+        # argmin ||x - c||^2 == argmin (||c||^2 - 2 x.c); ||x||^2 is a
+        # per-row constant that cannot move the argmin
+        d = (c * c).sum(axis=1)[None, :] - 2.0 * (x @ c.T)
+        return jnp.argmin(d, axis=1).astype(jnp.int32)
+
+    c_dev = jnp.asarray(centroids)
+    out = np.empty(len(vecs), np.int32)
+    for start in range(0, len(vecs), chunk):
+        sl = vecs[start : start + chunk]
+        out[start : start + len(sl)] = np.asarray(nearest(jnp.asarray(sl), c_dev))
+    return out
+
+
+def kmeans(
+    vecs: np.ndarray, clusters: int, iters: int, seed: int, chunk: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Batched Lloyd: jitted chunked assignment + deterministic host
+    update. Returns (centroids [C,f] f32, assignment [n] int32). Empty
+    clusters are re-seeded from the member of the fattest cluster farthest
+    from its centroid — deterministic, and it splits exactly the cluster
+    whose padding would otherwise dominate the bucket capacity."""
+    vecs = np.ascontiguousarray(vecs, np.float32)
+    n = len(vecs)
+    clusters = max(1, min(clusters, n))
+    rng = np.random.default_rng(seed)
+    centroids = vecs[rng.choice(n, size=clusters, replace=False)].copy()
+    assign = np.zeros(n, np.int32)
+    for _ in range(max(1, iters)):
+        assign = _assign(vecs, centroids, chunk)
+        sums = np.zeros_like(centroids, dtype=np.float64)
+        np.add.at(sums, assign, vecs)
+        counts = np.bincount(assign, minlength=clusters)
+        empty = np.flatnonzero(counts == 0)
+        nonempty = counts > 0
+        centroids[nonempty] = (
+            sums[nonempty] / counts[nonempty, None]
+        ).astype(np.float32)
+        for e in empty:
+            fat = int(np.argmax(counts))
+            members = np.flatnonzero(assign == fat)
+            d = ((vecs[members] - centroids[fat]) ** 2).sum(axis=1)
+            far = members[int(np.argmax(d))]
+            centroids[e] = vecs[far]
+            # hand the stolen point over so the same donor isn't re-picked
+            assign[far] = e
+            counts[fat] -= 1
+            counts[e] += 1
+    assign = _assign(vecs, centroids, chunk)
+    return centroids, assign
+
+
+def bucket_capacity(n_items: int, clusters: int) -> int:
+    """The shared padded bucket capacity: pow2 of 2x the balanced mean —
+    the rule that bounds the probe-time gather volume. A skew-free build
+    half-fills it; skew spills instead of inflating every bucket (the
+    fattest-cluster rule blew the padded gather volume ~5x on real
+    builds, which is exactly the O(corpus) creep this subsystem exists to
+    kill). Mirrored by ``obs/xray.estimate_ann``."""
+    mean = -(-n_items // max(1, clusters))
+    return next_pow2(max(1, PAD_SKEW_MODEL * mean))
+
+
+def _bucketize(
+    vecs: np.ndarray,
+    assign: np.ndarray,
+    clusters: int,
+    centroids: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Capacity-bounded scatter into the padded [C, cap] layout.
+
+    Buckets are capped at :func:`bucket_capacity`; an overflowing cluster
+    keeps its cap members CLOSEST to the centroid and spills the rest to
+    their nearest cluster with space (in increasing spill-distance order
+    — deterministic). Probing several clusters recovers spilled boundary
+    items; the recall harness measures the cost instead of assuming it.
+
+    Returns (bucket_ids, bucket_vecs, balanced_assign) where
+    ``balanced_assign`` is the post-spill bucket membership.
+    """
+    n = len(assign)
+    cap = bucket_capacity(n, clusters)
+    balanced = assign.astype(np.int32).copy()
+    counts = np.bincount(balanced, minlength=clusters)
+    if int(counts.max(initial=0)) > cap:
+        # distance of each item to its assigned centroid (for keep/spill)
+        d_own = ((vecs - centroids[balanced]) ** 2).sum(axis=1)
+        spilled: list[int] = []
+        for c in np.flatnonzero(counts > cap):
+            members = np.flatnonzero(balanced == c)
+            order = members[np.argsort(d_own[members], kind="stable")]
+            spilled.extend(order[cap:])
+        counts = np.minimum(counts, cap)
+        # nearest-with-space, nearest-first: deterministic greedy
+        sp = np.asarray(spilled, np.int64)
+        d_all = (
+            (centroids * centroids).sum(axis=1)[None, :]
+            - 2.0 * (vecs[sp] @ centroids.T)
+        )
+        pref = np.argsort(d_all, axis=1, kind="stable")
+        best = d_all[np.arange(len(sp)), pref[:, 0]]
+        for row in np.argsort(best, kind="stable"):
+            item = int(sp[row])
+            for c in pref[row]:
+                if counts[c] < cap:
+                    balanced[item] = c
+                    counts[c] += 1
+                    break
+    bucket_ids = np.full((clusters, cap), -1, np.int32)
+    order = np.argsort(balanced, kind="stable")
+    sorted_assign = balanced[order]
+    starts = np.searchsorted(sorted_assign, np.arange(clusters))
+    pos = np.arange(n) - starts[sorted_assign]
+    bucket_ids[sorted_assign, pos] = order
+    bucket_vecs = vecs[np.maximum(bucket_ids, 0)].astype(np.float32)
+    bucket_vecs[bucket_ids < 0] = 0.0
+    return bucket_ids, bucket_vecs, balanced
+
+
+def _quantize_int8(
+    bucket_vecs: np.ndarray, bucket_ids: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-item symmetric int8: scale = max|x| / 127 per row. Pad rows get
+    scale 0 (their dequantized vector is exactly zero)."""
+    amax = np.abs(bucket_vecs).max(axis=2)
+    scale = (amax / 127.0).astype(np.float32)
+    scale[bucket_ids < 0] = 0.0
+    safe = np.where(scale > 0, scale, 1.0)[..., None]
+    q = np.clip(np.rint(bucket_vecs / safe), -127, 127).astype(np.int8)
+    q[bucket_ids < 0] = 0
+    return q, scale
+
+
+def build_index(
+    vectors: np.ndarray,
+    config: AnnConfig | None = None,
+    *,
+    model_version: str = "",
+    built_from: str = "train",
+) -> AnnIndex:
+    """Full build: k-means + bucketize (+ optional int8 quantize).
+    Deterministic for (vectors, config): the registry's content addressing
+    dedupes identical rebuilds."""
+    config = (config or AnnConfig()).resolved(len(vectors))
+    vecs = np.ascontiguousarray(vectors, np.float32)
+    if vecs.ndim != 2 or len(vecs) == 0:
+        raise ValueError(f"need a [n, f] vector table, got shape {vecs.shape}")
+    centroids, assign = kmeans(
+        vecs, config.clusters, config.build_iters, config.seed,
+        config.assign_chunk,
+    )
+    return _finish(vecs, centroids, assign, config, model_version, built_from)
+
+
+def _finish(
+    vecs: np.ndarray,
+    centroids: np.ndarray,
+    assign: np.ndarray,
+    config: AnnConfig,
+    model_version: str,
+    built_from: str,
+) -> AnnIndex:
+    clusters = len(centroids)
+    bucket_ids, bucket_vecs, _balanced = _bucketize(
+        vecs, assign, clusters, centroids
+    )
+    bucket_scale = None
+    if config.quantize_int8:
+        bucket_vecs, bucket_scale = _quantize_int8(bucket_vecs, bucket_ids)
+    return AnnIndex(
+        centroids=centroids.astype(np.float32),
+        bucket_ids=bucket_ids,
+        bucket_vecs=bucket_vecs,
+        bucket_scale=bucket_scale,
+        nearest_assign=assign.astype(np.int32),
+        n_items=len(vecs),
+        nprobe=min(config.nprobe, clusters),
+        model_version=model_version,
+        built_from=built_from,
+        config=config,
+    )
+
+
+def refresh_index(
+    index: AnnIndex,
+    vectors: np.ndarray,
+    *,
+    model_version: str = "",
+) -> tuple[AnnIndex, dict[str, Any]]:
+    """Incremental refresh: assign the NEW vector table (updated + grown
+    items) to the EXISTING centroids and rebucket — no k-means. When the
+    assignment drift (fraction of surviving items whose nearest centroid
+    moved) crosses ``config.refresh_drift``, or the geometry changed
+    (dim), the centroids are stale and a full rebuild runs instead.
+
+    Returns (new index, report) where report carries the drift fraction
+    and which path ran — the stream layer publishes both."""
+    vecs = np.ascontiguousarray(vectors, np.float32)
+    cfg = index.config
+    if vecs.ndim != 2 or len(vecs) == 0:
+        raise ValueError(f"need a [n, f] vector table, got shape {vecs.shape}")
+    if vecs.shape[1] != index.dim:
+        rebuilt = build_index(
+            vecs, cfg, model_version=model_version, built_from="rebuild"
+        )
+        return rebuilt, {"path": "rebuild", "drift": 1.0, "reason": "dim-changed"}
+    assign = _assign(vecs, index.centroids, cfg.assign_chunk)
+    prev = index.assignments()
+    shared = min(len(prev), len(assign))
+    drift = (
+        float(np.mean(assign[:shared] != prev[:shared])) if shared else 1.0
+    )
+    if drift > cfg.refresh_drift:
+        rebuilt = build_index(
+            vecs, cfg, model_version=model_version, built_from="rebuild"
+        )
+        return rebuilt, {
+            "path": "rebuild",
+            "drift": round(drift, 4),
+            "reason": "drift-guard",
+        }
+    refreshed = _finish(
+        vecs, index.centroids, assign, cfg, model_version, "refresh"
+    )
+    return refreshed, {"path": "refresh", "drift": round(drift, 4)}
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+
+class AnnFormatError(RuntimeError):
+    """The blob is not a well-formed ANN index artifact."""
+
+
+def serialize_index(index: AnnIndex) -> bytes:
+    """magic + [u32 header length] + json header + raw C-order array
+    bytes, concatenated in header order. Deterministic for equal indexes."""
+    arrays: dict[str, np.ndarray] = {
+        "centroids": index.centroids,
+        "bucket_ids": index.bucket_ids,
+        "bucket_vecs": index.bucket_vecs,
+    }
+    if index.bucket_scale is not None:
+        arrays["bucket_scale"] = index.bucket_scale
+    if index.nearest_assign is not None:
+        arrays["nearest_assign"] = index.nearest_assign
+    header = {
+        "meta": {
+            "n_items": index.n_items,
+            "nprobe": index.nprobe,
+            "model_version": index.model_version,
+            "built_from": index.built_from,
+            "config": dataclasses.asdict(index.config),
+        },
+        "arrays": [
+            {
+                "name": name,
+                "dtype": arr.dtype.str,
+                "shape": list(arr.shape),
+            }
+            for name, arr in arrays.items()
+        ],
+    }
+    head = json.dumps(header, sort_keys=True, separators=(",", ":")).encode()
+    parts = [_MAGIC, len(head).to_bytes(4, "big"), head]
+    for arr in arrays.values():
+        parts.append(np.ascontiguousarray(arr).tobytes())
+    return b"".join(parts)
+
+
+def deserialize_index(blob: bytes) -> AnnIndex:
+    if blob[: len(_MAGIC)] != _MAGIC:
+        raise AnnFormatError("not an ANN index artifact (bad magic)")
+    off = len(_MAGIC)
+    head_len = int.from_bytes(blob[off : off + 4], "big")
+    off += 4
+    try:
+        header = json.loads(blob[off : off + head_len].decode())
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise AnnFormatError(f"corrupt index header: {exc}") from exc
+    off += head_len
+    arrays: dict[str, np.ndarray] = {}
+    for spec in header["arrays"]:
+        dtype = np.dtype(spec["dtype"])
+        shape = tuple(int(s) for s in spec["shape"])
+        n_bytes = dtype.itemsize * int(np.prod(shape)) if shape else dtype.itemsize
+        raw = blob[off : off + n_bytes]
+        if len(raw) != n_bytes:
+            raise AnnFormatError(
+                f"truncated index artifact at array {spec['name']!r}"
+            )
+        arrays[spec["name"]] = np.frombuffer(raw, dtype).reshape(shape).copy()
+        off += n_bytes
+    meta = header["meta"]
+    known = {f.name for f in dataclasses.fields(AnnConfig)}
+    config = AnnConfig(
+        **{k: v for k, v in (meta.get("config") or {}).items() if k in known}
+    )
+    try:
+        return AnnIndex(
+            centroids=arrays["centroids"],
+            bucket_ids=arrays["bucket_ids"],
+            bucket_vecs=arrays["bucket_vecs"],
+            bucket_scale=arrays.get("bucket_scale"),
+            nearest_assign=arrays.get("nearest_assign"),
+            n_items=int(meta["n_items"]),
+            nprobe=int(meta["nprobe"]),
+            model_version=str(meta.get("model_version", "")),
+            built_from=str(meta.get("built_from", "")),
+            config=config,
+        )
+    except KeyError as exc:
+        raise AnnFormatError(f"index artifact missing field {exc}") from exc

@@ -390,19 +390,39 @@ class _ALSBase(JaxAlgorithm):
     ) -> list[PredictedResult]:
         return self.predict_batch_dispatch(model, queries)()
 
+    @staticmethod
+    def _has_filters(q: Query) -> bool:
+        return (
+            q.categories is not None
+            or q.category_black_list is not None
+            or q.white_list is not None
+            or q.black_list is not None
+        )
+
     def predict_batch_dispatch(self, model: SimilarModel, queries: Sequence[Query]):
         """One fused device call for the whole micro-batch: query-item
         indices and per-query candidate masks are assembled directly into
         reusable staging buffers, the gather->sum-cosine->mask->top-k runs
         as one jitted program, and only [B, k] score/index pairs are
         fetched (in the returned finalize, so the query server overlaps
-        transport with the next batch's dispatch)."""
+        transport with the next batch's dispatch).
+
+        With an ANN index pinned (docs/ann.md), scoring routes through
+        the clustered search instead: the summed query vector (sum of
+        cosines == dot with the summed factor vector) probes nprobe
+        buckets, so the corpus-wide matmul disappears. Filter-less
+        batches exclude the query's own items inside the kernel by id;
+        filtered batches hand their candidate mask to the masked search
+        variant. Exact stays the fallback and the sampled recall shadow."""
+        from predictionio_tpu.ann.lifecycle import ATTR as _ANN_ATTR
+
         n = len(model.item_vocab)
         results: list[PredictedResult | None] = [None] * len(queries)
         rows: list[int] = []
         row_qidx: list[list[int]] = []
         max_q = 1
         max_num = 1
+        filtered = False
         for i, q in enumerate(queries):
             qidx = [
                 j for it in q.items if (j := model.item_index(it)) is not None
@@ -414,7 +434,10 @@ class _ALSBase(JaxAlgorithm):
             row_qidx.append(qidx)
             max_q = max(max_q, len(qidx))
             max_num = max(max_num, q.num)
+            filtered = filtered or self._has_filters(q)
         handle = None
+        ann = None
+        exact_handle = None
         kk = 0
         if rows:
             # pow2 buckets on batch/query-width/k keep the compile universe
@@ -424,20 +447,59 @@ class _ALSBase(JaxAlgorithm):
             pool = topk.scratch()
             qidx_buf = pool.zeros("similar.qidx", (b, qcap), np.int32)
             qw_buf = pool.zeros("similar.qw", (b, qcap), np.float32)
-            mask_buf = pool.get("similar.mask", (b, n), np.bool_)
-            mask_buf[len(rows):] = True  # pad rows: harmless full mask
-            for row, (i, qidx) in enumerate(zip(rows, row_qidx)):
+            for row, qidx in enumerate(row_qidx):
                 qidx_buf[row, : len(qidx)] = qidx
                 qw_buf[row, : len(qidx)] = 1.0
-                candidate_mask(model, queries[i], qidx, out=mask_buf[row])
             kk = min(topk.next_pow2(max_num), n)
-            handle = topk.gather_sum_top_k_async(
-                model.device_factors(), qidx_buf, qw_buf, mask_buf, kk
-            )
+            ann = getattr(model, _ANN_ATTR, None)
+            if ann is not None and not ann.supports(kk, filtered=filtered):
+                ann.count_fallback(len(rows))
+                ann = None
+            mask_buf = None
+            sample = ann is not None and ann.take_recall_sample()
+            if ann is None or filtered or sample:
+                # the exact kernels (and the masked ANN variant) consume
+                # the full candidate mask; the filter-less pure-ANN path
+                # skips this O(B*n) host assembly entirely
+                mask_buf = pool.get("similar.mask", (b, n), np.bool_)
+                mask_buf[len(rows):] = True  # pad rows: harmless full mask
+                for row, (i, qidx) in enumerate(zip(rows, row_qidx)):
+                    candidate_mask(model, queries[i], qidx, out=mask_buf[row])
+            if ann is not None:
+                qvec_buf = pool.zeros(
+                    "similar.qvec", (b, model.item_factors.shape[1]), np.float32
+                )
+                for row, qidx in enumerate(row_qidx):
+                    # sum of per-item cosines == one dot with the summed
+                    # normalized factors — the IVF probe sees one vector
+                    np.sum(model.item_factors[qidx], axis=0, out=qvec_buf[row])
+                if filtered:
+                    handle = ann.search_async(qvec_buf, kk, mask=mask_buf)
+                else:
+                    excl_buf = pool.full(
+                        "similar.excl", (b, qcap), np.int32, -1
+                    )
+                    for row, qidx in enumerate(row_qidx):
+                        excl_buf[row, : len(qidx)] = qidx
+                    handle = ann.search_async(qvec_buf, kk, exclude=excl_buf)
+                if sample:
+                    exact_handle = topk.gather_sum_top_k_async(
+                        model.device_factors(), qidx_buf, qw_buf, mask_buf, kk
+                    )
+            else:
+                handle = topk.gather_sum_top_k_async(
+                    model.device_factors(), qidx_buf, qw_buf, mask_buf, kk
+                )
 
         def finalize() -> list[PredictedResult]:
             if handle is not None:
-                scores, idx = topk.fetch_topk(handle)
+                if ann is not None:
+                    scores, idx = ann.fetch(handle, rows=len(rows))
+                    if exact_handle is not None:
+                        _, exact_idx = topk.fetch_topk(exact_handle)
+                        ann.record_recall(idx, exact_idx, rows=len(rows))
+                else:
+                    scores, idx = topk.fetch_topk(handle)
                 for row, i in enumerate(rows):
                     num = min(queries[i].num, kk)
                     results[i] = PredictedResult(
@@ -458,7 +520,11 @@ class _ALSBase(JaxAlgorithm):
     def warmup_serving(self, model: SimilarModel, max_batch: int) -> None:
         """Pre-compile the single-item-query program for every pow2 batch
         bucket at the default k, so the first burst after deploy/reload
-        pays no XLA compiles on the common shape."""
+        pays no XLA compiles on the common shape. The exact program warms
+        even with an ANN index pinned (it stays the recall shadow and the
+        fallback); the index's own buckets warm via AnnServing.warmup."""
+        from predictionio_tpu.ann.lifecycle import ATTR as _ANN_ATTR
+
         n = len(model.item_vocab)
         kk = min(topk.next_pow2(10), n)
         topk.warmup_pow2_buckets(
@@ -471,6 +537,17 @@ class _ALSBase(JaxAlgorithm):
                 kk,
             ),
         )
+        ann = getattr(model, _ANN_ATTR, None)
+        if ann is not None and ann.supports(kk):
+            # the filter-less dispatch shape (id exclusion) is the hot one
+            topk.warmup_pow2_buckets(
+                max_batch,
+                lambda b: ann.search_async(
+                    np.zeros((b, model.item_factors.shape[1]), np.float32),
+                    kk,
+                    exclude=np.full((b, 1), -1, np.int32),
+                )[0],
+            )
 
 
 class ALSAlgorithm(_ALSBase):

@@ -140,6 +140,7 @@ class TwoTowerModelState(SanityCheck):
         self._device_items = None
         self._device_params = None
         self._serve_fn = None
+        self._embed_fn = None
         self._model: TwoTower | None = None
 
     def sanity_check(self) -> None:
@@ -214,6 +215,31 @@ class TwoTowerModelState(SanityCheck):
             k,
         )
 
+    def embed_users_async(self, uidx, hist):
+        """Dispatch the user-tower forward alone: the [B, out_dim] device
+        embedding handle the ANN search composes with (tower -> probe ->
+        bucket scoring stay on device, no host round-trip in between)."""
+        if self._embed_fn is None:
+            import functools
+
+            import jax
+
+            from predictionio_tpu.models.twotower.model import TwoTower as _TT
+
+            mdl = self.model()
+
+            @functools.partial(jax.jit, donate_argnums=(1, 2))
+            def _embed(params, uidx, hist):
+                return mdl.apply(
+                    {"params": params}, uidx, hist, method=_TT.embed_users
+                )
+
+            self._embed_fn = _embed
+        import jax.numpy as jnp
+
+        hist_d = jnp.asarray(hist) if hist is not None else None
+        return self._embed_fn(self.device_params(), jnp.asarray(uidx), hist_d)
+
     def __getstate__(self):
         return {
             "config": self.config,
@@ -232,6 +258,7 @@ class TwoTowerModelState(SanityCheck):
         self._device_items = None
         self._device_params = None
         self._serve_fn = None
+        self._embed_fn = None
         self._model = None
 
 
@@ -300,7 +327,16 @@ class TwoTowerAlgorithm(JaxAlgorithm):
         forward -> dot products against the resident item table -> top-k,
         with user indices (and histories) assembled into reusable staging
         buffers and only [B, k] results fetched in the finalize. Unknown
-        users answer empty without touching the device."""
+        users answer empty without touching the device.
+
+        When the deployed version pins an ANN index (docs/ann.md), the
+        dot-products stage routes through it instead: the user embedding
+        handle feeds the two-stage clustered search and only nprobe
+        buckets are scored — O(batch * nprobe * cap), not O(batch *
+        corpus). Exact scoring remains the fallback (no index, or k wider
+        than the probe pool); sampled batches ALSO run exact as a shadow
+        to measure the live recall proxy."""
+        from predictionio_tpu.ann.lifecycle import ATTR as _ANN_ATTR
         from predictionio_tpu.ops import topk
 
         n = len(model.item_vocab)
@@ -317,6 +353,8 @@ class TwoTowerAlgorithm(JaxAlgorithm):
             uidxs.append(uidx)
             max_num = max(max_num, q.num)
         handle = None
+        ann = None
+        exact_handle = None
         kk = 0
         if rows:
             b = topk.next_pow2(len(rows))
@@ -331,13 +369,29 @@ class TwoTowerAlgorithm(JaxAlgorithm):
                 )
                 np.take(model.history, uidx_buf, axis=0, out=hist_buf)
             kk = min(topk.next_pow2(max_num), n)
-            handle = model.serve_topk(uidx_buf, hist_buf, kk)
+            ann = getattr(model, _ANN_ATTR, None)
+            if ann is not None and not ann.supports(kk):
+                ann.count_fallback(len(rows))
+                ann = None
+            if ann is not None:
+                vec_handle = model.embed_users_async(uidx_buf, hist_buf)
+                handle = ann.search_async(vec_handle, kk)
+                if ann.take_recall_sample():
+                    exact_handle = model.serve_topk(uidx_buf, hist_buf, kk)
+            else:
+                handle = model.serve_topk(uidx_buf, hist_buf, kk)
 
         def finalize() -> list[PredictedResult]:
             if handle is not None:
                 from predictionio_tpu.ops.topk import fetch_topk
 
-                scores, idx = fetch_topk(handle)
+                if ann is not None:
+                    scores, idx = ann.fetch(handle, rows=len(rows))
+                    if exact_handle is not None:
+                        _, exact_idx = fetch_topk(exact_handle)
+                        ann.record_recall(idx, exact_idx, rows=len(rows))
+                else:
+                    scores, idx = fetch_topk(handle)
                 for row, i in enumerate(rows):
                     num = min(queries[i].num, kk)
                     results[i] = PredictedResult(
@@ -353,11 +407,17 @@ class TwoTowerAlgorithm(JaxAlgorithm):
 
     def warmup_serving(self, model: TwoTowerModelState, max_batch: int) -> None:
         """Pre-compile the fused tower->score->top-k program for every
-        pow2 batch bucket at the default k."""
+        pow2 batch bucket at the default k — and, when an ANN index is
+        pinned, the tower->probe->bucket-search composition the dispatch
+        path actually runs (plus exact, which stays the shadow/fallback)."""
+        from predictionio_tpu.ann.lifecycle import ATTR as _ANN_ATTR
         from predictionio_tpu.ops import topk
 
         n = len(model.item_vocab)
         kk = min(topk.next_pow2(10), n)
+        ann = getattr(model, _ANN_ATTR, None)
+        if ann is not None and not ann.supports(kk):
+            ann = None
 
         def dispatch(b: int):
             hist = (
@@ -365,9 +425,28 @@ class TwoTowerAlgorithm(JaxAlgorithm):
                 if model.history is not None
                 else None
             )
+            if ann is not None:
+                packed, _counts = ann.search_async(
+                    model.embed_users_async(np.zeros(b, np.int32), hist), kk
+                )
+                return packed
             return model.serve_topk(np.zeros(b, np.int32), hist, kk)
 
         topk.warmup_pow2_buckets(max_batch, dispatch)
+        if ann is not None:
+            # the exact program stays warm at every bucket too: it is the
+            # recall shadow (sampled at arbitrary batch sizes) and the
+            # automatic fallback — a shadow must never pay a serving-time
+            # compile the watcher would alarm on
+            def dispatch_exact(b: int):
+                hist = (
+                    np.zeros((b, model.history.shape[1]), model.history.dtype)
+                    if model.history is not None
+                    else None
+                )
+                return model.serve_topk(np.zeros(b, np.int32), hist, kk)
+
+            topk.warmup_pow2_buckets(max_batch, dispatch_exact)
 
 
 class Serving(BaseServing):

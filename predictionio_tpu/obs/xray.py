@@ -717,6 +717,69 @@ def estimate_factors(
     )
 
 
+def estimate_ann(
+    items: int,
+    dim: int,
+    clusters: int = 0,
+    nprobe: int = 0,
+    *,
+    quantize_int8: bool = False,
+    batch: int = 64,
+) -> dict[str, Any]:
+    """Price a pinned ANN index's serving HBM next to the factor tables
+    (``pio doctor --capacity ... --ann "clusters,nprobe"``; docs/ann.md).
+
+    Model (mirrors ``ann/index.py``'s layout):
+
+    - centroids ``[C, dim]`` f32;
+    - bucket ids ``[C, cap]`` int32 + bucket vectors ``[C, cap, dim]``
+      (f32, or int8 + a per-item f32 scale when quantized), with ``cap``
+      the build's own capacity rule (``ann.index.bucket_capacity``: pow2
+      of 2x the balanced mean — overflow spills to neighbor clusters
+      instead of inflating every bucket);
+    - a per-batch search transient: the gathered probe slabs
+      ``[batch, nprobe, cap, dim]`` plus their score matrix — the term
+      that actually bounds ``batch * nprobe``.
+
+    The index is replicated per serving device (it answers point queries,
+    it is not sharded), so every byte here is a per-device byte.
+    """
+    if items <= 0 or dim <= 0:
+        raise ValueError(f"need items > 0 and dim > 0, got {items}/{dim}")
+    from predictionio_tpu.ann.index import (
+        bucket_capacity,
+        default_clusters,
+        default_nprobe,
+    )
+
+    clusters = clusters or default_clusters(items)
+    clusters = max(1, min(clusters, items))
+    nprobe = min(nprobe or default_nprobe(clusters), clusters)
+    # the build's own capacity rule — estimate and artifact agree exactly
+    cap = bucket_capacity(items, clusters)
+    vec_elem = 1 if quantize_int8 else 4
+    centroid_bytes = clusters * dim * 4
+    bucket_bytes = clusters * cap * (dim * vec_elem + 4)
+    if quantize_int8:
+        bucket_bytes += clusters * cap * 4  # per-item f32 scales
+    search_transient = batch * nprobe * cap * (dim * 4 + 8)
+    total = centroid_bytes + bucket_bytes
+    return {
+        "items": items,
+        "dim": dim,
+        "clusters": clusters,
+        "nprobe": nprobe,
+        "bucketCap": cap,
+        "quantized": quantize_int8,
+        "centroidBytes": centroid_bytes,
+        "bucketBytes": bucket_bytes,
+        "searchTransientBytes": search_transient,
+        "perDeviceBytes": total,
+        "candidatesPerQuery": nprobe * cap,
+        "candidateFrac": round(min(1.0, nprobe * cap / max(1, items)), 4),
+    }
+
+
 # ---------------------------------------------------------------------------
 # sharding inspector
 # ---------------------------------------------------------------------------
@@ -860,6 +923,7 @@ __all__ = [
     "describe_shardings",
     "device_fetch",
     "device_memory_stats",
+    "estimate_ann",
     "estimate_factors",
     "find_replicated",
     "inspect_train_step",

@@ -52,6 +52,13 @@ class ModelManifest:
     # compares wall clock and memory between versions. Empty for versions
     # published before the profiler existed (or with PIO_XRAY=0).
     train_profile: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # the version's ANN retrieval index (predictionio_tpu/ann, docs/ann.md):
+    # a second content-addressed blob in the same engine's blob store,
+    # recorded here with its sha256/bytes plus layout metadata (items,
+    # clusters, bucketCap, nprobe, quantized, builtFrom). Empty when no
+    # index was built (small corpus, or a model type ANN doesn't apply to)
+    # — serving then stays on exact scoring.
+    ann_index: dict[str, Any] = dataclasses.field(default_factory=dict)
     blob_sha256: str = ""  # filled by the store on publish
     blob_size: int = 0
 

@@ -327,6 +327,88 @@ class ArtifactStore:
             )
         return blob
 
+    def attach_ann_index(
+        self,
+        engine_id: str,
+        version: str,
+        blob: bytes,
+        meta: dict[str, Any],
+    ) -> ModelManifest:
+        """Pin an ANN index artifact on an existing version: write the
+        blob content-addressed into the engine's blob store and record it
+        (sha256 + layout metadata) in the version's manifest under
+        ``ann_index``. Atomic manifest rewrite under the transition lock —
+        a lane loader reads either the manifest without the index or with
+        the complete one, never a half-written entry."""
+        with self._lock, self._state_mutex(engine_id):
+            manifest = self.get_manifest(engine_id, version)
+            if manifest is None:
+                raise ValueError(f"unknown version {version!r}")
+            sha = hashlib.sha256(blob).hexdigest()
+            blob_path = self._blob_path(engine_id, sha)
+            if not os.path.exists(blob_path):  # dedupe by content address
+                _atomic_write(blob_path, blob)
+            manifest.ann_index = {
+                **meta,
+                "sha256": sha,
+                "bytes": len(blob),
+                "attachedAt": ModelManifest.now_iso(),
+            }
+            _atomic_write(
+                self._manifest_path(engine_id, version),
+                json.dumps(manifest.to_json_dict(), indent=1).encode("utf-8"),
+            )
+            logger.info(
+                "ann index attached to %s %s (%d bytes, sha %s)",
+                self.engine_key(engine_id),
+                version,
+                len(blob),
+                sha[:12],
+            )
+            return manifest
+
+    def load_ann_blob(
+        self, engine_id: str, version: str
+    ) -> tuple[bytes, dict[str, Any]] | None:
+        """Read and *verify* the version's ANN index artifact. None when
+        the version pins no index; :class:`ArtifactIntegrityError` when it
+        does but the bytes on disk are not the bytes that were attached."""
+        manifest = self.get_manifest(engine_id, version)
+        if manifest is None or not manifest.ann_index:
+            return None
+        meta = manifest.ann_index
+        sha = meta.get("sha256", "")
+        path = self._blob_path(engine_id, sha)
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except OSError as exc:
+            raise ArtifactIntegrityError(
+                f"ann index blob missing for {version}: {exc}"
+            ) from exc
+        if len(blob) != int(meta.get("bytes", -1)):
+            raise ArtifactIntegrityError(
+                f"ann index for {version} length mismatch: manifest says "
+                f"{meta.get('bytes')} bytes, blob is {len(blob)}"
+            )
+        digest = hashlib.sha256(blob).hexdigest()
+        if digest != sha:
+            raise ArtifactIntegrityError(
+                f"ann index for {version} checksum mismatch: manifest says "
+                f"{sha[:12]}…, blob hashes to {digest[:12]}…"
+            )
+        return blob, meta
+
+    @staticmethod
+    def _blob_shas_of(manifest: ModelManifest) -> set[str]:
+        """Every blob a manifest pins: the model artifact plus its ANN
+        index (GC must treat both as referenced)."""
+        shas = {manifest.blob_sha256}
+        ann_sha = (manifest.ann_index or {}).get("sha256")
+        if ann_sha:
+            shas.add(ann_sha)
+        return shas - {""}
+
     def gc(self, engine_id: str, keep_last: int) -> list[str]:
         """Drop all but the newest ``keep_last`` versions, never dropping
         a version the rollout state still references. Returns the removed
@@ -349,12 +431,17 @@ class ArtifactStore:
                     continue
                 removed.append(m.version)
             if removed:
-                # delete blobs no surviving manifest references
-                live_shas = {m.blob_sha256 for m in self.list_versions(engine_id)}
+                # delete blobs no surviving manifest references (model
+                # artifacts AND ann index artifacts both count)
+                live_shas: set[str] = set()
+                for m in self.list_versions(engine_id):
+                    live_shas |= self._blob_shas_of(m)
                 for m in versions:
-                    if m.version in removed and m.blob_sha256 not in live_shas:
+                    if m.version not in removed:
+                        continue
+                    for sha in self._blob_shas_of(m) - live_shas:
                         try:
-                            os.unlink(self._blob_path(engine_id, m.blob_sha256))
+                            os.unlink(self._blob_path(engine_id, sha))
                         except OSError:
                             pass
                 logger.info(

@@ -115,6 +115,12 @@ class StreamInstruments:
         # the fold-in profiler fills it, scrapers and the docs contract
         # see it immediately
         xray.register_train_metrics(r)
+        # the pio_ann_* family: the stream layer is the index's refresh
+        # producer (refreshes/rebuilds count here; the serving-side
+        # query/recall instruments ride the query server's registry)
+        from predictionio_tpu.ann.metrics import AnnInstruments
+
+        self.ann = AnnInstruments(r)
 
 
 class StreamPipeline:
@@ -352,7 +358,8 @@ class StreamPipeline:
                 self._stage(version)
             else:
                 with xray.phase(xray.PHASE_HOST_ETL):
-                    blob = model_io.serialize_models(self.trainer.snapshot())
+                    models = self.trainer.snapshot()
+                    blob = model_io.serialize_models(models)
                 # the fold-in profile is this candidate's training
                 # evidence: finished here (publish I/O is outside it by
                 # causality — the manifest must embed a closed profile),
@@ -391,6 +398,13 @@ class StreamPipeline:
                     keep_last=cfg.keep_versions,
                 )
                 version = manifest.version
+                # refresh the parent's ANN index for this candidate BEFORE
+                # staging: the lane loader reads the manifest at stage
+                # time, so the index must be pinned first. Same
+                # publish-as-candidate discipline as the model — the
+                # refreshed index bakes with its candidate and can never
+                # hot-swap into stable on its own.
+                self._refresh_ann(state.stable, version, models)
                 self._stage(version)
             sp.tags["version"] = version
         self.cursor.record_publish(version, span_id, span_to)
@@ -401,6 +415,28 @@ class StreamPipeline:
         self._pending_events = 0
         self._pending_absorbed = 0
         return version, False
+
+    def _refresh_ann(self, parent_version: str, version: str, models) -> None:
+        """Carry the stable version's ANN index forward onto the freshly
+        published candidate (incremental rebucket, or a drift-guarded
+        full rebuild — ann/lifecycle). Best-effort: a failed refresh
+        leaves the candidate serving exact, never blocks the publish."""
+        try:
+            from predictionio_tpu.ann import lifecycle as ann_lifecycle
+
+            ann_lifecycle.refresh_for_publish(
+                self.store,
+                self.config.engine_id,
+                parent_version,
+                version,
+                models,
+                instruments=self.instruments.ann,
+            )
+        except Exception:
+            logger.exception(
+                "ann index refresh failed (candidate %s serves exact)", version
+            )
+            self.instruments.errors.inc(stage="ann")
 
     def _stage(self, version: str) -> None:
         """Hand the published version to the rollout path. The first ever

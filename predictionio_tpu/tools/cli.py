@@ -695,11 +695,16 @@ def _parse_bytes(text: str) -> int:
 def cmd_doctor(args) -> int:
     """Preflight diagnostics. ``--capacity USERS ITEMS K`` runs the HBM
     capacity planner (obs/xray.estimate_factors): will this ALS train fit
-    per-device HBM? Exits nonzero when the estimate exceeds
+    per-device HBM? ``--ann "clusters,nprobe"`` prices a serving-side ANN
+    index for the same corpus next to the factor tables (the budget check
+    then gates the sum). Exits nonzero when the estimate exceeds
     ``--hbm-bytes`` — ROADMAP item 1's memory target as a gate instead of
-    an OOM. Without ``--capacity``: device inventory + live memory."""
+    an OOM. Without ``--capacity``: device inventory + live memory + any
+    ANN indexes pinned in the registry."""
     from predictionio_tpu.obs import xray
 
+    if getattr(args, "ann", None) and not args.capacity:
+        return _die("--ann needs --capacity USERS ITEMS K (ITEMS and K size the index)")
     if args.capacity:
         users, items, k = (int(v) for v in args.capacity)
         est = xray.estimate_factors(
@@ -712,25 +717,50 @@ def cmd_doctor(args) -> int:
             gather_dtype=args.gather_dtype,
         )
         budget = _parse_bytes(args.hbm_bytes) if args.hbm_bytes else None
+        need = est.per_device_bytes
+        ann_est = None
+        if getattr(args, "ann", None):
+            try:
+                clusters_s, _, nprobe_s = args.ann.partition(",")
+                clusters, nprobe = int(clusters_s or 0), int(nprobe_s or 0)
+            except ValueError:
+                return _die(
+                    f"--ann expects 'clusters,nprobe' (0 = auto), got {args.ann!r}"
+                )
+            ann_est = xray.estimate_ann(
+                items,
+                k,
+                clusters,
+                nprobe,
+                quantize_int8=bool(getattr(args, "ann_int8", False)),
+            )
+            need += ann_est["perDeviceBytes"]
         out = {
             "capacity": est.to_json_dict(),
+            "ann": ann_est,
+            "perDeviceBytesTotal": need,
             "hbmBudgetBytes": budget,
-            "fits": est.fits(budget) if budget is not None else None,
+            "fits": (need <= budget) if budget is not None else None,
         }
         print(json.dumps(out, indent=2))
         if budget is not None:
-            gb = est.per_device_bytes / 1e9
-            if not est.fits(budget):
+            gb = need / 1e9
+            if need > budget:
                 print(
                     f"EXCEEDS BUDGET: {gb:.2f} GB/device needed vs "
                     f"{budget / 1e9:.2f} GB budget — shard wider (--mesh), "
-                    f"lower k, or bf16 the tables",
+                    f"lower k, bf16 the tables"
+                    + (
+                        ", or --ann-int8 / fewer clusters for the index"
+                        if ann_est
+                        else ""
+                    ),
                     file=sys.stderr,
                 )
                 return 1
             print(
                 f"fits: {gb:.2f} GB/device of {budget / 1e9:.2f} GB budget "
-                f"({100.0 * est.per_device_bytes / budget:.1f}%)"
+                f"({100.0 * need / budget:.1f}%)"
             )
         return 0
     # inventory mode: what does this host actually have
@@ -753,7 +783,45 @@ def cmd_doctor(args) -> int:
             print(line)
     except Exception as exc:  # noqa: BLE001 - doctor reports, never crashes
         print(f"devices unavailable: {exc}")
+    _doctor_ann_inventory(getattr(args, "registry_dir", None))
     return 0
+
+
+def _doctor_ann_inventory(registry_dir: str | None) -> None:
+    """List every ANN index pinned on a registry-stable version — the
+    'what retrieval indexes are live' half of the inventory."""
+    import os as _os
+
+    registry_dir = registry_dir or _os.environ.get("PIO_REGISTRY_DIR")
+    if not registry_dir or not _os.path.isdir(registry_dir):
+        return
+    try:
+        from predictionio_tpu.registry import ArtifactStore
+
+        store = ArtifactStore(registry_dir)
+        lines = []
+        for key in store.engines():
+            state = store.state_by_key(key)
+            if not state.stable:
+                continue
+            versions = {m.version: m for m in store.versions_by_key(key)}
+            manifest = versions.get(state.stable)
+            if manifest is None or not manifest.ann_index:
+                continue
+            a = manifest.ann_index
+            lines.append(
+                f"  {key} {state.stable}: {a.get('items', '?')} items, "
+                f"{a.get('clusters', '?')} clusters x cap "
+                f"{a.get('bucketCap', '?')}, nprobe {a.get('nprobe', '?')}, "
+                f"{a.get('hbmBytes', 0)} B"
+                + (" (int8)" if a.get("quantized") else "")
+            )
+        if lines:
+            print("ann indexes (registry-pinned stable):")
+            for line in lines:
+                print(line)
+    except Exception as exc:  # noqa: BLE001 - doctor reports, never crashes
+        print(f"ann inventory unavailable: {exc}")
 
 
 def cmd_import(args) -> int:
@@ -1620,9 +1688,26 @@ def build_parser() -> argparse.ArgumentParser:
     )
     x.add_argument("--nnz", type=int, help="rating count (adds wire bytes)")
     x.add_argument(
+        "--ann",
+        metavar="CLUSTERS,NPROBE",
+        help="price an ANN retrieval index (ITEMS items, dim K) next to "
+        "the factor tables: 'clusters,nprobe' (0,0 = auto sizing); the "
+        "budget check then covers factors + index (docs/ann.md)",
+    )
+    x.add_argument(
+        "--ann-int8",
+        action="store_true",
+        help="price the int8-quantized index layout",
+    )
+    x.add_argument(
         "--hbm-bytes",
         help="per-device HBM budget (accepts 16e9 / 16GB / 16GiB); "
         "exit 1 when the estimate exceeds it",
+    )
+    x.add_argument(
+        "--registry-dir",
+        help="registry to inventory pinned ANN indexes from "
+        "(default $PIO_REGISTRY_DIR)",
     )
     x.set_defaults(fn=cmd_doctor)
 

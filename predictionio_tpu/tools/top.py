@@ -174,6 +174,7 @@ def summarize(
     }
     out["phases"] = _phase_summary(metrics)
     out["cache_hit_ratio"] = _cache_hit_ratio(metrics)
+    out["ann"] = _ann_summary(metrics)
     out["slo"] = _slo_summary(metrics)
     out["stream"] = _stream_summary(metrics, now)
     out["train"] = _train_summary(metrics)
@@ -242,6 +243,37 @@ def _cache_hit_ratio(metrics: Metrics) -> float | None:
     misses = _total(metrics, "pio_cache_misses_total")
     total = hits + misses
     return (hits / total) if total else None
+
+
+def _ann_summary(metrics: Metrics) -> dict[str, Any] | None:
+    """The ANN retrieval line, from the ``pio_ann_*`` family: pinned
+    index shape, probes per query, candidate fraction, sampled recall.
+    None when no index is pinned AND no ANN query was ever served (the
+    family registers eagerly at zero, which must not render a line)."""
+    indexes = {
+        labels.get("version", "?"): {"items": v}
+        for labels, v in metrics.get("pio_ann_index_items", ())
+        if v > 0
+    }
+    for labels, v in metrics.get("pio_ann_index_clusters", ()):
+        ver = labels.get("version", "?")
+        if ver in indexes:
+            indexes[ver]["clusters"] = v
+    queries = _total(metrics, "pio_ann_queries_total")
+    if not indexes and queries <= 0:
+        return None
+    probes = _total(metrics, "pio_ann_probes_total")
+    return {
+        "queries_total": queries,
+        "fallback_total": _total(metrics, "pio_ann_fallback_total"),
+        "probes_per_query": (probes / queries) if queries else None,
+        "candidates_frac": _total(metrics, "pio_ann_candidates_frac"),
+        "recall_sampled": _total(metrics, "pio_ann_recall_sampled"),
+        "recall_samples_total": _total(metrics, "pio_ann_recall_samples_total"),
+        "refreshes_total": _total(metrics, "pio_ann_refreshes_total"),
+        "rebuilds_total": _total(metrics, "pio_ann_rebuilds_total"),
+        "indexes": indexes,
+    }
 
 
 def _slo_summary(metrics: Metrics) -> dict[str, dict[str, Any]] | None:
@@ -451,6 +483,29 @@ def render(summary: dict[str, Any], url: str) -> str:
             # explains a Σ well under the e2e p50 (hits skip most phases)
             tail += f"   cache hit {hit_ratio * 100.0:.0f}%"
         lines.append("  waterfall  " + " | ".join(parts) + tail)
+    ann = summary.get("ann")
+    if ann is not None:
+        idx_parts = [
+            f"{ver} ({num(info.get('items'))} items/"
+            f"{num(info.get('clusters'))} clusters)"
+            for ver, info in sorted((ann.get("indexes") or {}).items())
+        ]
+        line = "  ann        " + (" ".join(idx_parts) or "(no index pinned)")
+        line += f"   queries {num(ann['queries_total'])}"
+        if ann.get("probes_per_query") is not None:
+            line += f"   probes/q {ann['probes_per_query']:.1f}"
+        if ann.get("candidates_frac"):
+            line += f"   cand {ann['candidates_frac'] * 100.0:.1f}%"
+        if ann.get("recall_samples_total"):
+            line += f"   recall~{ann['recall_sampled']:.3f}"
+        if ann.get("fallback_total"):
+            line += f"   fallback {num(ann['fallback_total'])}"
+        if ann.get("refreshes_total") or ann.get("rebuilds_total"):
+            line += (
+                f"   refreshes {num(ann['refreshes_total'])}"
+                f"/{num(ann['rebuilds_total'])} rebuilt"
+            )
+        lines.append(line)
     slos = summary.get("slo") or {}
     if slos:
         parts = []

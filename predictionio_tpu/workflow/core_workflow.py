@@ -203,6 +203,7 @@ def run_train(
             registry_dir,
             keep_versions,
             train_profile=profile.to_json_dict() if profile is not None else {},
+            models=persistable,
         )
         logger.info(
             "training completed: instance %s, %.2fs, %d model(s), %d byte blob",
@@ -231,13 +232,19 @@ def _publish_to_registry(
     registry_dir: str | None,
     keep_versions: int,
     train_profile: dict | None = None,
+    models: list[Any] | None = None,
 ) -> None:
     """Write the trained blob into the artifact registry with its lineage
     manifest — including the train profile, so every version carries its
     training evidence (`pio models show` answers "how was this trained,
     how long, how big"). Atomic (tmp+rename inside the store);
     best-effort by contract — a broken registry disk must not fail a
-    completed train."""
+    completed train.
+
+    When the trained models expose an item-vector table and the corpus
+    clears the ANN threshold (predictionio_tpu/ann, docs/ann.md), the
+    version also gets its retrieval index built and pinned here — the
+    end-of-train half of the index lifecycle."""
     registry_dir = registry_dir or os.environ.get("PIO_REGISTRY_DIR")
     if not registry_dir:
         return
@@ -248,7 +255,8 @@ def _publish_to_registry(
             params_hash_of,
         )
 
-        published = ArtifactStore(registry_dir).publish(
+        store = ArtifactStore(registry_dir)
+        published = store.publish(
             ModelManifest(
                 version="",
                 engine_id=manifest.engine_id,
@@ -270,6 +278,12 @@ def _publish_to_registry(
         logger.info(
             "registry: published %s (instance %s)", published.version, instance_id
         )
+        if models:
+            from predictionio_tpu.ann import lifecycle as ann_lifecycle
+
+            ann_lifecycle.build_for_version(
+                store, manifest.engine_id, published.version, models
+            )
     except Exception:
         logger.exception(
             "registry publish failed (metadata store remains authoritative)"

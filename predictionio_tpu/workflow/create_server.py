@@ -39,6 +39,8 @@ from typing import Any
 
 from aiohttp import web
 
+from predictionio_tpu.ann import lifecycle as ann_lifecycle
+from predictionio_tpu.ann.metrics import AnnInstruments
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.registry import Storage
@@ -960,6 +962,14 @@ class QueryServer:
                 self._m_shed,
             ),
         )
+        # the pio_ann_* family (docs/ann.md): registered eagerly so the
+        # family exists from process start; lanes loaded from the registry
+        # bind their attached AnnServing to it in _warmup_components. The
+        # collector reconciles the version-labeled index gauges against
+        # the LIVE lanes each scrape — a reload must retire the old
+        # version's series, not leave it rendering as pinned forever
+        self.ann_instruments = AnnInstruments(m)
+        m.register_collector(self._collect_ann_indexes)
         # jit cache misses / XLA compile events become first-class metrics;
         # sampled at scrape time via the registry collector hook
         self.compile_watcher = CompileWatcher(m)
@@ -2023,6 +2033,11 @@ class QueryServer:
                 engine_params = self._engine_params_of(instance)
         ctx = WorkflowContext(mode="serving", _storage=self.storage)
         models = self.engine.prepare_deploy(ctx, engine_params, persisted)
+        # pin the version's ANN index (if the manifest carries one) onto
+        # the model object BEFORE warmup compiles the serving programs
+        ann_lifecycle.attach_from_registry(
+            store, self.manifest.engine_id, version, models
+        )
         _, _, algorithms, serving = self.engine.make_components(engine_params)
         self._warmup_components(algorithms, models)
         return Lane(
@@ -2463,7 +2478,23 @@ class QueryServer:
         lane = self._active
         self._warmup_components(lane.algorithms, lane.models)
 
+    def _collect_ann_indexes(self) -> None:
+        candidate = self._candidate
+        self.ann_instruments.sync_indexes(
+            ann_lifecycle.pinned_indexes(
+                [self._active.models]
+                + ([candidate.models] if candidate is not None else [])
+            )
+        )
+
     def _warmup_components(self, algorithms: list[Any], models: list[Any]) -> None:
+        # late-bind any registry-attached ANN index to this server's
+        # pio_ann_* instruments (the lane loader runs before the metrics
+        # registry is in scope). The ANN search buckets warm inside each
+        # engine's warmup_serving below — each engine compiles exactly
+        # the kernel variant its dispatch actually runs (exclusion /
+        # composed-tower), not the generic one
+        ann_lifecycle.bind_instruments(models, self.ann_instruments)
         for algo, model in zip(algorithms, models):
             try:
                 algo.warmup_serving(model, self.config.max_batch_size)
@@ -2685,6 +2716,7 @@ def _query_server_from_registry(
         engine_params = engine.engine_params_from_variant(manifest.variant_json)
     ctx = WorkflowContext(mode="serving", _storage=storage)
     models = engine.prepare_deploy(ctx, engine_params, persisted)
+    ann_lifecycle.attach_from_registry(store, manifest.engine_id, version, models)
     logger.info(
         "deploying registry stable %s (instance %s)",
         version,

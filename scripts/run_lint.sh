@@ -104,6 +104,64 @@ PYEOF
   fi
   echo "serving smoke vs baseline: $(tail -c 240 /tmp/pio_compare_smoke.json)"
 
+  # --- ANN smoke (ISSUE 10, docs/ann.md): build a small clustered index,
+  #     serve a real engine through it via the registry attach path, and
+  #     hold the two acceptance rails by measurement: recall@10 >= 0.95
+  #     vs exact at <=10% of the corpus scored, and the exact path still
+  #     answering when no index is pinned (the fallback default).
+  env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np, tempfile
+from predictionio_tpu.ann import AnnConfig
+from predictionio_tpu.ann import lifecycle
+from predictionio_tpu.models.similarproduct.engine import (
+    ALSAlgorithm, Query, SimilarModel,
+)
+from predictionio_tpu.registry import ArtifactStore, ModelManifest
+from predictionio_tpu.workflow import model_io
+
+rng = np.random.default_rng(0)
+n, f = 8000, 16
+modes = rng.normal(size=(48, f)); modes /= np.linalg.norm(modes, axis=1, keepdims=True)
+vf = (modes[rng.integers(0, 48, n)] + 0.1 * rng.normal(size=(n, f))).astype(np.float32)
+vf /= np.linalg.norm(vf, axis=1, keepdims=True)
+vocab = [f"i{j}" for j in range(n)]
+algo = ALSAlgorithm(None)
+queries = [Query(items=(vocab[int(j)],), num=10) for j in rng.integers(0, n, 32)]
+
+# exact-fallback rail: a model with NO index pinned answers exactly
+plain = SimilarModel(vf.copy(), list(vocab), [None] * n)
+exact = algo.predict_batch(plain, queries)
+assert all(len(r.item_scores) == 10 for r in exact), "exact fallback broken"
+
+with tempfile.TemporaryDirectory() as d:
+    store = ArtifactStore(d)
+    model = SimilarModel(vf.copy(), list(vocab), [None] * n)
+    m = store.publish(
+        ModelManifest(version="", engine_id="ann-smoke", engine_version="1",
+                      engine_variant="v"),
+        model_io.serialize_models([model]),
+    )
+    lifecycle.build_for_version(
+        store, "ann-smoke", m.version, [model], AnnConfig(min_items=0), force=True
+    )
+    models = model_io.deserialize_models(store.load_blob("ann-smoke", m.version))
+    serving = lifecycle.attach_from_registry(store, "ann-smoke", m.version, models)
+    assert serving is not None, "index did not attach"
+    ann = algo.predict_batch(models[0], queries)
+    hits = total = 0
+    for a, e in zip(ann, exact):
+        ai = {s.item for s in a.item_scores}
+        ei = [s.item for s in e.item_scores]
+        hits += sum(1 for it in ei if it in ai)
+        total += len(ei)
+    recall = hits / total
+    frac = serving.index.bucket_cap * serving.index.nprobe / n
+    assert recall >= 0.95, f"ANN recall@10 {recall:.3f} < 0.95"
+    assert frac <= 0.10, f"ANN candidate bound {frac:.3f} > 10% of corpus"
+    print(f"ann smoke: recall@10 {recall:.3f} at <= {frac:.1%} of corpus scored, "
+          f"exact fallback answers")
+PYEOF
+
   # --- fleet smoke (ISSUE 9, docs/fleet.md): 2 workers + gateway, kill
   #     one — the gateway must keep answering (ejection + failover) and
   #     `pio top --fleet` must render from the federated /metrics. The

@@ -135,10 +135,12 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
             return {}, "phase timed out after 90s"
         if name == "serving_local":
             return {"serving_local_e2e_p50_ms": 6.0}, None
-        if name == "secondary":
-            # host-side workloads run on the CPU backend instead of being
-            # zeroed by the outage
+        if name in ("ann", "secondary"):
+            # host-side/backed-independent workloads run on the CPU
+            # backend instead of being zeroed by the outage
             assert env == {"JAX_PLATFORMS": "cpu"}
+            if name == "ann":
+                return {"serving_ann_recall_at_10": 0.99}, None
             return {"cooccurrence_build_ms": 150.0,
                     "cooccurrence_build_gate_ok": True}, None
         raise AssertionError(f"device phase {name} must not run")
@@ -148,10 +150,12 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     monkeypatch.setenv("PIO_BENCH_LATE_RETRY_DELAY_S", "0")
     rc = bench.main()
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    # only probes, the CPU phase, and the CPU-fallback secondary ever run:
-    # never a device phase itself, and never a per-phase re-probe
+    # only probes, the CPU phase, and the CPU-fallback ann/secondary ever
+    # run: never a device phase itself, and never a per-phase re-probe
     names = [c[0] for c in calls]
-    assert [n for n in names if n != "probe"] == ["serving_local", "secondary"]
+    assert [n for n in names if n != "probe"] == [
+        "serving_local", "ann", "secondary",
+    ]
     assert names.count("probe") == 2  # initial + the single late retry
     assert out["preflight_attempts"] == 2
     assert rc == 1  # headline phases never ran -> degraded
@@ -160,6 +164,8 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     assert out["serving_local_e2e_p50_ms"] == 6.0
     assert out["cooccurrence_build_ms"] == 150.0
     assert out["secondary_platform"] == "cpu_fallback"
+    assert out["ann_platform"] == "cpu_fallback"
+    assert out["serving_ann_recall_at_10"] == 0.99
 
 
 def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
@@ -173,8 +179,10 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
         assert name != "probe", "--cpu-only must never probe"
         if name == "serving_local":
             return {"serving_local_e2e_p50_ms": 6.0}, None
-        if name == "secondary":
+        if name in ("ann", "secondary"):
             assert env == {"JAX_PLATFORMS": "cpu"}
+            if name == "ann":
+                return {"serving_ann_recall_at_10": 0.99}, None
             return {"naive_bayes_train_ms": 50.0}, None
         raise AssertionError(f"device phase {name} must not run")
 
@@ -187,7 +195,7 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
     rc = bench.main()
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0  # a requested CPU-only run that shipped numbers is healthy
-    assert calls == ["serving_local", "secondary"]
+    assert calls == ["serving_local", "ann", "secondary"]
     assert out["preflight_attempts"] == 0
     assert out["bench_cpu_only"] is True
     assert out["als_error"] == "skipped: --cpu-only"
@@ -230,6 +238,7 @@ def test_failed_serving_retry_keeps_random_label(monkeypatch, capsys):
             ),
             "serving_local": ({"serving_local_e2e_p50_ms": 4.0}, None),
             "twotower": ({}, None),
+            "ann": ({}, None),
             "secondary": ({}, None),
         }
         return results[name]
@@ -340,6 +349,7 @@ def test_dead_then_alive_device_recovers_the_capture(monkeypatch, capsys):
             ),
             "serving_local": ({"serving_local_e2e_p50_ms": 4.0}, None),
             "twotower": ({"twotower_recall_at_10": 0.45, "twotower_recall_gate_ok": True}, None),
+            "ann": ({"serving_ann_recall_at_10": 0.99}, None),
             "secondary": ({"naive_bayes_train_ms": 50.0}, None),
         }
         return results[name]
