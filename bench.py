@@ -2344,11 +2344,22 @@ def main() -> int:
         "metric": f"als_{scale_name}_train_wall_clock",
         "value": train_wall,
         "unit": "s",
-        "vs_baseline": round(e2e_p50 / 10.0, 4) if e2e_p50 is not None else None,
         **fields,
         **errors,
         "bench_host_cores": os.cpu_count(),
     }
+    # evidence semantics (ROADMAP item 5): vs_baseline is OMITTED — never
+    # null-paired — when the serving headline it rates is absent. A reader
+    # of BENCH_r*.json must never see a ratio standing next to a missing
+    # measurement and wonder which run produced it. Same contract for the
+    # gateway-hop fields: _bench_gateway_hop returns {} on failure, and
+    # the scrub below guarantees no None ever rides a serving_gateway_*
+    # key even if a future path pairs one.
+    if e2e_p50 is not None:
+        result["vs_baseline"] = round(e2e_p50 / 10.0, 4)
+    for key in list(result):
+        if key.startswith("serving_gateway_") and result[key] is None:
+            del result[key]
     compare_ok = True
     if args.compare:
         # the perf-regression gate: this run vs the best prior round(s);

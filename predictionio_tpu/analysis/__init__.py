@@ -36,6 +36,7 @@ from predictionio_tpu.analysis.core import (
 # importing the rule modules registers their checkers
 from predictionio_tpu.analysis import (  # noqa: F401  (registration side effect)
     rules_concurrency,
+    rules_fleet,
     rules_hostsync,
     rules_obs,
     rules_recompile,

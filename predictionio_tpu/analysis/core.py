@@ -88,6 +88,16 @@ class LintConfig:
         "*/stream/trainers.py",
         "*/stream/pipeline.py",
     )
+    # fleet gateway/supervisor modules: outbound replica calls and
+    # replica state transitions must route through the span/telemetry
+    # helpers (rule fleet-unattributed-proxy) — an unattributed proxy is
+    # a hop /traces/recent can never assemble, an unattributed
+    # eject/park is evidence the incident recorder never sees
+    fleet_globs: tuple[str, ...] = (
+        "*/fleet/gateway.py",
+        "*/fleet/supervisor.py",
+        "*/fleet/launch.py",
+    )
     # engine modules whose predict paths must keep score+select fused on
     # device (rule serving-host-roundtrip): a full-array device fetch or a
     # host argsort there ships O(corpus) floats over the wire per query

@@ -9,6 +9,17 @@ for additive gauges (queue depth, in-flight), documented as
 sum-of-replicas for the rest (``docs/fleet.md``); per-replica truth
 stays one scrape away on the replica's own endpoint.
 
+OpenMetrics tolerance: a replica scraped with ``?exemplars=1`` decorates
+bucket lines with exemplar clauses (``… # {trace_id="…"} v``) and ends
+with ``# EOF``. The merge VALUE math strips both via the same ``" # "``
+split ``pio top`` uses (the sample still sums exactly); with
+``exemplars=True`` the clauses are additionally *carried* onto the
+merged output — last replica wins per series, which is the same
+last-writer-wins the per-process histogram applies per bucket — so a
+federated p99 exemplar still names a concrete trace id that the
+gateway's ``/traces/recent?trace_id=`` assembles into a cross-tier
+waterfall.
+
 Built on the same stdlib parser ``pio top`` uses, so whatever a replica
 can expose, the federated view can carry.
 """
@@ -17,10 +28,18 @@ from __future__ import annotations
 
 import re
 
-from predictionio_tpu.tools.top import _parse_value, parse_prometheus
+# _LABEL_RE/_unescape shared with the parser so exemplar-clause keys can
+# never diverge from the merged-series keys parse_prometheus produces
+from predictionio_tpu.tools.top import (
+    _LABEL_RE,
+    _parse_value,
+    _unescape,
+    parse_prometheus,
+)
 
 _TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (\w+)\s*$")
 _HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+_SAMPLE_NAME_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s")
 
 
 def _escape(value: str) -> str:
@@ -53,15 +72,41 @@ def _sample_sort_key(item):
     )
 
 
-def federate_metrics(texts: list[str]) -> str:
-    """Merge N Prometheus text expositions into one.
+def _collect_exemplars(
+    text: str,
+) -> dict[tuple[str, tuple[tuple[str, str], ...]], str]:
+    """``(series_name, label_key) -> exemplar clause`` for every sample
+    line carrying one (`` # {…} v`` after the value). The clause is kept
+    verbatim for re-attachment to the merged line."""
+    out: dict[tuple[str, tuple[tuple[str, str], ...]], str] = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " # " not in line:
+            continue
+        sample, clause = line.split(" # ", 1)
+        m = _SAMPLE_NAME_RE.match(sample.strip() + " ")
+        if not m:
+            continue
+        labels = {
+            k: _unescape(v) for k, v in _LABEL_RE.findall(m.group(2) or "")
+        }
+        out[(m.group(1), _series_key(labels))] = clause.strip()
+    return out
+
+
+def federate_metrics(texts: list[str], exemplars: bool = False) -> str:
+    """Merge N Prometheus/OpenMetrics text expositions into one.
 
     Identical ``(name, labels)`` series have their values summed; HELP and
     TYPE lines are carried from the first exposition that declares them.
     Input order is the replica order — series unique to one replica pass
-    through unchanged.
+    through unchanged. Exemplar clauses and ``# EOF`` in the inputs never
+    corrupt the sums (stripped before value parsing); with
+    ``exemplars=True`` the clauses are re-attached to the merged lines
+    (last input wins per series) and the output ends with ``# EOF`` —
+    serve that variant only to scrapers that negotiated OpenMetrics.
     """
     merged: dict[str, dict[tuple[tuple[str, str], ...], float]] = {}
+    exemplar_clauses: dict[tuple[str, tuple[tuple[str, str], ...]], str] = {}
     types: dict[str, str] = {}
     helps: dict[str, str] = {}
     order: list[str] = []
@@ -81,6 +126,8 @@ def federate_metrics(texts: list[str]) -> str:
             for labels, value in samples:
                 key = _series_key(labels)
                 series[key] = series.get(key, 0.0) + value
+        if exemplars:
+            exemplar_clauses.update(_collect_exemplars(text))
     lines: list[str] = []
     for name in sorted(order):
         base = _base_metric_name(name, types)
@@ -93,7 +140,11 @@ def federate_metrics(texts: list[str]) -> str:
             if key:
                 inner = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
                 label_str = "{" + inner + "}"
-            lines.append(f"{name}{label_str} {_format_value(value)}")
+            clause = exemplar_clauses.get((name, key)) if exemplars else None
+            suffix = f" # {clause}" if clause else ""
+            lines.append(f"{name}{label_str} {_format_value(value)}{suffix}")
+    if exemplars:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
 

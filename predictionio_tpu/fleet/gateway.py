@@ -33,6 +33,31 @@ part, not a new runtime. Responsibilities:
 - **Federation.** ``GET /metrics`` merges every replica's scrape with
   the gateway's own ``pio_fleet_*``/``pio_gateway_*`` instruments
   (:mod:`.federation`) — the endpoint ``pio top --fleet`` reads.
+- **Cross-tier tracing.** Every routed query is recorded as real spans
+  on the ingress trace id: ``gateway.route`` (replica chosen,
+  healthy-replica count, panic/retry attribution, final status) and one
+  ``gateway.proxy`` per forward attempt (upstream wall time per
+  replica) — the gateway hop ``bench.py`` prices is attributable per
+  request. ``GET /traces/recent`` fan-in merges the gateway's own span
+  ring with each replica's (fetched live from healthy replicas, served
+  from the per-tick cache for dead ones — a SIGKILLed worker's last
+  spans survive it); ``?trace_id=`` assembles one gateway→replica
+  waterfall, which is where a federated p99 exemplar resolves.
+- **Telemetry ring + fleet SLOs.** Each telemetry tick (probe cadence
+  by default) the gateway federates the fleet's counters, evaluates
+  fleet-level SLOs over the federated deltas (:mod:`obs.slo` burn-rate
+  engine — availability, the paper's <10 ms p50, shed), and appends a
+  snapshot (per-replica health/inflight/breaker, queue depth, burn
+  rates) to the durable on-disk :class:`~predictionio_tpu.obs.tsring.
+  TelemetryRing` — the history ``GET /telemetry/window?s=N`` and
+  ``pio top --history`` serve, and the sensory input a future
+  autoscaler reads.
+- **Incident triggers.** A fleet SLO flipping to alerting, a replica
+  breaker tripping open, or a 5xx escaping to a client (the zero-5xx
+  invariant the chaos suite asserts) each fire the attached
+  :class:`~predictionio_tpu.obs.incidents.IncidentRecorder`, whose
+  sources capture the merged traces, ring tail, and rollout state at
+  that moment (``docs/observability.md``).
 
 Model-rollout admin (``GET /models``, ``POST /models/*``) proxies to one
 healthy replica; the change lands in the shared registry and every other
@@ -54,17 +79,27 @@ from aiohttp import web
 
 from predictionio_tpu.fleet.federation import federate_metrics
 from predictionio_tpu.obs.metrics import MetricsRegistry
-from predictionio_tpu.obs.tracing import TRACE_HEADER, mint_trace_id
+from predictionio_tpu.obs.slo import DEFAULT_WINDOWS, SLOEngine
+from predictionio_tpu.obs.tracing import (
+    TRACE_HEADER,
+    Tracer,
+    mint_trace_id,
+)
 from predictionio_tpu.obs.web import (
     BreakerInstruments,
+    OPENMETRICS_CONTENT_TYPE,
     PROMETHEUS_CONTENT_TYPE,
+    _wants_exemplars,
+    slo_response,
 )
 from predictionio_tpu.registry.router import routing_key, sticky_bucket
 from predictionio_tpu.resilience import (
     CircuitBreaker,
     CircuitOpenError,
+    OPEN,
     RetryBudget,
 )
+from predictionio_tpu.tools.top import parse_prometheus
 
 logger = logging.getLogger(__name__)
 
@@ -73,6 +108,11 @@ logger = logging.getLogger(__name__)
 # fail identically everywhere, and re-dispatching it doubles load for
 # nothing.
 RETRIABLE_STATUSES = frozenset((500, 502, 503, 504))
+
+# spans fetched per replica per telemetry tick: enough ring to cover a
+# probe interval of traffic at fleet scale without the fan-in dominating
+# the tick
+TRACE_FANIN_LIMIT = 200
 
 
 @dataclasses.dataclass
@@ -99,6 +139,9 @@ class GatewayConfig:
     max_payload_bytes: int = 1 << 20
     shed_retry_after_s: float = 1.0
     drain_grace_s: float = 15.0
+    # telemetry tick cadence (federate + SLO + ring append + trace
+    # fan-in refresh); None follows probe_interval_s, 0 disables
+    telemetry_interval_s: float | None = None
 
 
 class Replica:
@@ -132,11 +175,17 @@ class Gateway:
         self,
         config: GatewayConfig,
         metrics: MetricsRegistry | None = None,
+        tracer: Tracer | None = None,
+        telemetry: Any | None = None,  # obs.tsring.TelemetryRing
+        incidents: Any | None = None,  # obs.incidents.IncidentRecorder
     ):
         if not config.replica_urls:
             raise ValueError("gateway needs at least one replica URL")
         self.config = config
         self.metrics = metrics or MetricsRegistry()
+        self.tracer = tracer or Tracer(ring_size=512)
+        self.telemetry = telemetry
+        self.incidents = incidents
         m = self.metrics
         self._breaker_instruments = BreakerInstruments(m)
         self.replicas = [
@@ -152,6 +201,11 @@ class Gateway:
             )
             for url in config.replica_urls
         ]
+        for replica in self.replicas:
+            # a breaker tripping OPEN is an incident trigger: by the time
+            # an operator looks, the consecutive failures that tripped it
+            # are only in the flight recorder
+            replica.breaker.chain_listener(self._on_breaker_transition)
         self.retry_budget = RetryBudget(ratio=config.retry_budget_ratio)
         self._m_replicas = m.gauge(
             "pio_fleet_replicas", "replicas configured behind this gateway"
@@ -200,9 +254,42 @@ class Gateway:
             "gateway e2e proxy wall time (ingress to upstream answer relayed)",
             labelnames=("endpoint",),
         )
+        self._m_responses = m.counter(
+            "pio_gateway_responses_total",
+            "CLIENT-VISIBLE /queries.json outcomes by status class — what "
+            "the retry already rescued is a 2xx here (pio_fleet_requests_"
+            "total counts the per-attempt forwards)",
+            labelnames=("status",),
+        )
+        self._m_telemetry_snapshots = m.counter(
+            "pio_telemetry_snapshots_total",
+            "fleet snapshots appended to the on-disk telemetry ring",
+        )
+        self._m_telemetry_errors = m.counter(
+            "pio_telemetry_errors_total",
+            "telemetry ticks that failed (federation, SLO, or ring append)",
+        )
+        self._m_telemetry_records = m.gauge(
+            "pio_telemetry_ring_records",
+            "records currently live in the telemetry ring (0 when no ring "
+            "is attached)",
+        )
         m.register_collector(self._collect)
+        # fleet-level SLOs over the federated view (obs/slo.py burn-rate
+        # engine): snapshots ride the telemetry tick AND the scrape
+        self.slo = SLOEngine(m)
+        self._last_federated: dict[str, list[tuple[dict[str, str], float]]] = {}
+        self._add_fleet_slos()
+        m.register_collector(self.slo.collect)
+        self._slo_alerting: dict[str, bool] = {}
+        # trace fan-in cache: replica name -> last fetched span dicts.
+        # Refreshed per telemetry tick and on /traces/recent; NEVER
+        # cleared on fetch failure — a dead replica's final spans are
+        # exactly the evidence an incident bundle needs.
+        self._replica_spans: dict[str, list[dict[str, Any]]] = {}
         self._session: aiohttp.ClientSession | None = None
         self._probe_task: asyncio.Task | None = None
+        self._telemetry_task: asyncio.Task | None = None
         self._runner: web.AppRunner | None = None
         self._draining = False
         self._inflight_requests = 0
@@ -214,6 +301,10 @@ class Gateway:
         for r in self.replicas:
             self._m_up.set(1.0 if r.healthy else 0.0, replica=r.name)
             self._m_inflight.set(float(r.inflight), replica=r.name)
+        if self.telemetry is not None:
+            self._m_telemetry_records.set(
+                float(getattr(self.telemetry, "approx_count", 0))
+            )
 
     def _http(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -224,17 +315,150 @@ class Gateway:
             )
         return self._session
 
+    # ---------------------------------------------------------- fleet SLOs
+    def _add_fleet_slos(self) -> None:
+        """Fleet-level objectives evaluated over federated counter deltas
+        (the replicas' own /slo endpoints rate each process in isolation;
+        these rate what CLIENTS of the fleet experience)."""
+
+        def availability() -> tuple[float, float]:
+            # CLIENT-VISIBLE outcomes only: a forward that failed and was
+            # rescued by the retry is a success here (rating per-attempt
+            # forwards would flip this SLO to alerting during a chaos
+            # kill whose zero-5xx invariant is actually holding). Sheds
+            # are 503 responses, so they are already counted as bad.
+            total = bad = 0.0
+            for key, v in self._m_responses.collect():
+                labels = dict(zip(self._m_responses.labelnames, key))
+                total += v
+                if labels.get("status") == "5xx":
+                    bad += v
+            return total, bad
+
+        def latency() -> tuple[float, float]:
+            # the paper's <10 ms p50 target, fleet-wide: over-threshold
+            # fraction from the FEDERATED request histogram (the
+            # replicas' cumulative buckets summed series-wise; 0.01 sits
+            # exactly on a ladder bound so good = the 0.01 bucket)
+            total = good = 0.0
+            for labels, v in self._last_federated.get(
+                "pio_request_seconds_bucket", ()
+            ):
+                if labels.get("endpoint") != "/queries.json":
+                    continue
+                le = labels.get("le")
+                if le == "+Inf":
+                    total += v
+                elif le == "0.01":
+                    good += v
+            return total, max(0.0, total - good)
+
+        def shed() -> tuple[float, float]:
+            total = sum(v for _key, v in self._m_responses.collect())
+            return total, self._m_no_replica.total()
+
+        self.slo.add(
+            "fleet-availability",
+            "fraction of fleet queries answered without a 5xx, transport "
+            "error, or shed",
+            objective=0.999,
+            source=availability,
+        )
+        self.slo.add(
+            "fleet-latency",
+            "fraction of fleet queries under the paper's 10 ms target "
+            "(federated replica histograms)",
+            objective=0.50,
+            source=latency,
+        )
+        self.slo.add(
+            "fleet-shed",
+            "fraction of fleet queries NOT shed for want of a routable "
+            "replica",
+            objective=0.99,
+            source=shed,
+            windows=DEFAULT_WINDOWS,
+        )
+
+    # --------------------------------------------------- incident plumbing
+    def _trigger_incident(self, kind: str, context: dict[str, Any]) -> None:
+        """Fire the flight recorder WITHOUT stalling the event loop: a
+        capture does real disk I/O (ring tail, registry read, bundle
+        write), and it fires exactly when the fleet is degraded — the
+        worst moment to block every in-flight proxy. Off-loop callers
+        fall back to inline capture."""
+        if self.incidents is None:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self.incidents.trigger(kind, context=context)
+            return
+        loop.run_in_executor(
+            None, lambda: self.incidents.trigger(kind, context=context)
+        )
+
+    def _on_breaker_transition(self, name: str, old: str, new: str) -> None:
+        if new == OPEN:
+            self._trigger_incident(
+                "breaker-trip",
+                {"breaker": name, "from": old, "to": new},
+            )
+
+    def _note_transition(
+        self, event: str, replica: Replica, **tags: Any
+    ) -> None:
+        """The single funnel for replica state transitions: counter +
+        health-event span (the eject/readmit timeline incident bundles
+        and ``/traces/recent`` replay) — the ``fleet-unattributed-proxy``
+        lint rule holds every transition to this path."""
+        if event == "eject":
+            self._m_ejections.inc(replica=replica.name)
+        elif event == "readmit":
+            self._m_readmissions.inc(replica=replica.name)
+        self.tracer.record_span(
+            "gateway.health",
+            "gateway",
+            0.0,
+            trace_id=mint_trace_id(),
+            status=event,
+            replica=replica.name,
+            **tags,
+        )
+
+    def cached_spans(self) -> list[dict[str, Any]]:
+        """Sync merged-trace snapshot (gateway ring + per-tick replica
+        caches) — what incident sources capture without touching the
+        network mid-incident. Each span is tagged with its ``source``
+        tier."""
+        out = [
+            {**s, "source": "gateway"} for s in self.tracer.recent(None)
+        ]
+        # list() first: incident captures read this from an executor
+        # thread while the telemetry loop mutates the cache on the loop
+        for name, spans in list(self._replica_spans.items()):
+            out.extend({**s, "source": name} for s in spans)
+        out.sort(key=lambda s: s.get("startTime", 0.0), reverse=True)
+        return out
+
     # -------------------------------------------------------------- routing
     def pick_replica(
-        self, key: str, exclude: frozenset[str] = frozenset()
+        self,
+        key: str,
+        exclude: frozenset[str] = frozenset(),
+        meta: dict[str, Any] | None = None,
     ) -> Replica | None:
         """Least-loaded routable replica; consistent-hash tie-break.
 
         Claims a breaker slot (``allow()``) on the winner — the caller
         MUST pair the pick with ``record_success``/``record_failure``.
+        ``meta``, when given, is filled with routing attribution (panic
+        mode, healthy count) for the ``gateway.route`` span.
         """
         pool = [r for r in self.replicas if r.name not in exclude]
         candidates = [r for r in pool if r.healthy]
+        if meta is not None:
+            meta["healthy"] = len(candidates)
         if not candidates and pool:
             # panic routing: EVERY replica failed its last probe. Probes
             # are advisory — one can time out against a loaded-but-alive
@@ -244,6 +468,8 @@ class Gateway:
             # gate backends that are truly gone.
             candidates = pool
             self._m_panic.inc()
+            if meta is not None:
+                meta["panic"] = True
         if not candidates:
             return None
         low = min(r.inflight for r in candidates)
@@ -282,16 +508,20 @@ class Gateway:
         body: bytes | None,
         headers: dict[str, str],
     ) -> tuple[int, bytes, str]:
-        """One proxied request. Returns (status, body, content_type);
-        raises on transport failure. Replica accounting (inflight,
-        breaker, counters) is the caller's job — retry logic needs to
-        see the raw outcome."""
+        """One proxied request, recorded as a ``gateway.proxy`` span on
+        the request's trace id (upstream wall time = span duration).
+        Returns (status, body, content_type); raises on transport
+        failure. Replica accounting (inflight, breaker, counters) is the
+        caller's job — retry logic needs to see the raw outcome."""
         replica.inflight += 1
+        t0 = time.perf_counter()
+        status: Any = "error"
         try:
             async with self._http().request(
                 method, f"{replica.url}{path}", data=body, headers=headers
             ) as resp:
                 payload = await resp.read()
+                status = resp.status
                 return (
                     resp.status,
                     payload,
@@ -299,6 +529,15 @@ class Gateway:
                 )
         finally:
             replica.inflight -= 1
+            self.tracer.record_span(
+                "gateway.proxy",
+                "gateway",
+                time.perf_counter() - t0,
+                trace_id=headers.get(TRACE_HEADER),
+                replica=replica.name,
+                path=path,
+                upstream_status=status,
+            )
 
     @staticmethod
     def _status_class(status: int) -> str:
@@ -320,12 +559,18 @@ class Gateway:
     # --------------------------------------------------------------- routes
     async def handle_queries(self, request: web.Request) -> web.Response:
         t0 = time.perf_counter()
+        resp: web.Response | None = None
         try:
-            return await self._handle_queries_inner(request)
+            resp = await self._handle_queries_inner(request)
+            return resp
         finally:
             self._m_latency.observe(
                 time.perf_counter() - t0, endpoint="/queries.json"
             )
+            # client-visible outcome (an escaping exception becomes
+            # aiohttp's 500): the fleet-availability SLO's input
+            status = resp.status if resp is not None else 500
+            self._m_responses.inc(status=self._status_class(status))
 
     async def _handle_queries_inner(self, request: web.Request) -> web.Response:
         if (
@@ -350,10 +595,19 @@ class Gateway:
         }
         self._inflight_requests += 1
         try:
-            resp = await self._route_query(key, body, headers)
+            resp = await self._route_query(key, body, headers, trace_id)
         finally:
             self._inflight_requests -= 1
         resp.headers[TRACE_HEADER] = trace_id
+        if resp.status >= 500:
+            # the zero-5xx invariant (docs/fleet.md) just broke for a
+            # real client: capture the fleet state while the evidence —
+            # the dead replica's cached spans, the ring history — is
+            # still warm
+            self._trigger_incident(
+                "fleet-5xx",
+                {"status": resp.status, "traceId": trace_id},
+            )
         if self._draining:
             # drain keeps ANSWERING: the listener is closed (new
             # connections refused at TCP), but a request arriving on an
@@ -365,15 +619,40 @@ class Gateway:
         return resp
 
     async def _route_query(
-        self, key: str, body: bytes, headers: dict[str, str]
+        self,
+        key: str,
+        body: bytes,
+        headers: dict[str, str],
+        trace_id: str,
+    ) -> web.Response:
+        with self.tracer.span(
+            "gateway.route", kind="gateway", trace_id=trace_id
+        ) as route_span:
+            resp = await self._route_query_inner(
+                key, body, headers, route_span
+            )
+            route_span.tags["status"] = resp.status
+            return resp
+
+    async def _route_query_inner(
+        self,
+        key: str,
+        body: bytes,
+        headers: dict[str, str],
+        route_span: Any,
     ) -> web.Response:
         self.retry_budget.record_attempt()
-        first = self.pick_replica(key)
+        pick_meta: dict[str, Any] = {}
+        first = self.pick_replica(key, meta=pick_meta)
+        route_span.tags.update(pick_meta)
         if first is None:
             self._m_no_replica.inc()
+            route_span.tags["shed"] = True
             return self._unavailable(
                 "no healthy replica available", self.config.shed_retry_after_s
             )
+        route_span.tags["replica"] = first.name
+        route_span.tags["breaker"] = first.breaker.snapshot()["state"]
         failure: tuple[int, bytes, str] | None = None
         try:
             status, payload, ctype = await self._forward(
@@ -393,9 +672,16 @@ class Gateway:
         # one retry on a DIFFERENT replica — /queries.json is idempotent
         # (pure read), so re-dispatch cannot double-apply anything
         if self.retry_budget.try_spend():
-            second = self.pick_replica(key, exclude=frozenset((first.name,)))
+            retry_meta: dict[str, Any] = {}
+            second = self.pick_replica(
+                key, exclude=frozenset((first.name,)), meta=retry_meta
+            )
             if second is not None:
                 self._m_retries.inc()
+                route_span.tags["retried"] = True
+                route_span.tags["retry_replica"] = second.name
+                if retry_meta.get("panic"):
+                    route_span.tags["panic"] = True
                 try:
                     status, payload, ctype = await self._forward(
                         second, "POST", "/queries.json", body, headers
@@ -436,13 +722,14 @@ class Gateway:
                 "no healthy replica available", self.config.shed_retry_after_s
             )
         body = await request.read() if request.can_read_body else None
+        trace_id = request.headers.get(TRACE_HEADER) or mint_trace_id()
         try:
             status, payload, ctype = await self._forward(
                 replica,
                 method,
                 path,
                 body,
-                {"Content-Type": "application/json"},
+                {"Content-Type": "application/json", TRACE_HEADER: trace_id},
             )
         except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
             replica.breaker.record_failure()
@@ -466,21 +753,47 @@ class Gateway:
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """Federated fleet scrape: every reachable replica's /metrics
         merged (counters summed, histogram buckets added) plus the
-        gateway's own pio_fleet_* instruments."""
-        texts = [self.metrics.render_prometheus()]
-        results = await asyncio.gather(
-            *(self._fetch_metrics(r) for r in self.replicas)
-        )
-        texts.extend(t for t in results if t is not None)
+        gateway's own pio_fleet_* instruments. An OpenMetrics-negotiated
+        scrape (Accept or ``?exemplars=1``) federates the replicas'
+        exemplar-decorated expositions and carries the clauses through
+        the merge — a federated p99 exemplar still resolves to a trace
+        id, which ``/traces/recent?trace_id=`` assembles cross-tier."""
+        exemplars = _wants_exemplars(request)
+        text = await self._federate(exemplars=exemplars)
         return web.Response(
-            text=federate_metrics(texts),
-            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+            text=text,
+            headers={
+                "Content-Type": (
+                    OPENMETRICS_CONTENT_TYPE
+                    if exemplars
+                    else PROMETHEUS_CONTENT_TYPE
+                )
+            },
         )
 
-    async def _fetch_metrics(self, replica: Replica) -> str | None:
+    async def _federate(self, exemplars: bool = False) -> str:
+        """Fetch + merge the fleet's expositions; refreshes the cached
+        federated parse the fleet SLO sources read."""
+        texts = [self.metrics.render_prometheus(exemplars=exemplars)]
+        results = await asyncio.gather(
+            *(self._fetch_metrics(r, exemplars=exemplars) for r in self.replicas)
+        )
+        texts.extend(t for t in results if t is not None)
+        merged = federate_metrics(texts, exemplars=exemplars)
+        self._last_federated = parse_prometheus(merged)
+        return merged
+
+    async def _fetch_metrics(
+        self, replica: Replica, exemplars: bool = False
+    ) -> str | None:
+        suffix = "?exemplars=1" if exemplars else ""
         try:
+            # the telemetry plane's own traffic: this fetch FEEDS
+            # federation/the ring; a span per scrape per replica would
+            # flood the span ring with the instrument's own data
+            # pio-lint: disable=fleet-unattributed-proxy -- telemetry plane fetch
             async with self._http().get(
-                f"{replica.url}/metrics",
+                f"{replica.url}/metrics{suffix}",
                 timeout=aiohttp.ClientTimeout(total=self.config.probe_timeout_s),
             ) as resp:
                 if resp.status != 200:
@@ -488,6 +801,164 @@ class Gateway:
                 return await resp.text()
         except (aiohttp.ClientError, asyncio.TimeoutError):
             return None
+
+    # ----------------------------------------------------- trace fan-in
+    async def _fetch_traces(self, replica: Replica) -> None:
+        """Refresh one replica's span cache. Failures keep the stale
+        cache — a SIGKILLed replica's final spans are incident evidence,
+        not staleness."""
+        try:
+            # fan-in that fills the span cache; tracing the trace fetch
+            # would recurse the instrument into its own data
+            # pio-lint: disable=fleet-unattributed-proxy -- trace fan-in fetch
+            async with self._http().get(
+                f"{replica.url}/traces/recent?limit={TRACE_FANIN_LIMIT}",
+                timeout=aiohttp.ClientTimeout(total=self.config.probe_timeout_s),
+            ) as resp:
+                if resp.status != 200:
+                    return
+                data = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            return
+        spans = data.get("spans")
+        if isinstance(spans, list):
+            self._replica_spans[replica.name] = spans
+
+    async def merged_recent(
+        self, limit: int = 100, trace_id: str | None = None
+    ) -> list[dict[str, Any]]:
+        """The fan-in merged trace view: gateway ring + every replica's,
+        refreshed live from healthy replicas (dead ones serve from the
+        telemetry tick's cache). With ``trace_id``, the assembled
+        cross-tier waterfall: that trace's spans only, oldest first."""
+        await asyncio.gather(
+            *(self._fetch_traces(r) for r in self.replicas if r.healthy)
+        )
+        merged = self.cached_spans()
+        if trace_id is not None:
+            waterfall = [s for s in merged if s.get("traceId") == trace_id]
+            waterfall.sort(key=lambda s: s.get("startTime", 0.0))
+            return waterfall
+        return merged[: max(0, limit)]
+
+    async def handle_traces(self, request: web.Request) -> web.Response:
+        try:
+            limit = int(request.query.get("limit", 100))
+        except ValueError:
+            return web.json_response(
+                {"message": "limit must be an integer"}, status=400
+            )
+        trace_id = request.query.get("trace_id") or None
+        spans = await self.merged_recent(limit=limit, trace_id=trace_id)
+        return web.json_response({"spans": spans})
+
+    # ----------------------------------------------------- telemetry ring
+    def fleet_snapshot(self) -> dict[str, Any]:
+        """One telemetry-ring record: per-replica state + federated
+        counters + SLO burn — the queue-depth/burn/utilization history
+        the ROADMAP-2 autoscaler will read."""
+        fed = self._last_federated
+        counters = {
+            key: sum(v for _labels, v in fed.get(name, ()))
+            for key, name in (
+                ("requests", "pio_fleet_requests_total"),
+                ("retries", "pio_fleet_retries_total"),
+                ("no_replica", "pio_fleet_no_replica_total"),
+                ("panic_picks", "pio_fleet_panic_picks_total"),
+                # the workers' own admission-control sheds, federated
+                ("load_shed", "pio_load_shed_total"),
+            )
+        }
+        counters["errors_5xx"] = sum(
+            v
+            for labels, v in fed.get("pio_fleet_requests_total", ())
+            if labels.get("status") in ("5xx", "error")
+        )
+        gauges = {
+            "queue_depth": sum(
+                v for _labels, v in fed.get("pio_queue_depth", ())
+            ),
+            "inflight": sum(r.inflight for r in self.replicas),
+        }
+        slo: dict[str, Any] = {}
+        for report in self.slo.evaluate():
+            slo[report["name"]] = {
+                "alerting": report["alerting"],
+                "burn": {
+                    str(int(w["window_s"])): w["burn_rate"]
+                    for w in report["windows"]
+                },
+            }
+        return {
+            "kind": "fleet",
+            "replicas": {
+                r.name: {
+                    "healthy": r.healthy,
+                    "ever_ready": r.ever_ready,
+                    "inflight": r.inflight,
+                    "breaker": r.breaker.snapshot()["state"],
+                }
+                for r in self.replicas
+            },
+            "counters": counters,
+            "gauges": gauges,
+            "slo": slo,
+        }
+
+    async def _telemetry_tick(self) -> None:
+        await self._federate()
+        await asyncio.gather(
+            *(self._fetch_traces(r) for r in self.replicas if r.healthy)
+        )
+        self.slo.tick()
+        record = self.fleet_snapshot()
+        # SLO alert *transitions* trigger the flight recorder (level
+        # triggers would re-fire every tick of a long burn; the rate
+        # limiter bounds it anyway, but the transition is the incident)
+        for name, state in record["slo"].items():
+            was = self._slo_alerting.get(name, False)
+            now_alerting = bool(state["alerting"])
+            self._slo_alerting[name] = now_alerting
+            if now_alerting and not was:
+                self._trigger_incident("slo-alert", {"slo": name, **state})
+        if self.telemetry is not None:
+            self.telemetry.append(record)
+            self._m_telemetry_snapshots.inc()
+
+    async def _telemetry_loop(self) -> None:
+        interval = self.config.telemetry_interval_s
+        if interval is None:
+            interval = self.config.probe_interval_s
+        if interval <= 0:
+            return
+        while True:
+            try:
+                await self._telemetry_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self._m_telemetry_errors.inc()
+                logger.exception("telemetry tick failed")
+            await asyncio.sleep(interval)
+
+    async def handle_telemetry(self, request: web.Request) -> web.Response:
+        if self.telemetry is None:
+            return web.json_response(
+                {"message": "no telemetry ring attached"}, status=404
+            )
+        try:
+            seconds = float(request.query.get("s", 600))
+        except ValueError:
+            return web.json_response(
+                {"message": "s must be a number"}, status=400
+            )
+        records = self.telemetry.window(seconds)
+        return web.json_response(
+            {"windowS": seconds, "records": records}
+        )
+
+    async def handle_slo(self, request: web.Request) -> web.Response:
+        return slo_response(self.slo)
 
     async def handle_healthz(self, request: web.Request) -> web.Response:
         healthy = sum(1 for r in self.replicas if r.healthy)
@@ -540,6 +1011,9 @@ class Gateway:
 
     async def _probe(self, replica: Replica) -> None:
         try:
+            # probe GETs are the health plane's own traffic (one per
+            # replica per second); their OUTCOME transitions route
+            # through _note_transition below, which attributes this fn
             async with self._http().get(
                 f"{replica.url}/healthz",
                 timeout=aiohttp.ClientTimeout(total=self.config.probe_timeout_s),
@@ -551,15 +1025,16 @@ class Gateway:
             if not replica.healthy:
                 replica.healthy = True
                 if replica.ever_ready:
-                    self._m_readmissions.inc(replica=replica.name)
+                    self._note_transition("readmit", replica)
                     logger.info("replica %s readmitted", replica.name)
                 else:
+                    self._note_transition("up", replica)
                     logger.info("replica %s up", replica.name)
             replica.ever_ready = True
         elif replica.healthy:
             replica.healthy = False
             if replica.ever_ready:
-                self._m_ejections.inc(replica=replica.name)
+                self._note_transition("eject", replica)
                 logger.warning(
                     "replica %s ejected (failed /healthz)", replica.name
                 )
@@ -574,6 +1049,9 @@ class Gateway:
                 web.get("/", self.handle_status),
                 web.get("/healthz", self.handle_healthz),
                 web.get("/metrics", self.handle_metrics),
+                web.get("/slo", self.handle_slo),
+                web.get("/traces/recent", self.handle_traces),
+                web.get("/telemetry/window", self.handle_telemetry),
                 web.post("/queries.json", self.handle_queries),
                 web.get("/models", self.handle_models),
                 web.post("/models/{action}", self.handle_models_post),
@@ -581,20 +1059,27 @@ class Gateway:
             ]
         )
 
-        async def _start_probes(app: web.Application) -> None:
+        async def _start_loops(app: web.Application) -> None:
             self._probe_task = asyncio.ensure_future(self._probe_loop())
+            self._telemetry_task = asyncio.ensure_future(
+                self._telemetry_loop()
+            )
 
         async def _cleanup(app: web.Application) -> None:
-            task = self._probe_task
+            tasks = [self._probe_task, self._telemetry_task]
             self._probe_task = None
-            if task is not None:
-                task.cancel()
-                await asyncio.gather(task, return_exceptions=True)
+            self._telemetry_task = None
+            for task in tasks:
+                if task is not None:
+                    task.cancel()
+            await asyncio.gather(
+                *(t for t in tasks if t is not None), return_exceptions=True
+            )
             if self._session is not None and not self._session.closed:
                 await self._session.close()
             self._session = None
 
-        app.on_startup.append(_start_probes)
+        app.on_startup.append(_start_loops)
         app.on_cleanup.append(_cleanup)
         return app
 

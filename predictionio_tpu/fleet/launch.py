@@ -10,12 +10,22 @@ SIGTERM to the parent is a zero-downtime stop: the gateway drains
 (listener closed, in-flight answered), then the supervisor SIGTERMs the
 workers — which drain too (``create_server`` drain path) — escalating
 to SIGKILL only past the grace window.
+
+The fleet observability plane (``--obs-dir``, default ``pio_obs``) also
+lives here: worker stderr/stdout captured into per-replica rotating tail
+files (:mod:`.worklog`), a durable telemetry ring the gateway appends
+fleet snapshots into (:mod:`obs.tsring`), and the incident flight
+recorder (:mod:`obs.incidents`) whose sources — merged traces, ring
+tail, supervisor ladder, registry state — are wired up so a worker
+crash, breaker trip, or fleet SLO alert leaves an inspectable bundle
+(``pio incidents list``).
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import signal
 import subprocess
 import sys
@@ -26,7 +36,10 @@ from predictionio_tpu.fleet.supervisor import (
     SupervisorConfig,
     WorkerSpec,
 )
+from predictionio_tpu.fleet.worklog import WorkerLogBook, spawn_with_log
+from predictionio_tpu.obs.incidents import IncidentRecorder
 from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tsring import TelemetryRing
 
 logger = logging.getLogger(__name__)
 
@@ -38,6 +51,7 @@ _STRIP_FLAGS = {
     "--ip": True,
     "--fleet-probe-interval": True,
     "--registry-sync-interval": True,
+    "--obs-dir": True,
 }
 
 
@@ -97,13 +111,26 @@ def run_fleet(args, cli_argv: list[str]) -> int:
         WorkerSpec(name=f"w{i}", port=args.port + 1 + i) for i in range(n)
     ]
     metrics = MetricsRegistry()
+    obs = build_obs_plane(
+        getattr(args, "obs_dir", "pio_obs"),
+        metrics,
+        registry_dir=getattr(args, "registry_dir", None),
+    )
+    logbook = obs.get("logbook")
+
+    def spawn(spec: WorkerSpec):
+        argv = worker_argv(cli_argv, spec.port, sync_s)
+        if logbook is not None:
+            return spawn_with_log(argv, logbook, spec.name)
+        return subprocess.Popen(argv)
+
     supervisor = Supervisor(
-        spawn=lambda spec: subprocess.Popen(
-            worker_argv(cli_argv, spec.port, sync_s)
-        ),
+        spawn=spawn,
         specs=specs,
         config=SupervisorConfig(),
         metrics=metrics,
+        logbook=logbook,
+        on_crash=obs.get("on_crash"),
     )
     gateway = Gateway(
         GatewayConfig(
@@ -117,7 +144,10 @@ def run_fleet(args, cli_argv: list[str]) -> int:
             sticky_key_field=args.sticky_key,
         ),
         metrics=metrics,  # one registry: supervisor counters federate too
+        telemetry=obs.get("telemetry"),
+        incidents=obs.get("incidents"),
     )
+    wire_incident_sources(obs.get("incidents"), gateway, supervisor)
 
     async def main() -> None:
         supervisor.start()
@@ -140,8 +170,96 @@ def run_fleet(args, cli_argv: list[str]) -> int:
         f"Fleet gateway starting on {args.ip}:{args.port} "
         f"({n} workers on ports {specs[0].port}-{specs[-1].port}) ..."
     )
-    asyncio.run(main())
+    if obs.get("dir"):
+        print(
+            f"Fleet flight recorder in {obs['dir']} "
+            "(telemetry ring, worker logs, incident bundles; "
+            "`pio incidents list`, `pio top --history`)"
+        )
+    try:
+        asyncio.run(main())
+    finally:
+        ring = obs.get("telemetry")
+        if ring is not None:
+            ring.close()
     return 0
 
 
-__all__ = ["run_fleet", "worker_argv"]
+def build_obs_plane(
+    obs_dir: str | None,
+    metrics: MetricsRegistry,
+    registry_dir: str | None = None,
+) -> dict:
+    """The fleet flight-recorder wiring: worker logbook, telemetry ring,
+    incident recorder (all under ``obs_dir``; empty/None disables).
+    Returns the pieces keyed by role plus the supervisor ``on_crash``
+    hook. Split out of :func:`run_fleet` so tests and the chaos e2e can
+    assemble the identical plane around in-process fleets."""
+    if not obs_dir:
+        return {}
+    obs_dir = os.path.abspath(obs_dir)
+    logbook = WorkerLogBook(os.path.join(obs_dir, "logs"))
+    telemetry = TelemetryRing(os.path.join(obs_dir, "telemetry"))
+    incidents = IncidentRecorder(
+        os.path.join(obs_dir, "incidents"), metrics=metrics
+    )
+    if registry_dir:
+
+        def registry_state() -> dict:
+            # lazy import: the launcher must not pay the registry import
+            # unless an incident actually captures
+            from predictionio_tpu.registry.store import ArtifactStore
+
+            store = ArtifactStore(registry_dir)
+            out: dict = {}
+            for engine_key in store.engines():
+                state = store.state_by_key(engine_key)
+                out[engine_key] = {
+                    "generation": state.generation,
+                    "stable": state.stable,
+                    "candidate": state.candidate,
+                    "mode": state.mode,
+                    "fraction": state.fraction,
+                }
+            return out
+
+        incidents.add_source("registry", registry_state)
+    incidents.add_source(
+        "telemetry", lambda: telemetry.tail(120)
+    )
+
+    def on_crash(info: dict) -> None:
+        texts = {}
+        tail = info.pop("stderrTail", None)
+        if tail:
+            texts["stderr_tail"] = tail
+        incidents.trigger(
+            "worker-park" if info.get("parked") else "worker-crash",
+            context=info,
+            texts=texts,
+        )
+
+    return {
+        "dir": obs_dir,
+        "logbook": logbook,
+        "telemetry": telemetry,
+        "incidents": incidents,
+        "on_crash": on_crash,
+    }
+
+
+def wire_incident_sources(
+    incidents, gateway: Gateway, supervisor: Supervisor
+) -> None:
+    """Attach the live-state evidence sources once both tiers exist: the
+    gateway's merged trace snapshot (its own ring + the per-tick replica
+    caches — a SIGKILLed worker's final spans survive in the cache) and
+    the supervisor's restart ladder."""
+    if incidents is None:
+        return
+    incidents.add_source("traces", lambda: gateway.cached_spans()[:400])
+    incidents.add_source("fleet", gateway.fleet_snapshot)
+    incidents.add_source("supervisor", supervisor.snapshot)
+
+
+__all__ = ["build_obs_plane", "run_fleet", "wire_incident_sources", "worker_argv"]

@@ -13,6 +13,13 @@ gateway simply keeps routing around the missing replica.
 The process handle and the clock are injectable so the restart policy is
 unit-testable without real processes or real sleeping; production use
 passes a ``subprocess.Popen`` factory (see ``fleet/launch.py``).
+
+With a :class:`~predictionio_tpu.fleet.worklog.WorkerLogBook` attached,
+every crash captures the worker's stderr/stdout tail (the spawn factory
+routes the child's fds into the logbook — see ``worklog.spawn_with_log``)
+and hands it to the ``on_crash`` hook, which the fleet launcher wires to
+the incident flight recorder: a SIGKILLed or crash-looping replica
+leaves an inspectable bundle, not a silent restart counter.
 """
 
 from __future__ import annotations
@@ -22,6 +29,7 @@ import logging
 import time
 from typing import Any, Callable, Protocol
 
+from predictionio_tpu.fleet.worklog import WorkerLogBook
 from predictionio_tpu.obs.metrics import MetricsRegistry
 
 logger = logging.getLogger(__name__)
@@ -106,12 +114,19 @@ class Supervisor:
         config: SupervisorConfig | None = None,
         metrics: MetricsRegistry | None = None,
         clock: Callable[[], float] = time.monotonic,
+        logbook: WorkerLogBook | None = None,
+        on_crash: Callable[[dict[str, Any]], None] | None = None,
     ):
         self._spawn = spawn
         self.config = config or SupervisorConfig()
         self._clock = clock
         self._workers = [_Worker(spec) for spec in specs]
         self._stopping = False
+        # crash evidence plumbing: the logbook tails the dead worker's
+        # captured output, on_crash (the incident-recorder hook) gets one
+        # dict per exit with the tail attached
+        self.logbook = logbook
+        self._on_crash = on_crash
         m = metrics or MetricsRegistry()
         self.metrics = m
         self._m_restarts = m.counter(
@@ -134,6 +149,25 @@ class Supervisor:
             "1 when the worker exceeded its crash-loop budget and was parked",
             labelnames=("replica",),
         )
+        self._m_last_crash = m.gauge(
+            "pio_fleet_worker_last_crash_unix",
+            "unix time of the worker's most recent exit (0 = never crashed)",
+            labelnames=("replica",),
+        )
+        self._m_log_info = m.gauge(
+            "pio_fleet_worker_log_info",
+            "1 per worker whose output is captured; the `path` label is "
+            "where the rotating tail lives (`pio top --fleet` shows it "
+            "for crashed workers)",
+            labelnames=("replica", "path"),
+        )
+        if self.logbook is not None:
+            for w in self._workers:
+                self._m_log_info.set(
+                    1.0,
+                    replica=w.spec.name,
+                    path=self.logbook.path(w.spec.name),
+                )
         m.register_collector(self._collect)
 
     # ------------------------------------------------------------- lifecycle
@@ -186,13 +220,14 @@ class Supervisor:
                 "worker %s (port %d) exited rc=%s", w.spec.name, w.spec.port, rc
             )
             w.proc = None
-            self._record_crash(w)
+            self._record_crash(w, rc=rc)
 
-    def _record_crash(self, w: _Worker) -> None:
+    def _record_crash(self, w: _Worker, rc: int | None = None) -> None:
         now = self._clock()
         w.crash_times.append(now)
         cutoff = now - self.config.crash_loop_window_s
         w.crash_times = [t for t in w.crash_times if t >= cutoff]
+        self._m_last_crash.set(time.time(), replica=w.spec.name)
         if len(w.crash_times) > self.config.crash_loop_budget:
             w.parked = True
             self._m_crash_loops.inc(replica=w.spec.name)
@@ -204,6 +239,7 @@ class Supervisor:
                 self.config.crash_loop_window_s,
                 self.config.crash_loop_budget,
             )
+            self._notify_crash(w, rc, parked=True)
             return
         backoff = min(
             self.config.backoff_max_s,
@@ -218,6 +254,30 @@ class Supervisor:
             backoff,
             w.consecutive_crashes,
         )
+        self._notify_crash(w, rc, parked=False)
+
+    def _notify_crash(self, w: _Worker, rc: int | None, parked: bool) -> None:
+        """Hand the crash (with the dead worker's captured stderr tail)
+        to the on_crash hook — the incident-recorder wiring. Guarded: the
+        flight recorder failing must never stall the restart policy."""
+        if self._on_crash is None:
+            return
+        info: dict[str, Any] = {
+            "replica": w.spec.name,
+            "port": w.spec.port,
+            "rc": rc,
+            "parked": parked,
+            "restarts": w.restarts,
+            "consecutiveCrashes": w.consecutive_crashes,
+            "crashesInWindow": len(w.crash_times),
+        }
+        if self.logbook is not None:
+            info["logPath"] = self.logbook.path(w.spec.name)
+            info["stderrTail"] = self.logbook.tail(w.spec.name)
+        try:
+            self._on_crash(info)
+        except Exception:
+            logger.exception("on_crash hook failed for %s", w.spec.name)
 
     async def run(self) -> None:
         """Asyncio driver for :meth:`tick` (process polls are non-blocking,
@@ -273,6 +333,11 @@ class Supervisor:
                 "parked": w.parked,
                 "restarts": w.restarts,
                 "consecutiveCrashes": w.consecutive_crashes,
+                "logPath": (
+                    self.logbook.path(w.spec.name)
+                    if self.logbook is not None
+                    else None
+                ),
             }
             for w in self._workers
         ]

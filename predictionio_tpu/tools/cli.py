@@ -588,9 +588,22 @@ def cmd_top(args) -> int:
     ``--fleet`` points it at a fleet gateway's federated /metrics (the
     fleet line renders automatically when pio_fleet_* metrics exist);
     repeated ``--metrics-url`` polls several endpoints per refresh —
-    with ``--json``, one object per endpoint per refresh."""
-    from predictionio_tpu.tools.top import run_top
+    with ``--json``, one object per endpoint per refresh. ``--history``
+    renders the telemetry ring's queue-depth/burn series instead: from
+    the gateway's ``/telemetry/window`` endpoint, or straight off the
+    on-disk ring (``--obs-dir``) when the gateway is down."""
+    from predictionio_tpu.tools.top import run_history, run_top
 
+    if args.history:
+        url = args.url if (args.fleet or args.url != _TOP_DEFAULT_URL) else None
+        if args.obs_dir is None and url is None:
+            url = args.url  # default gateway address is still worth a try
+        return run_history(
+            url=url,
+            obs_dir=args.obs_dir,
+            window_s=args.history_window,
+            json_mode=args.json,
+        )
     iterations = 1 if args.once else args.iterations
     # --metrics-url endpoints poll IN ADDITION to a --url the operator
     # actually pointed somewhere (the flag's "too"): replicas scrape
@@ -610,6 +623,75 @@ def cmd_top(args) -> int:
         json_mode=args.json,
         urls=urls or None,
     )
+
+
+def _incidents_dir(args) -> str:
+    return os.path.join(args.obs_dir, "incidents")
+
+
+def cmd_incidents_list(args) -> int:
+    """Incident bundles captured by the fleet flight recorder
+    (docs/observability.md §Incident flight recorder)."""
+    from predictionio_tpu.obs.incidents import list_bundles
+
+    refs = list_bundles(_incidents_dir(args))
+    if not refs:
+        print(
+            f"No incident bundles under {_incidents_dir(args)} "
+            "(fleet deploys write them on worker crash / breaker trip / "
+            "SLO alert; --obs-dir points elsewhere)"
+        )
+        return 0
+    print(f"Incidents: {_incidents_dir(args)}")
+    print(f"{'Bundle':<30} | {'Trigger':<14} | Captured")
+    import time as _time
+
+    for ref in refs:
+        when = _time.strftime(
+            "%Y-%m-%d %H:%M:%S", _time.localtime(ref.captured_at)
+        )
+        print(f"{ref.bundle_id:<30} | {ref.trigger:<14} | {when}")
+    return 0
+
+
+def cmd_incidents_show(args) -> int:
+    from predictionio_tpu.obs.incidents import load_bundle
+
+    try:
+        bundle = load_bundle(_incidents_dir(args), args.bundle)
+    except (FileNotFoundError, ValueError) as exc:
+        return _die(str(exc))
+    if args.json:
+        print(json.dumps(bundle, indent=2, sort_keys=True, default=repr))
+        return 0
+    manifest = bundle["manifest"]
+    print(f"trigger   {manifest.get('trigger')}")
+    print(f"captured  {manifest.get('capturedAt')}")
+    print(f"sha256    {manifest.get('sha256')}")
+    context = manifest.get("context") or {}
+    if context:
+        print("context   " + json.dumps(context, sort_keys=True))
+    for name, part in sorted(bundle["parts"].items()):
+        size = len(json.dumps(part))
+        print(f"part      {name}.json ({size} bytes)")
+    for name, text in sorted(bundle["texts"].items()):
+        print(f"text      {name}.txt ({len(text)} bytes)")
+        n = max(0, args.tail_lines)
+        tail = text.strip().splitlines()[-n:] if n else []
+        for line in tail:
+            print(f"  | {line}")
+    return 0
+
+
+def cmd_incidents_export(args) -> int:
+    from predictionio_tpu.obs.incidents import export_bundle
+
+    try:
+        dest = export_bundle(_incidents_dir(args), args.bundle, args.dest)
+    except (FileNotFoundError, ValueError, OSError) as exc:
+        return _die(str(exc))
+    print(f"Exported to {dest}")
+    return 0
 
 
 def cmd_status(args) -> int:
@@ -1539,6 +1621,14 @@ def build_parser() -> argparse.ArgumentParser:
         "a dead replica is ejected)",
     )
     x.add_argument(
+        "--obs-dir",
+        default="pio_obs",
+        help="fleet flight-recorder directory: worker log tails, the "
+        "durable telemetry ring (`pio top --history`), and incident "
+        "bundles (`pio incidents list`); '' disables "
+        "(docs/observability.md)",
+    )
+    x.add_argument(
         "--registry-sync-interval",
         type=float,
         default=None,
@@ -1662,7 +1752,60 @@ def build_parser() -> argparse.ArgumentParser:
         help="fleet mode: point --url at a `pio deploy --fleet` gateway; "
         "the per-replica fleet line renders from its federated /metrics",
     )
+    x.add_argument(
+        "--history",
+        action="store_true",
+        help="render the fleet telemetry ring's queue-depth/burn/health "
+        "series (one shot): from the gateway's /telemetry/window, or "
+        "straight off the on-disk ring via --obs-dir when the gateway "
+        "is down (the ring survives the process)",
+    )
+    x.add_argument(
+        "--history-window",
+        type=float,
+        default=600.0,
+        metavar="S",
+        help="trailing seconds of telemetry to render (default 600)",
+    )
+    x.add_argument(
+        "--obs-dir",
+        default=None,
+        help="read the telemetry ring from this fleet obs directory "
+        "instead of over HTTP (pairs with --history)",
+    )
     x.set_defaults(fn=cmd_top)
+
+    inc = sub.add_parser(
+        "incidents",
+        help="inspect incident bundles captured by the fleet flight "
+        "recorder (worker crash, breaker trip, SLO alert; "
+        "docs/observability.md)",
+    ).add_subparsers(dest="subcommand", required=True)
+    x = inc.add_parser("list", help="bundles oldest first")
+    x.add_argument(
+        "--obs-dir",
+        default="pio_obs",
+        help="fleet observability directory (`pio deploy --fleet --obs-dir`)",
+    )
+    x.set_defaults(fn=cmd_incidents_list)
+    x = inc.add_parser(
+        "show", help="manifest, parts, and the stderr tail of one bundle"
+    )
+    x.add_argument("bundle", help="bundle id (unique prefix accepted)")
+    x.add_argument("--obs-dir", default="pio_obs")
+    x.add_argument("--json", action="store_true", help="full bundle as JSON")
+    x.add_argument(
+        "--tail-lines",
+        type=int,
+        default=20,
+        help="stderr-tail lines to print (default 20)",
+    )
+    x.set_defaults(fn=cmd_incidents_show)
+    x = inc.add_parser("export", help="copy one bundle somewhere shippable")
+    x.add_argument("bundle", help="bundle id (unique prefix accepted)")
+    x.add_argument("dest", help="destination directory")
+    x.add_argument("--obs-dir", default="pio_obs")
+    x.set_defaults(fn=cmd_incidents_export)
 
     x = sub.add_parser(
         "doctor",

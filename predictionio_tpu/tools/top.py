@@ -16,6 +16,7 @@ Prometheus-format endpoint.
 
 from __future__ import annotations
 
+import os
 import re
 import sys
 import time
@@ -370,11 +371,19 @@ def _fleet_summary(metrics: Metrics) -> dict[str, Any] | None:
         ("pio_fleet_replica_inflight", "inflight", float),
         ("pio_fleet_ejections_total", "ejections", float),
         ("pio_fleet_readmissions_total", "readmissions", float),
+        ("pio_fleet_worker_last_crash_unix", "last_crash_unix", float),
     ):
         for labels, v in metrics.get(name, ()):
             rep = labels.get("replica")
             if rep:
                 replicas.setdefault(rep, {})[field] = cast(v)
+    # the captured-log path rides an info gauge (bounded: one series per
+    # replica); `pio top --fleet` shows it for workers that have crashed,
+    # so the excerpt feeding the incident bundle is one `tail` away
+    for labels, v in metrics.get("pio_fleet_worker_log_info", ()):
+        rep = labels.get("replica")
+        if rep and v > 0 and labels.get("path"):
+            replicas.setdefault(rep, {})["log_path"] = labels["path"]
     up = sum(1 for info in replicas.values() if info.get("up"))
     return {
         "replicas_total": _total(metrics, "pio_fleet_replicas")
@@ -578,6 +587,12 @@ def render(summary: dict[str, Any], url: str) -> str:
     if fleet is not None:
         parts = []
         for rep, info in sorted((fleet.get("replicas") or {}).items()):
+            if "up" not in info:
+                # supervisor-side series (crash time, log path) use the
+                # worker NAME as the replica label; without probe state
+                # they are not routing targets — the crash line below
+                # renders them, a phantom [DOWN] entry here would not
+                continue
             state = "up" if info.get("up") else "DOWN"
             inflight = info.get("inflight")
             tag = f"{rep}[{state}"
@@ -601,6 +616,15 @@ def render(summary: dict[str, Any], url: str) -> str:
         if fleet.get("gateway_p50_ms"):
             line += f"   gw p50 {fleet['gateway_p50_ms']:.2f} ms"
         lines.append(line)
+        for rep, info in sorted((fleet.get("replicas") or {}).items()):
+            # the last-crash excerpt: which replica died and where its
+            # captured stderr tail lives (the incident bundle's source)
+            if info.get("last_crash_unix") and info.get("log_path"):
+                lines.append(
+                    f"  crash      {rep} last "
+                    f"{time.strftime('%H:%M:%S', time.localtime(info['last_crash_unix']))}"
+                    f"   log {info['log_path']}"
+                )
     if summary.get("events_ingested"):
         lines.append(f"  ingested   {num(summary['events_ingested']):>12}")
     return "\n".join(lines)
@@ -609,6 +633,140 @@ def render(summary: dict[str, Any], url: str) -> str:
 def fetch_metrics(url: str, timeout_s: float = 5.0) -> str:
     with urllib.request.urlopen(f"{url}/metrics", timeout=timeout_s) as resp:
         return resp.read().decode("utf-8", errors="replace")
+
+
+# ---------------------------------------------------------------------------
+# --history: the telemetry ring rendered as series
+# ---------------------------------------------------------------------------
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float], width: int = 60) -> str:
+    """Downsample to ``width`` columns and render with block glyphs;
+    empty input renders as '-'. Scaled to the series max (min pinned at
+    0 — queue depth and burn are magnitudes, not deltas)."""
+    if not values:
+        return "-"
+    if len(values) > width:
+        # mean-pool into width buckets so a spike several records wide
+        # survives; a single-record spike still lands in some bucket
+        step = len(values) / width
+        pooled = []
+        for i in range(width):
+            lo, hi = int(i * step), max(int(i * step) + 1, int((i + 1) * step))
+            chunk = values[lo:hi]
+            pooled.append(sum(chunk) / len(chunk))
+        values = pooled
+    top = max(values)
+    if top <= 0:
+        return _SPARK_BLOCKS[0] * len(values)
+    out = []
+    for v in values:
+        idx = int((max(0.0, v) / top) * (len(_SPARK_BLOCKS) - 1) + 0.5)
+        out.append(_SPARK_BLOCKS[min(idx, len(_SPARK_BLOCKS) - 1)])
+    return "".join(out)
+
+
+def render_history(records: list[dict[str, Any]], window_s: float) -> str:
+    """The ``pio top --history`` screen: queue-depth / inflight / burn /
+    shed series from the telemetry ring's snapshot records, oldest on
+    the left. Works identically whether the records came over HTTP
+    (``GET /telemetry/window``) or straight off the on-disk ring — the
+    ring surviving a gateway restart is the whole point."""
+    if not records:
+        return "pio top --history: no telemetry records in the window"
+    t0 = float(records[0].get("t", 0.0))
+    t1 = float(records[-1].get("t", t0))
+    queue = [float(r.get("gauges", {}).get("queue_depth", 0.0)) for r in records]
+    inflight = [float(r.get("gauges", {}).get("inflight", 0.0)) for r in records]
+    shed = [float(r.get("counters", {}).get("no_replica", 0.0)) for r in records]
+    healthy = [
+        float(sum(1 for rep in r.get("replicas", {}).values() if rep.get("healthy")))
+        for r in records
+    ]
+    # fast-window burn per SLO: the series the ROADMAP-2 autoscaler reads
+    burns: dict[str, list[float]] = {}
+    alerts = 0
+    for r in records:
+        for name, state in (r.get("slo") or {}).items():
+            burn = state.get("burn") or {}
+            fast = min(burn, key=float, default=None)
+            burns.setdefault(name, []).append(
+                float(burn.get(fast, 0.0)) if fast is not None else 0.0
+            )
+            if state.get("alerting"):
+                alerts += 1
+    lines = [
+        f"pio top --history — {len(records)} snapshots over "
+        f"{max(0.0, t1 - t0):.0f}s (window {window_s:.0f}s)   "
+        f"{time.strftime('%H:%M:%S', time.localtime(t0))} → "
+        f"{time.strftime('%H:%M:%S', time.localtime(t1))}",
+        "",
+        f"  queue      {sparkline(queue)}  max {format_number(max(queue))}",
+        f"  inflight   {sparkline(inflight)}  max {format_number(max(inflight))}",
+        f"  healthy    {sparkline(healthy)}  "
+        f"min {format_number(min(healthy) if healthy else 0)}",
+        f"  shed Σ     {sparkline(shed)}  last {format_number(shed[-1])}",
+    ]
+    for name, series in sorted(burns.items()):
+        lines.append(
+            f"  burn {name[:20]:<20} {sparkline(series, width=40)}  "
+            f"last {series[-1]:.2f}"
+        )
+    if alerts:
+        lines.append(f"  ALERTING in {alerts} snapshot(s)")
+    return "\n".join(lines)
+
+
+def fetch_telemetry_window(
+    url: str, window_s: float, timeout_s: float = 5.0
+) -> list[dict[str, Any]]:
+    import json as _json
+
+    with urllib.request.urlopen(
+        f"{url}/telemetry/window?s={window_s:g}", timeout=timeout_s
+    ) as resp:
+        data = _json.loads(resp.read().decode("utf-8", errors="replace"))
+    return data.get("records", [])
+
+
+def run_history(
+    url: str | None = None,
+    obs_dir: str | None = None,
+    window_s: float = 600.0,
+    json_mode: bool = False,
+    out: Callable[[str], None] = print,
+) -> int:
+    """One-shot history screen, from the gateway's
+    ``/telemetry/window`` endpoint (``--url``) or straight off an
+    on-disk ring directory (``--obs-dir``, for when the gateway is down
+    — the forensic case the ring exists for)."""
+    import json as _json
+
+    try:
+        if obs_dir:
+            ring_dir = os.path.join(obs_dir, "telemetry")
+            if not os.path.isdir(ring_dir):
+                # read path must not mkdir a typo'd --obs-dir into being
+                out(f"pio top --history: no telemetry ring at {ring_dir}")
+                return 1
+            from predictionio_tpu.obs.tsring import TelemetryRing
+
+            records = TelemetryRing(ring_dir).window(window_s)
+        elif url:
+            records = fetch_telemetry_window(url, window_s)
+        else:
+            out("pio top --history needs --url or --obs-dir")
+            return 2
+    except Exception as exc:
+        out(f"pio top --history: telemetry unavailable ({exc})")
+        return 1
+    if json_mode:
+        out(_json.dumps({"window_s": window_s, "records": records}))
+    else:
+        out(render_history(records, window_s))
+    return 0
 
 
 def run_top(

@@ -4,14 +4,21 @@ Self-contained (no training, no shared storage): each worker is THIS
 script in ``--worker`` mode serving the recommendation engine over
 random factors — latency/availability smoke only, model quality is the
 bench's job. The orchestrator spawns the workers under the fleet
-supervisor, fronts them with the gateway, then:
+supervisor (with the flight-recorder plane attached: worker log
+capture, telemetry ring, incident recorder), fronts them with the
+gateway, then:
 
 1. proves the fleet answers through the gateway;
 2. SIGKILLs one worker and asserts the gateway KEEPS answering
    (ejection + failover, zero client-visible failures);
 3. asserts ``pio top --fleet`` renders the fleet line from the
    gateway's federated /metrics;
-4. waits for the supervisor restart + gateway readmission.
+4. waits for the supervisor restart + gateway readmission;
+5. **incident-bundle smoke** (ISSUE 11): the kill must have produced an
+   incident bundle containing the dead worker's captured stderr tail
+   AND a merged gateway+replica trace for an affected request — the
+   flight recorder is CI-proven on every run, not only in the slow
+   chaos suite.
 
 Exit 0 = all held; any assertion exits nonzero and fails CI.
 """
@@ -24,6 +31,7 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -100,11 +108,15 @@ def worker_main(port: int) -> int:
             pass
         await server.run_until_stopped()
 
+    # stderr breadcrumb: captured by the supervisor's logbook so a
+    # SIGKILLed worker still leaves a tail for the incident bundle
+    print(f"fleet smoke worker serving on 127.0.0.1:{port}",
+          file=sys.stderr, flush=True)
     asyncio.run(run())
     return 0
 
 
-async def orchestrate() -> int:
+async def orchestrate(obs_dir: str) -> int:
     import aiohttp
 
     from predictionio_tpu.fleet import (
@@ -114,24 +126,34 @@ async def orchestrate() -> int:
         SupervisorConfig,
         WorkerSpec,
     )
+    from predictionio_tpu.fleet.launch import (
+        build_obs_plane,
+        wire_incident_sources,
+    )
+    from predictionio_tpu.fleet.worklog import spawn_with_log
     from predictionio_tpu.obs.metrics import MetricsRegistry
 
     specs = [WorkerSpec(f"w{i}", _free_port()) for i in range(2)]
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    metrics = MetricsRegistry()
+    obs = build_obs_plane(obs_dir, metrics)
 
     def spawn(spec):
-        return subprocess.Popen(
+        return spawn_with_log(
             [sys.executable, os.path.abspath(__file__), "--worker", str(spec.port)],
+            obs["logbook"],
+            spec.name,
             env=env,
             cwd=REPO,
         )
 
-    metrics = MetricsRegistry()
     sup = Supervisor(
         spawn,
         specs,
         SupervisorConfig(poll_interval_s=0.1, backoff_base_s=0.2, term_grace_s=8.0),
         metrics=metrics,
+        logbook=obs["logbook"],
+        on_crash=obs["on_crash"],
     )
     gw_port = _free_port()
     gw = Gateway(
@@ -142,9 +164,13 @@ async def orchestrate() -> int:
             probe_interval_s=0.2,
             probe_timeout_s=1.0,
             request_timeout_s=8.0,
+            telemetry_interval_s=0.2,
         ),
         metrics=metrics,
+        telemetry=obs["telemetry"],
+        incidents=obs["incidents"],
     )
+    wire_incident_sources(obs["incidents"], gw, sup)
     gw_url = f"http://127.0.0.1:{gw_port}"
     sup.start()
     sup_task = asyncio.ensure_future(sup.run())
@@ -180,6 +206,9 @@ async def orchestrate() -> int:
         )
         for i in range(10):
             assert await query(i) == 200, "fleet did not answer pre-kill"
+        # let a telemetry tick fan-in the replicas' spans: the incident
+        # bundle must hold the VICTIM's spans after it is SIGKILLed
+        await asyncio.sleep(0.5)
         # 2. SIGKILL one worker; the gateway must keep answering
         victim = sup.snapshot()[1]
         os.kill(victim["pid"], signal.SIGKILL)
@@ -219,6 +248,28 @@ async def orchestrate() -> int:
             "restarted replica never readmitted",
             120.0,
         )
+        # 5. incident-bundle smoke (ISSUE 11): the kill left a bundle
+        # with the dead worker's stderr tail and a merged two-tier trace
+        from predictionio_tpu.obs.incidents import list_bundles, load_bundle
+
+        inc_dir = os.path.join(obs_dir, "incidents")
+        crash = [
+            r for r in list_bundles(inc_dir) if r.trigger == "worker-crash"
+        ]
+        assert crash, "SIGKILL produced no worker-crash incident bundle"
+        bundle = load_bundle(inc_dir, crash[0].bundle_id)
+        tail = bundle["texts"].get("stderr_tail", "")
+        assert "fleet smoke worker serving" in tail, (
+            f"bundle missing the dead worker's stderr tail: {tail!r}"
+        )
+        tiers_by_tid: dict = {}
+        for s in bundle["parts"]["traces"]:
+            tiers_by_tid.setdefault(s.get("traceId"), set()).add(
+                "gateway" if s.get("source") == "gateway" else "replica"
+            )
+        assert any(
+            t == {"gateway", "replica"} for t in tiers_by_tid.values()
+        ), "no merged gateway+replica trace in the incident bundle"
         print(
             json.dumps(
                 {
@@ -227,6 +278,9 @@ async def orchestrate() -> int:
                     "killed": victim["name"],
                     "restarts": sup.snapshot()[1]["restarts"],
                     "top_screen_has_fleet_line": True,
+                    "incident_bundle": crash[0].bundle_id,
+                    "incident_has_stderr_tail": True,
+                    "incident_has_merged_trace": True,
                 }
             )
         )
@@ -237,6 +291,7 @@ async def orchestrate() -> int:
         await session.close()
         await gw.stop()
         await asyncio.get_running_loop().run_in_executor(None, sup.stop)
+        obs["telemetry"].close()
 
 
 async def _is(fn, expect) -> bool:
@@ -246,7 +301,8 @@ async def _is(fn, expect) -> bool:
 def main() -> int:
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         return worker_main(int(sys.argv[2]))
-    return asyncio.run(orchestrate())
+    with tempfile.TemporaryDirectory(prefix="pio_fleet_smoke_obs_") as obs_dir:
+        return asyncio.run(orchestrate(obs_dir))
 
 
 if __name__ == "__main__":

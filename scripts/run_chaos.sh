@@ -15,7 +15,14 @@
 # slow-marked e2e) runs REAL worker processes behind the fleet gateway
 # under load, SIGKILLs one mid-bake, and asserts zero 5xx on the stable
 # lane, ejection within the probe interval, supervisor restart +
-# readmission, and bake-gate convergence.
+# readmission, and bake-gate convergence — AND (ISSUE 11) that the kill
+# leaves a full incident bundle: the dead worker's stderr tail, a merged
+# gateway+replica trace, the telemetry-ring window covering the kill,
+# and the registry generation at trigger time. The flight-recorder
+# stage (tests/test_flightrec.py) covers the plane itself: telemetry
+# ring rotation/resume, incident capture mechanics, cross-tier span
+# merging with the dead-replica cache, and trace-id continuity through
+# a gateway retry down to a storage span.
 # See docs/resilience.md, docs/observability.md, docs/model_registry.md,
 # docs/streaming.md, docs/fleet.md.
 # Usage: scripts/run_chaos.sh [extra pytest args...]
@@ -26,5 +33,5 @@ cd "$repo_root"
 
 exec env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_resilience.py tests/test_obs.py tests/test_registry.py \
-  tests/test_stream.py tests/test_fleet.py -q \
+  tests/test_stream.py tests/test_fleet.py tests/test_flightrec.py -q \
   -p no:cacheprovider "$@"

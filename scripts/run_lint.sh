@@ -162,13 +162,16 @@ with tempfile.TemporaryDirectory() as d:
           f"exact fallback answers")
 PYEOF
 
-  # --- fleet smoke (ISSUE 9, docs/fleet.md): 2 workers + gateway, kill
-  #     one — the gateway must keep answering (ejection + failover) and
-  #     `pio top --fleet` must render from the federated /metrics. The
-  #     full kill-mid-ROLLOUT chaos stage lives in tests/test_fleet.py
-  #     (run by the chaos gate below); this is the fast availability rail.
+  # --- fleet smoke (ISSUEs 9+11, docs/fleet.md): 2 workers + gateway,
+  #     kill one — the gateway must keep answering (ejection + failover),
+  #     `pio top --fleet` must render from the federated /metrics, AND
+  #     the flight recorder must capture the kill: an incident bundle
+  #     with the dead worker's stderr tail and a merged gateway+replica
+  #     trace (the incident-bundle smoke). The full kill-mid-ROLLOUT
+  #     chaos stage lives in tests/test_fleet.py (run by the chaos gate
+  #     below); this is the fast availability+evidence rail.
   env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
-  echo "fleet smoke: gateway survives replica kill, pio top --fleet renders"
+  echo "fleet smoke: gateway survives replica kill, pio top --fleet renders, incident bundle captured"
 
   # chaos gate includes the observability suite (tests/test_obs.py):
   # counters moving under faults + trace propagation are CI-asserted

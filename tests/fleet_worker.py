@@ -55,6 +55,15 @@ def main() -> int:
     server = _query_server_from_registry(
         _mk_engine(), _engine_manifest(), store, state.stable, storage, config
     )
+    # operational stderr breadcrumb: when the supervisor's logbook
+    # captures this worker's output, a SIGKILLed process still leaves a
+    # tail for the incident bundle (the chaos e2e asserts it)
+    print(
+        f"fleet worker serving on 127.0.0.1:{port} "
+        f"(stable {state.stable})",
+        file=sys.stderr,
+        flush=True,
+    )
 
     async def run() -> None:
         loop = asyncio.get_running_loop()

@@ -117,7 +117,36 @@ def test_fully_crashed_run_is_rc1(monkeypatch, capsys):
         },
     )
     assert rc == 1
-    assert out["value"] is None and out["vs_baseline"] is None
+    # evidence semantics (ROADMAP item 5): the headline metric is absent,
+    # so vs_baseline is OMITTED — a null-paired ratio would invite a
+    # reader to rate a measurement that never happened
+    assert out["value"] is None
+    assert "vs_baseline" not in out
+
+
+def test_gateway_hop_fields_omitted_never_null(monkeypatch, capsys):
+    """serving_gateway_* evidence is omit-on-absence too: a failed hop
+    probe must leave NO gateway keys (not null-paired ones) while a
+    successful serving phase that happened to null one is scrubbed."""
+    rc, out = _run_main(
+        monkeypatch,
+        capsys,
+        {
+            "als": ({}, "boom"),
+            "serving": (
+                {
+                    "serving_e2e_p50_ms": 5.0,
+                    # simulated mispairing: a null hop next to a real p50
+                    "serving_gateway_hop_p50_ms": None,
+                },
+                None,
+            ),
+            "twotower": ({}, "boom"),
+            "secondary": ({}, "boom"),
+        },
+    )
+    assert out["vs_baseline"] == 0.5  # headline present -> ratio present
+    assert "serving_gateway_hop_p50_ms" not in out
 
 
 def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):

@@ -94,6 +94,47 @@ class TestFederation:
         merged = federate_metrics(["only_a 1\n", "only_b 2\n"])
         assert "only_a 1" in merged and "only_b 2" in merged
 
+    def test_openmetrics_exemplars_and_eof_never_corrupt_the_sum(self):
+        """A replica scraped with ?exemplars=1 decorates bucket lines
+        with ` # {trace_id=...} v` and ends with `# EOF` — the merge must
+        strip both from the VALUE math (the same " # " split `pio top`
+        uses), or the series-wise sum silently corrupts."""
+        a = (
+            "# TYPE pio_phase_seconds histogram\n"
+            'pio_phase_seconds_bucket{le="0.01",phase="fetch"} 5'
+            ' # {trace_id="aaa"} 0.003\n'
+            'pio_phase_seconds_bucket{le="+Inf",phase="fetch"} 7'
+            ' # {trace_id="bbb"} 0.2\n'
+            'pio_phase_seconds_sum{phase="fetch"} 0.5\n'
+            'pio_phase_seconds_count{phase="fetch"} 7\n'
+            "# EOF\n"
+        )
+        b = a.replace(" 5 ", " 3 ").replace('"aaa"', '"ccc"')
+        merged = federate_metrics([a, b])
+        assert (
+            'pio_phase_seconds_bucket{le="0.01",phase="fetch"} 8' in merged
+        )
+        assert 'pio_phase_seconds_bucket{le="+Inf",phase="fetch"} 14' in merged
+        assert 'pio_phase_seconds_sum{phase="fetch"} 1' in merged
+        # plain merge stays strict v0.0.4: no clauses, no EOF
+        assert " # " not in merged and "# EOF" not in merged
+
+    def test_exemplar_clauses_carried_when_negotiated(self):
+        """With exemplars=True the clauses survive the merge (last input
+        wins per series) and the output is OpenMetrics-terminated — a
+        federated p99 exemplar still names a concrete trace id."""
+        a = (
+            'pio_phase_seconds_bucket{le="0.01",phase="fetch"} 5'
+            ' # {trace_id="aaa"} 0.003\n'
+        )
+        b = a.replace(" 5 ", " 3 ").replace('"aaa"', '"ccc"')
+        merged = federate_metrics([a, b], exemplars=True)
+        assert (
+            'pio_phase_seconds_bucket{le="0.01",phase="fetch"} 8'
+            ' # {trace_id="ccc"} 0.003' in merged
+        )
+        assert merged.rstrip().endswith("# EOF")
+
 
 # ---------------------------------------------------------------------------
 # gateway: fake replicas over real sockets
@@ -1087,7 +1128,14 @@ class TestKillMidRolloutE2E:
     worker while a canary bakes; the stable lane must never 5xx, the
     dead replica must be ejected within the probe window, the supervisor
     must restart and the gateway readmit it, and the bake gate must
-    still converge (promote) fleet-wide."""
+    still converge (promote) fleet-wide.
+
+    The fleet flight recorder rides the same chaos (ISSUE 11): the kill
+    must leave an incident bundle holding the dead worker's stderr tail,
+    a merged gateway+replica trace for an affected request, the
+    telemetry-ring window covering the kill, and the registry state (with
+    generation) at trigger time — and the on-disk ring must cover the
+    kill after the fact."""
 
     def test_kill_worker_mid_rollout(self, tmp_path):
         from predictionio_tpu.data.storage.registry import Storage
@@ -1120,8 +1168,18 @@ class TestKillMidRolloutE2E:
             "PIO_FS_BASEDIR": basedir,
         }
 
+        from predictionio_tpu.fleet.launch import (
+            build_obs_plane,
+            wire_incident_sources,
+        )
+        from predictionio_tpu.fleet.worklog import spawn_with_log
+
+        metrics = MetricsRegistry()
+        obs_dir = str(tmp_path / "obs")
+        obs = build_obs_plane(obs_dir, metrics, registry_dir=registry_dir)
+
         def spawn(spec):
-            return subprocess.Popen(
+            return spawn_with_log(
                 [
                     sys.executable,
                     os.path.join(REPO, "tests", "fleet_worker.py"),
@@ -1129,11 +1187,12 @@ class TestKillMidRolloutE2E:
                     str(spec.port),
                     basedir,
                 ],
+                obs["logbook"],
+                spec.name,
                 env=env,
                 cwd=REPO,
             )
 
-        metrics = MetricsRegistry()
         sup = Supervisor(
             spawn,
             specs,
@@ -1141,6 +1200,8 @@ class TestKillMidRolloutE2E:
                 poll_interval_s=0.1, backoff_base_s=0.2, term_grace_s=8.0
             ),
             metrics=metrics,
+            logbook=obs["logbook"],
+            on_crash=obs["on_crash"],
         )
         gw = Gateway(
             GatewayConfig(
@@ -1150,14 +1211,19 @@ class TestKillMidRolloutE2E:
                 probe_interval_s=0.2,
                 probe_timeout_s=1.0,
                 request_timeout_s=8.0,
+                telemetry_interval_s=0.2,
             ),
             metrics=metrics,
+            telemetry=obs["telemetry"],
+            incidents=obs["incidents"],
         )
+        wire_incident_sources(obs["incidents"], gw, sup)
         results: dict = {"statuses": [], "errors": [], "eject_s": None}
         try:
             asyncio.run(self._drive(sup, gw, store, results))
         finally:
             sup.stop()
+            obs["telemetry"].close()
         fivexx = [s for s in results["statuses"] if s >= 500]
         assert fivexx == [], (
             f"{len(fivexx)} 5xx under replica loss "
@@ -1168,6 +1234,53 @@ class TestKillMidRolloutE2E:
         assert len(results["statuses"]) > 50
         assert results["eject_s"] is not None and results["eject_s"] < 3.0
         assert store.get_state("regtest").stable == "v000002"
+        self._assert_flight_recorder_evidence(
+            obs_dir, results["t_kill_unix"], results["victim"]
+        )
+
+    def _assert_flight_recorder_evidence(
+        self, obs_dir, t_kill_unix, victim
+    ) -> None:
+        """ISSUE-11 acceptance: the SIGKILL left a full evidence chain."""
+        from predictionio_tpu.obs.incidents import list_bundles, load_bundle
+        from predictionio_tpu.obs.tsring import TelemetryRing
+
+        inc_dir = os.path.join(obs_dir, "incidents")
+        refs = list_bundles(inc_dir)
+        crash = [r for r in refs if r.trigger == "worker-crash"]
+        assert crash, f"no worker-crash bundle (got {[r.trigger for r in refs]})"
+        bundle = load_bundle(inc_dir, crash[0].bundle_id)
+        # 1. the dead worker's stderr tail
+        assert bundle["manifest"]["context"]["replica"] == victim
+        tail = bundle["texts"].get("stderr_tail", "")
+        assert "fleet worker serving" in tail, f"stderr tail missing: {tail!r}"
+        # 2. a merged gateway+replica trace for an affected request: some
+        # trace id must carry spans from BOTH tiers in the captured view
+        traces = bundle["parts"]["traces"]
+        by_tid: dict = {}
+        for s in traces:
+            by_tid.setdefault(s.get("traceId"), set()).add(
+                "gateway" if s.get("source") == "gateway" else "replica"
+            )
+        assert any(
+            tiers == {"gateway", "replica"} for tiers in by_tid.values()
+        ), "no trace with both tiers in the captured merge"
+        # 3. the telemetry-ring tail rode along and the on-disk ring's
+        # window covers the kill (records both before and after it)
+        assert bundle["parts"]["telemetry"], "no telemetry tail in bundle"
+        ring = TelemetryRing(os.path.join(obs_dir, "telemetry"))
+        times = [float(r["t"]) for r in ring.records()]
+        assert times and min(times) < t_kill_unix < max(times), (
+            "ring window does not cover the kill"
+        )
+        # 4. registry state with generation at trigger time
+        registry = bundle["parts"]["registry"]
+        assert any(
+            isinstance(v, dict) and v.get("generation", 0) >= 1
+            for v in registry.values()
+        ), registry
+        # 5. the supervisor ladder rode along
+        assert any(w["name"] == victim for w in bundle["parts"]["supervisor"])
 
     async def _drive(self, sup, gw, store, results) -> None:
         import aiohttp
@@ -1206,6 +1319,8 @@ class TestKillMidRolloutE2E:
             victim = sup.snapshot()[1]
             os.kill(victim["pid"], signal.SIGKILL)
             t_kill = time.monotonic()
+            results["victim"] = victim["name"]
+            results["t_kill_unix"] = time.time()
             await self._poll_async(
                 lambda: self._gw_healthy_count(session, gw_url, 1),
                 "dead replica never ejected",

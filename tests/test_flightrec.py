@@ -1,0 +1,990 @@
+"""Fleet flight recorder tests (ISSUE 11, docs/observability.md).
+
+Covers the three connected pieces at unit + integration tiers:
+
+- the durable telemetry ring (``obs/tsring.py``): bounded rotation,
+  crash-safe resume (torn trailing lines skipped, seq continues),
+  window/tail queries — the on-disk history ``pio top --history`` and
+  incident bundles read;
+- the incident recorder (``obs/incidents.py``): content-addressed
+  atomic bundles, per-kind rate limiting, failing sources recorded not
+  fatal, GC, list/show/export plumbing;
+- worker log capture (``fleet/worklog.py``): spawn with captured
+  stderr/stdout, rotation at respawn, rotation-aware tails;
+- the gateway's cross-tier tracing + telemetry loop: ``gateway.route``/
+  ``gateway.proxy`` spans on the ingress trace id (retry + panic
+  attribution), the fan-in merged ``/traces/recent`` (incl. the dead-
+  replica span cache), ``/telemetry/window`` over a ring that SURVIVES
+  a gateway restart, the fleet SLO engine, and the incident triggers
+  (5xx escape, breaker trip, SLO alert transition);
+- trace-id continuity end to end: client -> gateway retry on a second
+  replica -> REAL QueryServer micro-batcher -> storage span, one trace
+  id throughout, both tiers visible in the merged view, and a federated
+  exemplar scrape resolving to that assembled waterfall.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+from predictionio_tpu.fleet.launch import build_obs_plane, wire_incident_sources
+from predictionio_tpu.fleet.supervisor import (
+    Supervisor,
+    SupervisorConfig,
+    WorkerSpec,
+)
+from predictionio_tpu.fleet.worklog import WorkerLogBook, spawn_with_log
+from predictionio_tpu.obs.incidents import (
+    IncidentRecorder,
+    export_bundle,
+    list_bundles,
+    load_bundle,
+)
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tracing import TRACE_HEADER, mint_trace_id
+from predictionio_tpu.obs.tsring import TelemetryRing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if "tests" not in sys.path:
+    sys.path.insert(0, "tests")
+
+
+# ---------------------------------------------------------------------------
+# telemetry ring
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetryRing:
+    def test_append_read_roundtrip(self, tmp_path):
+        ring = TelemetryRing(str(tmp_path), segment_records=4, segments=3)
+        for i in range(3):
+            ring.append({"gauges": {"queue_depth": float(i)}})
+        recs = ring.records()
+        assert [r["seq"] for r in recs] == [0, 1, 2]
+        assert all("t" in r for r in recs)
+        assert recs[2]["gauges"]["queue_depth"] == 2.0
+
+    def test_ring_is_bounded_and_drops_oldest(self, tmp_path):
+        ring = TelemetryRing(str(tmp_path), segment_records=4, segments=3)
+        for i in range(50):
+            ring.append({"i": i})
+        recs = ring.records()
+        # capacity is segments*segment_records minus the rotated-away
+        # partials; the INVARIANTS are the bound and oldest-first loss
+        assert len(recs) <= 12
+        assert recs[-1]["seq"] == 49
+        assert recs[0]["seq"] > 0
+        files = [f for f in os.listdir(tmp_path) if f.startswith("seg-")]
+        assert len(files) <= 3
+
+    def test_resume_continues_sequence_after_reopen(self, tmp_path):
+        ring = TelemetryRing(str(tmp_path), segment_records=4, segments=3)
+        for i in range(6):
+            ring.append({"i": i})
+        ring.close()
+        # "gateway restart": a NEW ring instance over the same directory
+        ring2 = TelemetryRing(str(tmp_path), segment_records=4, segments=3)
+        pre_restart = [r["seq"] for r in ring2.records()]
+        assert 5 in pre_restart, "pre-restart history must survive"
+        seq = ring2.append({"i": 6})
+        assert seq == 6  # monotonic across the restart
+        assert ring2.records()[-1]["i"] == 6
+
+    def test_torn_trailing_line_is_skipped_not_fatal(self, tmp_path):
+        ring = TelemetryRing(str(tmp_path), segment_records=8, segments=2)
+        for i in range(3):
+            ring.append({"i": i})
+        ring.close()
+        seg = sorted(
+            f for f in os.listdir(tmp_path) if f.startswith("seg-")
+        )[0]
+        with open(tmp_path / seg, "a") as fh:
+            fh.write('{"seq": 99, "torn')  # crashed-writer tail
+        ring2 = TelemetryRing(str(tmp_path), segment_records=8, segments=2)
+        assert [r["seq"] for r in ring2.records()] == [0, 1, 2]
+        assert ring2.append({"i": 3}) == 3
+
+    def test_window_filters_on_time(self, tmp_path):
+        ring = TelemetryRing(str(tmp_path))
+        ring.append({"t": 100.0, "i": 0})
+        ring.append({"t": 200.0, "i": 1})
+        ring.append({"t": 290.0, "i": 2})
+        got = ring.window(seconds=120, now=300.0)
+        assert [r["i"] for r in got] == [1, 2]
+        assert ring.window(seconds=1000, now=300.0) == ring.records()
+
+    def test_tail_and_approx_count(self, tmp_path):
+        ring = TelemetryRing(str(tmp_path), segment_records=4, segments=2)
+        for i in range(5):
+            ring.append({"i": i})
+        assert [r["i"] for r in ring.tail(2)] == [3, 4]
+        assert ring.approx_count == 5
+        for i in range(20):
+            ring.append({"i": i})
+        assert ring.approx_count == 8  # clamped to capacity
+
+
+# ---------------------------------------------------------------------------
+# incident recorder
+# ---------------------------------------------------------------------------
+
+
+class TestIncidentRecorder:
+    def _recorder(self, tmp_path, **kw):
+        self.clock = [0.0]
+        kw.setdefault("clock", lambda: self.clock[0])
+        return IncidentRecorder(
+            str(tmp_path), metrics=MetricsRegistry(), **kw
+        )
+
+    def test_bundle_contains_manifest_parts_and_texts(self, tmp_path):
+        rec = self._recorder(tmp_path)
+        rec.add_source("fleet", lambda: {"replicas": 2})
+        path = rec.trigger(
+            "worker-crash",
+            context={"replica": "w1", "rc": -9},
+            texts={"stderr_tail": "Fatal: device lost\n"},
+        )
+        assert path is not None and os.path.isdir(path)
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        assert manifest["trigger"] == "worker-crash"
+        assert manifest["context"]["replica"] == "w1"
+        assert manifest["parts"] == ["fleet"]
+        assert json.load(open(os.path.join(path, "fleet.json"))) == {
+            "replicas": 2
+        }
+        tail = open(os.path.join(path, "stderr_tail.txt")).read()
+        assert "device lost" in tail
+        # content-addressed: the manifest's sha prefix names the dir
+        assert manifest["sha256"][:12] in os.path.basename(path)
+
+    def test_failing_source_recorded_not_fatal(self, tmp_path):
+        rec = self._recorder(tmp_path)
+        rec.add_source("boom", lambda: 1 / 0)
+        rec.add_source("ok", lambda: [1, 2])
+        path = rec.trigger("slo-alert")
+        bundle = load_bundle(str(tmp_path), os.path.basename(path))
+        assert bundle["parts"]["ok"] == [1, 2]
+        assert "ZeroDivisionError" in bundle["parts"]["boom"]["error"]
+
+    def test_rate_limit_is_per_kind(self, tmp_path):
+        rec = self._recorder(tmp_path, min_interval_s=10.0)
+        assert rec.trigger("worker-crash") is not None
+        assert rec.trigger("worker-crash") is None  # limited
+        assert rec.trigger("breaker-trip") is not None  # different kind
+        self.clock[0] = 11.0
+        assert rec.trigger("worker-crash") is not None  # window passed
+
+    def test_gc_keeps_newest(self, tmp_path):
+        rec = self._recorder(tmp_path, min_interval_s=0.0, max_bundles=3)
+        for i in range(6):
+            self.clock[0] = float(i)
+            rec.trigger("worker-crash", context={"n": i})
+        refs = list_bundles(str(tmp_path))
+        assert len(refs) == 3
+        kept = [
+            json.load(open(os.path.join(r.path, "manifest.json")))["context"]["n"]
+            for r in refs
+        ]
+        assert kept == [3, 4, 5]
+
+    def test_list_load_export_with_prefix(self, tmp_path):
+        rec = self._recorder(tmp_path)
+        path = rec.trigger("fleet-5xx", context={"status": 502})
+        ref = list_bundles(str(tmp_path))[0]
+        assert ref.trigger == "fleet-5xx"
+        # unique sha prefix resolves like a git short hash
+        sha_prefix = os.path.basename(path).rsplit("-", 1)[1][:8]
+        bundle = load_bundle(str(tmp_path), ref.bundle_id)
+        assert bundle["manifest"]["context"]["status"] == 502
+        dest = tmp_path / "export"
+        os.makedirs(dest)
+        out = export_bundle(str(tmp_path), ref.bundle_id, str(dest))
+        assert os.path.isfile(os.path.join(out, "manifest.json"))
+        assert sha_prefix in out
+
+    def test_trigger_never_raises(self, tmp_path):
+        rec = self._recorder(tmp_path)
+        blocker = tmp_path / "blocker"
+        blocker.write_text("not a directory")
+        rec.dir = str(blocker)  # capture will fail to mkdir under a file
+        assert rec.trigger("worker-crash") is None  # swallowed, not raised
+
+
+# ---------------------------------------------------------------------------
+# worker log capture
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerLogBook:
+    def test_spawn_with_log_captures_stderr_and_stdout(self, tmp_path):
+        book = WorkerLogBook(str(tmp_path))
+        proc = spawn_with_log(
+            [
+                sys.executable,
+                "-c",
+                "import sys; print('out line'); print('err line', file=sys.stderr)",
+            ],
+            book,
+            "w0",
+        )
+        proc.wait(timeout=30)
+        tail = book.tail("w0")
+        assert "out line" in tail and "err line" in tail
+
+    def test_rotation_at_open_bounds_the_file(self, tmp_path):
+        book = WorkerLogBook(str(tmp_path), max_bytes=64)
+        with book.open_for("w0") as fh:
+            fh.write(b"A" * 100 + b"\n")
+        # respawn: the oversized generation shifts to .1, fresh file opens
+        with book.open_for("w0") as fh:
+            fh.write(b"B" * 10 + b"\n")
+        assert os.path.getsize(book.path("w0")) < 64
+        assert os.path.exists(book.rotated_path("w0"))
+        tail = book.tail("w0", max_bytes=200)
+        assert "B" * 10 in tail
+        assert "A" in tail  # rotation-aware: reaches into .1 for the gap
+
+    def test_tail_missing_worker_is_empty(self, tmp_path):
+        book = WorkerLogBook(str(tmp_path))
+        assert book.tail("ghost") == ""
+
+
+# ---------------------------------------------------------------------------
+# supervisor crash capture -> incident hook
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self):
+        self.pid = 4242
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.rc = -15
+
+    def kill(self):
+        self.rc = -9
+
+
+class TestSupervisorCrashCapture:
+    def _sup(self, tmp_path, on_crash, budget=5):
+        self.clock = [0.0]
+        self.procs = []
+
+        def spawn(spec):
+            p = _FakeProc()
+            self.procs.append(p)
+            return p
+
+        book = WorkerLogBook(str(tmp_path / "logs"))
+        sup = Supervisor(
+            spawn,
+            [WorkerSpec("w0", 9001)],
+            SupervisorConfig(crash_loop_budget=budget, crash_loop_window_s=60.0),
+            metrics=MetricsRegistry(),
+            clock=lambda: self.clock[0],
+            logbook=book,
+            on_crash=on_crash,
+        )
+        return sup, book
+
+    def test_crash_hands_stderr_tail_to_hook(self, tmp_path):
+        crashes = []
+        sup, book = self._sup(tmp_path, crashes.append)
+        sup.start()
+        with book.open_for("w0") as fh:
+            fh.write(b"Traceback: boom\n")
+        self.procs[-1].rc = 1
+        sup.tick()
+        assert len(crashes) == 1
+        info = crashes[0]
+        assert info["replica"] == "w0" and info["rc"] == 1
+        assert not info["parked"]
+        assert "boom" in info["stderrTail"]
+        assert info["logPath"].endswith("w0.log")
+
+    def test_park_reported_as_parked(self, tmp_path):
+        crashes = []
+        sup, _ = self._sup(tmp_path, crashes.append, budget=1)
+        sup.start()
+        for i in range(3):
+            self.clock[0] += 0.1
+            if self.procs and self.procs[-1].rc is None:
+                self.procs[-1].rc = 1
+            sup.tick()
+            self.clock[0] += 10.0
+            sup.tick()
+        assert any(c["parked"] for c in crashes)
+        assert sup.snapshot()[0]["parked"]
+
+    def test_hook_failure_never_stalls_restarts(self, tmp_path):
+        def bad_hook(info):
+            raise RuntimeError("recorder down")
+
+        sup, _ = self._sup(tmp_path, bad_hook)
+        sup.start()
+        self.procs[-1].rc = 1
+        sup.tick()  # must not raise
+        self.clock[0] += 60.0
+        sup.tick()  # restart still happens
+        assert len(self.procs) == 2
+
+    def test_snapshot_and_metric_carry_log_path(self, tmp_path):
+        sup, book = self._sup(tmp_path, None)
+        sup.start()
+        assert sup.snapshot()[0]["logPath"] == book.path("w0")
+        text = sup.metrics.render_prometheus()
+        assert "pio_fleet_worker_log_info" in text
+        assert "w0.log" in text
+
+
+# ---------------------------------------------------------------------------
+# gateway: spans, merged traces, telemetry, incidents
+# ---------------------------------------------------------------------------
+
+
+class FakeObsReplica:
+    """A replica with the observability surface the gateway fans into:
+    /queries.json (optionally failing), /healthz, /metrics (fixed
+    exposition incl. a queue-depth gauge and an exemplar-decorated
+    histogram), /traces/recent (its own span list)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.fail_status: int | None = None
+        self.queries = 0
+        self.spans: list[dict] = []
+        self.server: TestServer | None = None
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+
+        async def queries(request: web.Request) -> web.Response:
+            self.queries += 1
+            tid = request.headers.get(TRACE_HEADER, "")
+            self.spans.append(
+                {
+                    "traceId": tid,
+                    "spanId": f"{self.name}-{self.queries}",
+                    "name": "ingress",
+                    "kind": "ingress",
+                    "startTime": time.time(),
+                    "durationMs": 1.0,
+                    "status": "ok",
+                    "tags": {},
+                }
+            )
+            if self.fail_status:
+                return web.json_response({"m": "injected"}, status=self.fail_status)
+            return web.json_response({"replica": self.name})
+
+        async def healthz(request):
+            return web.json_response({"ready": True})
+
+        async def metrics(request):
+            exemplar = ""
+            if request.query.get("exemplars"):
+                exemplar = ' # {trace_id="exemplar-tid"} 0.004'
+            return web.Response(
+                text=(
+                    "pio_queue_depth 3\n"
+                    "# TYPE pio_request_seconds histogram\n"
+                    'pio_request_seconds_bucket{endpoint="/queries.json",le="0.01"} 5'
+                    + exemplar
+                    + "\n"
+                    'pio_request_seconds_bucket{endpoint="/queries.json",le="+Inf"} 8\n'
+                    'pio_request_seconds_count{endpoint="/queries.json"} 8\n'
+                )
+            )
+
+        async def traces(request):
+            return web.json_response({"spans": self.spans})
+
+        app.add_routes(
+            [
+                web.post("/queries.json", queries),
+                web.get("/healthz", healthz),
+                web.get("/metrics", metrics),
+                web.get("/traces/recent", traces),
+            ]
+        )
+        return app
+
+    async def start(self) -> str:
+        self.server = TestServer(self.make_app())
+        await self.server.start_server()
+        return f"http://127.0.0.1:{self.server.port}"
+
+    async def stop(self) -> None:
+        if self.server is not None:
+            await self.server.close()
+            self.server = None
+
+
+def _gw_rig(tmp_path, n=2, **cfg_kw):
+    replicas = [FakeObsReplica(f"r{i}") for i in range(n)]
+
+    def run(body, telemetry=True, incidents_interval=0.0):
+        async def outer():
+            urls = [await r.start() for r in replicas]
+            cfg_kw.setdefault("probe_interval_s", 0.05)
+            cfg_kw.setdefault("telemetry_interval_s", 0.05)
+            cfg_kw.setdefault("request_timeout_s", 5.0)
+            metrics = MetricsRegistry()
+            ring = (
+                TelemetryRing(str(tmp_path / "telemetry"))
+                if telemetry
+                else None
+            )
+            recorder = IncidentRecorder(
+                str(tmp_path / "incidents"),
+                metrics=metrics,
+                min_interval_s=incidents_interval,
+            )
+            gw = Gateway(
+                GatewayConfig(replica_urls=tuple(urls), **cfg_kw),
+                metrics=metrics,
+                telemetry=ring,
+                incidents=recorder,
+            )
+            client = TestClient(TestServer(gw.make_app()))
+            await client.start_server()
+            try:
+                await asyncio.sleep(0.12)  # first probe + telemetry ticks
+                await body(gw, client, recorder, ring)
+            finally:
+                await client.close()
+                for r in replicas:
+                    await r.stop()
+                if ring is not None:
+                    ring.close()
+
+        asyncio.run(outer())
+
+    return replicas, run
+
+
+class TestGatewaySpans:
+    def test_route_and_proxy_spans_share_ingress_trace_id(self, tmp_path):
+        replicas, run = _gw_rig(tmp_path)
+        tid = mint_trace_id()
+
+        async def body(gw, client, recorder, ring):
+            resp = await client.post(
+                "/queries.json",
+                json={"user": "u1"},
+                headers={TRACE_HEADER: tid},
+            )
+            assert resp.status == 200
+            assert resp.headers[TRACE_HEADER] == tid
+            spans = gw.tracer.find(tid)
+            names = [s["name"] for s in spans]
+            assert "gateway.route" in names and "gateway.proxy" in names
+            route = next(s for s in spans if s["name"] == "gateway.route")
+            assert route["tags"]["status"] == 200
+            assert route["tags"]["replica"]
+            assert route["tags"]["healthy"] == 2
+            proxy = next(s for s in spans if s["name"] == "gateway.proxy")
+            assert proxy["tags"]["upstream_status"] == 200
+            assert proxy["durationMs"] >= 0
+
+        run(body)
+
+    def test_retry_attribution_lands_in_route_span(self, tmp_path):
+        replicas, run = _gw_rig(tmp_path)
+        tid = mint_trace_id()
+
+        async def body(gw, client, recorder, ring):
+            replicas[0].fail_status = 500
+            replicas[1].fail_status = None
+            # hammer until a request lands on the failing replica first
+            # (sticky hashing may pick either first)
+            retried = None
+            for i in range(16):
+                t = f"{tid}{i:02d}"
+                resp = await client.post(
+                    "/queries.json",
+                    json={"user": f"u{i}"},
+                    headers={TRACE_HEADER: t},
+                )
+                assert resp.status == 200  # retry always rescues
+                spans = gw.tracer.find(t)
+                route = next(
+                    s for s in spans if s["name"] == "gateway.route"
+                )
+                if route["tags"].get("retried"):
+                    retried = route
+                    # BOTH forward attempts recorded on the same trace
+                    proxies = [
+                        s for s in spans if s["name"] == "gateway.proxy"
+                    ]
+                    assert len(proxies) == 2
+                    assert {p["tags"]["upstream_status"] for p in proxies} == {
+                        500,
+                        200,
+                    }
+                    break
+            assert retried is not None, "no request ever hit the bad replica"
+            assert retried["tags"]["retry_replica"]
+            # SLO semantics: the retry RESCUED every client — the
+            # per-attempt forwards recorded 5xx, but the client-visible
+            # response counter (the fleet-availability input) must not
+            responses = {
+                dict(zip(gw._m_responses.labelnames, k))["status"]: v
+                for k, v in gw._m_responses.collect()
+            }
+            attempts = {
+                dict(zip(gw._m_requests.labelnames, k)).get("status"): v
+                for k, v in gw._m_requests.collect()
+            }
+            assert attempts.get("5xx", 0) > 0  # the failures happened...
+            assert responses.get("5xx", 0) == 0  # ...but no client saw one
+
+        run(body)
+
+    def test_merged_traces_and_dead_replica_cache(self, tmp_path):
+        replicas, run = _gw_rig(tmp_path)
+        tid = mint_trace_id()
+
+        async def body(gw, client, recorder, ring):
+            resp = await client.post(
+                "/queries.json",
+                json={"user": "u1"},
+                headers={TRACE_HEADER: tid},
+            )
+            assert resp.status == 200
+            # wait for a telemetry tick to cache the replica spans
+            await asyncio.sleep(0.12)
+            # the merged view holds both tiers for the trace id
+            t = await client.get(f"/traces/recent?trace_id={tid}")
+            spans = (await t.json())["spans"]
+            sources = {s["source"] for s in spans}
+            assert "gateway" in sources
+            assert any(src != "gateway" for src in sources)
+            # the waterfall is time-ordered oldest-first
+            starts = [s["startTime"] for s in spans]
+            assert starts == sorted(starts)
+            # SIGKILL analog: stop the replica that served the query;
+            # its spans must STILL be served (from the fan-in cache)
+            for r in replicas:
+                await r.stop()
+            t = await client.get(f"/traces/recent?trace_id={tid}")
+            spans = (await t.json())["spans"]
+            assert any(
+                s["kind"] == "ingress" and s["source"] != "gateway"
+                for s in spans
+            ), "dead replica's spans evaporated from the merged view"
+
+        run(body)
+
+    def test_federated_exemplar_resolves_cross_tier(self, tmp_path):
+        """Acceptance: scrape the GATEWAY with exemplars negotiated; the
+        federated exposition still carries the replica's exemplar
+        clause, and the trace id it names assembles into a waterfall via
+        the gateway's /traces/recent."""
+        replicas, run = _gw_rig(tmp_path)
+
+        async def body(gw, client, recorder, ring):
+            # seed the replica span rings with the exemplar's trace id
+            resp = await client.post(
+                "/queries.json",
+                json={"user": "u1"},
+                headers={TRACE_HEADER: "exemplar-tid"},
+            )
+            assert resp.status == 200
+            scrape = await client.get("/metrics?exemplars=1")
+            text = await scrape.text()
+            assert "openmetrics" in scrape.headers["Content-Type"]
+            assert text.rstrip().endswith("# EOF")
+            line = next(
+                ln
+                for ln in text.splitlines()
+                if ln.startswith("pio_request_seconds_bucket") and " # " in ln
+            )
+            exemplar_tid = line.split('trace_id="')[1].split('"')[0]
+            assert exemplar_tid == "exemplar-tid"
+            # ... and the plain scrape stays strict v0.0.4
+            plain = await (await client.get("/metrics")).text()
+            assert " # " not in plain and "# EOF" not in plain
+            # the exemplar resolves through the merged trace view
+            t = await client.get(f"/traces/recent?trace_id={exemplar_tid}")
+            spans = (await t.json())["spans"]
+            assert any(s["name"] == "gateway.route" for s in spans)
+            assert any(s["source"] != "gateway" for s in spans)
+
+        run(body)
+
+    def test_health_transitions_recorded_as_spans(self, tmp_path):
+        replicas, run = _gw_rig(tmp_path)
+
+        async def body(gw, client, recorder, ring):
+            await replicas[0].stop()
+            await asyncio.sleep(0.2)  # probe ejects
+            events = [
+                s
+                for s in gw.tracer.recent(None)
+                if s["name"] == "gateway.health"
+            ]
+            assert any(s["status"] == "eject" for s in events)
+
+        run(body)
+
+
+class TestGatewayTelemetry:
+    def test_ring_snapshots_and_window_endpoint(self, tmp_path):
+        replicas, run = _gw_rig(tmp_path)
+
+        async def body(gw, client, recorder, ring):
+            await client.post("/queries.json", json={"user": "u"})
+            await asyncio.sleep(0.15)
+            resp = await client.get("/telemetry/window?s=60")
+            assert resp.status == 200
+            records = (await resp.json())["records"]
+            assert records, "telemetry loop appended nothing"
+            last = records[-1]
+            # federated gauge: 3 queue depth per replica
+            assert last["gauges"]["queue_depth"] == 6.0
+            assert set(last["replicas"]) == {r.name for r in gw.replicas}
+            assert "fleet-availability" in last["slo"]
+            assert last["counters"]["requests"] >= 1.0
+            text = gw.metrics.render_prometheus()
+            assert "pio_telemetry_snapshots_total" in text
+
+        run(body)
+
+    def test_ring_survives_gateway_restart(self, tmp_path):
+        """Acceptance: the on-disk ring outlives the process — a NEW
+        gateway over the same directory serves the pre-restart window,
+        and `pio top --history` renders it."""
+        replicas, run = _gw_rig(tmp_path)
+
+        async def body(gw, client, recorder, ring):
+            await asyncio.sleep(0.2)
+            assert ring.approx_count > 0
+
+        run(body)
+        # "restart": fresh ring instance + fresh gateway over the same dir
+        ring2 = TelemetryRing(str(tmp_path / "telemetry"))
+        pre = ring2.records()
+        assert pre, "history did not survive the restart"
+        from predictionio_tpu.tools.top import render_history, run_history
+
+        screen = render_history(ring2.window(3600), 3600)
+        assert "queue" in screen and "burn" in screen
+        out: list[str] = []
+        rc = run_history(
+            obs_dir=str(tmp_path), window_s=3600, out=out.append
+        )
+        assert rc == 0
+        assert "snapshots" in out[0]
+        ring2.close()
+
+    def test_telemetry_window_bad_param_400s(self, tmp_path):
+        replicas, run = _gw_rig(tmp_path)
+
+        async def body(gw, client, recorder, ring):
+            resp = await client.get("/telemetry/window?s=banana")
+            assert resp.status == 400
+
+        run(body)
+
+    def test_fleet_slo_endpoint_reports_objectives(self, tmp_path):
+        replicas, run = _gw_rig(tmp_path)
+
+        async def body(gw, client, recorder, ring):
+            resp = await client.get("/slo")
+            names = {s["name"] for s in (await resp.json())["slos"]}
+            assert names == {
+                "fleet-availability",
+                "fleet-latency",
+                "fleet-shed",
+            }
+
+        run(body)
+
+
+async def _await_bundle(inc_dir: str, trigger: str, deadline_s: float = 5.0):
+    """Captures run on an executor thread (never on the event loop — the
+    gateway must keep proxying mid-incident), so tests poll."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        refs = [b for b in list_bundles(inc_dir) if b.trigger == trigger]
+        if refs:
+            return refs
+        assert time.monotonic() < deadline, f"no {trigger} bundle appeared"
+        await asyncio.sleep(0.05)
+
+
+class TestGatewayIncidents:
+    def test_escaped_5xx_triggers_bundle(self, tmp_path):
+        replicas, run = _gw_rig(tmp_path)
+
+        async def body(gw, client, recorder, ring):
+            for r in replicas:
+                r.fail_status = 500
+            resp = await client.post("/queries.json", json={"user": "u"})
+            assert resp.status == 500  # relayed, not masked
+            await _await_bundle(str(tmp_path / "incidents"), "fleet-5xx")
+
+        run(body)
+
+    def test_breaker_trip_triggers_bundle(self, tmp_path):
+        replicas, run = _gw_rig(tmp_path, breaker_threshold=2)
+
+        async def body(gw, client, recorder, ring):
+            replicas[0].fail_status = 503
+            replicas[1].fail_status = 503
+            for i in range(8):
+                await client.post("/queries.json", json={"user": f"u{i}"})
+            await _await_bundle(str(tmp_path / "incidents"), "breaker-trip")
+
+        run(body)
+
+    def test_slo_alert_transition_triggers_bundle(self, tmp_path):
+        replicas, run = _gw_rig(tmp_path)
+
+        async def body(gw, client, recorder, ring):
+            # force an alert: every window of every objective breaching is
+            # simulated by monkeying the engine's evaluate output
+            orig = gw.slo.evaluate
+
+            def alerting_evaluate(now=None):
+                out = orig(now)
+                for rpt in out:
+                    rpt["alerting"] = True
+                return out
+
+            gw.slo.evaluate = alerting_evaluate
+            await _await_bundle(str(tmp_path / "incidents"), "slo-alert")
+            # let the SAME tick's captures (one per flipping objective)
+            # settle on the executor before counting
+            await asyncio.sleep(0.3)
+            n = len(
+                [
+                    b
+                    for b in list_bundles(str(tmp_path / "incidents"))
+                    if b.trigger == "slo-alert"
+                ]
+            )
+            # level-triggered refiring is suppressed: alert state latched,
+            # several more still-alerting ticks must add no bundles
+            await asyncio.sleep(0.3)
+            refs = list_bundles(str(tmp_path / "incidents"))
+            assert (
+                len([b for b in refs if b.trigger == "slo-alert"]) == n
+            ), "alert incident re-fired while still alerting"
+
+        run(body)
+
+
+# ---------------------------------------------------------------------------
+# launch wiring: build_obs_plane + incident sources
+# ---------------------------------------------------------------------------
+
+
+class TestObsPlaneWiring:
+    def test_disabled_when_no_dir(self):
+        assert build_obs_plane("", MetricsRegistry()) == {}
+        assert build_obs_plane(None, MetricsRegistry()) == {}
+
+    def test_plane_pieces_and_crash_capture(self, tmp_path):
+        metrics = MetricsRegistry()
+        obs = build_obs_plane(str(tmp_path / "obs"), metrics)
+        assert set(obs) == {
+            "dir",
+            "logbook",
+            "telemetry",
+            "incidents",
+            "on_crash",
+        }
+        obs["on_crash"](
+            {
+                "replica": "w0",
+                "rc": -9,
+                "parked": False,
+                "stderrTail": "dying words\n",
+            }
+        )
+        refs = list_bundles(str(tmp_path / "obs" / "incidents"))
+        assert refs and refs[0].trigger == "worker-crash"
+        bundle = load_bundle(
+            str(tmp_path / "obs" / "incidents"), refs[0].bundle_id
+        )
+        assert "dying words" in bundle["texts"]["stderr_tail"]
+        # telemetry tail source captured (empty ring -> empty list)
+        assert bundle["parts"]["telemetry"] == []
+
+    def test_wire_incident_sources_captures_both_tiers(self, tmp_path):
+        metrics = MetricsRegistry()
+        obs = build_obs_plane(str(tmp_path / "obs"), metrics)
+        gw = Gateway(
+            GatewayConfig(replica_urls=("http://127.0.0.1:1",)),
+            metrics=metrics,
+            telemetry=obs["telemetry"],
+            incidents=obs["incidents"],
+        )
+        sup = Supervisor(
+            spawn=lambda spec: _FakeProc(),
+            specs=[WorkerSpec("w0", 9001)],
+            metrics=metrics,
+            logbook=obs["logbook"],
+            on_crash=obs["on_crash"],
+        )
+        wire_incident_sources(obs["incidents"], gw, sup)
+        gw.tracer.record_span("gateway.route", "gateway", 0.01)
+        path = obs["incidents"].trigger("breaker-trip", context={"b": "r0"})
+        bundle = load_bundle(
+            str(tmp_path / "obs" / "incidents"), os.path.basename(path)
+        )
+        assert {"traces", "fleet", "supervisor", "telemetry"} <= set(
+            bundle["parts"]
+        )
+        assert any(
+            s["name"] == "gateway.route" for s in bundle["parts"]["traces"]
+        )
+        assert bundle["parts"]["supervisor"][0]["name"] == "w0"
+
+
+# ---------------------------------------------------------------------------
+# trace continuity: client -> gateway retry -> REAL server -> storage span
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContinuityE2E:
+    def test_one_trace_id_client_to_storage_through_retry(
+        self, memory_storage
+    ):
+        """Satellite acceptance: a query that fails on the first replica,
+        retries onto a REAL QueryServer (micro-batcher + storage read),
+        keeps ONE trace id end to end — and the gateway's merged
+        /traces/recent shows the gateway AND replica tiers for it."""
+        from predictionio_tpu.data.storage.traced import trace_dao
+        from predictionio_tpu.obs.tracing import get_tracer
+        from tests.sample_engine import Serving0
+        from tests.test_resilience import _make_query_server
+
+        traced_apps = trace_dao(memory_storage.get_meta_data_apps(), "apps")
+
+        class StorageTouchingServing(Serving0):
+            def supplement(self, query):
+                traced_apps.get_all()
+                return query
+
+        tid = mint_trace_id()
+        get_tracer().clear()
+
+        async def outer():
+            server = _make_query_server(request_timeout_s=5.0)
+            server.engine.serving_classes = {"s": StorageTouchingServing}
+            server._active = server._active._replace(
+                serving=StorageTouchingServing()
+            )
+            bad = FakeObsReplica("bad")
+            bad.fail_status = 502
+            bad_url = await bad.start()
+            real = TestServer(server.make_app())
+            await real.start_server()
+            real_url = f"http://127.0.0.1:{real.port}"
+            gw = Gateway(
+                GatewayConfig(
+                    replica_urls=(bad_url, real_url),
+                    probe_interval_s=0.05,
+                    telemetry_interval_s=0.05,
+                    request_timeout_s=5.0,
+                )
+            )
+            client = TestClient(TestServer(gw.make_app()))
+            await client.start_server()
+            try:
+                await asyncio.sleep(0.12)
+                # hit until the BAD replica is picked first (forcing the
+                # retry path onto the real server)
+                hit_tid = None
+                for i in range(24):
+                    t = f"{tid}{i:02d}"
+                    resp = await client.post(
+                        "/queries.json",
+                        json={"qid": 7, "user": f"u{i}"},
+                        headers={TRACE_HEADER: t},
+                    )
+                    assert resp.status == 200
+                    assert resp.headers[TRACE_HEADER] == t
+                    route = next(
+                        s
+                        for s in gw.tracer.find(t)
+                        if s["name"] == "gateway.route"
+                    )
+                    if route["tags"].get("retried"):
+                        hit_tid = t
+                        break
+                assert hit_tid, "no query ever routed bad-first"
+                # the REAL server saw the same trace id through its
+                # micro-batcher down to the storage DAO span
+                server_spans = get_tracer().find(hit_tid)
+                kinds = {s["kind"] for s in server_spans}
+                assert {"ingress", "batch", "storage"} <= kinds, server_spans
+                # the merged view assembles BOTH tiers for that one id
+                await asyncio.sleep(0.12)  # fan-in tick
+                t = await client.get(f"/traces/recent?trace_id={hit_tid}")
+                merged = (await t.json())["spans"]
+                merged_names = {s["name"] for s in merged}
+                assert "gateway.route" in merged_names
+                assert "gateway.proxy" in merged_names
+                merged_kinds = {
+                    s["kind"] for s in merged if s["source"] != "gateway"
+                }
+                assert {"ingress", "batch", "storage"} <= merged_kinds
+            finally:
+                await client.close()
+                await bad.stop()
+                await real.close()
+                await server.stop()
+
+        asyncio.run(outer())
+
+
+# ---------------------------------------------------------------------------
+# pio top: the crash line + history rendering units
+# ---------------------------------------------------------------------------
+
+
+class TestTopCrashLine:
+    def test_fleet_screen_shows_last_crash_excerpt_path(self):
+        from predictionio_tpu.tools.top import parse_prometheus, render, summarize
+
+        text = (
+            "pio_fleet_replicas 2\n"
+            'pio_fleet_replica_up{replica="w0"} 1\n'
+            'pio_fleet_replica_up{replica="w1"} 0\n'
+            'pio_fleet_worker_last_crash_unix{replica="w1"} 1700000000\n'
+            'pio_fleet_worker_log_info{replica="w0",path="/obs/logs/w0.log"} 1\n'
+            'pio_fleet_worker_log_info{replica="w1",path="/obs/logs/w1.log"} 1\n'
+        )
+        summary = summarize(parse_prometheus(text))
+        screen = render(summary, "http://gw:8000")
+        assert "crash" in screen
+        assert "/obs/logs/w1.log" in screen
+        # the healthy worker has a log but no crash: no crash line for it
+        assert "/obs/logs/w0.log" not in screen
+
+    def test_sparkline_shapes(self):
+        from predictionio_tpu.tools.top import sparkline
+
+        assert sparkline([]) == "-"
+        assert len(sparkline([0.0, 1.0, 2.0])) == 3
+        assert len(sparkline(list(range(500)), width=60)) == 60
+        flat = sparkline([0.0, 0.0])
+        assert flat == flat[0] * 2

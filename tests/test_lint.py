@@ -925,6 +925,7 @@ class TestEngine:
             "concurrency",
             "storage-contract",
             "obs",
+            "fleet",
         } <= families
 
     def test_enabled_filter(self):
@@ -1090,3 +1091,156 @@ class TestStorageRawPickle:
             "predictionio_tpu/data/storage/sqlite.py",
         )
         assert "storage-raw-pickle" not in rule_ids(active)
+
+
+class TestFleetUnattributedProxy:
+    """fleet-unattributed-proxy: outbound replica calls and replica state
+    transitions in the fleet gateway/supervisor must route through the
+    span/telemetry helpers — an unattributed proxy is a hop the merged
+    /traces/recent can never assemble, an unattributed eject/park is
+    evidence the incident flight recorder never sees."""
+
+    FLEET_PATH = "predictionio_tpu/fleet/gateway.py"
+
+    def test_bare_session_call_fires(self):
+        active, _ = lint_snippet(
+            """
+            async def forward(self, replica, body):
+                async with self._http().request("POST", replica.url, data=body) as r:
+                    return await r.read()
+            """,
+            self.FLEET_PATH,
+        )
+        assert rule_ids(active) == ["fleet-unattributed-proxy"]
+        assert active[0].severity == Severity.ERROR
+        assert "span" in active[0].message
+
+    def test_span_wrapped_call_quiet(self):
+        active, _ = lint_snippet(
+            """
+            async def forward(self, replica, body):
+                with self.tracer.span("gateway.proxy", kind="gateway"):
+                    async with self._http().request("POST", replica.url) as r:
+                        return await r.read()
+            """,
+            self.FLEET_PATH,
+        )
+        assert active == []
+
+    def test_record_span_after_call_quiet(self):
+        active, _ = lint_snippet(
+            """
+            async def forward(self, replica):
+                t0 = time.perf_counter()
+                async with self._http().get(replica.url) as r:
+                    body = await r.read()
+                self.tracer.record_span("gateway.proxy", "gateway", 1.0)
+                return body
+            """,
+            self.FLEET_PATH,
+        )
+        assert active == []
+
+    def test_unattributed_state_transition_fires(self):
+        active, _ = lint_snippet(
+            """
+            def on_probe(self, replica, ok):
+                if not ok:
+                    replica.healthy = False
+            """,
+            self.FLEET_PATH,
+        )
+        assert rule_ids(active) == ["fleet-unattributed-proxy"]
+        assert "transition" in active[0].message
+
+    def test_transition_via_note_helper_quiet(self):
+        active, _ = lint_snippet(
+            """
+            def on_probe(self, replica, ok):
+                if not ok:
+                    replica.healthy = False
+                    self._note_transition("eject", replica)
+            """,
+            self.FLEET_PATH,
+        )
+        assert active == []
+
+    def test_transition_with_counter_quiet(self):
+        active, _ = lint_snippet(
+            """
+            def record_crash(self, w):
+                w.parked = True
+                self._m_crash_loops.inc(replica=w.spec.name)
+            """,
+            "predictionio_tpu/fleet/supervisor.py",
+        )
+        assert active == []
+
+    def test_init_constructing_state_quiet(self):
+        active, _ = lint_snippet(
+            """
+            class Replica:
+                def __init__(self, url):
+                    self.healthy = True
+            """,
+            self.FLEET_PATH,
+        )
+        assert active == []
+
+    def test_off_fleet_path_quiet(self):
+        active, _ = lint_snippet(
+            """
+            async def fetch(self, session, url):
+                async with session.get(url) as r:
+                    return await r.read()
+            """,
+            "predictionio_tpu/tools/dashboard.py",
+        )
+        assert "fleet-unattributed-proxy" not in rule_ids(active)
+
+    def test_suppressible_with_reason(self):
+        active, suppressed = lint_snippet(
+            """
+            async def fetch_metrics(self, replica):
+                # pio-lint: disable=fleet-unattributed-proxy -- telemetry plane fetch
+                async with self._http().get(replica.url) as r:
+                    return await r.text()
+            """,
+            self.FLEET_PATH,
+        )
+        assert active == []
+        assert rule_ids(suppressed) == ["fleet-unattributed-proxy"]
+
+    def test_nested_helper_judged_on_its_own(self):
+        # the outer fn records a span, but the nested helper makes the
+        # call without attribution of its own — still flagged
+        active, _ = lint_snippet(
+            """
+            async def outer(self, replica):
+                self.tracer.record_span("x", "gateway", 0.0)
+
+                async def inner():
+                    async with self._http().get(replica.url) as r:
+                        return await r.read()
+
+                return await inner()
+            """,
+            self.FLEET_PATH,
+        )
+        assert rule_ids(active) == ["fleet-unattributed-proxy"]
+
+    def test_nested_attribution_does_not_vouch_for_outer(self):
+        # symmetric blindness: a span recorded inside a NESTED helper
+        # must not silence an unattributed call in the OUTER function
+        active, _ = lint_snippet(
+            """
+            async def outer(self, replica):
+                def unrelated_helper():
+                    self.tracer.record_span("x", "gateway", 0.0)
+
+                async with self._http().get(replica.url) as r:
+                    return await r.read()
+            """,
+            self.FLEET_PATH,
+        )
+        assert rule_ids(active) == ["fleet-unattributed-proxy"]

@@ -1243,7 +1243,7 @@ class TestTopWaterfallSLO:
 
 
 class TestMetricsContract:
-    def test_documented_metrics_all_registered(self):
+    def test_documented_metrics_all_registered(self, tmp_path):
         """Every `pio_*` metric named in the docs/observability.md tables
         must be registered (and therefore exported with a # TYPE line) by
         the surface that owns it — docs that drift from the exporters are
@@ -1275,7 +1275,11 @@ class TestMetricsContract:
         registered.update(es.metrics._metrics)
         registered.update(StreamInstruments().registry._metrics)
         # the fleet family lives on the gateway/supervisor registry (the
-        # `pio deploy --fleet` parent), not on any worker's
+        # `pio deploy --fleet` parent), not on any worker's — including
+        # the flight-recorder instruments (telemetry ring + incidents)
+        from predictionio_tpu.fleet.worklog import WorkerLogBook
+        from predictionio_tpu.obs.incidents import IncidentRecorder
+
         fleet_metrics = MetricsRegistry()
         Gateway(
             GatewayConfig(replica_urls=("http://127.0.0.1:1",)),
@@ -1285,7 +1289,9 @@ class TestMetricsContract:
             spawn=lambda spec: None,
             specs=[WorkerSpec(name="w0", port=1)],
             metrics=fleet_metrics,
+            logbook=WorkerLogBook(str(tmp_path / "logs")),
         )
+        IncidentRecorder(str(tmp_path / "incidents"), metrics=fleet_metrics)
         registered.update(fleet_metrics._metrics)
         missing = documented - registered
         assert not missing, f"documented but not registered: {sorted(missing)}"
