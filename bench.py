@@ -69,6 +69,9 @@ PHASES: list[tuple[str, int]] = [
     ("twotower", 900),
     ("ann", 600),
     ("secondary", 600),
+    # diurnal/spike trace against a real self-sizing fleet (CPU workers;
+    # never needs the device) — ISSUE 13 acceptance evidence
+    ("elastic", 600),
 ]
 
 # phases that need the accelerator; serving_local forces the CPU backend.
@@ -1859,6 +1862,236 @@ def _bench_snapshot_ingest(n_events: int = 200_000) -> tuple[float, float]:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def phase_elastic(ck: _Checkpoint) -> None:
+    """SLO-driven elasticity under a synthetic diurnal/spike load trace
+    (ISSUE 13): a REAL fleet — worker processes under the supervisor,
+    gateway in front, telemetry ring + autoscaler attached — driven
+    through steady -> spike -> decay. The autoscaler must track the
+    trace (scale out during the spike, drain back in during the decay)
+    with ZERO client-visible 5xx and bounded over-provisioning.
+
+    Recorded evidence (``--compare`` gates the starred fields):
+      fleet_trace_p95_ms*      p95 across the whole trace (spike included)
+      fleet_peak_replicas*     most replicas the fleet grew to (bounded
+                               over-provisioning: more is worse)
+      fleet_shed_total         gateway sheds + worker load sheds (target 0)
+      fleet_trace_5xx          client-visible 5xx count (target 0)
+      fleet_steady_replicas    replicas after the decay (the scale-in proof)
+      fleet_scale_outs/ins     decisions applied, from the telemetry ring
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"  # fleet parent: no device needed
+    import asyncio
+
+    result = asyncio.run(_elastic_trace())
+    ck.save(**result)
+
+
+async def _elastic_trace() -> dict:
+    import asyncio
+    import tempfile as _tempfile
+
+    import aiohttp
+    import numpy as np
+
+    from predictionio_tpu.fleet.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        ScalingPolicy,
+    )
+    from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+    from predictionio_tpu.fleet.launch import build_obs_plane
+    from predictionio_tpu.fleet.supervisor import (
+        Supervisor,
+        SupervisorConfig,
+        WorkerSpec,
+    )
+    from predictionio_tpu.fleet.worklog import spawn_with_log
+    from predictionio_tpu.obs.metrics import MetricsRegistry
+
+    worker_script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts", "fleet_smoke.py"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    ports = [_free_port() for _ in range(8)]
+    next_slot = [1]
+
+    def spec_factory(worker_class: str) -> WorkerSpec:
+        i = next_slot[0]
+        next_slot[0] += 1
+        return WorkerSpec(
+            name=f"w{i}", port=ports[i], worker_class=worker_class
+        )
+
+    obs_dir = _tempfile.mkdtemp(prefix="pio_bench_elastic_obs_")
+    metrics = MetricsRegistry()
+    obs = build_obs_plane(obs_dir, metrics)
+
+    def spawn(spec: WorkerSpec):
+        return spawn_with_log(
+            [sys.executable, worker_script, "--worker", str(spec.port)],
+            obs["logbook"],
+            spec.name,
+            env=env,
+        )
+
+    sup = Supervisor(
+        spawn,
+        [WorkerSpec(name="w0", port=ports[0])],
+        SupervisorConfig(poll_interval_s=0.1, term_grace_s=10.0),
+        metrics=metrics,
+        logbook=obs["logbook"],
+        on_crash=obs["on_crash"],
+    )
+    gw = Gateway(
+        GatewayConfig(
+            ip="127.0.0.1",
+            port=_free_port(),
+            replica_urls=(WorkerSpec("w0", ports[0]).url,),
+            probe_interval_s=0.2,
+            probe_timeout_s=2.0,
+            request_timeout_s=15.0,
+            telemetry_interval_s=0.25,
+            # short burn windows so post-spike burn decays inside the
+            # trace (the SRE 300s default would pin the idle detector)
+            slo_windows=((10.0, 10.0), (30.0, 5.0)),
+        ),
+        metrics=metrics,
+        telemetry=obs["telemetry"],
+        incidents=obs["incidents"],
+    )
+    auto = Autoscaler(
+        ScalingPolicy(
+            AutoscalerConfig(
+                min_replicas=1,
+                max_replicas=3,
+                tick_interval_s=0.5,
+                lookback_s=120.0,
+                burn_threshold=1.0,
+                queue_depth_high=2.0,
+                inflight_high_per_replica=6.0,
+                confirm_s=2.0,
+                idle_sustain_s=6.0,
+                queue_depth_low=1.0,
+                idle_inflight_per_replica=2.0,
+                idle_burn_max=0.5,
+                scale_out_cooldown_s=6.0,
+                scale_in_cooldown_s=8.0,
+            )
+        ),
+        sup,
+        gw,
+        spec_factory,
+        ring=obs["telemetry"],
+        metrics=metrics,
+        incidents=obs["incidents"],
+    )
+    statuses: list[int] = []
+    lat_s: list[float] = []
+    replica_timeline: list[int] = []
+    sup.start()
+    sup_task = asyncio.ensure_future(sup.run())
+    auto_task = asyncio.ensure_future(auto.run())
+    await gw.start()
+    gw_url = f"http://127.0.0.1:{gw.config.port}"
+    session = aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(total=20))
+
+    async def one_query(i: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            async with session.post(
+                f"{gw_url}/queries.json",
+                json={"user": f"u{i % 500}", "num": 5},
+            ) as resp:
+                await resp.read()
+                statuses.append(resp.status)
+        except Exception:
+            statuses.append(599)  # transport failure = client-visible 5xx
+        lat_s.append(time.perf_counter() - t0)
+
+    async def load(duration_s: float, concurrency: int, rps: float | None):
+        """Closed-loop when rps is None; paced open-ish loop otherwise."""
+        stop_at = time.monotonic() + duration_s
+        i = [0]
+
+        async def worker_loop():
+            while time.monotonic() < stop_at:
+                i[0] += 1
+                await one_query(i[0])
+                if rps is not None:
+                    await asyncio.sleep(concurrency / rps)
+                replica_timeline.append(len(sup.live_specs()))
+
+        await asyncio.gather(*(worker_loop() for _ in range(concurrency)))
+
+    try:
+        # worker 0 up (pays the jax import once)
+        deadline = time.monotonic() + 120.0
+        while True:
+            try:
+                async with session.get(f"{gw_url}/healthz") as resp:
+                    if (await resp.json()).get("replicasHealthy", 0) >= 1:
+                        break
+            except Exception:
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError("elastic bench: worker never became ready")
+            await asyncio.sleep(0.25)
+        trace_t0 = time.perf_counter()
+        await load(6.0, 2, rps=10.0)  # steady morning
+        await load(30.0, 24, rps=None)  # spike: closed-loop flood
+        await load(30.0, 1, rps=4.0)  # decay back to idle
+        trace_s = time.perf_counter() - trace_t0
+        # let the last drain finish before reading the final shape
+        deadline = time.monotonic() + 30.0
+        while len(sup.snapshot()) > len(sup.live_specs()):
+            if time.monotonic() > deadline:
+                break
+            await asyncio.sleep(0.25)
+        fivexx = sum(1 for s in statuses if s >= 500)
+        ring = obs["telemetry"]
+        # sheds = gateway no-replica 503s PLUS the workers' own
+        # admission-control sheds (federated pio_load_shed_total) — the
+        # last fleet snapshot already carries both summed
+        fleet_recs = [r for r in ring.records() if r.get("kind") == "fleet"]
+        sheds = metrics.get("pio_fleet_no_replica_total").total()
+        if fleet_recs:
+            counters = fleet_recs[-1].get("counters") or {}
+            sheds = float(counters.get("no_replica", sheds)) + float(
+                counters.get("load_shed", 0.0)
+            )
+        scaling = [
+            r for r in ring.records() if r.get("kind") == "scaling"
+        ]
+        outs = sum(
+            1 for r in scaling if r["decision"]["action"] == "scale-out"
+        )
+        ins = sum(
+            1 for r in scaling if r["decision"]["action"] == "scale-in"
+        )
+        lat_ms = np.asarray(lat_s) * 1000.0
+        return {
+            "fleet_trace_requests": len(statuses),
+            "fleet_trace_s": round(trace_s, 1),
+            "fleet_trace_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "fleet_trace_p95_ms": round(float(np.percentile(lat_ms, 95)), 3),
+            "fleet_trace_5xx": fivexx,
+            "fleet_shed_total": float(sheds),
+            "fleet_zero_5xx": bool(fivexx == 0 and sheds == 0),
+            "fleet_peak_replicas": max(replica_timeline) if replica_timeline else 1,
+            "fleet_steady_replicas": len(sup.live_specs()),
+            "fleet_scale_outs": outs,
+            "fleet_scale_ins": ins,
+        }
+    finally:
+        for task in (auto_task, sup_task):
+            task.cancel()
+        await asyncio.gather(auto_task, sup_task, return_exceptions=True)
+        await session.close()
+        await gw.stop()
+        await asyncio.get_running_loop().run_in_executor(None, sup.stop)
+        obs["telemetry"].close()
+
+
 def _bench_naive_bayes(n: int = 200_000, f: int = 64, classes: int = 8) -> float:
     """Classification template training wall-clock (BASELINE workload 1)."""
     import numpy as np
@@ -1939,6 +2172,14 @@ _COMPARE_LOWER_IS_BETTER = frozenset(
         # wall clock hides it on fast hardware
         "serving_ann_p50_ms",
         "serving_ann_candidates_frac",
+        # elasticity trace (ISSUE 13): the fleet must keep tracking the
+        # spike within latency (p95 over the WHOLE trace, spike included),
+        # without shedding or erroring, and without over-provisioning
+        # (peak replicas growing across rounds = the policy got greedier)
+        "fleet_trace_p95_ms",
+        "fleet_trace_5xx",
+        "fleet_shed_total",
+        "fleet_peak_replicas",
     }
 )
 # the per-phase waterfall percentiles ride the same gate, whatever phases
@@ -2070,6 +2311,7 @@ _PHASE_FNS = {
     "twotower": phase_twotower,
     "ann": phase_ann,
     "secondary": phase_secondary,
+    "elastic": phase_elastic,
     "probe": phase_probe,
 }
 

@@ -97,6 +97,10 @@ class LintConfig:
         "*/fleet/gateway.py",
         "*/fleet/supervisor.py",
         "*/fleet/launch.py",
+        # the autoscaler's scaling actions are replica-set transitions:
+        # each must ride the span/metric attribution funnel so the
+        # scale-out/scale-in timeline is replayable from telemetry
+        "*/fleet/autoscaler.py",
     )
     # engine modules whose predict paths must keep score+select fused on
     # device (rule serving-host-roundtrip): a full-array device fetch or a

@@ -58,7 +58,10 @@ _SESSION_MARKERS = ("_http", "session")
 
 # calls that count as telemetry attribution inside the same function
 _SPAN_HELPERS = frozenset({"span", "record_span"})
-_TRANSITION_ATTRS = frozenset({"healthy", "parked"})
+# replica/worker state attributes whose assignment IS a fleet transition:
+# health (eject/readmit), park (crash-loop budget), and retire (scale-in
+# drain) all change what the routable set means
+_TRANSITION_ATTRS = frozenset({"healthy", "parked", "retiring"})
 
 
 def _is_session_receiver(node: ast.AST) -> bool:

@@ -12,8 +12,13 @@ knob (``pio deploy --fleet N``) instead of a rewrite:
   different replica, and drains gracefully on SIGTERM;
 - :mod:`.federation` — merges the replicas' Prometheus scrapes into the
   gateway's ``/metrics`` (the ``pio top --fleet`` endpoint);
+- :mod:`.autoscaler` — SLO-driven elasticity: a control loop that reads
+  the telemetry ring (fleet burn rates, queue-depth/inflight/shed
+  history) and resizes the fleet through the supervisor and the
+  gateway's membership funnel, with heterogeneous ``cpu-fallback``
+  overflow replicas (``pio deploy --fleet N --autoscale``);
 - :mod:`.launch` — the ``pio deploy --fleet N`` glue that runs
-  supervisor + gateway in one process.
+  supervisor + gateway (+ autoscaler) in one process.
 
 Replicas coordinate ONLY through the model registry: its rollout state
 carries a monotonic ``state_generation`` every worker polls, so a
@@ -22,18 +27,30 @@ fleet-wide and flushes each per-process result cache. See
 ``docs/fleet.md``.
 """
 
+from predictionio_tpu.fleet.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    ScalingPolicy,
+)
 from predictionio_tpu.fleet.federation import federate_metrics
 from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig, Replica
 from predictionio_tpu.fleet.supervisor import (
+    REPLICA_CLASS_CPU,
+    REPLICA_CLASS_DEVICE,
     Supervisor,
     SupervisorConfig,
     WorkerSpec,
 )
 
 __all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
     "Gateway",
     "GatewayConfig",
+    "REPLICA_CLASS_CPU",
+    "REPLICA_CLASS_DEVICE",
     "Replica",
+    "ScalingPolicy",
     "Supervisor",
     "SupervisorConfig",
     "WorkerSpec",

@@ -70,6 +70,7 @@ import asyncio
 import dataclasses
 import json
 import logging
+import threading
 import time
 from typing import Any
 from urllib.parse import urlsplit
@@ -78,6 +79,7 @@ import aiohttp
 from aiohttp import web
 
 from predictionio_tpu.fleet.federation import federate_metrics
+from predictionio_tpu.fleet.supervisor import REPLICA_CLASS_CPU
 from predictionio_tpu.obs.metrics import MetricsRegistry
 from predictionio_tpu.obs.slo import DEFAULT_WINDOWS, SLOEngine
 from predictionio_tpu.obs.tracing import (
@@ -136,26 +138,49 @@ class GatewayConfig:
     # consistent-hash tie-break key (same field the servers use for
     # sticky canary routing)
     sticky_key_field: str = "user"
+    # replica class per replica_urls entry ("device" default); shorter
+    # tuples pad with "device". cpu-fallback replicas absorb OVERFLOW
+    # only: routed to when every healthy device-class replica already
+    # carries >= cpu_overflow_inflight proxied queries (or none is
+    # routable) — slower answers instead of sheds, never instead of the
+    # fast path (docs/fleet.md §Replica classes)
+    replica_classes: tuple[str, ...] = ()
+    cpu_overflow_inflight: int = 4
     max_payload_bytes: int = 1 << 20
     shed_retry_after_s: float = 1.0
     drain_grace_s: float = 15.0
     # telemetry tick cadence (federate + SLO + ring append + trace
     # fan-in refresh); None follows probe_interval_s, 0 disables
     telemetry_interval_s: float | None = None
+    # fleet SLO burn windows ((seconds, threshold), ...); None = the SRE
+    # defaults (300s fast / 3600s slow). Elasticity tests and benches
+    # shrink these so post-spike burn decays inside the run instead of
+    # pinning the autoscaler's idle detector for five minutes
+    slo_windows: tuple[tuple[float, float], ...] | None = None
 
 
 class Replica:
     """Gateway-side state for one backend QueryServer."""
 
-    def __init__(self, url: str, breaker: CircuitBreaker):
+    def __init__(
+        self,
+        url: str,
+        breaker: CircuitBreaker,
+        worker_class: str = "device",
+        healthy: bool = True,
+    ):
         self.url = url.rstrip("/")
         split = urlsplit(self.url)
         self.name = split.netloc or self.url
+        self.worker_class = worker_class
         self.breaker = breaker
         # healthy-until-proven-otherwise: the first probe fires
         # immediately at startup, and the breaker bounds the damage of
-        # routing to a replica that was never up
-        self.healthy = True
+        # routing to a replica that was never up. A replica JOINING at
+        # runtime (scale-out) is the opposite case — its worker process
+        # is still importing jax — so it joins unhealthy and earns
+        # routing from its first passing probe.
+        self.healthy = healthy
         # a replica that has never passed a probe is "not up yet", not
         # "ejected": startup must not inflate the ejection counter
         self.ever_ready = False
@@ -165,6 +190,7 @@ class Replica:
         return {
             "url": self.url,
             "healthy": self.healthy,
+            "workerClass": self.worker_class,
             "inflight": self.inflight,
             "breaker": self.breaker.snapshot(),
         }
@@ -188,24 +214,17 @@ class Gateway:
         self.incidents = incidents
         m = self.metrics
         self._breaker_instruments = BreakerInstruments(m)
-        self.replicas = [
-            Replica(
-                url,
-                self._breaker_instruments.watch(
-                    CircuitBreaker(
-                        name=f"replica:{urlsplit(url.rstrip('/')).netloc or url}",
-                        failure_threshold=config.breaker_threshold,
-                        recovery_timeout_s=config.breaker_recovery_s,
-                    )
-                ),
-            )
-            for url in config.replica_urls
-        ]
-        for replica in self.replicas:
-            # a breaker tripping OPEN is an incident trigger: by the time
-            # an operator looks, the consecutive failures that tripped it
-            # are only in the flight recorder
-            replica.breaker.chain_listener(self._on_breaker_transition)
+        # membership funnel: every runtime add/retire mutates the replica
+        # set, the breaker map, and the per-replica gauges under this one
+        # lock, so the probe loop, routing, and the scrape never see them
+        # disagree (docs/fleet.md §Autoscaling)
+        self._membership_lock = threading.Lock()
+        classes = tuple(config.replica_classes) + ("device",) * max(
+            0, len(config.replica_urls) - len(config.replica_classes)
+        )
+        self.replicas: list[Replica] = []
+        for url, worker_class in zip(config.replica_urls, classes):
+            self._make_replica(url, worker_class, healthy=True)
         self.retry_budget = RetryBudget(ratio=config.retry_budget_ratio)
         self._m_replicas = m.gauge(
             "pio_fleet_replicas", "replicas configured behind this gateway"
@@ -248,6 +267,18 @@ class Gateway:
             "pio_fleet_panic_picks_total",
             "queries routed in panic mode: every replica failed its last "
             "probe, so health was ignored (breakers still applied)",
+        )
+        self._m_overflow = m.counter(
+            "pio_fleet_overflow_picks_total",
+            "queries routed to a cpu-fallback replica because every "
+            "healthy device-class replica was saturated (slower answer "
+            "instead of a shed)",
+        )
+        self._m_membership = m.counter(
+            "pio_fleet_membership_changes_total",
+            "runtime replica set changes through the membership funnel, "
+            "by kind (join/retire)",
+            labelnames=("kind",),
         )
         self._m_latency = m.histogram(
             "pio_gateway_request_seconds",
@@ -293,14 +324,33 @@ class Gateway:
         self._runner: web.AppRunner | None = None
         self._draining = False
         self._inflight_requests = 0
+        # high-water mark since the last telemetry tick: the instant
+        # inflight gauge aliases badly under bursty event-loop scheduling
+        # (a tick can sample 0 mid-flood); the autoscaler needs "was
+        # there concurrency since I last looked", not "at this instant"
+        self._inflight_peak = 0
         self._stop_event = asyncio.Event()
         self._drain_task: asyncio.Task | None = None
 
     # ------------------------------------------------------------- plumbing
     def _collect(self) -> None:
-        for r in self.replicas:
+        replicas = self.replicas
+        self._m_replicas.set(len(replicas))
+        for r in replicas:
             self._m_up.set(1.0 if r.healthy else 0.0, replica=r.name)
             self._m_inflight.set(float(r.inflight), replica=r.name)
+        # reconcile-against-live-set (same discipline as pio_ann_index_*):
+        # a retired replica's series must not outlive its membership —
+        # covers any write that raced the retire funnel
+        live = [r.name for r in replicas]
+        self._m_up.prune("replica", live)
+        self._m_inflight.prune("replica", live)
+        state_gauge = self.metrics.get("pio_breaker_state")
+        if state_gauge is not None and hasattr(state_gauge, "remove"):
+            live_breakers = {r.breaker.name for r in replicas}
+            for (bname,), _v in state_gauge.collect():
+                if bname.startswith("replica:") and bname not in live_breakers:
+                    state_gauge.remove(breaker=bname)
         if self.telemetry is not None:
             self._m_telemetry_records.set(
                 float(getattr(self.telemetry, "approx_count", 0))
@@ -357,12 +407,14 @@ class Gateway:
             total = sum(v for _key, v in self._m_responses.collect())
             return total, self._m_no_replica.total()
 
+        windows = self.config.slo_windows or DEFAULT_WINDOWS
         self.slo.add(
             "fleet-availability",
             "fraction of fleet queries answered without a 5xx, transport "
             "error, or shed",
             objective=0.999,
             source=availability,
+            windows=windows,
         )
         self.slo.add(
             "fleet-latency",
@@ -370,6 +422,7 @@ class Gateway:
             "(federated replica histograms)",
             objective=0.50,
             source=latency,
+            windows=windows,
         )
         self.slo.add(
             "fleet-shed",
@@ -377,7 +430,7 @@ class Gateway:
             "replica",
             objective=0.99,
             source=shed,
-            windows=DEFAULT_WINDOWS,
+            windows=windows,
         )
 
     # --------------------------------------------------- incident plumbing
@@ -426,6 +479,80 @@ class Gateway:
             **tags,
         )
 
+    # ----------------------------------------------------- fleet membership
+    def _make_replica(
+        self, url: str, worker_class: str, healthy: bool
+    ) -> Replica:
+        """Construct + register one replica: breaker watched (state
+        gauge), trip listener chained (incident trigger), appended to the
+        routing set. The only place replicas are born."""
+        breaker = self._breaker_instruments.watch(
+            CircuitBreaker(
+                name=f"replica:{urlsplit(url.rstrip('/')).netloc or url}",
+                failure_threshold=self.config.breaker_threshold,
+                recovery_timeout_s=self.config.breaker_recovery_s,
+            )
+        )
+        # a breaker tripping OPEN is an incident trigger: by the time an
+        # operator looks, the consecutive failures that tripped it are
+        # only in the flight recorder
+        breaker.chain_listener(self._on_breaker_transition)
+        replica = Replica(url, breaker, worker_class=worker_class, healthy=healthy)
+        self.replicas = [*self.replicas, replica]
+        return replica
+
+    def add_replica(self, url: str, worker_class: str = "device") -> Replica:
+        """Scale-out membership: one locked funnel adds the replica to
+        the routing set, the breaker map, and the probe loop's view in
+        one step. The replica joins UNHEALTHY — no query routes to it
+        until its first ``/healthz`` probe passes (a worker paying its
+        jax import must not eat traffic)."""
+        with self._membership_lock:
+            name = urlsplit(url.rstrip("/")).netloc or url
+            for r in self.replicas:
+                if r.name == name:
+                    raise ValueError(f"replica {name!r} already routed")
+            replica = self._make_replica(url, worker_class, healthy=False)
+            self._m_replicas.set(len(self.replicas))
+            self._m_membership.inc(kind="join")
+            self._note_transition("join", replica, worker_class=worker_class)
+            return replica
+
+    def retire_replica(self, url_or_name: str) -> Replica | None:
+        """Scale-in membership: remove the replica from routing through
+        the same locked funnel. New requests stop routing to it
+        immediately; requests already forwarded hold the Replica object
+        and complete normally (the worker drains them after its SIGTERM)
+        — the ordering that makes scale-in 5xx-free. Its live-set gauges
+        (up/inflight/breaker state) drop from the exposition; its span
+        cache is dropped too (a planned retire is not incident
+        evidence). Returns the retired replica, or None when unknown."""
+        name = urlsplit(url_or_name.rstrip("/")).netloc or url_or_name
+        with self._membership_lock:
+            victim = next((r for r in self.replicas if r.name == name), None)
+            if victim is None:
+                return None
+            self.replicas = [r for r in self.replicas if r is not victim]
+            self._breaker_instruments.unwatch(victim.breaker)
+            self._m_up.remove(replica=victim.name)
+            self._m_inflight.remove(replica=victim.name)
+            self._replica_spans.pop(victim.name, None)
+            self._m_replicas.set(len(self.replicas))
+            self._m_membership.inc(kind="retire")
+            self._note_transition(
+                "retire", victim, worker_class=victim.worker_class
+            )
+            return victim
+
+    def replica_shape(self) -> dict[str, int]:
+        """Routable-set census by replica class (the ``gateway`` side of
+        the autoscaler's shape; the supervisor's ``live_specs`` is the
+        process side)."""
+        shape: dict[str, int] = {}
+        for r in self.replicas:
+            shape[r.worker_class] = shape.get(r.worker_class, 0) + 1
+        return shape
+
     def cached_spans(self) -> list[dict[str, Any]]:
         """Sync merged-trace snapshot (gateway ring + per-tick replica
         caches) — what incident sources capture without touching the
@@ -472,9 +599,50 @@ class Gateway:
                 meta["panic"] = True
         if not candidates:
             return None
-        low = min(r.inflight for r in candidates)
+        chosen = None
+        for group in self._class_preference(candidates):
+            chosen = self._pick_admitted(group, key)
+            if chosen is not None:
+                break
+        if chosen is None:
+            return None
+        if chosen.worker_class == REPLICA_CLASS_CPU and any(
+            r.worker_class != REPLICA_CLASS_CPU for r in candidates
+        ):
+            # the device class was saturated (or breaker-refused): this
+            # query degrades to a slower cpu-fallback answer, not a shed
+            self._m_overflow.inc()
+            if meta is not None:
+                meta["overflow"] = True
+        return chosen
+
+    def _class_preference(self, candidates: list[Replica]) -> list[list[Replica]]:
+        """Cost/latency-aware routing order: device-bound replicas carry
+        traffic while any has headroom; cpu-fallback replicas absorb
+        overflow only; a fully saturated fleet falls back to least-loaded
+        across everything (queueing beats shedding)."""
+        cpu = [r for r in candidates if r.worker_class == REPLICA_CLASS_CPU]
+        device = [r for r in candidates if r.worker_class != REPLICA_CLASS_CPU]
+        if not cpu or not device:
+            return [candidates]
+        thresh = max(1, self.config.cpu_overflow_inflight)
+        under_dev = [r for r in device if r.inflight < thresh]
+        under_cpu = [r for r in cpu if r.inflight < thresh]
+        if under_dev:
+            return [g for g in (under_dev, under_cpu, candidates) if g]
+        if under_cpu:
+            return [under_cpu, candidates]
+        return [candidates]
+
+    @staticmethod
+    def _pick_admitted(group: list[Replica], key: str) -> Replica | None:
+        """Least-loaded within the group, consistent-hash tie-break,
+        first replica whose breaker admits the request."""
+        if not group:
+            return None
+        low = min(r.inflight for r in group)
         tied = sorted(
-            (r for r in candidates if r.inflight == low),
+            (r for r in group if r.inflight == low),
             key=lambda r: r.name,
         )
         # rotate the tie list by the sticky hash: same key -> same replica
@@ -489,7 +657,7 @@ class Gateway:
             return r
         # every tied replica's breaker refused; try the rest by load
         rest = sorted(
-            (r for r in candidates if r.inflight != low),
+            (r for r in group if r.inflight != low),
             key=lambda r: (r.inflight, r.name),
         )
         for r in rest:
@@ -594,6 +762,8 @@ class Gateway:
             TRACE_HEADER: trace_id,
         }
         self._inflight_requests += 1
+        if self._inflight_requests > self._inflight_peak:
+            self._inflight_peak = self._inflight_requests
         try:
             resp = await self._route_query(key, body, headers, trace_id)
         finally:
@@ -865,6 +1035,7 @@ class Gateway:
                 ("retries", "pio_fleet_retries_total"),
                 ("no_replica", "pio_fleet_no_replica_total"),
                 ("panic_picks", "pio_fleet_panic_picks_total"),
+                ("overflow_picks", "pio_fleet_overflow_picks_total"),
                 # the workers' own admission-control sheds, federated
                 ("load_shed", "pio_load_shed_total"),
             )
@@ -874,11 +1045,18 @@ class Gateway:
             for labels, v in fed.get("pio_fleet_requests_total", ())
             if labels.get("status") in ("5xx", "error")
         )
+        inflight_now = sum(r.inflight for r in self.replicas)
         gauges = {
             "queue_depth": sum(
                 v for _labels, v in fed.get("pio_queue_depth", ())
             ),
-            "inflight": sum(r.inflight for r in self.replicas),
+            "inflight": inflight_now,
+            # peak concurrency since the previous TELEMETRY TICK — the
+            # alias-proof pressure signal the autoscaler reads. This
+            # getter is side-effect-free: incident captures also call it
+            # (the 'fleet' evidence source), and a capture mid-spike must
+            # not consume the high-water mark out from under the ring
+            "inflight_peak": max(self._inflight_peak, inflight_now),
         }
         slo: dict[str, Any] = {}
         for report in self.slo.evaluate():
@@ -896,10 +1074,12 @@ class Gateway:
                     "healthy": r.healthy,
                     "ever_ready": r.ever_ready,
                     "inflight": r.inflight,
+                    "class": r.worker_class,
                     "breaker": r.breaker.snapshot()["state"],
                 }
                 for r in self.replicas
             },
+            "shape": self.replica_shape(),
             "counters": counters,
             "gauges": gauges,
             "slo": slo,
@@ -924,6 +1104,10 @@ class Gateway:
         if self.telemetry is not None:
             self.telemetry.append(record)
             self._m_telemetry_snapshots.inc()
+        # ONLY the telemetry tick consumes the inflight high-water mark
+        # (reset to the current level so a sustained plateau stays
+        # visible on the next record)
+        self._inflight_peak = self._inflight_requests
 
     async def _telemetry_loop(self) -> None:
         interval = self.config.telemetry_interval_s

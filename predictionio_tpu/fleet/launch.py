@@ -32,6 +32,7 @@ import sys
 
 from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
 from predictionio_tpu.fleet.supervisor import (
+    REPLICA_CLASS_CPU,
     Supervisor,
     SupervisorConfig,
     WorkerSpec,
@@ -52,6 +53,13 @@ _STRIP_FLAGS = {
     "--fleet-probe-interval": True,
     "--registry-sync-interval": True,
     "--obs-dir": True,
+    # elasticity flags are parent-only too (a worker recursively
+    # autoscaling would be a fork bomb with extra steps)
+    "--autoscale": False,
+    "--fleet-min": True,
+    "--fleet-max": True,
+    "--cpu-fallback-max": True,
+    "--autoscale-interval": True,
 }
 
 
@@ -118,11 +126,32 @@ def run_fleet(args, cli_argv: list[str]) -> int:
     )
     logbook = obs.get("logbook")
 
+    # scale-out slot allocator: names/ports after the boot-time range,
+    # monotonic so a retired slot is never reused while its old process
+    # could still be draining
+    next_slot = [n]
+
+    def spec_factory(worker_class: str) -> WorkerSpec:
+        i = next_slot[0]
+        next_slot[0] += 1
+        prefix = "c" if worker_class == REPLICA_CLASS_CPU else "w"
+        return WorkerSpec(
+            name=f"{prefix}{i}",
+            port=args.port + 1 + i,
+            worker_class=worker_class,
+        )
+
     def spawn(spec: WorkerSpec):
         argv = worker_argv(cli_argv, spec.port, sync_s)
+        env = None
+        if spec.worker_class == REPLICA_CLASS_CPU:
+            # the cpu-fallback class IS the cheap tier: same server
+            # stack, CPU backend — overflow degrades to slower answers
+            # instead of competing for the accelerator
+            env = {**os.environ, "JAX_PLATFORMS": "cpu"}
         if logbook is not None:
-            return spawn_with_log(argv, logbook, spec.name)
-        return subprocess.Popen(argv)
+            return spawn_with_log(argv, logbook, spec.name, env=env)
+        return subprocess.Popen(argv, env=env)
 
     supervisor = Supervisor(
         spawn=spawn,
@@ -149,10 +178,27 @@ def run_fleet(args, cli_argv: list[str]) -> int:
     )
     wire_incident_sources(obs.get("incidents"), gateway, supervisor)
 
+    autoscaler = None
+    if getattr(args, "autoscale", False):
+        ring = obs.get("telemetry")
+        if ring is None:
+            raise ValueError(
+                "--autoscale reads the telemetry ring; it cannot run with "
+                "the flight recorder disabled (--obs-dir '')"
+            )
+        autoscaler = build_autoscaler(
+            args, supervisor, gateway, spec_factory, ring, metrics, obs
+        )
+
     async def main() -> None:
         supervisor.start()
         loop = asyncio.get_running_loop()
         sup_task = asyncio.ensure_future(supervisor.run())
+        auto_task = (
+            asyncio.ensure_future(autoscaler.run())
+            if autoscaler is not None
+            else None
+        )
         try:
             loop.add_signal_handler(signal.SIGTERM, gateway.begin_drain)
         except (NotImplementedError, RuntimeError):
@@ -160,8 +206,10 @@ def run_fleet(args, cli_argv: list[str]) -> int:
         try:
             await gateway.run_until_stopped()
         finally:
-            sup_task.cancel()
-            await asyncio.gather(sup_task, return_exceptions=True)
+            tasks = [t for t in (sup_task, auto_task) if t is not None]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
             # workers drain on SIGTERM (create_server drain path); the
             # supervisor escalates to SIGKILL only past the grace window
             await loop.run_in_executor(None, supervisor.stop)
@@ -176,6 +224,13 @@ def run_fleet(args, cli_argv: list[str]) -> int:
             "(telemetry ring, worker logs, incident bundles; "
             "`pio incidents list`, `pio top --history`)"
         )
+    if autoscaler is not None:
+        cfg = autoscaler.policy.config
+        print(
+            f"Autoscaler on: device envelope [{cfg.min_replicas}.."
+            f"{cfg.max_replicas}], cpu-fallback max {cfg.cpu_fallback_max}, "
+            f"tick {cfg.tick_interval_s:g}s (docs/fleet.md §Autoscaling)"
+        )
     try:
         asyncio.run(main())
     finally:
@@ -183,6 +238,75 @@ def run_fleet(args, cli_argv: list[str]) -> int:
         if ring is not None:
             ring.close()
     return 0
+
+
+def build_autoscaler(
+    args,
+    supervisor: Supervisor,
+    gateway: Gateway,
+    spec_factory,
+    ring,
+    metrics: MetricsRegistry,
+    obs: dict,
+):
+    """Assemble the elasticity loop from the deploy flags: policy
+    envelope (``--fleet-min/--fleet-max/--cpu-fallback-max``), the
+    telemetry ring as the single signal path, the registry as the
+    mid-bake gate, and the incident recorder for envelope saturation."""
+    from predictionio_tpu.fleet.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        ScalingPolicy,
+        registry_rollout_probe,
+    )
+
+    n = int(args.fleet)
+
+    def flag(name, default, cast):
+        # None = unset -> default; an EXPLICIT value is honored verbatim
+        # and validated below (`or` would silently turn an explicit 0
+        # into the default — the unset-vs-zero bug PR 9 fixed for
+        # --registry-sync-interval)
+        value = getattr(args, name, None)
+        return default if value is None else cast(value)
+
+    config = AutoscalerConfig(
+        min_replicas=flag("fleet_min", 1, int),
+        # default headroom: twice the boot size (an envelope equal to N
+        # would make --autoscale a no-op outward)
+        max_replicas=flag("fleet_max", max(1, 2 * n), int),
+        cpu_fallback_max=flag("cpu_fallback_max", 0, int),
+        tick_interval_s=flag("autoscale_interval", 5.0, float),
+    )
+    if config.min_replicas < 1:
+        raise ValueError("--fleet-min must be >= 1 (0 would drain the fleet)")
+    if config.min_replicas > config.max_replicas:
+        raise ValueError("--fleet-min cannot exceed --fleet-max")
+    if config.max_replicas < n:
+        # booting above the ceiling would pin every pressured tick on
+        # "saturated" (bundle spam) while the operator believes the
+        # envelope bounds the fleet
+        raise ValueError(
+            f"--fleet-max ({config.max_replicas}) must be >= the --fleet "
+            f"boot size ({n})"
+        )
+    if config.cpu_fallback_max < 0:
+        raise ValueError("--cpu-fallback-max must be >= 0")
+    if config.tick_interval_s <= 0:
+        raise ValueError("--autoscale-interval must be > 0")
+    registry_dir = getattr(args, "registry_dir", None)
+    return Autoscaler(
+        ScalingPolicy(config),
+        supervisor,
+        gateway,
+        spec_factory,
+        ring=ring,
+        rollout_probe=(
+            registry_rollout_probe(registry_dir) if registry_dir else None
+        ),
+        metrics=metrics,
+        incidents=obs.get("incidents"),
+    )
 
 
 def build_obs_plane(
@@ -262,4 +386,10 @@ def wire_incident_sources(
     incidents.add_source("supervisor", supervisor.snapshot)
 
 
-__all__ = ["build_obs_plane", "run_fleet", "wire_incident_sources", "worker_argv"]
+__all__ = [
+    "build_autoscaler",
+    "build_obs_plane",
+    "run_fleet",
+    "wire_incident_sources",
+    "worker_argv",
+]

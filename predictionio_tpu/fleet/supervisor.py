@@ -47,13 +47,21 @@ class ProcessHandle(Protocol):
     def kill(self) -> None: ...
 
 
+REPLICA_CLASS_DEVICE = "device"
+REPLICA_CLASS_CPU = "cpu-fallback"
+
+
 @dataclasses.dataclass(frozen=True)
 class WorkerSpec:
-    """One replica slot: a stable name (metric label, restart identity)
-    and the port its QueryServer binds."""
+    """One replica slot: a stable name (metric label, restart identity),
+    the port its QueryServer binds, and its replica class — ``device``
+    (accelerator-bound, the latency path) or ``cpu-fallback`` (cheap
+    overflow capacity the gateway routes to only when the device class
+    is saturated; docs/fleet.md §Autoscaling)."""
 
     name: str
     port: int
+    worker_class: str = REPLICA_CLASS_DEVICE
 
     @property
     def url(self) -> str:
@@ -89,6 +97,8 @@ class _Worker:
         "next_restart_at",
         "parked",
         "restarts",
+        "retiring",
+        "retire_deadline",
     )
 
     def __init__(self, spec: WorkerSpec):
@@ -100,6 +110,10 @@ class _Worker:
         self.next_restart_at = 0.0
         self.parked = False
         self.restarts = 0  # respawns after a crash (not the initial spawn)
+        # scale-in state: a retiring worker was SIGTERMed to drain; its
+        # exit is a completion, not a crash, and it is never respawned
+        self.retiring = False
+        self.retire_deadline = 0.0
 
 
 class Supervisor:
@@ -161,6 +175,12 @@ class Supervisor:
             "for crashed workers)",
             labelnames=("replica", "path"),
         )
+        self._m_retired = m.counter(
+            "pio_fleet_retired_total",
+            "workers retired by a scale-in (graceful SIGTERM drain, never "
+            "respawned), by replica class",
+            labelnames=("worker_class",),
+        )
         if self.logbook is not None:
             for w in self._workers:
                 self._m_log_info.set(
@@ -195,11 +215,15 @@ class Supervisor:
         )
 
     def tick(self) -> None:
-        """One supervision pass: reap exits, schedule/execute restarts."""
+        """One supervision pass: reap exits, schedule/execute restarts,
+        escalate and reap retiring (scale-in) workers."""
         if self._stopping:
             return
         now = self._clock()
-        for w in self._workers:
+        for w in list(self._workers):
+            if w.retiring:
+                self._tick_retiring(w, now)
+                continue
             if w.parked:
                 continue
             if w.proc is None:
@@ -221,6 +245,102 @@ class Supervisor:
             )
             w.proc = None
             self._record_crash(w, rc=rc)
+
+    def _tick_retiring(self, w: _Worker, now: float) -> None:
+        """Drive one retiring worker: already gone -> reap; past the
+        drain grace -> SIGKILL (reaped on a later tick)."""
+        rc = w.proc.poll() if w.proc is not None else 0
+        if w.proc is None or rc is not None:
+            self._reap_retired(w, rc)
+            return
+        if now >= w.retire_deadline:
+            logger.warning(
+                "retiring worker %s ignored SIGTERM for %.0fs; killing",
+                w.spec.name,
+                self.config.term_grace_s,
+            )
+            try:
+                w.proc.kill()
+            except Exception:
+                pass
+            # one more grace slice for the SIGKILL to be reaped
+            w.retire_deadline = now + self.config.poll_interval_s
+
+    def _reap_retired(self, w: _Worker, rc: int | None) -> None:
+        self._workers = [x for x in self._workers if x is not w]
+        self._prune_series()
+        logger.info(
+            "worker %s retired (rc=%s); %d workers remain",
+            w.spec.name,
+            rc,
+            len(self._workers),
+        )
+
+    # ------------------------------------------------------------ elasticity
+    def add_worker(self, spec: WorkerSpec) -> None:
+        """Scale-out entry: register + spawn one new worker at runtime.
+        The restart/park policy covers it exactly like a boot-time
+        worker."""
+        if any(w.spec.name == spec.name for w in self._workers):
+            raise ValueError(f"worker {spec.name!r} already supervised")
+        w = _Worker(spec)
+        self._workers.append(w)
+        if self.logbook is not None:
+            self._m_log_info.set(
+                1.0, replica=spec.name, path=self.logbook.path(spec.name)
+            )
+        self._start_worker(w)
+
+    def retire_worker(self, name: str) -> bool:
+        """Scale-in entry: SIGTERM the worker (it drains via the
+        ``create_server`` drain path — in-flight answered, listener
+        closed) and stop restarting it. The exit is reaped by
+        :meth:`tick`, which drops the worker and its per-replica gauges.
+        Returns False when no such worker exists. The caller must stop
+        routing to the replica BEFORE retiring it (gateway membership
+        first, process second) — that ordering is what makes scale-in
+        5xx-free."""
+        for w in self._workers:
+            if w.spec.name != name or w.retiring:
+                continue
+            w.retiring = True
+            # the retire DECISION is the telemetry event (the reap is
+            # mechanics); counted here so the scale-in timeline in the
+            # exposition matches the moment routing stopped
+            self._m_retired.inc(worker_class=w.spec.worker_class)
+            w.retire_deadline = self._clock() + self.config.term_grace_s
+            if w.proc is not None and w.proc.poll() is None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+            else:
+                # nothing running (crashed/parked): reap immediately
+                self._reap_retired(w, None)
+            return True
+        return False
+
+    def live_specs(self) -> list[WorkerSpec]:
+        """Workers that count toward fleet capacity: not parked, not
+        retiring — the shape the autoscaler's envelope clamps."""
+        return [
+            w.spec for w in self._workers if not w.parked and not w.retiring
+        ]
+
+    def _prune_series(self) -> None:
+        """Reconcile per-replica gauges against the live worker set: a
+        retired worker's ``pio_fleet_worker_up``/``parked``/crash/log
+        series must drop from the exposition, not render as a live-but-
+        down replica forever. Counters (restarts, crash loops) stay —
+        they are monotonic history, not live-set claims."""
+        live = [w.spec.name for w in self._workers]
+        for gauge in (
+            self._m_up,
+            self._m_parked,
+            self._m_last_crash,
+            self._m_log_info,
+        ):
+            gauge.prune("replica", live)
 
     def _record_crash(self, w: _Worker, rc: int | None = None) -> None:
         now = self._clock()
@@ -331,6 +451,8 @@ class Supervisor:
                 "pid": getattr(w.proc, "pid", None) if w.proc else None,
                 "up": w.proc is not None and w.proc.poll() is None,
                 "parked": w.parked,
+                "retiring": w.retiring,
+                "workerClass": w.spec.worker_class,
                 "restarts": w.restarts,
                 "consecutiveCrashes": w.consecutive_crashes,
                 "logPath": (
@@ -357,6 +479,8 @@ def terminate_gracefully(proc: ProcessHandle) -> None:
 
 __all__ = [
     "ProcessHandle",
+    "REPLICA_CLASS_CPU",
+    "REPLICA_CLASS_DEVICE",
     "Supervisor",
     "SupervisorConfig",
     "WorkerSpec",

@@ -111,6 +111,31 @@ class _Metric:
         with self._lock:
             return sorted(self._series.items())
 
+    def remove(self, **labels: str) -> bool:
+        """Drop one labeled series from the exposition (no-op when it was
+        never observed). The fleet uses this when a replica is retired:
+        a gauge for a worker that no longer exists is not 'zero', it is
+        *gone* — rendering it forever reads as a live-but-down replica."""
+        key = self._key(labels)
+        with self._lock:
+            return self._series.pop(key, None) is not None
+
+    def prune(self, label_name: str, keep: Iterable[str]) -> int:
+        """Reconcile-against-live-set: drop every series whose value for
+        ``label_name`` is not in ``keep`` (the same discipline PR 10
+        applied to ``pio_ann_index_*``). Returns how many series were
+        dropped. Unlabeled metrics and metrics without ``label_name``
+        are left untouched."""
+        if label_name not in self.labelnames:
+            return 0
+        idx = self.labelnames.index(label_name)
+        keep_set = {str(v) for v in keep}
+        with self._lock:
+            dead = [k for k in self._series if k[idx] not in keep_set]
+            for k in dead:
+                del self._series[k]
+            return len(dead)
+
     def render(self, exemplars: bool = False) -> list[str]:
         raise NotImplementedError
 

@@ -460,6 +460,13 @@ def cmd_deploy(args) -> int:
         run_query_server,
     )
 
+    if getattr(args, "autoscale", False) and not args.fleet:
+        # silently ignoring elasticity flags would leave the operator
+        # believing the fleet sizes itself when nothing is running
+        return _die(
+            "--autoscale requires --fleet N (the autoscaler drives the "
+            "fleet supervisor; docs/fleet.md §Autoscaling)"
+        )
     if args.fleet:
         # N supervised worker processes behind a gateway (docs/fleet.md):
         # the gateway takes --port, workers take port+1..port+N and get a
@@ -1619,6 +1626,46 @@ def build_parser() -> argparse.ArgumentParser:
         default=1.0,
         help="gateway /healthz probe cadence in seconds (bounds how fast "
         "a dead replica is ejected)",
+    )
+    x.add_argument(
+        "--autoscale",
+        action="store_true",
+        help="size the fleet from the telemetry ring: scale out on "
+        "fast-window SLO burn / sustained queue depth, scale in (graceful "
+        "drain) on sustained idle; never resizes mid-bake; needs the "
+        "flight recorder (--obs-dir) enabled (docs/fleet.md §Autoscaling)",
+    )
+    x.add_argument(
+        "--fleet-min",
+        type=int,
+        default=None,
+        metavar="N",
+        help="autoscaler device-class floor (default 1)",
+    )
+    x.add_argument(
+        "--fleet-max",
+        type=int,
+        default=None,
+        metavar="N",
+        help="autoscaler device-class ceiling (default 2x the --fleet "
+        "boot size); wanting capacity past the whole envelope snapshots "
+        "an autoscaler-saturated incident bundle",
+    )
+    x.add_argument(
+        "--cpu-fallback-max",
+        type=int,
+        default=None,
+        metavar="N",
+        help="max cheap cpu-fallback replicas (JAX_PLATFORMS=cpu workers) "
+        "added once the device envelope is exhausted; the gateway routes "
+        "them overflow-first so spikes degrade to slower answers instead "
+        "of sheds (default 0 = disabled)",
+    )
+    x.add_argument(
+        "--autoscale-interval",
+        type=float,
+        default=None,
+        help="autoscaler control-loop cadence in seconds (default 5)",
     )
     x.add_argument(
         "--obs-dir",

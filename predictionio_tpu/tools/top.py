@@ -180,6 +180,7 @@ def summarize(
     out["stream"] = _stream_summary(metrics, now)
     out["train"] = _train_summary(metrics)
     out["fleet"] = _fleet_summary(metrics)
+    out["autoscaler"] = _autoscaler_summary(metrics)
     out["qps"] = None
     out["shed_rate"] = None
     out["stream_drain_rate"] = None
@@ -366,17 +367,27 @@ def _fleet_summary(metrics: Metrics) -> dict[str, Any] | None:
     ):
         return None
     replicas: dict[str, dict[str, Any]] = {}
+    # rows are created by LIVE-SET gauges only (the exporters prune these
+    # when a replica is retired by a scale-in); the monotonic counters
+    # below merely annotate surviving rows — a retired replica's ejection
+    # history must not resurrect it as a live-but-down entry
     for name, field, cast in (
         ("pio_fleet_replica_up", "up", lambda v: bool(v)),
         ("pio_fleet_replica_inflight", "inflight", float),
-        ("pio_fleet_ejections_total", "ejections", float),
-        ("pio_fleet_readmissions_total", "readmissions", float),
         ("pio_fleet_worker_last_crash_unix", "last_crash_unix", float),
     ):
         for labels, v in metrics.get(name, ()):
             rep = labels.get("replica")
             if rep:
                 replicas.setdefault(rep, {})[field] = cast(v)
+    for name, field in (
+        ("pio_fleet_ejections_total", "ejections"),
+        ("pio_fleet_readmissions_total", "readmissions"),
+    ):
+        for labels, v in metrics.get(name, ()):
+            rep = labels.get("replica")
+            if rep in replicas:
+                replicas[rep][field] = float(v)
     # the captured-log path rides an info gauge (bounded: one series per
     # replica); `pio top --fleet` shows it for workers that have crashed,
     # so the excerpt feeding the incident bundle is one `tail` away
@@ -400,6 +411,34 @@ def _fleet_summary(metrics: Metrics) -> dict[str, Any] | None:
             metrics, "pio_gateway_request_seconds", 0.50
         )
         * 1e3,
+    }
+
+
+def _autoscaler_summary(metrics: Metrics) -> dict[str, Any] | None:
+    """The autoscaler line, from the fleet parent's ``pio_autoscaler_*``
+    family: live shape per class vs the envelope, plus the decision
+    counters. None when no autoscaler runs on the scraped endpoint."""
+    if "pio_autoscaler_replicas" not in metrics:
+        return None
+    shape = {
+        labels.get("worker_class", "?"): v
+        for labels, v in metrics.get("pio_autoscaler_replicas", ())
+    }
+    return {
+        "shape": shape,
+        "min_replicas": _total(metrics, "pio_autoscaler_replicas_min"),
+        "max_replicas": _total(metrics, "pio_autoscaler_replicas_max"),
+        "cpu_fallback_max": _total(metrics, "pio_autoscaler_cpu_fallback_max"),
+        "scale_outs_total": _total(metrics, "pio_autoscaler_scale_outs_total"),
+        "scale_ins_total": _total(metrics, "pio_autoscaler_scale_ins_total"),
+        "deferred_total": _total(metrics, "pio_autoscaler_deferred_total"),
+        "saturated_total": _total(metrics, "pio_autoscaler_saturated_total"),
+        "overflow_picks_total": _total(
+            metrics, "pio_fleet_overflow_picks_total"
+        ),
+        "last_scale_unix": _total(metrics, "pio_autoscaler_last_scale_unix"),
+        "ticks_total": _total(metrics, "pio_autoscaler_ticks_total"),
+        "errors_total": _total(metrics, "pio_autoscaler_errors_total"),
     }
 
 
@@ -625,6 +664,34 @@ def render(summary: dict[str, Any], url: str) -> str:
                     f"{time.strftime('%H:%M:%S', time.localtime(info['last_crash_unix']))}"
                     f"   log {info['log_path']}"
                 )
+    scaler = summary.get("autoscaler")
+    if scaler is not None:
+        shape = scaler.get("shape") or {}
+        device = shape.get("device", 0.0)
+        cpu = shape.get("cpu-fallback", 0.0)
+        line = (
+            f"  autoscaler device {num(device)} "
+            f"[{num(scaler['min_replicas'])}..{num(scaler['max_replicas'])}]"
+        )
+        if scaler.get("cpu_fallback_max"):
+            line += (
+                f"   cpu {num(cpu)}/{num(scaler['cpu_fallback_max'])}"
+                f"   overflow {num(scaler['overflow_picks_total'])}"
+            )
+        line += (
+            f"   outs {num(scaler['scale_outs_total'])}"
+            f"   ins {num(scaler['scale_ins_total'])}"
+        )
+        if scaler.get("deferred_total"):
+            line += f"   deferred {num(scaler['deferred_total'])}"
+        if scaler.get("saturated_total"):
+            line += f"   SATURATED {num(scaler['saturated_total'])}"
+        if scaler.get("last_scale_unix"):
+            line += (
+                "   last "
+                f"{time.strftime('%H:%M:%S', time.localtime(scaler['last_scale_unix']))}"
+            )
+        lines.append(line)
     if summary.get("events_ingested"):
         lines.append(f"  ingested   {num(summary['events_ingested']):>12}")
     return "\n".join(lines)
@@ -676,6 +743,13 @@ def render_history(records: list[dict[str, Any]], window_s: float) -> str:
     ring surviving a gateway restart is the whole point."""
     if not records:
         return "pio top --history: no telemetry records in the window"
+    # the ring carries two record kinds: "fleet" snapshots (the series
+    # below) and the autoscaler's "scaling" decisions (rendered as a
+    # marker line — they have no gauges to sparkline)
+    scaling = [r for r in records if r.get("kind") == "scaling"]
+    records = [r for r in records if r.get("kind", "fleet") == "fleet"]
+    if not records:
+        return "pio top --history: no fleet snapshots in the window"
     t0 = float(records[0].get("t", 0.0))
     t1 = float(records[-1].get("t", t0))
     queue = [float(r.get("gauges", {}).get("queue_depth", 0.0)) for r in records]
@@ -716,6 +790,22 @@ def render_history(records: list[dict[str, Any]], window_s: float) -> str:
         )
     if alerts:
         lines.append(f"  ALERTING in {alerts} snapshot(s)")
+    if scaling:
+        last = scaling[-1]
+        decision = last.get("decision") or {}
+        shape = last.get("shape") or {}
+        lines.append(
+            f"  scaling    {len(scaling)} decision(s)   last: "
+            f"{decision.get('action', '?')} {decision.get('class') or ''} "
+            f"({decision.get('reason', '?')}) -> "
+            f"device {format_number(shape.get('device', 0))}"
+            + (
+                f" + cpu {format_number(shape.get('cpu'))}"
+                if shape.get("cpu")
+                else ""
+            )
+            + f"   {time.strftime('%H:%M:%S', time.localtime(float(last.get('t', 0.0))))}"
+        )
     return "\n".join(lines)
 
 

@@ -18,7 +18,14 @@ gateway, then:
    incident bundle containing the dead worker's captured stderr tail
    AND a merged gateway+replica trace for an affected request — the
    flight recorder is CI-proven on every run, not only in the slow
-   chaos suite.
+   chaos suite;
+6. **elasticity smoke** (ISSUE 13): one deterministic scale-out/scale-in
+   cycle through the autoscaler's apply funnel — a third worker is
+   spawned at runtime, earns routing via its first passing probe,
+   answers traffic, then is retired through the gateway-first drain
+   ordering with zero client-visible failures; both decisions must land
+   in the telemetry ring and the retired replica's gauges must drop
+   from the federated /metrics.
 
 Exit 0 = all held; any assertion exits nonzero and fails CI.
 """
@@ -248,6 +255,80 @@ async def orchestrate(obs_dir: str) -> int:
             "restarted replica never readmitted",
             120.0,
         )
+        # 6. elasticity smoke (ISSUE 13): one deterministic scale cycle
+        # through the autoscaler's apply funnel — spawn-at-runtime,
+        # probe-gated admission, drain-based retire, ring records
+        from predictionio_tpu.fleet.autoscaler import (
+            Autoscaler,
+            AutoscalerConfig,
+            Decision,
+            SCALE_IN,
+            SCALE_OUT,
+            ScalingPolicy,
+        )
+
+        extra_port = _free_port()
+
+        def spec_factory(worker_class: str) -> WorkerSpec:
+            return WorkerSpec("w2", extra_port, worker_class)
+
+        auto = Autoscaler(
+            ScalingPolicy(AutoscalerConfig(min_replicas=1, max_replicas=3)),
+            sup,
+            gw,
+            spec_factory,
+            ring=obs["telemetry"],
+            metrics=metrics,
+            incidents=obs["incidents"],
+        )
+        auto.apply(Decision(SCALE_OUT, "ci-smoke", "device", 1))
+        assert len(sup.live_specs()) == 3, "scale-out spawned no worker"
+        await wait_for(
+            lambda: _is(healthy_count, 3),
+            "scaled-out replica never became routable",
+            120.0,
+        )
+        for i in range(10):
+            assert await query(200 + i) == 200, "fleet failed after scale-out"
+        # scale-in: gateway stops routing first, then the worker drains —
+        # traffic through the cycle must stay failure-free
+        auto.apply(Decision(SCALE_IN, "ci-smoke", "device", 1))
+        failures = 0
+        for i in range(20):
+            if await query(300 + i) != 200:
+                failures += 1
+        assert failures == 0, f"{failures}/20 queries failed during scale-in"
+        async def live_count() -> int:
+            return len(sup.live_specs()) + sum(
+                1 for w in sup.snapshot() if w["retiring"]
+            )
+
+        await wait_for(
+            lambda: _is(live_count, 2), "retired worker never reaped", 30.0
+        )
+        scaling = [
+            r
+            for r in obs["telemetry"].records()
+            if r.get("kind") == "scaling"
+        ]
+        actions = [r["decision"]["action"] for r in scaling]
+        assert SCALE_OUT in actions and SCALE_IN in actions, (
+            f"scaling decisions missing from the telemetry ring: {actions}"
+        )
+        # the retired replica's live-set series dropped from /metrics
+        async with session.get(f"{gw_url}/metrics") as resp:
+            exposition = await resp.text()
+        retired_lines = [
+            line
+            for line in exposition.splitlines()
+            if line.startswith(
+                ("pio_fleet_replica_up", "pio_fleet_worker_up")
+            )
+            and (f":{extra_port}" in line or 'replica="w2"' in line)
+        ]
+        assert retired_lines == [], (
+            f"retired replica still in the exposition: {retired_lines}"
+        )
         # 5. incident-bundle smoke (ISSUE 11): the kill left a bundle
         # with the dead worker's stderr tail and a merged two-tier trace
         from predictionio_tpu.obs.incidents import list_bundles, load_bundle
@@ -281,6 +362,8 @@ async def orchestrate(obs_dir: str) -> int:
                     "incident_bundle": crash[0].bundle_id,
                     "incident_has_stderr_tail": True,
                     "incident_has_merged_trace": True,
+                    "elastic_cycle": "ok",
+                    "elastic_scaling_actions": actions,
                 }
             )
         )

@@ -22,7 +22,12 @@
 # stage (tests/test_flightrec.py) covers the plane itself: telemetry
 # ring rotation/resume, incident capture mechanics, cross-tier span
 # merging with the dead-replica cache, and trace-id continuity through
-# a gateway retry down to a storage span.
+# a gateway retry down to a storage span. The elasticity stage
+# (tests/test_autoscaler.py, incl. the slow-marked e2e) drives a REAL
+# 1->3->1 fleet through a spike trace: scale-out under load and the
+# drain-based scale-in both zero-5xx, scaling decisions in the
+# telemetry ring, an autoscaler-saturated incident bundle at the
+# envelope, and retired replicas' gauges dropped from the exposition.
 # See docs/resilience.md, docs/observability.md, docs/model_registry.md,
 # docs/streaming.md, docs/fleet.md.
 # Usage: scripts/run_chaos.sh [extra pytest args...]
@@ -33,5 +38,6 @@ cd "$repo_root"
 
 exec env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_resilience.py tests/test_obs.py tests/test_registry.py \
-  tests/test_stream.py tests/test_fleet.py tests/test_flightrec.py -q \
+  tests/test_stream.py tests/test_fleet.py tests/test_flightrec.py \
+  tests/test_autoscaler.py -q \
   -p no:cacheprovider "$@"

@@ -171,7 +171,7 @@ PYEOF
   #     chaos stage lives in tests/test_fleet.py (run by the chaos gate
   #     below); this is the fast availability+evidence rail.
   env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
-  echo "fleet smoke: gateway survives replica kill, pio top --fleet renders, incident bundle captured"
+  echo "fleet smoke: gateway survives replica kill, pio top --fleet renders, incident bundle captured, scale-out/scale-in cycle clean"
 
   # chaos gate includes the observability suite (tests/test_obs.py):
   # counters moving under faults + trace propagation are CI-asserted

@@ -1,0 +1,1333 @@
+"""SLO-driven elasticity tests (docs/fleet.md §Autoscaling, ISSUE 13).
+
+Three tiers, mirroring test_fleet.py:
+
+- policy units — the :class:`ScalingPolicy` decision engine driven by a
+  fake clock and hand-built telemetry-ring records: scale-out on burn /
+  sustained queue depth / sheds, scale-in on sustained idle, hysteresis
+  and cooldown suppression, min/max envelope clamps with cpu-fallback
+  spill, and mid-bake deferral — no process, no socket, no sleep;
+- membership integration — runtime replica add/retire through the
+  gateway's locked membership funnel (new requests stop routing, an
+  in-flight request to a retiring replica completes, retired gauges drop
+  from the exposition) and the supervisor's spawn-at-runtime/graceful
+  retire with fake clocks and procs;
+- e2e (slow, run by scripts/run_chaos.sh) — a spike trace against a
+  REAL 1->3->1 fleet: zero client-visible 5xx during both the scale-out
+  and the drain-based scale-in, scaling decisions landing in the
+  telemetry ring, and an incident bundle when the envelope saturates.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+
+import pytest
+
+from predictionio_tpu.fleet.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    DEFER,
+    Decision,
+    FleetShape,
+    HOLD,
+    SCALE_IN,
+    SCALE_OUT,
+    ScalingPolicy,
+    registry_rollout_probe,
+)
+from predictionio_tpu.fleet.supervisor import (
+    REPLICA_CLASS_CPU,
+    REPLICA_CLASS_DEVICE,
+    Supervisor,
+    SupervisorConfig,
+    WorkerSpec,
+)
+from predictionio_tpu.obs.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rec(
+    t: float,
+    burn: float = 0.0,
+    qd: float = 0.0,
+    healthy: int = 1,
+    shed: float = 0.0,
+    inflight: float = 0.0,
+) -> dict:
+    """One fake fleet snapshot, shaped like Gateway.fleet_snapshot()."""
+    return {
+        "kind": "fleet",
+        "t": t,
+        "replicas": {f"r{i}": {"healthy": True} for i in range(healthy)},
+        "gauges": {"queue_depth": qd, "inflight": inflight},
+        "counters": {"no_replica": shed, "load_shed": 0.0},
+        "slo": {
+            "fleet-latency": {
+                "alerting": False,
+                "burn": {"300": burn, "3600": burn / 2.0},
+            }
+        },
+    }
+
+
+def _policy(**kw) -> ScalingPolicy:
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("confirm_s", 10.0)
+    kw.setdefault("idle_sustain_s", 60.0)
+    kw.setdefault("scale_out_cooldown_s", 30.0)
+    kw.setdefault("scale_in_cooldown_s", 60.0)
+    return ScalingPolicy(AutoscalerConfig(**kw))
+
+
+NOW = 10_000.0
+
+
+class TestScalingPolicyScaleOut:
+    def test_sustained_burn_scales_out(self):
+        p = _policy()
+        recs = [_rec(NOW - 8, burn=2.0), _rec(NOW - 4, burn=2.0), _rec(NOW - 1, burn=2.0)]
+        d = p.decide(recs, FleetShape(1, 0), False, NOW)
+        assert (d.action, d.reason, d.replica_class) == (
+            SCALE_OUT,
+            "burn",
+            REPLICA_CLASS_DEVICE,
+        )
+
+    def test_one_pressured_record_is_probe_noise(self):
+        """Hysteresis: a single hot snapshot (one probe interval) must
+        not resize the fleet."""
+        p = _policy()
+        recs = [_rec(NOW - 8, burn=0.0), _rec(NOW - 1, burn=5.0)]
+        d = p.decide(recs, FleetShape(1, 0), False, NOW)
+        assert d.action == HOLD
+        # ... and a lone record in the window is never enough
+        d = p.decide([_rec(NOW - 1, burn=5.0)], FleetShape(1, 0), False, NOW)
+        assert d.action == HOLD
+
+    def test_sustained_queue_depth_scales_out(self):
+        p = _policy(queue_depth_high=8.0)
+        recs = [
+            _rec(NOW - 6, qd=20.0, healthy=2),
+            _rec(NOW - 1, qd=24.0, healthy=2),
+        ]
+        d = p.decide(recs, FleetShape(2, 0), False, NOW)
+        assert (d.action, d.reason) == (SCALE_OUT, "queue-depth")
+        # the same depth spread over enough replicas is NOT pressure
+        recs = [
+            _rec(NOW - 6, qd=20.0, healthy=4),
+            _rec(NOW - 1, qd=24.0, healthy=4),
+        ]
+        assert p.decide(recs, FleetShape(4, 0), False, NOW).action == HOLD
+
+    def test_fresh_shed_triggers_alone_without_confirmation(self):
+        """A shed already cost users 503s: a fresh shed delta triggers
+        even when the newest record samples calm (clients backing off
+        must not veto the response)."""
+        p = _policy()
+        recs = [_rec(NOW - 30, shed=0.0), _rec(NOW - 1, shed=5.0)]
+        d = p.decide(recs, FleetShape(1, 0), False, NOW)
+        assert (d.action, d.reason) == (SCALE_OUT, "shed")
+
+    def test_cooldown_suppresses_back_to_back_scale_out(self):
+        p = _policy(scale_out_cooldown_s=30.0)
+        recs = [_rec(NOW - 8, burn=2.0), _rec(NOW - 1, burn=2.0)]
+        d = p.decide(recs, FleetShape(1, 0), False, NOW)
+        assert d.action == SCALE_OUT
+        p.note_applied(d, NOW)
+        recs2 = [_rec(NOW + 2, burn=2.0), _rec(NOW + 9, burn=2.0)]
+        assert p.decide(recs2, FleetShape(2, 0), False, NOW + 10).action == HOLD
+        assert (
+            p.decide(recs2, FleetShape(2, 0), False, NOW + 10).reason
+            == "cooldown-out"
+        )
+        # past the cooldown the same pressure acts again
+        recs3 = [_rec(NOW + 32, burn=2.0), _rec(NOW + 39, burn=2.0)]
+        assert p.decide(recs3, FleetShape(2, 0), False, NOW + 40).action == SCALE_OUT
+
+    def test_unapplied_decision_starts_no_cooldown(self):
+        """A resize the executor failed to apply must stay actionable:
+        only note_applied starts the cooldown clock."""
+        p = _policy()
+        recs = [_rec(NOW - 8, burn=2.0), _rec(NOW - 1, burn=2.0)]
+        assert p.decide(recs, FleetShape(1, 0), False, NOW).action == SCALE_OUT
+        assert p.decide(recs, FleetShape(1, 0), False, NOW).action == SCALE_OUT
+
+    def test_max_clamp_spills_to_cpu_fallback_then_saturates(self):
+        p = _policy(max_replicas=2, cpu_fallback_max=1)
+        recs = [_rec(NOW - 8, burn=2.0), _rec(NOW - 1, burn=2.0)]
+        d = p.decide(recs, FleetShape(2, 0), False, NOW)
+        assert (d.action, d.replica_class) == (SCALE_OUT, REPLICA_CLASS_CPU)
+        d = p.decide(recs, FleetShape(2, 1), False, NOW)
+        assert (d.action, d.reason) == (HOLD, "saturated")
+
+    def test_confirm_fraction_tolerates_aliased_cold_samples(self):
+        """One cold instant sampled inside an otherwise hot window must
+        not veto the scale-out (live-verify finding: the gateway's
+        instantaneous gauges alias under bursty scheduling)."""
+        p = _policy(confirm_fraction=0.8)
+        recs = [_rec(NOW - 9 + i, burn=2.0) for i in range(9)]
+        recs[4] = _rec(NOW - 5, burn=0.0)  # 8/9 hot ≈ 0.89 >= 0.8
+        d = p.decide(recs, FleetShape(1, 0), False, NOW)
+        assert d.action == SCALE_OUT
+        # ...but a half-cold window is still no trend
+        for i in range(0, 9, 2):
+            recs[i] = _rec(NOW - 9 + i, burn=0.0)
+        assert p.decide(recs, FleetShape(1, 0), False, NOW).action == HOLD
+
+    def test_inflight_peak_signal_beats_instant_aliasing(self):
+        """The per-tick PEAK concurrency pressures even when every
+        instant sample landed on an idle moment."""
+        p = _policy(inflight_high_per_replica=16.0)
+        recs = [_rec(NOW - 6, healthy=1), _rec(NOW - 1, healthy=1)]
+        for r in recs:
+            r["gauges"]["inflight"] = 0.0
+            r["gauges"]["inflight_peak"] = 24.0
+        d = p.decide(recs, FleetShape(1, 0), False, NOW)
+        assert (d.action, d.reason) == (SCALE_OUT, "inflight")
+        # and a nonzero peak BLOCKS the idle detector symmetrically
+        p2 = _policy(idle_sustain_s=60.0, idle_inflight_per_replica=1.0)
+        idle = [_rec(t) for t in range(int(NOW - 70), int(NOW), 10)]
+        for r in idle:
+            r["gauges"]["inflight_peak"] = 9.0
+        assert p2.decide(idle, FleetShape(3, 0), False, NOW).action == HOLD
+
+    def test_cpu_fallback_disabled_saturates_at_device_max(self):
+        p = _policy(max_replicas=2, cpu_fallback_max=0)
+        recs = [_rec(NOW - 8, burn=2.0), _rec(NOW - 1, burn=2.0)]
+        d = p.decide(recs, FleetShape(2, 0), False, NOW)
+        assert (d.action, d.reason) == (HOLD, "saturated")
+
+
+class TestScalingPolicyScaleIn:
+    def _idle_records(self, start: float, end: float, step: float = 10.0):
+        t, out = start, []
+        while t <= end:
+            out.append(_rec(t))
+            t += step
+        return out
+
+    def test_sustained_idle_scales_in(self):
+        p = _policy(idle_sustain_s=60.0)
+        recs = self._idle_records(NOW - 70, NOW - 1)
+        d = p.decide(recs, FleetShape(3, 0), False, NOW)
+        assert (d.action, d.replica_class) == (SCALE_IN, REPLICA_CLASS_DEVICE)
+
+    def test_idle_window_must_be_covered(self):
+        """Two cold records ten seconds apart must not vouch for a
+        minute of idleness."""
+        p = _policy(idle_sustain_s=60.0)
+        recs = [_rec(NOW - 12), _rec(NOW - 2)]
+        assert p.decide(recs, FleetShape(3, 0), False, NOW).action == HOLD
+
+    def test_warm_burn_blocks_scale_in(self):
+        p = _policy(idle_sustain_s=60.0, idle_burn_max=0.25)
+        recs = self._idle_records(NOW - 70, NOW - 1)
+        recs[-1] = _rec(NOW - 1, burn=0.5)
+        assert p.decide(recs, FleetShape(3, 0), False, NOW).action == HOLD
+
+    def test_sheds_in_window_block_scale_in(self):
+        p = _policy(idle_sustain_s=60.0)
+        recs = self._idle_records(NOW - 70, NOW - 1)
+        recs[-1]["counters"]["no_replica"] = 2.0
+        assert p.decide(recs, FleetShape(3, 0), False, NOW).action == HOLD
+
+    def test_min_clamp_holds_at_floor(self):
+        p = _policy(min_replicas=1)
+        recs = self._idle_records(NOW - 70, NOW - 1)
+        d = p.decide(recs, FleetShape(1, 0), False, NOW)
+        assert (d.action, d.reason) == (HOLD, "at-floor")
+
+    def test_cpu_fallback_retires_first(self):
+        p = _policy(cpu_fallback_max=2)
+        recs = self._idle_records(NOW - 70, NOW - 1)
+        d = p.decide(recs, FleetShape(2, 1), False, NOW)
+        assert (d.action, d.replica_class) == (SCALE_IN, REPLICA_CLASS_CPU)
+
+    def test_scale_in_cooldown_counts_any_resize(self):
+        """An idle dip right after a scale-out must not whipsaw the
+        fleet back down."""
+        p = _policy(idle_sustain_s=60.0, scale_in_cooldown_s=120.0)
+        out = p.decide(
+            [_rec(NOW - 8, burn=2.0), _rec(NOW - 1, burn=2.0)],
+            FleetShape(1, 0),
+            False,
+            NOW,
+        )
+        p.note_applied(out, NOW)
+        recs = self._idle_records(NOW + 10, NOW + 80)
+        d = p.decide(recs, FleetShape(2, 0), False, NOW + 81)
+        assert (d.action, d.reason) == (HOLD, "cooldown-in")
+
+
+class TestScalingPolicyMidBake:
+    def test_resize_mid_bake_is_deferred_then_fires(self):
+        p = _policy()
+        recs = [_rec(NOW - 8, burn=2.0), _rec(NOW - 1, burn=2.0)]
+        d = p.decide(recs, FleetShape(1, 0), rollout_active=True, now=NOW)
+        assert d.action == DEFER
+        assert d.reason.startswith("mid-bake")
+        assert p.pending is not None
+        # still baking: stays deferred (pending survives)
+        d = p.decide([], FleetShape(1, 0), rollout_active=True, now=NOW + 30)
+        assert d.action == HOLD and p.pending is not None
+        # bake ended: the DEFERRED resize fires even though the signal
+        # that wanted it is stale (records empty)
+        d = p.decide([], FleetShape(1, 0), rollout_active=False, now=NOW + 60)
+        assert d.action == SCALE_OUT and d.deferred is True
+        p.note_applied(d, NOW + 60)
+        assert p.pending is None
+
+    def test_deferred_resize_reclamped_against_current_shape(self):
+        """The fleet may have drifted while baking (crash, park): a
+        deferral that no longer fits the envelope dissolves instead of
+        over-scaling."""
+        p = _policy(max_replicas=2)
+        recs = [_rec(NOW - 8, burn=2.0), _rec(NOW - 1, burn=2.0)]
+        p.decide(recs, FleetShape(1, 0), rollout_active=True, now=NOW)
+        assert p.pending is not None
+        d = p.decide([], FleetShape(2, 0), rollout_active=False, now=NOW + 60)
+        assert (d.action, d.reason) == (HOLD, "saturated")
+        assert p.pending is None
+
+    def test_scale_in_mid_bake_is_deferred_too(self):
+        p = _policy(idle_sustain_s=60.0)
+        recs = [_rec(t) for t in range(int(NOW - 70), int(NOW), 10)]
+        d = p.decide(recs, FleetShape(3, 0), rollout_active=True, now=NOW)
+        assert d.action == DEFER and p.pending.action == SCALE_IN
+
+    def test_defer_is_an_episode_not_a_tick_counter(self):
+        """The same resize re-wanted on later ticks of the same bake
+        updates the pending slot silently: one DEFER per deferral, so
+        the counter/ring record the Autoscaler emits count resizes
+        deferred, not ticks spent baking."""
+        p = _policy()
+        recs = [_rec(NOW - 8, burn=2.0), _rec(NOW - 1, burn=2.0)]
+        assert p.decide(recs, FleetShape(1, 0), True, NOW).action == DEFER
+        later = [_rec(NOW + 2, burn=2.0), _rec(NOW + 9, burn=2.0)]
+        d = p.decide(later, FleetShape(1, 0), True, NOW + 10)
+        assert (d.action, d.reason) == (HOLD, "mid-bake-pending")
+        assert p.pending is not None and p.pending.action == SCALE_OUT
+
+    def test_deferred_scale_in_dissolves_into_a_fresh_spike(self):
+        """The world moved while the bake ran: a scale-in deferred during
+        an idle spell must NOT retire capacity into a spike that arrived
+        mid-bake — a contradicted deferral dissolves."""
+        p = _policy(idle_sustain_s=60.0)
+        idle = [_rec(t) for t in range(int(NOW - 70), int(NOW), 10)]
+        assert p.decide(idle, FleetShape(3, 0), True, NOW).action == DEFER
+        spike = [
+            _rec(NOW + 50, burn=3.0),
+            _rec(NOW + 55, burn=3.0),
+            _rec(NOW + 59, burn=3.0),
+        ]
+        d = p.decide(spike, FleetShape(3, 0), False, NOW + 60)
+        assert d.action == HOLD and "contradicted" in d.reason
+        assert p.pending is None
+        # ...and the spike itself acts normally on the NEXT tick (given
+        # envelope headroom)
+        assert p.decide(spike, FleetShape(2, 0), False, NOW + 60).action == SCALE_OUT
+
+    def test_deferred_scale_out_dissolves_when_fleet_went_idle(self):
+        p = _policy(idle_sustain_s=60.0)
+        hot = [_rec(NOW - 8, burn=2.0), _rec(NOW - 1, burn=2.0)]
+        assert p.decide(hot, FleetShape(2, 0), True, NOW).action == DEFER
+        idle = [
+            _rec(t) for t in range(int(NOW + 100), int(NOW + 170), 10)
+        ]
+        d = p.decide(idle, FleetShape(2, 0), False, NOW + 170)
+        assert d.action == HOLD and "contradicted" in d.reason
+        assert p.pending is None
+
+
+class TestScalingPolicyShedBaseline:
+    def test_stale_shed_outside_confirm_window_never_retriggers(self):
+        """Sheds from minutes ago must not ratchet the fleet up off one
+        transiently-pressured record: the shed delta baselines against
+        the newest record just OUTSIDE the confirm window."""
+        p = _policy(confirm_s=10.0)
+        recs = [
+            _rec(NOW - 400, shed=5.0),  # old incident, long recovered
+            _rec(NOW - 60, shed=5.0),
+            _rec(NOW - 15, shed=5.0),  # newest pre-window record
+            _rec(NOW - 1, burn=5.0, shed=5.0),  # one hot record, no NEW shed
+        ]
+        d = p.decide(recs, FleetShape(1, 0), False, NOW)
+        assert d.action == HOLD  # one pressured record stays probe noise
+
+    def test_fresh_shed_inside_confirm_window_triggers(self):
+        p = _policy(confirm_s=10.0)
+        recs = [
+            _rec(NOW - 15, shed=5.0),
+            _rec(NOW - 1, burn=5.0, shed=8.0),  # 3 NEW sheds in-window
+        ]
+        d = p.decide(recs, FleetShape(1, 0), False, NOW)
+        assert (d.action, d.reason) == (SCALE_OUT, "shed")
+
+
+# ---------------------------------------------------------------------------
+# supervisor: spawn-at-runtime + graceful retire (fake clock + proc)
+# ---------------------------------------------------------------------------
+
+
+class TestSupervisorElasticity:
+    def _sup(self, **cfg_kw):
+        from tests.test_fleet import FakeClock, FakeProc
+
+        clock = FakeClock()
+        spawned: list = []
+
+        def spawn(spec):
+            p = FakeProc(ignore_term=cfg_kw.pop("_ignore_term", False))
+            spawned.append(p)
+            return p
+
+        ignore = cfg_kw.pop("ignore_term", False)
+        if ignore:
+
+            def spawn(spec):  # noqa: F811 - deliberate override
+                from tests.test_fleet import FakeProc as FP
+
+                p = FP(ignore_term=True)
+                spawned.append(p)
+                return p
+
+        sup = Supervisor(
+            spawn,
+            [WorkerSpec("w0", 9000)],
+            SupervisorConfig(**cfg_kw),
+            clock=clock,
+        )
+        return sup, spawned, clock
+
+    def test_add_worker_spawns_and_supervises(self):
+        sup, spawned, clock = self._sup(backoff_base_s=0.0)
+        sup.start()
+        sup.add_worker(WorkerSpec("w1", 9001, REPLICA_CLASS_CPU))
+        assert len(spawned) == 2
+        assert [s.name for s in sup.live_specs()] == ["w0", "w1"]
+        # the restart policy covers the added worker too
+        spawned[-1].exit(1)
+        sup.tick()  # reap
+        sup.tick()  # respawn (zero backoff)
+        assert len(spawned) == 3
+
+    def test_add_worker_rejects_duplicate_name(self):
+        sup, spawned, clock = self._sup()
+        sup.start()
+        with pytest.raises(ValueError):
+            sup.add_worker(WorkerSpec("w0", 9001))
+
+    def test_retire_terminates_drains_and_reaps(self):
+        sup, spawned, clock = self._sup(term_grace_s=10.0)
+        sup.start()
+        sup.add_worker(WorkerSpec("w1", 9001))
+        assert sup.retire_worker("w1") is True
+        assert spawned[1].terminated
+        # not reaped yet (exit honored by FakeProc.terminate -> rc=-15)
+        sup.tick()
+        assert [s.name for s in sup.live_specs()] == ["w0"]
+        assert all(w["name"] != "w1" for w in sup.snapshot())
+        # retire is a completion, never a crash: no respawn ever
+        clock.advance(1000.0)
+        sup.tick()
+        assert len(spawned) == 2
+        assert sup.metrics.get("pio_fleet_retired_total").total() == 1
+
+    def test_retire_escalates_to_kill_past_grace(self):
+        sup, spawned, clock = self._sup(term_grace_s=5.0, ignore_term=True)
+        sup.start()
+        sup.retire_worker("w0")
+        sup.tick()
+        assert not spawned[0].killed
+        clock.advance(6.0)
+        sup.tick()  # grace expired: SIGKILL
+        assert spawned[0].killed
+        sup.tick()  # killed proc reaped
+        assert sup.snapshot() == []
+
+    def test_retired_worker_gauges_drop_from_exposition(self):
+        """Satellite (federation/top staleness): a retired replica's
+        pio_fleet_worker_up/parked series must DROP, not render as a
+        live-but-down worker forever."""
+        sup, spawned, clock = self._sup()
+        sup.start()
+        sup.add_worker(WorkerSpec("w1", 9001))
+        text = sup.metrics.render_prometheus()
+        assert 'pio_fleet_worker_up{replica="w1"}' in text
+        sup.retire_worker("w1")
+        sup.tick()
+        text = sup.metrics.render_prometheus()
+        assert 'pio_fleet_worker_up{replica="w1"}' not in text
+        assert 'pio_fleet_worker_parked{replica="w1"}' not in text
+        assert 'pio_fleet_worker_up{replica="w0"}' in text
+
+    def test_live_specs_excludes_parked_and_retiring(self):
+        sup, spawned, clock = self._sup(
+            term_grace_s=1e9, ignore_term=True, crash_loop_budget=0
+        )
+        sup.start()
+        sup.add_worker(WorkerSpec("w1", 9001))
+        sup.add_worker(WorkerSpec("w2", 9002))
+        sup.retire_worker("w1")  # retiring (proc ignores SIGTERM)
+        spawned[2].exit(1)
+        sup.tick()  # w2 over the zero crash budget: parked
+        assert [s.name for s in sup.live_specs()] == ["w0"]
+
+
+# ---------------------------------------------------------------------------
+# gateway: dynamic membership + class-aware routing
+# ---------------------------------------------------------------------------
+
+
+class TestGatewayMembership:
+    def test_added_replica_earns_routing_via_probe(self):
+        from tests.test_fleet import FakeReplica, _gateway_rig
+
+        replicas, run = _gateway_rig(1)
+        late = FakeReplica("late")
+
+        async def body(gw, client):
+            late.ready = False  # still booting: probes must not admit it
+            url = await late.start()
+            added = gw.add_replica(url)
+            assert added.healthy is False  # joins unrouted
+            await asyncio.sleep(0.15)  # probe passes run and keep it out
+            # unhealthy member: traffic keeps flowing to the old replica
+            for i in range(4):
+                assert (
+                    await client.post(
+                        "/queries.json", json={"user": f"u{i}"}
+                    )
+                ).status == 200
+            assert late.queries == 0
+            late.ready = True
+            await asyncio.sleep(0.15)  # a probe pass admits it
+            assert added.healthy is True
+            for i in range(12):
+                await client.post("/queries.json", json={"user": f"x{i}"})
+            assert late.queries > 0
+            await late.stop()
+
+        run(body)
+
+    def test_duplicate_add_raises(self):
+        from tests.test_fleet import _gateway_rig
+
+        replicas, run = _gateway_rig(1)
+
+        async def body(gw, client):
+            with pytest.raises(ValueError):
+                gw.add_replica(gw.replicas[0].url)
+
+        run(body)
+
+    def test_retire_stops_new_routing_but_inflight_completes(self):
+        """The scale-in ordering invariant: membership first, process
+        second — a request already proxied to the retiring replica is
+        answered, new requests never route there."""
+        from tests.test_fleet import _gateway_rig
+
+        replicas, run = _gateway_rig(2)
+        for fake in replicas:
+            fake.delay_s = 0.4  # every answer is slow: any pick parks
+
+        async def body(gw, client):
+            # park a slow request on some replica, then retire it mid-flight
+            victim = slow = None
+            for i in range(40):
+                fut = asyncio.ensure_future(
+                    client.post("/queries.json", json={"user": f"u{i}"})
+                )
+                await asyncio.sleep(0.05)
+                busy = [r for r in gw.replicas if r.inflight > 0]
+                if busy:
+                    victim, slow = busy[0], fut
+                    break
+                resp = await fut
+                assert resp.status == 200
+            assert slow is not None, "no replica ever saw a request"
+            assert gw.retire_replica(victim.name) is victim
+            resp = await slow
+            assert resp.status == 200  # in-flight completed, not torn down
+            # new traffic all lands on the survivor
+            survivor = gw.replicas[0]
+            before = {r.name for r in gw.replicas}
+            assert victim.name not in before and len(gw.replicas) == 1
+            for i in range(10):
+                resp = await client.post(
+                    "/queries.json", json={"user": f"z{i}"}
+                )
+                assert resp.status == 200
+            assert survivor.healthy
+
+        run(body)
+
+    def test_retired_replica_series_drop_from_metrics(self):
+        from tests.test_fleet import _gateway_rig
+
+        replicas, run = _gateway_rig(2)
+
+        async def body(gw, client):
+            for i in range(6):
+                await client.post("/queries.json", json={"user": f"u{i}"})
+            victim = gw.replicas[1]
+            text = gw.metrics.render_prometheus()
+            assert f'pio_fleet_replica_up{{replica="{victim.name}"}}' in text
+            assert (
+                f'pio_breaker_state{{breaker="replica:{victim.name}"}}' in text
+            )
+            gw.retire_replica(victim.name)
+            text = gw.metrics.render_prometheus()
+            assert f'replica="{victim.name}"' not in "".join(
+                line
+                for line in text.splitlines()
+                if line.startswith(
+                    ("pio_fleet_replica_up", "pio_fleet_replica_inflight")
+                )
+            )
+            assert (
+                f'pio_breaker_state{{breaker="replica:{victim.name}"}}'
+                not in text
+            )
+            # monotonic history survives: the per-attempt counter stays
+            assert f'replica="{victim.name}"' in "".join(
+                line
+                for line in text.splitlines()
+                if line.startswith("pio_fleet_requests_total")
+            )
+            assert gw.metrics.get("pio_fleet_replicas").value() == 1.0
+
+        run(body)
+
+    def test_top_fleet_line_drops_retired_replica(self):
+        """Satellite: `pio top --fleet` must not render a retired replica
+        from its leftover ejection/readmission counters."""
+        from predictionio_tpu.tools.top import parse_prometheus, summarize
+        from tests.test_fleet import _gateway_rig
+
+        replicas, run = _gateway_rig(2)
+
+        async def body(gw, client):
+            victim = gw.replicas[1]
+            # leave a counter trace for the victim, then retire it
+            gw._m_ejections.inc(replica=victim.name)
+            gw._m_readmissions.inc(replica=victim.name)
+            gw.retire_replica(victim.name)
+            summary = summarize(parse_prometheus(gw.metrics.render_prometheus()))
+            fleet = summary["fleet"]
+            assert victim.name not in fleet["replicas"]
+            assert fleet["replicas_total"] == 1.0
+
+        run(body)
+
+    def test_cpu_fallback_gets_overflow_only(self):
+        from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+
+        gw = Gateway(
+            GatewayConfig(
+                replica_urls=(
+                    "http://127.0.0.1:9101",
+                    "http://127.0.0.1:9102",
+                ),
+                replica_classes=(REPLICA_CLASS_DEVICE, REPLICA_CLASS_CPU),
+                cpu_overflow_inflight=4,
+            )
+        )
+        device, cpu = gw.replicas
+        # idle fleet: every pick lands on the device replica
+        for i in range(8):
+            assert gw.pick_replica(f"u{i}").worker_class == REPLICA_CLASS_DEVICE
+        assert gw.metrics.get("pio_fleet_overflow_picks_total").total() == 0
+        # saturate the device class: picks spill to cpu-fallback and are
+        # counted as overflow (degraded answer, not a shed)
+        device.inflight = 4
+        meta: dict = {}
+        picked = gw.pick_replica("u-spill", meta=meta)
+        assert picked is cpu and meta.get("overflow") is True
+        assert gw.metrics.get("pio_fleet_overflow_picks_total").total() == 1
+        # device headroom back: routing returns to the fast path
+        device.inflight = 0
+        assert gw.pick_replica("u-back").worker_class == REPLICA_CLASS_DEVICE
+
+    def test_fleet_snapshot_is_side_effect_free_on_the_peak(self):
+        """Incident captures read fleet_snapshot too: a capture mid-spike
+        must not consume the inflight high-water mark out from under the
+        telemetry ring (only the telemetry tick resets it)."""
+        from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+
+        gw = Gateway(GatewayConfig(replica_urls=("http://127.0.0.1:9121",)))
+        gw._inflight_peak = 7
+        assert gw.fleet_snapshot()["gauges"]["inflight_peak"] == 7.0
+        # a second read (the incident capture) still sees the peak
+        assert gw.fleet_snapshot()["gauges"]["inflight_peak"] == 7.0
+
+    def test_saturated_everything_still_routes_least_loaded(self):
+        from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+
+        gw = Gateway(
+            GatewayConfig(
+                replica_urls=(
+                    "http://127.0.0.1:9111",
+                    "http://127.0.0.1:9112",
+                ),
+                replica_classes=(REPLICA_CLASS_DEVICE, REPLICA_CLASS_CPU),
+                cpu_overflow_inflight=2,
+            )
+        )
+        device, cpu = gw.replicas
+        device.inflight = 3
+        cpu.inflight = 7
+        assert gw.pick_replica("u") is device  # queueing beats shedding
+
+
+# ---------------------------------------------------------------------------
+# the control loop: ring -> policy -> supervisor + gateway
+# ---------------------------------------------------------------------------
+
+
+class FakeRing:
+    def __init__(self):
+        self.records_list: list[dict] = []
+
+    def append(self, record: dict) -> int:
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec["seq"] = len(self.records_list)
+        self.records_list.append(rec)
+        return rec["seq"]
+
+    def window(self, seconds: float) -> list[dict]:
+        cutoff = time.time() - seconds
+        return [r for r in self.records_list if r["t"] >= cutoff]
+
+    def records(self) -> list[dict]:
+        return list(self.records_list)
+
+
+class FakeIncidents:
+    def __init__(self):
+        self.triggers: list[tuple[str, dict]] = []
+
+    def trigger(self, kind, context=None, texts=None):
+        self.triggers.append((kind, context or {}))
+        return "/fake/bundle"
+
+
+def _autoscaler_rig(n_fake_replicas: int = 4, **policy_kw):
+    """Real Supervisor (fake procs) + real Gateway (fake replica servers)
+    + FakeRing; yields (autoscaler, ring, incidents, gw, sup, run)."""
+    from tests.test_fleet import FakeProc, FakeReplica
+
+    policy_kw.setdefault("min_replicas", 1)
+    policy_kw.setdefault("max_replicas", 3)
+    policy_kw.setdefault("confirm_s", 10.0)
+    policy_kw.setdefault("idle_sustain_s", 20.0)
+    policy_kw.setdefault("scale_out_cooldown_s", 0.0)
+    policy_kw.setdefault("scale_in_cooldown_s", 0.0)
+    fakes = [FakeReplica(f"f{i}") for i in range(n_fake_replicas)]
+
+    async def start(body):
+        from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+
+        urls = [await f.start() for f in fakes]
+        metrics = MetricsRegistry()
+        spawned: list = []
+
+        def spawn(spec):
+            p = FakeProc()
+            spawned.append(p)
+            return p
+
+        sup = Supervisor(
+            spawn, [WorkerSpec("w0", 9000)], SupervisorConfig(), metrics=metrics
+        )
+        gw = Gateway(
+            GatewayConfig(
+                replica_urls=(urls[0],), probe_interval_s=0.05
+            ),
+            metrics=metrics,
+        )
+        slot = [1]
+
+        def spec_factory(worker_class: str) -> WorkerSpec:
+            i = slot[0]
+            slot[0] += 1
+            from urllib.parse import urlsplit
+
+            port = int(urlsplit(urls[i]).port)
+            return WorkerSpec(f"w{i}", port, worker_class)
+
+        ring = FakeRing()
+        incidents = FakeIncidents()
+        auto = Autoscaler(
+            ScalingPolicy(AutoscalerConfig(**policy_kw)),
+            sup,
+            gw,
+            spec_factory,
+            ring=ring,
+            metrics=metrics,
+            incidents=incidents,
+        )
+        sup.start()
+        try:
+            await body(auto, ring, incidents, gw, sup)
+        finally:
+            for f in fakes:
+                await f.stop()
+
+    def run(body):
+        asyncio.run(start(body))
+
+    return run
+
+
+class TestAutoscalerLoop:
+    def _pressure(self, ring: FakeRing, n: int = 3):
+        now = time.time()
+        for i in range(n):
+            ring.append(_rec(now - (n - i), burn=3.0))
+
+    def test_tick_scale_out_goes_through_both_funnels(self):
+        run = _autoscaler_rig()
+
+        async def body(auto, ring, incidents, gw, sup):
+            self._pressure(ring)
+            decision = auto.tick()
+            assert decision.action == SCALE_OUT
+            assert [s.name for s in sup.live_specs()] == ["w0", "w1"]
+            assert len(gw.replicas) == 2  # joined (unhealthy until probed)
+            scaling = [r for r in ring.records() if r.get("kind") == "scaling"]
+            assert scaling and scaling[-1]["decision"]["action"] == SCALE_OUT
+            assert scaling[-1]["shape"]["device"] == 2
+            m = auto.metrics.get("pio_autoscaler_scale_outs_total")
+            assert m.value(worker_class=REPLICA_CLASS_DEVICE) == 1
+
+        run(body)
+
+    def test_scale_in_retires_gateway_before_supervisor(self):
+        run = _autoscaler_rig()
+
+        async def body(auto, ring, incidents, gw, sup):
+            self._pressure(ring)
+            auto.tick()  # out to 2
+            order: list[str] = []
+            orig_retire_replica = gw.retire_replica
+            orig_retire_worker = sup.retire_worker
+
+            def spy_gw(url):
+                order.append("gateway")
+                return orig_retire_replica(url)
+
+            def spy_sup(name):
+                order.append("supervisor")
+                return orig_retire_worker(name)
+
+            gw.retire_replica = spy_gw
+            sup.retire_worker = spy_sup
+            now = time.time()
+            ring.records_list.clear()
+            for i in range(5):
+                ring.append(_rec(now - 20 + i * 5, healthy=2))
+            decision = auto.tick()
+            assert decision.action == SCALE_IN
+            assert order == ["gateway", "supervisor"]
+            sup.tick()  # reap the drained worker
+            assert [s.name for s in sup.live_specs()] == ["w0"]
+            assert len(gw.replicas) == 1
+
+        run(body)
+
+    def test_saturation_fires_incident_once_per_episode(self):
+        run = _autoscaler_rig(max_replicas=1)
+
+        async def body(auto, ring, incidents, gw, sup):
+            self._pressure(ring)
+            auto.tick()
+            assert [k for k, _ in incidents.triggers] == ["autoscaler-saturated"]
+            self._pressure(ring)
+            auto.tick()  # still saturated: same episode, no second bundle
+            assert len(incidents.triggers) == 1
+            assert auto.metrics.get("pio_autoscaler_saturated_total").total() == 2
+            # pressure clears, then returns: a NEW episode captures again
+            ring.records_list.clear()
+            now = time.time()
+            for i in range(3):
+                ring.append(_rec(now - 3 + i))
+            auto.tick()
+            ring.records_list.clear()  # stale idle records out of the window
+            self._pressure(ring)
+            auto.tick()
+            assert len(incidents.triggers) == 2
+
+        run(body)
+
+    def test_mid_bake_defers_and_counts(self):
+        rollout = {"active": True}
+        run = _autoscaler_rig()
+
+        async def body(auto, ring, incidents, gw, sup):
+            auto._rollout_probe = lambda: rollout["active"]
+            self._pressure(ring)
+            decision = auto.tick()
+            assert decision.action == DEFER
+            assert auto.metrics.get("pio_autoscaler_deferred_total").total() == 1
+            assert len(sup.live_specs()) == 1  # nothing resized
+            scaling = [r for r in ring.records() if r.get("kind") == "scaling"]
+            assert scaling[-1]["decision"]["action"] == DEFER
+            # bake ends: the deferred resize fires on the next tick even
+            # though the pressure records have gone stale
+            rollout["active"] = False
+            ring.records_list[:] = [
+                r for r in ring.records_list if r.get("kind") == "scaling"
+            ]
+            decision = auto.tick()
+            assert decision.action == SCALE_OUT and decision.deferred
+            assert len(sup.live_specs()) == 2
+
+        run(body)
+
+    def test_rollout_probe_reads_registry_state(self, tmp_path):
+        from predictionio_tpu.registry import ArtifactStore, ModelManifest
+
+        store = ArtifactStore(str(tmp_path))
+        for blob in (b"one", b"two"):
+            store.publish(
+                ModelManifest(
+                    version="",
+                    engine_id="e",
+                    engine_version="1",
+                    engine_variant="v",
+                ),
+                blob,
+            )
+        probe = registry_rollout_probe(str(tmp_path))
+        assert probe() is False
+        versions = sorted(m.version for m in store.list_versions("e"))
+        store.stage_candidate("e", versions[-1], fraction=0.2)
+        assert probe() is True  # mid-bake
+        store.promote("e")
+        assert probe() is False  # bake over: deferred resizes may fire
+
+    def test_autoscaler_shape_metric_tracks_classes(self):
+        run = _autoscaler_rig(max_replicas=1, cpu_fallback_max=2)
+
+        async def body(auto, ring, incidents, gw, sup):
+            self._pressure(ring)
+            decision = auto.tick()  # device at max: cpu-fallback spill
+            assert decision.replica_class == REPLICA_CLASS_CPU
+            auto.metrics._run_collectors()
+            m = auto.metrics.get("pio_autoscaler_replicas")
+            assert m.value(worker_class=REPLICA_CLASS_DEVICE) == 1.0
+            assert m.value(worker_class=REPLICA_CLASS_CPU) == 1.0
+            assert gw.replicas[-1].worker_class == REPLICA_CLASS_CPU
+
+        run(body)
+
+
+class TestBuildAutoscalerValidation:
+    def _args(self, **kw):
+        import types
+
+        base = dict(
+            fleet=2,
+            fleet_min=None,
+            fleet_max=None,
+            cpu_fallback_max=None,
+            autoscale_interval=None,
+            registry_dir=None,
+        )
+        base.update(kw)
+        return types.SimpleNamespace(**base)
+
+    def _build(self, args):
+        from tests.test_fleet import FakeProc
+
+        from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+        from predictionio_tpu.fleet.launch import build_autoscaler
+
+        metrics = MetricsRegistry()
+        sup = Supervisor(
+            lambda spec: FakeProc(),
+            [WorkerSpec("w0", 9000)],
+            SupervisorConfig(),
+            metrics=metrics,
+        )
+        gw = Gateway(
+            GatewayConfig(replica_urls=("http://127.0.0.1:9000",)),
+            metrics=MetricsRegistry(),
+        )
+        ring = FakeRing()
+        return build_autoscaler(
+            args, sup, gw, lambda cls: WorkerSpec("w9", 9009, cls), ring,
+            metrics, {},
+        )
+
+    def test_defaults_give_boot_size_headroom(self):
+        auto = self._build(self._args(fleet=3))
+        assert auto.policy.config.max_replicas == 6  # 2x boot size
+        assert auto.policy.config.min_replicas == 1
+
+    def test_explicit_zero_is_rejected_not_silently_defaulted(self):
+        with pytest.raises(ValueError):
+            self._build(self._args(fleet_min=0))
+        with pytest.raises(ValueError):
+            self._build(self._args(autoscale_interval=0))
+
+    def test_fleet_max_below_boot_size_rejected(self):
+        """Booting above the ceiling would pin every pressured tick on
+        'saturated' while the operator believes the envelope binds."""
+        with pytest.raises(ValueError):
+            self._build(self._args(fleet=4, fleet_max=2))
+
+    def test_min_above_max_rejected(self):
+        with pytest.raises(ValueError):
+            self._build(self._args(fleet_min=5, fleet_max=3))
+
+
+class TestWorkerArgvElasticity:
+    def test_autoscale_flags_never_leak_into_worker_argv(self):
+        """A worker recursively autoscaling would be a fork bomb: every
+        elasticity flag is parent-only."""
+        from predictionio_tpu.fleet.launch import worker_argv
+
+        argv = [
+            "deploy",
+            "--engine-dir", "eng",
+            "--fleet", "2",
+            "--autoscale",
+            "--fleet-min", "1",
+            "--fleet-max=4",
+            "--cpu-fallback-max", "2",
+            "--autoscale-interval", "0.5",
+            "--port", "8000",
+        ]
+        out = worker_argv(argv, 8003, 1.0)
+        for flag in (
+            "--autoscale",
+            "--fleet-min",
+            "--fleet-max",
+            "--cpu-fallback-max",
+            "--autoscale-interval",
+        ):
+            assert not any(a.startswith(flag) for a in out), (flag, out)
+        assert "--engine-dir" in out and "eng" in out
+        assert out[out.index("--port") + 1] == "8003"
+
+
+# ---------------------------------------------------------------------------
+# pio top: the autoscaler line + history scaling markers
+# ---------------------------------------------------------------------------
+
+
+class TestTopAutoscaler:
+    def _metrics_text(self) -> str:
+        from tests.test_fleet import FakeProc
+
+        m = MetricsRegistry()
+        from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+
+        sup = Supervisor(
+            lambda spec: FakeProc(),
+            [WorkerSpec("w0", 9000)],
+            SupervisorConfig(),
+            metrics=m,
+        )
+        gw = Gateway(
+            GatewayConfig(replica_urls=("http://127.0.0.1:9000",)), metrics=m
+        )
+        auto = Autoscaler(
+            ScalingPolicy(
+                AutoscalerConfig(min_replicas=1, max_replicas=4, cpu_fallback_max=2)
+            ),
+            sup,
+            gw,
+            lambda cls: WorkerSpec("w9", 9009, cls),
+            metrics=m,
+        )
+        sup.start()
+        auto._m_outs.inc(worker_class=REPLICA_CLASS_DEVICE)
+        auto._m_deferred.inc()
+        return m.render_prometheus()
+
+    def test_summary_and_render_carry_autoscaler_line(self):
+        from predictionio_tpu.tools.top import parse_prometheus, render, summarize
+
+        summary = summarize(parse_prometheus(self._metrics_text()))
+        scaler = summary["autoscaler"]
+        assert scaler["max_replicas"] == 4.0
+        assert scaler["cpu_fallback_max"] == 2.0
+        assert scaler["scale_outs_total"] == 1.0
+        assert scaler["deferred_total"] == 1.0
+        screen = render(summary, "http://gw")
+        assert "autoscaler" in screen
+        assert "[1..4]" in screen
+        assert "deferred 1" in screen
+
+    def test_summary_none_without_autoscaler(self):
+        from predictionio_tpu.tools.top import parse_prometheus, summarize
+
+        summary = summarize(parse_prometheus("pio_requests_total 1\n"))
+        assert summary["autoscaler"] is None
+
+    def test_history_renders_scaling_markers(self):
+        from predictionio_tpu.tools.top import render_history
+
+        now = time.time()
+        records = [
+            _rec(now - 30, qd=4.0),
+            {
+                "kind": "scaling",
+                "t": now - 20,
+                "decision": {
+                    "action": "scale-out",
+                    "reason": "burn",
+                    "class": "device",
+                },
+                "shape": {"device": 2, "cpu": 0},
+            },
+            _rec(now - 10, qd=0.0, healthy=2),
+        ]
+        screen = render_history(records, 60.0)
+        assert "scaling    1 decision(s)" in screen
+        assert "scale-out device (burn) -> device 2" in screen
+        # the scaling record must NOT pollute the snapshot series
+        assert "2 snapshots" in screen
+
+
+# ---------------------------------------------------------------------------
+# e2e: spike trace against a real 1->3->1 fleet (the chaos stage)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestElasticFleetE2E:
+    """Real worker processes (scripts/fleet_smoke.py --worker), real
+    gateway, real telemetry ring, real autoscaler. A flood drives the
+    fleet 1->3 (zero 5xx throughout), pressure at the envelope snapshots
+    an autoscaler-saturated incident bundle, then idle drains it back to
+    1 via SIGTERM (zero 5xx during the drain too). Scaling decisions
+    must land in the on-disk ring."""
+
+    def test_spike_scale_out_saturate_and_drain_in(self, tmp_path):
+        import aiohttp  # noqa: F401 - fail fast if the env lacks it
+
+        from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+        from predictionio_tpu.fleet.launch import build_obs_plane
+        from predictionio_tpu.fleet.worklog import spawn_with_log
+        from predictionio_tpu.obs.incidents import list_bundles
+        from tests.test_fleet import TestKillMidRolloutE2E  # noqa: F401
+
+        import socket
+
+        def free_port() -> int:
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        ports = [free_port() for _ in range(6)]
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        obs_dir = str(tmp_path / "obs")
+        metrics = MetricsRegistry()
+        obs = build_obs_plane(obs_dir, metrics)
+        worker_script = os.path.join(REPO, "scripts", "fleet_smoke.py")
+
+        def spawn(spec):
+            return spawn_with_log(
+                [sys.executable, worker_script, "--worker", str(spec.port)],
+                obs["logbook"],
+                spec.name,
+                env=env,
+                cwd=REPO,
+            )
+
+        sup = Supervisor(
+            spawn,
+            [WorkerSpec("w0", ports[0])],
+            SupervisorConfig(poll_interval_s=0.1, term_grace_s=10.0),
+            metrics=metrics,
+            logbook=obs["logbook"],
+            on_crash=obs["on_crash"],
+        )
+        gw = Gateway(
+            GatewayConfig(
+                ip="127.0.0.1",
+                port=free_port(),
+                replica_urls=(WorkerSpec("w0", ports[0]).url,),
+                probe_interval_s=0.2,
+                probe_timeout_s=2.0,
+                request_timeout_s=15.0,
+                telemetry_interval_s=0.25,
+                slo_windows=((10.0, 10.0), (30.0, 5.0)),
+            ),
+            metrics=metrics,
+            telemetry=obs["telemetry"],
+            incidents=obs["incidents"],
+        )
+        slot = [1]
+
+        def spec_factory(worker_class: str) -> WorkerSpec:
+            i = slot[0]
+            slot[0] += 1
+            return WorkerSpec(f"w{i}", ports[i], worker_class)
+
+        auto = Autoscaler(
+            ScalingPolicy(
+                AutoscalerConfig(
+                    min_replicas=1,
+                    max_replicas=3,
+                    tick_interval_s=0.5,
+                    burn_threshold=1.0,
+                    queue_depth_high=2.0,
+                    inflight_high_per_replica=6.0,
+                    confirm_s=2.0,
+                    idle_sustain_s=5.0,
+                    queue_depth_low=1.0,
+                    idle_inflight_per_replica=2.0,
+                    idle_burn_max=0.5,
+                    scale_out_cooldown_s=4.0,
+                    scale_in_cooldown_s=6.0,
+                )
+            ),
+            sup,
+            gw,
+            spec_factory,
+            ring=obs["telemetry"],
+            metrics=metrics,
+            incidents=obs["incidents"],
+        )
+        results: dict = {"statuses": [], "errors": []}
+        try:
+            asyncio.run(self._drive(sup, gw, auto, results))
+        finally:
+            sup.stop()
+            obs["telemetry"].close()
+        fivexx = [s for s in results["statuses"] if s >= 500]
+        assert fivexx == [], (
+            f"{len(fivexx)} client-visible 5xx during elasticity "
+            f"(of {len(results['statuses'])})"
+        )
+        assert results["errors"] == []
+        assert results["peak_replicas"] == 3, results
+        assert results["steady_replicas"] == 1, results
+        # scaling decisions are telemetry: both directions in the ring
+        from predictionio_tpu.obs.tsring import TelemetryRing
+
+        ring = TelemetryRing(os.path.join(obs_dir, "telemetry"))
+        actions = [
+            r["decision"]["action"]
+            for r in ring.records()
+            if r.get("kind") == "scaling"
+        ]
+        assert SCALE_OUT in actions and SCALE_IN in actions, actions
+        # envelope saturation left an incident bundle
+        triggers = [
+            r.trigger
+            for r in list_bundles(os.path.join(obs_dir, "incidents"))
+        ]
+        assert "autoscaler-saturated" in triggers, triggers
+        # retired workers' gauges dropped from the exposition
+        text = metrics.render_prometheus()
+        for line in text.splitlines():
+            if line.startswith(("pio_fleet_worker_up{", "pio_fleet_replica_up{")):
+                assert 'replica="w0"' in line or ":%d" % ports[0] in line, line
+
+    async def _drive(self, sup, gw, auto, results) -> None:
+        import aiohttp
+
+        sup.start()
+        sup_task = asyncio.ensure_future(sup.run())
+        auto_task = asyncio.ensure_future(auto.run())
+        await gw.start()
+        gw_url = f"http://127.0.0.1:{gw.config.port}"
+        session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=20)
+        )
+
+        async def query(i: int) -> None:
+            try:
+                async with session.post(
+                    f"{gw_url}/queries.json",
+                    json={"user": f"u{i % 200}", "num": 5},
+                ) as resp:
+                    await resp.read()
+                    results["statuses"].append(resp.status)
+            except Exception as exc:
+                results["errors"].append(repr(exc))
+
+        async def flood_until(stop: asyncio.Event, concurrency: int):
+            counter = [0]
+
+            async def loop():
+                while not stop.is_set():
+                    counter[0] += 1
+                    await query(counter[0])
+
+            await asyncio.gather(*(loop() for _ in range(concurrency)))
+
+        async def trickle(duration_s: float):
+            stop_at = time.monotonic() + duration_s
+            i = 0
+            while time.monotonic() < stop_at:
+                i += 1
+                await query(i)
+                await asyncio.sleep(0.25)
+
+        try:
+            # worker 0 ready (pays the jax import)
+            deadline = time.monotonic() + 120.0
+            while True:
+                try:
+                    async with session.get(f"{gw_url}/healthz") as resp:
+                        if (await resp.json()).get("replicasHealthy", 0) >= 1:
+                            break
+                except Exception:
+                    pass
+                assert time.monotonic() < deadline, "w0 never ready"
+                await asyncio.sleep(0.25)
+            # flood CONTINUOUSLY until the fleet reaches the envelope
+            # (scale-out under load, zero 5xx) and pressure at the
+            # envelope records a saturation episode — a bursty load
+            # would tear the policy's confirm window between bursts.
+            # 24-way closed loop: 24/3 replicas = 8 in flight each,
+            # above the policy's threshold even at the envelope, so the
+            # saturation episode is reachable, not racy
+            stop_flood = asyncio.Event()
+            flood_task = asyncio.ensure_future(flood_until(stop_flood, 24))
+            deadline = time.monotonic() + 90.0
+            peak = 1
+            try:
+                while time.monotonic() < deadline:
+                    peak = max(peak, len(sup.live_specs()))
+                    if peak >= 3 and auto.metrics.get(
+                        "pio_autoscaler_saturated_total"
+                    ).total():
+                        break
+                    await asyncio.sleep(0.5)
+            finally:
+                stop_flood.set()
+                await asyncio.gather(flood_task, return_exceptions=True)
+            results["peak_replicas"] = peak
+            results["saturated"] = auto.metrics.get(
+                "pio_autoscaler_saturated_total"
+            ).total()
+            # decay: light load while the idle detector drains the fleet
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                await trickle(2.0)
+                if len(sup.live_specs()) == 1 and len(sup.snapshot()) == 1:
+                    break
+            results["steady_replicas"] = len(sup.live_specs())
+        finally:
+            for t in (auto_task, sup_task):
+                t.cancel()
+            await asyncio.gather(auto_task, sup_task, return_exceptions=True)
+            await session.close()
+            await gw.stop()

@@ -164,6 +164,8 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
             return {}, "phase timed out after 90s"
         if name == "serving_local":
             return {"serving_local_e2e_p50_ms": 6.0}, None
+        if name == "elastic":
+            return {"fleet_trace_p95_ms": 45.0}, None  # CPU fleet: still runs
         if name in ("ann", "secondary"):
             # host-side/backed-independent workloads run on the CPU
             # backend instead of being zeroed by the outage
@@ -183,7 +185,7 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     # run: never a device phase itself, and never a per-phase re-probe
     names = [c[0] for c in calls]
     assert [n for n in names if n != "probe"] == [
-        "serving_local", "ann", "secondary",
+        "serving_local", "ann", "secondary", "elastic",
     ]
     assert names.count("probe") == 2  # initial + the single late retry
     assert out["preflight_attempts"] == 2
@@ -208,6 +210,8 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
         assert name != "probe", "--cpu-only must never probe"
         if name == "serving_local":
             return {"serving_local_e2e_p50_ms": 6.0}, None
+        if name == "elastic":
+            return {"fleet_trace_p95_ms": 45.0}, None  # CPU fleet: still runs
         if name in ("ann", "secondary"):
             assert env == {"JAX_PLATFORMS": "cpu"}
             if name == "ann":
@@ -224,7 +228,7 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
     rc = bench.main()
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0  # a requested CPU-only run that shipped numbers is healthy
-    assert calls == ["serving_local", "ann", "secondary"]
+    assert calls == ["serving_local", "ann", "secondary", "elastic"]
     assert out["preflight_attempts"] == 0
     assert out["bench_cpu_only"] is True
     assert out["als_error"] == "skipped: --cpu-only"
@@ -269,6 +273,7 @@ def test_failed_serving_retry_keeps_random_label(monkeypatch, capsys):
             "twotower": ({}, None),
             "ann": ({}, None),
             "secondary": ({}, None),
+            "elastic": ({}, None),
         }
         return results[name]
 
@@ -380,6 +385,7 @@ def test_dead_then_alive_device_recovers_the_capture(monkeypatch, capsys):
             "twotower": ({"twotower_recall_at_10": 0.45, "twotower_recall_gate_ok": True}, None),
             "ann": ({"serving_ann_recall_at_10": 0.99}, None),
             "secondary": ({"naive_bayes_train_ms": 50.0}, None),
+            "elastic": ({"fleet_trace_p95_ms": 45.0}, None),
         }
         return results[name]
 
@@ -584,6 +590,30 @@ class TestCompareBench:
         )
         assert verdict["compare_ok"] is True
         assert verdict["compare_fields"] == 0
+
+    def test_elastic_trace_fields_are_gated(self):
+        """ISSUE 13 acceptance: the elasticity trace's p95 and its
+        over-provisioning bound (peak replicas) ride the compare gate."""
+        base = {**BASE, "fleet_trace_p95_ms": 40.0, "fleet_peak_replicas": 2}
+        cur = {**base, "fleet_trace_p95_ms": 80.0}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is False
+        assert verdict["compare_regressions"][0]["field"] == "fleet_trace_p95_ms"
+        # a greedier policy (more replicas for the same trace) trips too
+        cur = {**base, "fleet_peak_replicas": 3}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is False
+        assert (
+            verdict["compare_regressions"][0]["field"] == "fleet_peak_replicas"
+        )
+
+    def test_elastic_zero_shed_prior_is_degenerate_not_tripping(self):
+        # a 0-shed prior cannot form a ratio; the e2e/chaos suite owns
+        # the zero-shed assertion, the gate owns regressions from >0
+        base = {**BASE, "fleet_shed_total": 0.0}
+        cur = {**base, "fleet_shed_total": 3.0}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is True
 
 
 def _write_json(tmp_path, name, data):

@@ -1187,6 +1187,42 @@ class TestFleetUnattributedProxy:
         )
         assert active == []
 
+    def test_unattributed_retire_transition_fires(self):
+        """Scale-in is a fleet transition too: setting a worker retiring
+        without telemetry attribution hides the drain timeline."""
+        active, _ = lint_snippet(
+            """
+            def retire(self, w):
+                w.retiring = True
+                w.proc.terminate()
+            """,
+            "predictionio_tpu/fleet/supervisor.py",
+        )
+        assert rule_ids(active) == ["fleet-unattributed-proxy"]
+
+    def test_attributed_retire_quiet(self):
+        active, _ = lint_snippet(
+            """
+            def retire(self, w):
+                w.retiring = True
+                self._m_retired.inc(worker_class=w.spec.worker_class)
+            """,
+            "predictionio_tpu/fleet/supervisor.py",
+        )
+        assert active == []
+
+    def test_autoscaler_module_in_scope(self):
+        """fleet/autoscaler.py rides the same rule: a scaling action that
+        flips replica state without attribution is invisible telemetry."""
+        active, _ = lint_snippet(
+            """
+            def force_eject(self, replica):
+                replica.healthy = False
+            """,
+            "predictionio_tpu/fleet/autoscaler.py",
+        )
+        assert rule_ids(active) == ["fleet-unattributed-proxy"]
+
     def test_off_fleet_path_quiet(self):
         active, _ = lint_snippet(
             """

@@ -1285,13 +1285,32 @@ class TestMetricsContract:
             GatewayConfig(replica_urls=("http://127.0.0.1:1",)),
             metrics=fleet_metrics,
         )
-        Supervisor(
+        sup = Supervisor(
             spawn=lambda spec: None,
             specs=[WorkerSpec(name="w0", port=1)],
             metrics=fleet_metrics,
             logbook=WorkerLogBook(str(tmp_path / "logs")),
         )
         IncidentRecorder(str(tmp_path / "incidents"), metrics=fleet_metrics)
+        # the pio_autoscaler_* family rides the same fleet-parent registry
+        from predictionio_tpu.fleet.autoscaler import (
+            Autoscaler,
+            AutoscalerConfig,
+            ScalingPolicy,
+        )
+        from predictionio_tpu.fleet.gateway import Gateway as _Gw
+        from predictionio_tpu.fleet.gateway import GatewayConfig as _GwCfg
+
+        Autoscaler(
+            ScalingPolicy(AutoscalerConfig()),
+            sup,
+            _Gw(
+                _GwCfg(replica_urls=("http://127.0.0.1:1",)),
+                metrics=MetricsRegistry(),
+            ),
+            lambda cls: WorkerSpec(name="w9", port=9),
+            metrics=fleet_metrics,
+        )
         registered.update(fleet_metrics._metrics)
         missing = documented - registered
         assert not missing, f"documented but not registered: {sorted(missing)}"
