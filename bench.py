@@ -66,6 +66,10 @@ PHASES: list[tuple[str, int]] = [
     ("als", 900),
     ("serving", 900),
     ("serving_local", 600),
+    # offline mega-batch inference over the same factors (CPU backend,
+    # like serving_local): must land AFTER serving_local so the orchestrator
+    # can gate offline qps >= 5x the online qps measured in the same round
+    ("batchpredict", 600),
     ("twotower", 900),
     ("ann", 600),
     ("secondary", 600),
@@ -1659,6 +1663,131 @@ def phase_ann(ck: _Checkpoint) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Phase: batchpredict — offline mega-batch throughput (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def phase_batchpredict(ck: _Checkpoint) -> None:
+    """Device-saturating offline inference: the `pio batchpredict`
+    mega-batch pipeline (streaming source -> double-buffered fused-kernel
+    dispatch -> atomic file writeback) over the same factors the serving
+    phases use. Records offline qps / users-per-s, the per-phase p50s of
+    the read->assemble->dispatch->fetch->write timeline, and the tiling
+    ratio (phases must cover the run wall clock within 10% — the same
+    evidence contract as the serving waterfall and the train profiler).
+    Runs on the CPU backend like serving_local: the number the acceptance
+    gate compares against is the same-host online serving qps, so both
+    sides must share a backend."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    _jax_setup()
+    _, n_users, n_items, n_ratings, rank, _ = _scale_params("cpu")
+    if os.path.exists(FACTORS_PATH):
+        z = np.load(FACTORS_PATH)
+        uf, vf = z["uf"], z["vf"]
+        ck.save(batchpredict_factors="als")
+    else:
+        # same provenance rule as serving_local: throughput pairs with
+        # real factors when obtainable, labeled random fallback otherwise
+        try:
+            from predictionio_tpu.ops.als import ALSConfig, als_train
+
+            users, items, vals = synthesize_ratings(n_users, n_items, n_ratings)
+            cfg = ALSConfig(rank=rank, iterations=3, reg=0.05, chunk=65536)
+            uf_d, vf_d = als_train(users, items, vals, n_users, n_items, cfg)
+            uf, vf = np.asarray(uf_d), np.asarray(vf_d)
+            ck.save(batchpredict_factors="cpu_als")
+        except Exception as exc:  # noqa: BLE001 - throughput still worth shipping
+            ck.save(
+                batchpredict_factors="random_fallback",
+                batchpredict_factors_error=str(exc)[:200],
+            )
+            rng0 = np.random.default_rng(0)
+            uf = rng0.normal(size=(n_users, rank)).astype(np.float32)
+            vf = rng0.normal(size=(n_items, rank)).astype(np.float32)
+
+    from predictionio_tpu.models.recommendation.engine import (
+        ALSAlgorithm,
+        ALSAlgorithmParams,
+        ALSModel,
+        Serving,
+    )
+    from predictionio_tpu.models.recommendation import engine_factory
+    from predictionio_tpu.workflow.batch_predict import (
+        BatchPredictInstruments,
+        FileSink,
+        StatusFile,
+        run_pipeline,
+    )
+
+    engine = engine_factory()
+    algo = ALSAlgorithm(ALSAlgorithmParams(rank=uf.shape[1]))
+    batch = int(os.environ.get("PIO_BENCH_BP_BATCH", "512"))
+    n_queries = int(os.environ.get("PIO_BENCH_BP_QUERIES", "20000"))
+    # the true nightly shape is ONE query per DISTINCT user (what
+    # --from-events produces); tile the user factor table up to the query
+    # count so users_per_s measures real distinct-user throughput instead
+    # of cycling a small vocab
+    if uf.shape[0] < n_queries:
+        reps = -(-n_queries // uf.shape[0])
+        uf = np.tile(np.asarray(uf, np.float32), (reps, 1))[:n_queries]
+    model = ALSModel(
+        np.asarray(uf, np.float32),
+        np.asarray(vf, np.float32),
+        [f"u{i}" for i in range(uf.shape[0])],
+        [f"i{i}" for i in range(vf.shape[0])],
+    )
+    components = (None, None, [algo], Serving())
+
+    def source():
+        for i in range(n_queries):
+            yield i + 1, {"user": f"u{i}", "num": 10}
+
+    out_path = os.path.join(
+        tempfile.gettempdir(), f"pio_bench_bp_{os.getpid()}.jsonl"
+    )
+    status_path = os.path.join(
+        tempfile.gettempdir(), f"pio_bench_bp_{os.getpid()}.status.json"
+    )
+    status = StatusFile(status_path)
+    status.update(force=True, engineId="recommendation", source="synthetic")
+    report = run_pipeline(
+        engine,
+        components,
+        [model],
+        source(),
+        [FileSink(out_path)],
+        batch_size=batch,
+        instruments=BatchPredictInstruments(),
+        status=status,
+    )
+    with open(out_path) as fh:
+        written = sum(1 for _ in fh)
+    os.unlink(out_path)
+    assert written == n_queries, (written, n_queries)
+    tiling_ok = bool(0.9 <= report.tiling_ratio <= 1.001)
+    ck.save(
+        batchpredict_offline_qps=round(report.qps, 1),
+        # one query = one user's nightly precompute; engines fanning
+        # several queries per user would make these diverge
+        batchpredict_offline_users_per_s=round(report.users_per_s, 1),
+        batchpredict_queries=report.queries,
+        batchpredict_errors=report.errors,
+        batchpredict_batch=batch,
+        batchpredict_wall_s=report.wall_s,
+        batchpredict_warmup_s=report.warmup_s,
+        batchpredict_tiling_ratio=report.tiling_ratio,
+        batchpredict_tiling_gate_ok=tiling_ok,
+        batchpredict_status_file=status_path,
+        **{
+            f"batchpredict_phase_{name}_p50_ms": v
+            for name, v in report.phase_p50_ms.items()
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
 # Phase: secondary — remaining BASELINE workloads, one measurement each
 # ---------------------------------------------------------------------------
 
@@ -2187,7 +2316,11 @@ _COMPARE_LOWER_IS_BETTER = frozenset(
 # twins (obs/xray step profiler)
 _COMPARE_LOWER_RE = re.compile(
     r"^(serving(_local)?_phase_[a-z_]+_(p50|p95|mean)_ms"
-    r"|train_step_[a-z_]+_ms)$"
+    r"|train_step_[a-z_]+_ms"
+    # the offline pipeline's read->assemble->dispatch->fetch->write p50s
+    # (ISSUE 14): a host-side regression in any phase is a throughput
+    # regression even before it shows in the headline qps
+    r"|batchpredict_phase_[a-z_]+_p50_ms)$"
 )
 _COMPARE_HIGHER_IS_BETTER = frozenset(
     {
@@ -2199,6 +2332,11 @@ _COMPARE_HIGHER_IS_BETTER = frozenset(
         "event_ingest_eps",
         # measured ANN quality: recall@10 vs exact must not silently decay
         "serving_ann_recall_at_10",
+        # offline mega-batch throughput (ISSUE 14): the whole point of the
+        # dedicated offline path — its qps regressing means the nightly
+        # precompute window silently grows
+        "batchpredict_offline_qps",
+        "batchpredict_offline_users_per_s",
     }
 )
 
@@ -2308,6 +2446,7 @@ _PHASE_FNS = {
     "als": phase_als,
     "serving": phase_serving,
     "serving_local": phase_serving_local,
+    "batchpredict": phase_batchpredict,
     "twotower": phase_twotower,
     "ann": phase_ann,
     "secondary": phase_secondary,
@@ -2558,6 +2697,17 @@ def main() -> int:
                 else:
                     fields.update(res)
                     errors.pop("serving_error", None)
+
+    # offline-vs-online acceptance (ISSUE 14): the dedicated offline path
+    # exists because the online path can never saturate the device — hold
+    # that by measurement whenever both ran in this round, on the same CPU
+    # backend over the same factors. 5x is the floor; BENCH_r01 measured
+    # ~66x headroom (973 batched vs 14.6 sequential).
+    off_qps = fields.get("batchpredict_offline_qps")
+    on_qps = fields.get("serving_local_e2e_qps")
+    if off_qps is not None and on_qps:
+        fields["batchpredict_vs_online_x"] = round(off_qps / on_qps, 2)
+        fields["batchpredict_speedup_gate_ok"] = bool(off_qps >= 5.0 * on_qps)
 
     # co-located serving estimate (r4 verdict weak #2): the <10ms target is
     # physically untestable through the tunnel's ~67ms RTT, so compose the

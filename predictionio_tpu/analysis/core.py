@@ -112,6 +112,12 @@ class LintConfig:
     serving_predict_globs: tuple[str, ...] = (
         "*/models/*/engine.py",
         "*/ann/*.py",
+        # the offline mega-batch path (pio batchpredict): its dispatch /
+        # drain loop feeds the same fused kernels at device-saturating
+        # batch sizes, where a per-item device_get or host argsort
+        # sneaking back in costs O(mega-batch * corpus), not O(batch * k)
+        "*/workflow/batch_predict.py",
+        "*/controller/engine.py",
     )
     # function names that make up the predict path inside those modules
     # (nested helpers like a dispatch's `finalize` are covered implicitly)
@@ -126,6 +132,11 @@ class LintConfig:
         "search_async",
         "fetch",
         "record_recall",
+        # the offline mega-batch path (Engine.dispatch_batch and the
+        # batchpredict pipeline's scheduler/drain loop — nested helpers
+        # like `finalize`/`drain` are covered implicitly)
+        "dispatch_batch",
+        "run_pipeline",
     )
     # rule ids to run; None = all registered
     enabled: frozenset[str] | None = None

@@ -212,11 +212,15 @@ class AnnSearcher:
         (packed [B,2,k], counts [B]) device-handle pair."""
         import jax.numpy as jnp
 
+        from predictionio_tpu.ops.als import upload
+
         search, search_excl, search_masked, search_q8 = _KERNELS
         nprobe = min(nprobe or self.nprobe, self.index.clusters)
+        # upload() COPIES host staging buffers (scratch-pool reuse must
+        # not race the in-flight kernel); device handles pass through
         q = qvecs if hasattr(qvecs, "dtype") and not isinstance(
             qvecs, np.ndarray
-        ) else jnp.asarray(np.asarray(qvecs, np.float32))
+        ) else upload(qvecs, np.float32)
         if self._bucket_scale is not None:
             if mask is not None:
                 # a [B, n] mask gather is fine on ids, but masked queries
@@ -231,7 +235,7 @@ class AnnSearcher:
                 max(k, self.index.config.rescore * k), self.candidate_pool(nprobe)
             )
             excl = (
-                jnp.asarray(np.asarray(exclude, np.int32))
+                upload(exclude, np.int32)
                 if exclude is not None
                 else jnp.full((q.shape[0], 1), -1, jnp.int32)
             )
@@ -253,7 +257,7 @@ class AnnSearcher:
                 self._bucket_flat,
                 self._bucket_ids,
                 q,
-                jnp.asarray(mask),
+                upload(mask),
                 nprobe,
                 k,
             )
@@ -263,7 +267,7 @@ class AnnSearcher:
                 self._bucket_flat,
                 self._bucket_ids,
                 q,
-                jnp.asarray(np.asarray(exclude, np.int32)),
+                upload(exclude, np.int32),
                 nprobe,
                 k,
             )

@@ -17,7 +17,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
-from typing import Any, Generic, Mapping, Sequence
+from typing import Any, Callable, Generic, Mapping, Sequence
 
 from predictionio_tpu.controller.base import (
     A,
@@ -174,6 +174,52 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
                 _maybe_sanity_check(model, f"model {i}", options.skip_sanity_check)
             models.append(model)
         return models
+
+    # ------------------------------------------------------- offline dispatch
+    def dispatch_batch(
+        self,
+        algorithms: Sequence[BaseAlgorithm],
+        serving: BaseServing,
+        models: Sequence[Any],
+        queries: Sequence[Any],
+    ) -> "Callable[[], list[Any]]":
+        """Offline mega-batch entry (``pio batchpredict``): dispatch one
+        pre-assembled query batch's device work through every algorithm's
+        pipelined path — no HTTP, no micro-batcher, no per-request
+        accounting — and return a zero-arg finalize that fetches, regroups
+        per query index, and serves. The offline pipeline double-buffers
+        on this split: it dispatches batch N, then drains batch N-1 while
+        the device computes N. Algorithms without a pipelined path
+        (``predict_batch_dispatch`` returning None) run their *indexed*
+        ``batch_predict`` inside finalize — the same entry ``eval`` uses,
+        so an algorithm that vectorizes only that method (e.g. the
+        naive-Bayes classifier) keeps its one-call batch path instead of
+        degrading to per-query predicts. Covered by the
+        ``serving-host-roundtrip`` lint rule: score+select must stay
+        fused on device (ops/topk)."""
+        supplemented = [serving.supplement(q) for q in queries]
+        fins = [
+            algo.predict_batch_dispatch(model, supplemented)
+            for algo, model in zip(algorithms, models)
+        ]
+
+        def finalize() -> list[Any]:
+            per_query: list[list[Any]] = [[] for _ in supplemented]
+            for algo, model, fin in zip(algorithms, models, fins):
+                if fin is not None:
+                    for i, p in enumerate(fin()):
+                        per_query[i].append(p)
+                else:
+                    for i, p in algo.batch_predict(
+                        model, list(enumerate(supplemented))
+                    ):
+                        per_query[i].append(p)
+            return [
+                serving.serve(q, preds)
+                for q, preds in zip(queries, per_query)
+            ]
+
+        return finalize
 
     def make_serializable_models(
         self, ctx: WorkflowContext, engine_params: EngineParams, models: list[Any]

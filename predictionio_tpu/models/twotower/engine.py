@@ -204,13 +204,16 @@ class TwoTowerModelState(SanityCheck):
                 return pack_batch(s, i)
 
             self._serve_fn = _serve
-        import jax.numpy as jnp
+        from predictionio_tpu.ops.als import upload
 
-        hist_d = jnp.asarray(hist) if hist is not None else None
+        # upload() COPIES: uidx/hist live in reusable scratch buffers the
+        # dispatcher overwrites for the next batch while this one is in
+        # flight (jnp.asarray would alias them on the CPU backend)
+        hist_d = upload(hist) if hist is not None else None
         return self._serve_fn(
             self.device_params(),
             self.device_items(),
-            jnp.asarray(uidx),
+            upload(uidx),
             hist_d,
             k,
         )
@@ -235,10 +238,10 @@ class TwoTowerModelState(SanityCheck):
                 )
 
             self._embed_fn = _embed
-        import jax.numpy as jnp
+        from predictionio_tpu.ops.als import upload
 
-        hist_d = jnp.asarray(hist) if hist is not None else None
-        return self._embed_fn(self.device_params(), jnp.asarray(uidx), hist_d)
+        hist_d = upload(hist) if hist is not None else None
+        return self._embed_fn(self.device_params(), upload(uidx), hist_d)
 
     def __getstate__(self):
         return {

@@ -920,6 +920,28 @@ def next_pow2(n: int) -> int:
     return 1 << max(0, n - 1).bit_length()
 
 
+def upload(x, dtype=None):
+    """Host->device upload that GUARANTEES the device buffer is decoupled
+    from the host array.
+
+    On the CPU backend ``jnp.asarray(host_numpy)`` is ZERO-COPY: the jax
+    array aliases the numpy memory. Every async serving dispatch that
+    stages its batch in a reused ``ops.topk.ScratchBuffers`` slot then
+    races the in-flight kernel against the next batch's assembly — the
+    observed failure (offline double-buffer pipeline, CPU backend) was
+    batch N's first rows answering with batch N+1's users, a torn read of
+    the overwritten staging buffer. ``copy=True`` restores the contract
+    the scratch pools are built on: the host buffer is reusable the
+    moment the dispatch call returns. Device arrays pass through
+    untouched (immutable, nothing to decouple); on non-CPU backends the
+    H2D transfer is a copy regardless."""
+    if isinstance(x, jax.Array):
+        return x
+    # pio-lint: disable=train-unaccounted-sync -- host staging array, never a device handle
+    arr = np.asarray(x) if dtype is None else np.asarray(x, dtype)
+    return jnp.asarray(arr, copy=True)
+
+
 class ServingIndex:
     """Device-resident factor tables with index-addressed top-k serve.
 
@@ -1012,13 +1034,16 @@ class ServingIndex:
         packed [B,2,k] int32 device array WITHOUT fetching it. An async query
         server dispatches batch n+1 while fetching batch n's result, so
         device work and transport overlap; decode with ``unpack_batch``."""
-        m = self._full_mask if mask is None else jnp.asarray(mask)
+        m = self._full_mask if mask is None else upload(mask)
         if isinstance(user_indices, jax.Array):
             # already on device: a np.asarray round-trip would block on a
             # D2H fetch and defeat the non-blocking contract
             idxs = user_indices.astype(jnp.int32)
         else:
-            idxs = jnp.asarray(np.asarray(user_indices, np.int32))
+            # upload() COPIES: callers stage indices in reusable scratch
+            # buffers and overwrite them for the next batch while this
+            # batch's kernel is still in flight
+            idxs = upload(user_indices, np.int32)
         return _serve_by_index_batch(
             idxs, self.user_factors, self.item_factors, m, k
         )

@@ -23,8 +23,11 @@ Design (mirrors ops/als):
     staging buffers (thread-local: the micro-batcher's dispatch thread and
     the shadow/stable-retry threads each get their own pool), so batch
     assembly writes queries straight into a recycled numpy buffer instead
-    of allocating per window. jax copies host numpy on upload, so a buffer
-    is reusable as soon as the dispatch call returns.
+    of allocating per window. Reuse is only sound because every staging
+    upload goes through ``ops.als.upload`` (re-exported here), which
+    COPIES: ``jnp.asarray`` on the CPU backend aliases host numpy memory,
+    and an aliased buffer overwritten for batch N+1 while batch N's
+    kernel is still in flight serves batch N the wrong queries.
   - ``host_top_k`` is the sanctioned HOST ending for score vectors that
     are host-born in the first place (popularity counts, cooccurrence
     maps). It lives here so the ``serving-host-roundtrip`` lint rule can
@@ -42,7 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from predictionio_tpu.ops.als import next_pow2
+from predictionio_tpu.ops.als import next_pow2, upload
 
 __all__ = [
     "dot_top_k_async",
@@ -53,6 +56,7 @@ __all__ = [
     "warmup_pow2_buckets",
     "pack_batch",
     "scratch",
+    "upload",
     "ScratchBuffers",
     "next_pow2",
 ]
@@ -152,31 +156,30 @@ def dot_top_k_async(table, vecs, mask, k: int, weights=None):
     device-resident, ``vecs`` [B,f], ``mask`` [B,n] bool or None,
     ``weights`` an optional [n] per-item score multiplier. Returns the
     packed [B,2,k] device handle; decode with :func:`fetch_topk`."""
-    vecs_d = jnp.asarray(np.asarray(vecs, np.float32))
+    vecs_d = upload(vecs, np.float32)
     if weights is not None:
         m = (
-            jnp.asarray(mask)
+            upload(mask)
             if mask is not None
             else jnp.ones((vecs_d.shape[0], table.shape[0]), bool)
         )
         return _dot_top_k_weighted(
-            table, vecs_d, m, jnp.asarray(np.asarray(weights, np.float32)), k
+            table, vecs_d, m, upload(weights, np.float32), k
         )
     if mask is None:
         return _dot_top_k_unmasked(table, vecs_d, k)
-    return _dot_top_k(table, vecs_d, jnp.asarray(mask), k)
+    return _dot_top_k(table, vecs_d, upload(mask), k)
 
 
 def gather_sum_top_k_async(table, qidx, qweight, mask, k: int, weights=None):
     """Dispatch the gather->sum->mask->top-k kernel; see
     :func:`_gather_sum_top_k` for shapes. Returns the packed handle."""
-    qidx_d = jnp.asarray(np.asarray(qidx, np.int32))
-    qw_d = jnp.asarray(np.asarray(qweight, np.float32))
-    mask_d = jnp.asarray(mask)
+    qidx_d = upload(qidx, np.int32)
+    qw_d = upload(qweight, np.float32)
+    mask_d = upload(mask)
     if weights is not None:
         return _gather_sum_top_k_weighted(
-            table, qidx_d, qw_d, mask_d,
-            jnp.asarray(np.asarray(weights, np.float32)), k,
+            table, qidx_d, qw_d, mask_d, upload(weights, np.float32), k
         )
     return _gather_sum_top_k(table, qidx_d, qw_d, mask_d, k)
 
@@ -184,7 +187,7 @@ def gather_sum_top_k_async(table, qidx, qweight, mask, k: int, weights=None):
 def fused_top_k_async(scores, mask, k: int):
     """Mask + top-k over an already-computed device score matrix [B,n]
     (both donated — the scores buffer is consumed by the selection)."""
-    return _mask_top_k(scores, jnp.asarray(mask), k)
+    return _mask_top_k(scores, upload(mask), k)
 
 
 def fetch_topk(handle) -> tuple[np.ndarray, np.ndarray]:

@@ -531,15 +531,49 @@ def cmd_undeploy(args) -> int:
 
 
 def cmd_batchpredict(args) -> int:
+    """Offline mega-batch prediction (docs/batch_predict.md): stream
+    queries from a file or straight off the event store, dispatch
+    device-sized batches through the fused kernels (double-buffered), and
+    stream the scored top-k back to a file (atomic) and/or the event
+    store. Nonzero exit only when setup fails or EVERY query line failed
+    — a malformed line becomes a line-aligned error object, not an
+    abort."""
     from predictionio_tpu.workflow.batch_predict import run_batch_predict
 
-    n = run_batch_predict(
-        args.engine_dir,
-        args.input,
-        args.output,
-        variant_path=args.variant,
+    if args.from_events and args.input is not None:
+        return _die("--from-events and --input are mutually exclusive")
+    input_path = (
+        None
+        if args.from_events
+        else (args.input or "batchpredict-input.json")
     )
-    print(f"Batch predict completed: {n} queries -> {args.output}")
+    try:
+        report = run_batch_predict(
+            args.engine_dir,
+            input_path,
+            args.output,
+            variant_path=args.variant,
+            from_events=args.from_events,
+            app_name=args.app_name,
+            channel=args.channel,
+            query_num=args.query_num,
+            to_events=args.to_events,
+            batch_size=args.batch,
+            limit=args.limit,
+            status_path=args.status_file,
+        )
+    except (RuntimeError, OSError) as exc:
+        return _die(f"batchpredict failed: {exc}")
+    sinks = ([args.output] if args.output else []) + (
+        ["event store"] if args.to_events else []
+    )
+    print(
+        f"Batch predict completed: {report.queries} queries "
+        f"({report.ok} ok, {report.errors} errors) in {report.wall_s:.2f}s "
+        f"({report.qps:.0f} q/s) -> {', '.join(sinks)}"
+    )
+    if report.all_failed:
+        return _die("batch predict: every query line failed")
     return 0
 
 
@@ -599,8 +633,19 @@ def cmd_top(args) -> int:
     renders the telemetry ring's queue-depth/burn series instead: from
     the gateway's ``/telemetry/window`` endpoint, or straight off the
     on-disk ring (``--obs-dir``) when the gateway is down."""
-    from predictionio_tpu.tools.top import run_history, run_top
+    from predictionio_tpu.tools.top import (
+        run_batchpredict_top,
+        run_history,
+        run_top,
+    )
 
+    if args.batchpredict:
+        return run_batchpredict_top(
+            args.batchpredict,
+            interval_s=args.interval,
+            iterations=1 if args.once else args.iterations,
+            json_mode=args.json,
+        )
     if args.history:
         url = args.url if (args.fleet or args.url != _TOP_DEFAULT_URL) else None
         if args.obs_dir is None and url is None:
@@ -1698,10 +1743,68 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--ssl", action="store_true", help="server was deployed with TLS")
     x.set_defaults(fn=cmd_undeploy)
 
-    x = sub.add_parser("batchpredict")
+    x = sub.add_parser(
+        "batchpredict",
+        help="offline mega-batch prediction through the fused device "
+        "kernels (docs/batch_predict.md)",
+    )
     engine_args(x)
-    x.add_argument("--input", default="batchpredict-input.json")
-    x.add_argument("--output", default="batchpredict-output.json")
+    x.add_argument(
+        "--input",
+        default=None,
+        help="multi-line JSON query file, streamed (default "
+        "batchpredict-input.json; mutually exclusive with --from-events)",
+    )
+    x.add_argument(
+        "--output",
+        default="batchpredict-output.json",
+        help="line-aligned JSONL predictions, written atomically "
+        "(tmp+rename); '' disables the file sink",
+    )
+    x.add_argument(
+        "--from-events",
+        action="store_true",
+        help="stream DISTINCT users straight off the app's event store "
+        "(find_after order, bounded pages) instead of a query file",
+    )
+    x.add_argument(
+        "--app-name",
+        default="",
+        help="app for --from-events/--to-events (default: the engine "
+        "variant's datasource appName)",
+    )
+    x.add_argument("--channel", default="", help="channel name (optional)")
+    x.add_argument(
+        "--query-num",
+        type=int,
+        default=10,
+        help="top-k per synthesized --from-events query (default 10)",
+    )
+    x.add_argument(
+        "--batch",
+        type=int,
+        default=512,
+        help="mega-batch size; pow2 keeps the compiled-bucket universe "
+        "at one program (default 512)",
+    )
+    x.add_argument(
+        "--to-events",
+        action="store_true",
+        help="also write scored results back into the event store "
+        "(batchpredict.result events, retry/breaker-protected)",
+    )
+    x.add_argument(
+        "--limit",
+        type=int,
+        default=0,
+        help="cap the number of queries processed (0 = all)",
+    )
+    x.add_argument(
+        "--status-file",
+        default=None,
+        help="write throttled atomic progress snapshots here; "
+        "`pio top --batchpredict PATH` renders them live",
+    )
     x.set_defaults(fn=cmd_batchpredict)
 
     # servers
@@ -1819,6 +1922,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="read the telemetry ring from this fleet obs directory "
         "instead of over HTTP (pairs with --history)",
+    )
+    x.add_argument(
+        "--batchpredict",
+        default=None,
+        metavar="STATUS_FILE",
+        help="render the progress line of an offline `pio batchpredict` "
+        "run from its --status-file (live while the run is active, "
+        "final totals after)",
     )
     x.set_defaults(fn=cmd_top)
 

@@ -809,6 +809,79 @@ def render_history(records: list[dict[str, Any]], window_s: float) -> str:
     return "\n".join(lines)
 
 
+def render_batchpredict(status: dict[str, Any]) -> str:
+    """The ``pio top --batchpredict`` progress line, from the run's
+    throttled atomic status file (docs/batch_predict.md): live while the
+    run is active, final totals after it. One header + one line — the
+    offline twin of the serving waterfall line."""
+    num = format_number
+    state = status.get("state", "?")
+    qps = status.get("qps")
+    queries = status.get("queries", 0)
+    ok = status.get("ok", 0)
+    errors = status.get("errors", 0)
+    batches = status.get("batches", 0)
+    phase_p50 = status.get("phaseP50Ms") or {}
+    phases = (
+        "  phases "
+        + "|".join(
+            f"{name} {phase_p50[name]:.1f}"
+            for name in ("read", "assemble", "dispatch", "fetch", "write")
+            if name in phase_p50
+        )
+        + " ms"
+        if phase_p50
+        else ""
+    )
+    engine = status.get("engineId", "?")
+    src = status.get("source", "?")
+    return (
+        f"pio top — batchpredict {engine} (pid {status.get('pid', '?')}, "
+        f"{state})   {time.strftime('%H:%M:%S')}\n"
+        f"  batchpredict  {num(queries)} q ({num(ok)} ok, {num(errors)} err)"
+        f"  {num(batches)} batches x{num(status.get('batchSize'))}"
+        f"  {num(qps, ' q/s')}  src {src}{phases}"
+    )
+
+
+def run_batchpredict_top(
+    path: str,
+    interval_s: float = 2.0,
+    iterations: int | None = None,
+    json_mode: bool = False,
+    out: Callable[[str], None] = print,
+    sleep: Callable[[float], None] = time.sleep,
+) -> int:
+    """Poll-and-render loop over a batchpredict status file. A missing or
+    torn file degrades to an 'unreadable' line (the writer is atomic, so
+    torn means 'not started yet'); the loop keeps polling — the usual
+    mode is watching a run that is still warming up."""
+    import json as _json
+
+    n = 0
+    try:
+        while iterations is None or n < iterations:
+            try:
+                with open(path) as fh:
+                    status = _json.load(fh)
+            except (OSError, ValueError) as exc:
+                if json_mode:
+                    out(_json.dumps({"batchpredict": path, "error": str(exc)}))
+                else:
+                    out(f"pio top — batchpredict: {path} unreadable ({exc})")
+            else:
+                if json_mode:
+                    out(_json.dumps({"batchpredict": path, **status}))
+                else:
+                    out(render_batchpredict(status))
+            n += 1
+            if iterations is None or n < iterations:
+                sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def fetch_telemetry_window(
     url: str, window_s: float, timeout_s: float = 5.0
 ) -> list[dict[str, Any]]:

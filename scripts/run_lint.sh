@@ -104,6 +104,44 @@ PYEOF
   fi
   echo "serving smoke vs baseline: $(tail -c 240 /tmp/pio_compare_smoke.json)"
 
+  # --- batchpredict smoke (ISSUE 14, docs/batch_predict.md): the offline
+  #     mega-batch pipeline on the same CPU backend must beat the serving
+  #     smoke's online qps by >= 3x (the full-round gate in bench.py is
+  #     5x; the CI floor is looser for shared-host noise), its
+  #     read->assemble->dispatch->fetch->write timeline must tile the run
+  #     wall clock, and `pio top --batchpredict` must render the progress
+  #     line from the run's status file.
+  env JAX_PLATFORMS=cpu PIO_BENCH_SCALE=ml100k \
+    python bench.py --cpu-only --no-compare --only batchpredict \
+    > /tmp/pio_bench_bp.json
+  bp_status=$(python - <<'PYEOF'
+import json
+def last_json(path):
+    for line in reversed(open(path).read().strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit(f"no JSON line in {path}")
+bp = last_json("/tmp/pio_bench_bp.json")
+sv = last_json("/tmp/pio_bench_smoke.json")
+off, on = bp["batchpredict_offline_qps"], sv["serving_local_e2e_qps"]
+assert bp["batchpredict_errors"] == 0, bp["batchpredict_errors"]
+assert bp["batchpredict_tiling_gate_ok"], bp["batchpredict_tiling_ratio"]
+assert off >= 3.0 * on, f"offline {off} q/s < 3x online {on} q/s"
+import sys
+print(
+    f"batchpredict smoke: offline {off:.0f} q/s vs online {on:.0f} q/s "
+    f"({off / on:.1f}x), phases tile ({bp['batchpredict_tiling_ratio']:.3f})",
+    file=sys.stderr,
+)
+print(bp["batchpredict_status_file"])
+PYEOF
+  )
+  if ! ./pio top --batchpredict "$bp_status" --once | grep -q "batchpredict"; then
+    echo "pio top --batchpredict did not render the progress line" >&2
+    exit 1
+  fi
+  echo "pio top --batchpredict renders from the run's status file"
+
   # --- ANN smoke (ISSUE 10, docs/ann.md): build a small clustered index,
   #     serve a real engine through it via the registry attach path, and
   #     hold the two acceptance rails by measurement: recall@10 >= 0.95

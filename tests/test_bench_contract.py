@@ -164,6 +164,8 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
             return {}, "phase timed out after 90s"
         if name == "serving_local":
             return {"serving_local_e2e_p50_ms": 6.0}, None
+        if name == "batchpredict":
+            return {"batchpredict_offline_qps": 9000.0}, None  # CPU phase
         if name == "elastic":
             return {"fleet_trace_p95_ms": 45.0}, None  # CPU fleet: still runs
         if name in ("ann", "secondary"):
@@ -185,7 +187,7 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     # run: never a device phase itself, and never a per-phase re-probe
     names = [c[0] for c in calls]
     assert [n for n in names if n != "probe"] == [
-        "serving_local", "ann", "secondary", "elastic",
+        "serving_local", "batchpredict", "ann", "secondary", "elastic",
     ]
     assert names.count("probe") == 2  # initial + the single late retry
     assert out["preflight_attempts"] == 2
@@ -210,6 +212,8 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
         assert name != "probe", "--cpu-only must never probe"
         if name == "serving_local":
             return {"serving_local_e2e_p50_ms": 6.0}, None
+        if name == "batchpredict":
+            return {"batchpredict_offline_qps": 9000.0}, None  # CPU phase
         if name == "elastic":
             return {"fleet_trace_p95_ms": 45.0}, None  # CPU fleet: still runs
         if name in ("ann", "secondary"):
@@ -228,7 +232,9 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
     rc = bench.main()
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0  # a requested CPU-only run that shipped numbers is healthy
-    assert calls == ["serving_local", "ann", "secondary", "elastic"]
+    assert calls == [
+        "serving_local", "batchpredict", "ann", "secondary", "elastic",
+    ]
     assert out["preflight_attempts"] == 0
     assert out["bench_cpu_only"] is True
     assert out["als_error"] == "skipped: --cpu-only"
@@ -270,6 +276,7 @@ def test_failed_serving_retry_keeps_random_label(monkeypatch, capsys):
                 None,
             ),
             "serving_local": ({"serving_local_e2e_p50_ms": 4.0}, None),
+            "batchpredict": ({"batchpredict_offline_qps": 9000.0}, None),
             "twotower": ({}, None),
             "ann": ({}, None),
             "secondary": ({}, None),
@@ -382,6 +389,7 @@ def test_dead_then_alive_device_recovers_the_capture(monkeypatch, capsys):
                 None,
             ),
             "serving_local": ({"serving_local_e2e_p50_ms": 4.0}, None),
+            "batchpredict": ({"batchpredict_offline_qps": 9000.0}, None),
             "twotower": ({"twotower_recall_at_10": 0.45, "twotower_recall_gate_ok": True}, None),
             "ann": ({"serving_ann_recall_at_10": 0.99}, None),
             "secondary": ({"naive_bayes_train_ms": 50.0}, None),
@@ -543,6 +551,34 @@ class TestCompareBench:
         verdict = bench.compare_bench(cur, [base])
         assert verdict["compare_ok"] is False
         assert verdict["compare_regressions"][0]["field"] == "train_step_sweep_ms"
+
+    def test_batchpredict_offline_qps_is_gated(self):
+        # ISSUE 14: offline throughput regressing silently grows the
+        # nightly precompute window
+        base = {**BASE, "batchpredict_offline_qps": 10_000.0}
+        cur = {**base, "batchpredict_offline_qps": 5_000.0}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is False
+        assert (
+            verdict["compare_regressions"][0]["field"]
+            == "batchpredict_offline_qps"
+        )
+
+    def test_batchpredict_phase_p50s_are_gated(self):
+        base = {**BASE, "batchpredict_phase_dispatch_p50_ms": 4.0}
+        cur = {**base, "batchpredict_phase_dispatch_p50_ms": 8.0}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is False
+        assert (
+            verdict["compare_regressions"][0]["field"]
+            == "batchpredict_phase_dispatch_p50_ms"
+        )
+
+    def test_batchpredict_users_per_s_is_gated(self):
+        base = {**BASE, "batchpredict_offline_users_per_s": 10_000.0}
+        cur = {**base, "batchpredict_offline_users_per_s": 2_000.0}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is False
 
     def test_train_memory_peak_is_gated(self):
         base = {**BASE, "train_peak_bytes_per_device": 1_000_000.0}

@@ -247,3 +247,45 @@ class TestEngineLifecycleCLI:
         assert len(preds) == 2
         assert len(preds[0]["itemScores"]) == 3
         assert len(preds[1]["itemScores"]) == 2
+
+        # offline source straight off the event store, writeback included
+        # (the ISSUE-14 CLI surface; pipeline mechanics in
+        # tests/test_batch_predict.py)
+        status_path = tmp_path / "bp.status.json"
+        code, out, _ = run(
+            capsys,
+            "batchpredict",
+            "--engine-dir", engine_dir,
+            "--from-events",
+            "--app-name", "MyApp1",
+            "--to-events",
+            "--query-num", "3",
+            "--output", str(out_path),
+            "--status-file", str(status_path),
+        )
+        assert code == 0 and "20 queries" in out  # 20 distinct users
+        assert json.loads(status_path.read_text())["state"] == "done"
+
+        # a mixed file keeps going (line-aligned error object), but a run
+        # where EVERY line fails exits nonzero
+        queries.write_text("BROKEN1\nBROKEN2\n")
+        code, _, err = run(
+            capsys,
+            "batchpredict",
+            "--engine-dir", engine_dir,
+            "--input", str(queries),
+            "--output", str(out_path),
+        )
+        assert code != 0 and "every query line failed" in err
+        rows = [json.loads(l) for l in out_path.read_text().splitlines()]
+        assert [r["line"] for r in rows] == [1, 2]
+
+        # --from-events and --input are mutually exclusive
+        code, _, err = run(
+            capsys,
+            "batchpredict",
+            "--engine-dir", engine_dir,
+            "--from-events",
+            "--input", str(queries),
+        )
+        assert code != 0 and "mutually exclusive" in err

@@ -686,6 +686,41 @@ class TestServingRoundtripRule:
         )
         assert active == []
 
+    def test_offline_dispatch_path_covered(self):
+        # ISSUE 14: the mega-batch pipeline (workflow/batch_predict.py +
+        # Engine.dispatch_batch) dispatches the same fused kernels at
+        # device-saturating batch sizes — a per-item device_get or host
+        # argsort sneaking back in must fire the rule there too
+        active, _ = lint_snippet(
+            """
+            import numpy as np
+
+            def run_pipeline(engine, components, models, source, sinks):
+                def drain(pending):
+                    scores = np.asarray(pending.handle)
+                    return np.argsort(-scores)
+
+                return drain
+            """,
+            display_path="pkg/workflow/batch_predict.py",
+        )
+        assert rule_ids(active) == ["serving-host-roundtrip"] * 2
+
+    def test_engine_dispatch_batch_covered(self):
+        active, _ = lint_snippet(
+            """
+            import numpy as np
+
+            def dispatch_batch(self, algorithms, serving, models, queries):
+                def finalize():
+                    return np.argpartition(-np.asarray(models[0].scores), 10)
+
+                return finalize
+            """,
+            display_path="pkg/controller/engine.py",
+        )
+        assert rule_ids(active) == ["serving-host-roundtrip"] * 2
+
 
 # ---------------------------------------------------------------------------
 # engine mechanics: suppression, severity, parse errors

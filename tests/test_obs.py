@@ -1274,6 +1274,13 @@ class TestMetricsContract:
         es, _, _ = _make_event_server()
         registered.update(es.metrics._metrics)
         registered.update(StreamInstruments().registry._metrics)
+        # the offline batchpredict family rides the run's own registry
+        # (no server to scrape — docs/batch_predict.md)
+        from predictionio_tpu.workflow.batch_predict import (
+            BatchPredictInstruments,
+        )
+
+        registered.update(BatchPredictInstruments().registry._metrics)
         # the fleet family lives on the gateway/supervisor registry (the
         # `pio deploy --fleet` parent), not on any worker's — including
         # the flight-recorder instruments (telemetry ring + incidents)
