@@ -72,6 +72,10 @@ PHASES: list[tuple[str, int]] = [
     ("batchpredict", 600),
     ("twotower", 900),
     ("ann", 600),
+    # the evaluation grid vs the sequential MetricEvaluator (CPU backend
+    # like serving_local: the speedup compares two host-orchestrated
+    # paths, so both sides must share a backend) — ISSUE 15 acceptance
+    ("evalgrid", 600),
     ("secondary", 600),
     # diurnal/spike trace against a real self-sizing fleet (CPU workers;
     # never needs the device) — ISSUE 13 acceptance evidence
@@ -1788,6 +1792,220 @@ def phase_batchpredict(ck: _Checkpoint) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Phase: evalgrid — the evaluation grid vs the sequential MetricEvaluator
+# ---------------------------------------------------------------------------
+
+# Module-level DASE pieces: spawn-mode grid workers rebuild the evaluation
+# by unpickling these from bench.py's __main__, and the synthetic data is
+# a pure function of the params — every worker derives identical folds
+# with nothing shipped but a few integers.
+
+
+def _evalgrid_sizes() -> tuple[int, int, int, int]:
+    return (
+        int(os.environ.get("PIO_BENCH_EG_USERS", "24000")),
+        int(os.environ.get("PIO_BENCH_EG_ITEMS", "400")),
+        int(os.environ.get("PIO_BENCH_EG_RATINGS", "96000")),
+        int(os.environ.get("PIO_BENCH_EG_FOLDS", "2")),
+    )
+
+
+class _EvalGridDataSource:
+    """Synthetic-ratings data source with recommendation-template k-fold
+    read_eval (fold membership by rating index modulo k). Duck-typed
+    against BaseDataSource with lazy imports so plain
+    `python bench.py --compare` never pays the jax import."""
+
+    def __init__(self, params=None):
+        self.params = params
+        n_users, n_items, n_ratings, self.k = _evalgrid_sizes()
+        u, i, r = synthesize_ratings(n_users, n_items, n_ratings, seed=7)
+        self._u, self._i, self._r = u, i, r
+        self._user_vocab = [f"u{x}" for x in range(n_users)]
+        self._item_vocab = [f"i{x}" for x in range(n_items)]
+
+    def read_training(self, ctx):
+        from predictionio_tpu.models.recommendation.engine import TrainingData
+
+        return TrainingData(
+            self._u, self._i, self._r, self._user_vocab, self._item_vocab
+        )
+
+    def read_eval(self, ctx):
+        import numpy as np
+
+        from predictionio_tpu.models.recommendation.engine import (
+            ActualResult,
+            Query,
+            Rating,
+            TrainingData,
+        )
+
+        idx = np.arange(len(self._u))
+        folds = []
+        for fold in range(self.k):
+            test = idx % self.k == fold
+            td = TrainingData(
+                self._u[~test],
+                self._i[~test],
+                self._r[~test],
+                self._user_vocab,
+                self._item_vocab,
+            )
+            qa = []
+            tu, ti = self._u[test], self._i[test]
+            order = np.argsort(tu, kind="stable")
+            bounds = np.flatnonzero(
+                np.diff(tu[order], prepend=-1)
+            ).tolist() + [len(order)]
+            for s, e in zip(bounds[:-1], bounds[1:]):
+                rows = order[s:e]
+                user = self._user_vocab[int(tu[rows[0]])]
+                ratings = tuple(
+                    Rating(user, self._item_vocab[int(x)], 1.0)
+                    for x in ti[rows]
+                )
+                qa.append((Query(user, 10), ActualResult(ratings)))
+            folds.append((td, {"fold": fold}, qa))
+        return folds
+
+
+def _evalgrid_evaluation():
+    """2 ranks x 4 regularizations over the synthetic corpus — the grid
+    the phase searches AND the sequential baseline scores."""
+    from predictionio_tpu.controller import Engine, EngineParams
+    from predictionio_tpu.eval import Evaluation
+    from predictionio_tpu.models.recommendation.engine import (
+        ALSAlgorithm,
+        ALSAlgorithmParams,
+        Preparator,
+        Query,
+        Serving,
+    )
+    from predictionio_tpu.tuning.metrics import PrecisionAtK
+
+    params_list = [
+        EngineParams(
+            data_source=("", None),
+            preparator=("", None),
+            algorithms=[
+                (
+                    "als",
+                    ALSAlgorithmParams(
+                        rank=rank, num_iterations=2, lambda_=lam, seed=3
+                    ),
+                )
+            ],
+            serving=("", None),
+        )
+        for rank in (4, 8)
+        for lam in (0.02, 0.05, 0.2, 0.5)
+    ]
+    return Evaluation(
+        engine=Engine(
+            _EvalGridDataSource,
+            Preparator,
+            {"als": ALSAlgorithm},
+            Serving,
+            query_class=Query,
+        ),
+        metric=PrecisionAtK(10),
+        engine_params_generator=params_list,
+    )
+
+
+def phase_evalgrid(ck: _Checkpoint) -> None:
+    """The evaluation grid (ISSUE 15, docs/evaluation.md): the SAME
+    fold×params search run two ways on the CPU backend —
+
+    1. the seed-parity sequential ``MetricEvaluator`` (one EngineParams at
+       a time through ``Engine.eval``: re-read/re-prepare per params, one
+       per-query device round-trip per held-out query), and
+    2. the grid runner (parallel workers, FastEval prefix caching, scoring
+       through ``Engine.dispatch_batch`` mega-batches into the fused
+       kernels, durable ledger)
+
+    and records cells/hour, the measured speedup (the acceptance target is
+    >= 2x on the 4-worker CPU sandbox; on a 1-core box the win is the
+    batched scoring + prefix caching, on real hardware the workers stack
+    on top), and the winner's score — all ``--compare``-gated."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _jax_setup()
+    import tempfile as _tempfile
+    import time as _time
+
+    from predictionio_tpu.eval import MetricEvaluator
+    from predictionio_tpu.tuning import run_grid
+    from predictionio_tpu.workflow.context import WorkflowContext
+
+    n_users, n_items, n_ratings, k = _evalgrid_sizes()
+    ev = _evalgrid_evaluation()
+    params_list = list(ev.params_list())
+    ctx = WorkflowContext(mode="evaluation")
+
+    # --- sequential baseline: the path PR 15 replaces ----------------------
+    t0 = _time.perf_counter()
+    seq = MetricEvaluator(ev.metric).evaluate_base(ctx, ev.engine, params_list)
+    seq_s = _time.perf_counter() - t0
+
+    # --- the grid ----------------------------------------------------------
+    workers = int(
+        os.environ.get(
+            "PIO_BENCH_EVALGRID_WORKERS", str(min(4, os.cpu_count() or 1))
+        )
+    )
+    workdir = _tempfile.mkdtemp(prefix="pio_bench_evalgrid_")
+    status_path = os.path.join(workdir, "status.json")
+    t0 = _time.perf_counter()
+    report = run_grid(
+        _evalgrid_evaluation,
+        workdir=workdir,
+        workers=workers,
+        status_path=status_path,
+        env={
+            "JAX_PLATFORMS": "cpu",
+            **{
+                key: os.environ[key]
+                for key in os.environ
+                if key.startswith("PIO_BENCH_EG_")
+            },
+        },
+    )
+    grid_s = _time.perf_counter() - t0
+
+    # both paths must agree on the winner — the speedup is only evidence
+    # if the answer is the same answer. Exact equality holds here because
+    # precision@k counts every ratable query and this corpus makes every
+    # held-out query ratable: the grid's query-weighted fold mean IS the
+    # pooled metric (see tuning.runner.params_score_of for when it isn't)
+    assert report.best_params_index == seq.best_index, (
+        report.best_params_index,
+        seq.best_index,
+    )
+    assert abs(report.best_score - seq.best_score) < 1e-6, (
+        report.best_score,
+        seq.best_score,
+    )
+    speedup = seq_s / grid_s if grid_s > 0 else 0.0
+    ck.save(
+        evalgrid_params=len(params_list),
+        evalgrid_folds=report.folds,
+        evalgrid_cells=report.cells_total,
+        evalgrid_workers=workers,
+        evalgrid_corpus=f"{n_users}x{n_items}x{n_ratings}",
+        evalgrid_queries=sum(s["queries"] for s in report.scores),
+        evalgrid_wall_s=round(grid_s, 3),
+        evalgrid_seq_wall_s=round(seq_s, 3),
+        evalgrid_cells_per_hour=report.cells_per_hour,
+        evalgrid_speedup_x=round(speedup, 2),
+        # acceptance rail (ISSUE 15): >= 2x the sequential MetricEvaluator
+        evalgrid_speedup_gate_ok=bool(speedup >= 2.0),
+        evalgrid_winner_score=round(report.best_score, 6),
+        evalgrid_winner_params_index=report.best_params_index,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Phase: secondary — remaining BASELINE workloads, one measurement each
 # ---------------------------------------------------------------------------
 
@@ -2337,6 +2555,13 @@ _COMPARE_HIGHER_IS_BETTER = frozenset(
         # precompute window silently grows
         "batchpredict_offline_qps",
         "batchpredict_offline_users_per_s",
+        # the evaluation grid (ISSUE 15): search throughput (cells/hour),
+        # the measured advantage over the sequential MetricEvaluator, and
+        # the winner's score — a quality decay in the searched optimum is
+        # a regression even when the wall clock improves
+        "evalgrid_cells_per_hour",
+        "evalgrid_speedup_x",
+        "evalgrid_winner_score",
     }
 )
 
@@ -2449,6 +2674,7 @@ _PHASE_FNS = {
     "batchpredict": phase_batchpredict,
     "twotower": phase_twotower,
     "ann": phase_ann,
+    "evalgrid": phase_evalgrid,
     "secondary": phase_secondary,
     "elastic": phase_elastic,
     "probe": phase_probe,

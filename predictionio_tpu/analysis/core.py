@@ -87,6 +87,10 @@ class LintConfig:
         "*/ops/spd_solve.py",
         "*/stream/trainers.py",
         "*/stream/pipeline.py",
+        # the evaluation grid trains one model per fold×params cell under
+        # a per-cell xray profile — a bare sync in the cell loop leaks
+        # device time out of every cell's training evidence at once
+        "*/tuning/*.py",
     )
     # fleet gateway/supervisor modules: outbound replica calls and
     # replica state transitions must route through the span/telemetry
@@ -118,6 +122,10 @@ class LintConfig:
         # sneaking back in costs O(mega-batch * corpus), not O(batch * k)
         "*/workflow/batch_predict.py",
         "*/controller/engine.py",
+        # the evaluation grid's cell scoring rides the same mega-batch
+        # entry (tuning/cells.dispatch_scores -> Engine.dispatch_batch);
+        # a host round-trip here multiplies by cells × held-out queries
+        "*/tuning/*.py",
     )
     # function names that make up the predict path inside those modules
     # (nested helpers like a dispatch's `finalize` are covered implicitly)
@@ -137,6 +145,19 @@ class LintConfig:
         # like `finalize`/`drain` are covered implicitly)
         "dispatch_batch",
         "run_pipeline",
+        # the evaluation grid's scoring path (tuning/cells.py)
+        "dispatch_scores",
+        "score_cell",
+    )
+    # evaluation-grid modules + the functions that make up the cell
+    # scoring path (rule eval-per-query-predict): held-out scoring must
+    # go through Engine.dispatch_batch's mega-batches — a per-query
+    # ``.predict()`` loop reinstates one device round-trip per held-out
+    # query per cell, the exact cost the grid exists to delete
+    tuning_globs: tuple[str, ...] = ("*/tuning/*.py",)
+    eval_scoring_functions: tuple[str, ...] = (
+        "dispatch_scores",
+        "score_cell",
     )
     # rule ids to run; None = all registered
     enabled: frozenset[str] | None = None

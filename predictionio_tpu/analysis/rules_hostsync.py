@@ -152,6 +152,56 @@ def check_serving_roundtrip(ctx: FileContext):
     return findings
 
 
+register_rule(
+    "eval-per-query-predict",
+    "hostsync",
+    Severity.ERROR,
+    "per-query .predict() call on the evaluation grid's cell scoring "
+    "path; held-out queries must go through Engine.dispatch_batch "
+    "mega-batches (tuning/cells.dispatch_scores) — a predict loop costs "
+    "one device round-trip per held-out query per cell",
+)
+
+
+@register_checker
+def check_eval_per_query_predict(ctx: FileContext):
+    """The grid's whole reason to exist is deleting the sequential
+    MetricEvaluator's per-query device round-trips; hold that property
+    statically: inside the cell-scoring functions (and their nested
+    helpers), any ``X.predict(...)`` attribute call is an error.
+    ``predict_batch``/``predict_batch_dispatch``/``batch_predict`` (the
+    batched entries dispatch_batch composes) are the sanctioned
+    spellings."""
+    cfg = ctx.config
+    if not matches_any_glob(ctx.path or ctx.display_path, cfg.tuning_globs):
+        return []
+    scoring_names = set(cfg.eval_scoring_functions)
+    findings: list[Finding] = []
+    seen: set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in scoring_names:
+            continue
+        for sub in ast.walk(node):  # nested helpers covered by design
+            if not isinstance(sub, ast.Call) or id(sub) in seen:
+                continue
+            seen.add(id(sub))
+            func = sub.func
+            if isinstance(func, ast.Attribute) and func.attr == "predict":
+                findings.append(
+                    ctx.finding(
+                        "eval-per-query-predict",
+                        sub,
+                        f".predict() inside {node.name!r} scores one query "
+                        "per device round-trip; route the batch through "
+                        "Engine.dispatch_batch (tuning/cells."
+                        "dispatch_scores)",
+                    )
+                )
+    return findings
+
+
 @register_checker
 def check_hostsync(ctx: FileContext):
     cfg = ctx.config

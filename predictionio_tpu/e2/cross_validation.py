@@ -15,6 +15,15 @@ T = TypeVar("T")
 def k_fold_split(data: Sequence[T], k: int) -> list[tuple[list[T], list[T]]]:
     if k <= 0:
         raise ValueError("k must be positive")
+    if k > len(data):
+        # every fold past len(data) would have an EMPTY test split: its
+        # metric scores 0/NaN and the degenerate cell can silently drag a
+        # grid-search average (the evaluation grid clamps k with a warning
+        # BEFORE calling this — predictionio_tpu/tuning/grid.clamp_folds)
+        raise ValueError(
+            f"k={k} folds over {len(data)} records would yield empty test "
+            f"folds; use k <= {len(data)} (tuning.grid.clamp_folds clamps)"
+        )
     folds = []
     for fold in range(k):
         train = [x for i, x in enumerate(data) if i % k != fold]

@@ -45,15 +45,36 @@ class FastEvalEngine(Engine):
         self._eval_data_cache: dict[str, list] = {}
         self._prepared_cache: dict[str, list] = {}
         self._model_cache: dict[str, Any] = {}
+        # hit/miss accounting per stage — the evaluation grid's worker
+        # asserts its prefix sharing on these (docs/evaluation.md), and
+        # they make "did the cache actually help" a measurable question
+        self.cache_stats: dict[str, int] = {
+            "read_hits": 0,
+            "read_misses": 0,
+            "prepare_hits": 0,
+            "prepare_misses": 0,
+            "train_hits": 0,
+            "train_misses": 0,
+            "model_clears": 0,
+        }
 
-    def clear_caches(self) -> None:
-        self._eval_data_cache.clear()
-        self._prepared_cache.clear()
+    def clear_caches(self, keep_data: bool = False) -> None:
+        """Drop memoized stages. ``keep_data=True`` clears only the model
+        cache — the grid scheduler calls this between params groups to
+        bound worker memory (trained models are the big objects) while
+        cells in later groups still share the data_source/preparator
+        prefix reads."""
+        if not keep_data:
+            self._eval_data_cache.clear()
+            self._prepared_cache.clear()
+        if self._model_cache:
+            self.cache_stats["model_clears"] += 1
         self._model_cache.clear()
 
     def _eval_folds(self, ctx: WorkflowContext, ep: EngineParams) -> list:
         key = _key("ds", ep.data_source[0], ep.data_source[1])
         if key not in self._eval_data_cache:
+            self.cache_stats["read_misses"] += 1
             ds: BaseDataSource = Doer.apply(
                 self._pick(self.data_source_classes, ep.data_source[0], "datasource"),
                 ep.data_source[1],
@@ -62,6 +83,8 @@ class FastEvalEngine(Engine):
                 (td, ei, list(qa)) for td, ei, qa in ds.read_eval(ctx)
             ]
             logger.debug("fast-eval: read_eval MISS %s", key[:80])
+        else:
+            self.cache_stats["read_hits"] += 1
         return self._eval_data_cache[key]
 
     def _prepared(self, ctx: WorkflowContext, ep: EngineParams) -> list:
@@ -69,12 +92,15 @@ class FastEvalEngine(Engine):
             "prep", ep.data_source[0], ep.data_source[1], ep.preparator[0], ep.preparator[1]
         )
         if key not in self._prepared_cache:
+            self.cache_stats["prepare_misses"] += 1
             prep: BasePreparator = Doer.apply(
                 self._pick(self.preparator_classes, ep.preparator[0], "preparator"),
                 ep.preparator[1],
             )
             folds = self._eval_folds(ctx, ep)
             self._prepared_cache[key] = [prep.prepare(ctx, td) for td, _, _ in folds]
+        else:
+            self.cache_stats["prepare_hits"] += 1
         return self._prepared_cache[key]
 
     def _trained_model(
@@ -92,11 +118,14 @@ class FastEvalEngine(Engine):
             fold_idx,
         )
         if key not in self._model_cache:
+            self.cache_stats["train_misses"] += 1
             algo = Doer.apply(
                 self._pick(self.algorithm_classes, name, "algorithm"), params
             )
             pd = self._prepared(ctx, ep)[fold_idx]
             self._model_cache[key] = algo.train(ctx, pd)
+        else:
+            self.cache_stats["train_hits"] += 1
         return self._model_cache[key]
 
     def eval(

@@ -31,6 +31,7 @@ from predictionio_tpu.controller import (
     SanityCheck,
 )
 from predictionio_tpu.e2.cross_validation import k_fold_split
+from predictionio_tpu.tuning.grid import clamp_folds
 from predictionio_tpu.ops.classify import (
     NaiveBayesModel,
     RandomForestModel,
@@ -118,8 +119,12 @@ class DataSource(BaseDataSource):
             raise ValueError("DataSourceParams.evalK must not be None")
         labels, features = self._read_points(ctx)
         indices = list(range(len(labels)))
+        # an evalK beyond the corpus degrades loudly to leave-one-out
+        # instead of hard-failing every grid cell (k_fold_split raises on
+        # the empty test folds an oversized k would produce)
+        k = clamp_folds(self.params.eval_k, len(indices), what="points")
         folds = []
-        for train_idx, test_idx in k_fold_split(indices, self.params.eval_k):
+        for train_idx, test_idx in k_fold_split(indices, k):
             td = TrainingData(labels[train_idx], features[train_idx])
             qa = [
                 (

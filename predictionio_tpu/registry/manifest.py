@@ -52,6 +52,13 @@ class ModelManifest:
     # compares wall clock and memory between versions. Empty for versions
     # published before the profiler existed (or with PIO_XRAY=0).
     train_profile: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # evaluation-grid evidence (predictionio_tpu/tuning, docs/evaluation.md):
+    # when this version is a grid search's winning refit, the full search
+    # record rides here — metric, fold layout, per-params scores table,
+    # per-cell results, and the trial ledger's sha256 as the integrity
+    # anchor — so "why did this version ship" is answerable from the
+    # manifest alone. Empty for versions trained outside a grid.
+    eval_evidence: dict[str, Any] = dataclasses.field(default_factory=dict)
     # the version's ANN retrieval index (predictionio_tpu/ann, docs/ann.md):
     # a second content-addressed blob in the same engine's blob store,
     # recorded here with its sha256/bytes plus layout metadata (items,

@@ -367,6 +367,33 @@ class ArtifactStore:
             )
             return manifest
 
+    def attach_eval_evidence(
+        self, engine_id: str, version: str, evidence: dict[str, Any]
+    ) -> ModelManifest:
+        """Record an evaluation grid's evidence block on an existing
+        version's manifest (the winning refit of a ``pio eval`` search —
+        docs/evaluation.md). Atomic manifest rewrite under the transition
+        lock, the ``attach_ann_index`` idiom: a lane loader reads either
+        the manifest without the evidence or with the complete block,
+        never a torn one."""
+        with self._lock, self._state_mutex(engine_id):
+            manifest = self.get_manifest(engine_id, version)
+            if manifest is None:
+                raise ValueError(f"unknown version {version!r}")
+            manifest.eval_evidence = dict(evidence)
+            _atomic_write(
+                self._manifest_path(engine_id, version),
+                json.dumps(manifest.to_json_dict(), indent=1).encode("utf-8"),
+            )
+            logger.info(
+                "eval evidence attached to %s %s (metric %s, best %s)",
+                self.engine_key(engine_id),
+                version,
+                evidence.get("metric"),
+                evidence.get("bestScore"),
+            )
+            return manifest
+
     def load_ann_blob(
         self, engine_id: str, version: str
     ) -> tuple[bytes, dict[str, Any]] | None:

@@ -424,8 +424,17 @@ def cmd_stream(args) -> int:
 
 
 def cmd_eval(args) -> int:
-    from predictionio_tpu.workflow.core_workflow import run_evaluation
+    """Hyperparameter search as the evaluation grid (docs/evaluation.md):
+    fold×params cells trained in parallel workers, scored through the
+    offline mega-batch path, finished cells persisted to a durable ledger
+    (``--resume`` retrains zero finished cells), and — with an engine
+    identity and a registry — the winning refit published as a CANDIDATE
+    carrying the full grid evidence, riding the same bake gates as every
+    other model change."""
     import importlib
+    import tempfile
+
+    from predictionio_tpu.workflow.core_workflow import run_grid_evaluation
 
     # user evaluations live in the engine project's cwd (ref Console eval
     # runs from the engine dir); the installed `pio` script's sys.path[0]
@@ -434,23 +443,99 @@ def cmd_eval(args) -> int:
     cwd = os.getcwd()
     if cwd not in sys.path:
         sys.path.insert(0, cwd)
-    module_name, _, attr = args.evaluation.rpartition(".")
-    evaluation = getattr(importlib.import_module(module_name), attr)
-    # accept an Evaluation instance, an Evaluation subclass, or a zero-arg
-    # factory function (ref Console eval: object or class name)
-    if isinstance(evaluation, type) or (
-        callable(evaluation) and not hasattr(evaluation, "run")
-    ):
-        evaluation = evaluation()
+    source: str = args.evaluation
+    # FakeRun-style evaluations (run() but no engine/metric — the
+    # `pio eval HelloWorld` dev flow, workflow/fake_workflow.py) have no
+    # grid to search: keep them on the sequential parity path, which
+    # also honors their no_save contract
+    from predictionio_tpu.tuning.cells import resolve_evaluation
+
+    probe = resolve_evaluation(args.evaluation)
+    if (
+        getattr(probe, "engine", None) is None
+        or getattr(probe, "metric", None) is None
+    ) and hasattr(probe, "run"):
+        from predictionio_tpu.workflow.core_workflow import run_evaluation
+
+        instance_id, result = run_evaluation(probe, batch=args.batch or "")
+        print(result.one_liner())
+        print(f"Evaluation instance ID: {instance_id}")
+        return 0
     if args.engine_params_generator:
+        # a separate generator overrides the evaluation's own params list;
+        # resolve both here and hand the composed instance to the runner
+        # (workers then require a self-contained evaluation path, which
+        # the error below explains)
+        if args.workers > 0:
+            return _die(
+                "an explicit engine_params_generator cannot ride to "
+                "process workers (they rebuild the evaluation by its "
+                "dotted path); set engine_params_generator on the "
+                "Evaluation itself, or use --workers 0"
+            )
+        evaluation = probe
         module_name, _, attr = args.engine_params_generator.rpartition(".")
         generator = getattr(importlib.import_module(module_name), attr)
         if isinstance(generator, type):
             generator = generator()
         evaluation.engine_params_generator = generator
-    instance_id, result = run_evaluation(evaluation, batch=args.batch or "")
-    print(result.one_liner())
+        source = evaluation  # type: ignore[assignment]
+
+    engine_manifest = None
+    if args.engine_dir:
+        from predictionio_tpu.workflow.engine_loader import load_manifest
+
+        engine_manifest = load_manifest(args.engine_dir, args.variant)
+    registry_dir = args.registry_dir or os.environ.get("PIO_REGISTRY_DIR")
+    if args.publish and args.no_publish:
+        return _die("--publish and --no-publish are mutually exclusive")
+    # default: publish when the pieces are in place (engine identity +
+    # registry), stay quiet otherwise; --publish forces (and errors
+    # loudly on missing pieces), --no-publish always wins
+    publish = (
+        False
+        if args.no_publish
+        else (args.publish or bool(engine_manifest and registry_dir))
+    )
+    if args.resume and not args.workdir:
+        return _die(
+            "--resume needs the --workdir of the run to resume "
+            "(the trial ledger lives there)"
+        )
+    workdir = args.workdir or tempfile.mkdtemp(prefix="pio_eval_grid_")
+    try:
+        instance_id, report = run_grid_evaluation(
+            source,
+            evaluation=probe,  # already resolved above; don't rebuild
+            batch=args.batch or "",
+            workdir=workdir,
+            workers=args.workers,
+            folds=args.folds,
+            resume=args.resume,
+            batch_size=args.batch_size,
+            publish=publish,
+            registry_dir=registry_dir,
+            engine_manifest=engine_manifest,
+            stage_mode=args.stage_mode,
+            stage_fraction=args.stage_fraction,
+            status_path=args.status_file,
+            cwd=cwd,
+        )
+    except ValueError as exc:
+        return _die(str(exc))
+    print(report.one_liner())
+    if report.published_version:
+        print(
+            f"Winner published to registry as candidate "
+            f"{report.published_version} (evidence: {report.cells_total} "
+            f"cells, ledger sha {report.ledger_sha256[:12]})"
+        )
+    print(f"Trial ledger: {report.ledger_path}")
     print(f"Evaluation instance ID: {instance_id}")
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report.to_json_dict(), fh, indent=1, sort_keys=True)
+        print(f"Grid report written to {args.out}")
     return 0
 
 
@@ -635,10 +720,18 @@ def cmd_top(args) -> int:
     on-disk ring (``--obs-dir``) when the gateway is down."""
     from predictionio_tpu.tools.top import (
         run_batchpredict_top,
+        run_evalgrid_top,
         run_history,
         run_top,
     )
 
+    if args.eval:
+        return run_evalgrid_top(
+            args.eval,
+            interval_s=args.interval,
+            iterations=1 if args.once else args.iterations,
+            json_mode=args.json,
+        )
     if args.batchpredict:
         return run_batchpredict_top(
             args.batchpredict,
@@ -1559,10 +1652,93 @@ def build_parser() -> argparse.ArgumentParser:
     stream_args(x, require_app=True)
     x.set_defaults(fn=cmd_stream)
 
-    x = sub.add_parser("eval")
+    x = sub.add_parser(
+        "eval",
+        help="hyperparameter search: parallel, resumable fold×params "
+        "evaluation grid; winner publishes through the registry "
+        "(docs/evaluation.md)",
+    )
     x.add_argument("evaluation", help="dotted path to an Evaluation")
     x.add_argument("engine_params_generator", nargs="?")
     x.add_argument("--batch", default="")
+    x.add_argument(
+        "--workers",
+        type=int,
+        default=0,
+        help="parallel cell worker processes (0 = score cells in-process; "
+        "workers rebuild the evaluation from its dotted path)",
+    )
+    x.add_argument(
+        "--folds",
+        type=int,
+        default=None,
+        help="expected fold count (default: discovered from the data "
+        "source's read_eval)",
+    )
+    x.add_argument(
+        "--workdir",
+        default=None,
+        help="grid working directory holding the trial ledger; a stable "
+        "--workdir is what makes --resume possible (default: a fresh "
+        "temp dir per run)",
+    )
+    x.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume a killed run from --workdir's ledger: finished "
+        "cells are never retrained",
+    )
+    x.add_argument(
+        "--batch-size",
+        type=int,
+        default=512,
+        help="mega-batch size for held-out scoring through "
+        "Engine.dispatch_batch (default 512)",
+    )
+    x.add_argument(
+        "--engine-dir",
+        default=None,
+        help="engine project directory — supplies the registry identity "
+        "the winner publishes under (with --variant)",
+    )
+    x.add_argument("--variant", help="engine.json variant (with --engine-dir)")
+    x.add_argument(
+        "--registry-dir",
+        help="artifact registry receiving the winning refit as a "
+        "candidate (default: $PIO_REGISTRY_DIR)",
+    )
+    x.add_argument(
+        "--publish",
+        action="store_true",
+        help="force winner publication (default: publish automatically "
+        "when --engine-dir and a registry dir are both available)",
+    )
+    x.add_argument(
+        "--no-publish",
+        action="store_true",
+        help="never publish the winner (scores and ledger only)",
+    )
+    x.add_argument(
+        "--stage-mode",
+        choices=["canary", "shadow"],
+        default="canary",
+        help="rollout mode the winner is staged under (default canary)",
+    )
+    x.add_argument(
+        "--stage-fraction",
+        type=float,
+        default=0.1,
+        help="canary fraction for the staged winner (default 0.1)",
+    )
+    x.add_argument(
+        "--status-file",
+        default=None,
+        help="write throttled atomic progress snapshots here; "
+        "`pio top --eval PATH` renders them live",
+    )
+    x.add_argument(
+        "--out", default=None, help="write the grid report JSON here"
+    )
     x.set_defaults(fn=cmd_eval)
 
     x = sub.add_parser("deploy")
@@ -1930,6 +2106,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="render the progress line of an offline `pio batchpredict` "
         "run from its --status-file (live while the run is active, "
         "final totals after)",
+    )
+    x.add_argument(
+        "--eval",
+        default=None,
+        metavar="STATUS_FILE",
+        help="render the live grid line of a `pio eval` run from its "
+        "--status-file: cells done/total, running workers, best score "
+        "so far, ETA",
     )
     x.set_defaults(fn=cmd_top)
 

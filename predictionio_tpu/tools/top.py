@@ -882,6 +882,79 @@ def run_batchpredict_top(
     return 0
 
 
+def render_evalgrid(status: dict[str, Any]) -> str:
+    """The ``pio top --eval`` live grid line, from the run's throttled
+    atomic status file (docs/evaluation.md): cells done/total, running
+    workers, best score so far, ETA — live while the grid runs, final
+    totals after."""
+    num = format_number
+    state = status.get("state", "?")
+    done = status.get("cellsDone", 0)
+    total = status.get("cellsTotal", 0)
+    skipped = status.get("cellsSkipped", 0)
+    failed = status.get("cellsFailed", 0)
+    best = status.get("bestScore")
+    best_str = (
+        f"best {best:.4f} (params {status.get('bestParams', '?')})"
+        if isinstance(best, (int, float))
+        else "best —"
+    )
+    eta = status.get("etaS") or 0
+    eta_str = f"  eta {eta:.0f}s" if eta and state == "running" else ""
+    extras = []
+    if skipped:
+        extras.append(f"{num(skipped)} resumed")
+    if failed:
+        extras.append(f"{num(failed)} FAILED")
+    extra_str = f" ({', '.join(extras)})" if extras else ""
+    return (
+        f"pio top — eval grid [{status.get('metric', '?')}] "
+        f"(pid {status.get('pid', '?')}, {state})   {time.strftime('%H:%M:%S')}\n"
+        f"  grid   {num(done)}/{num(total)} cells{extra_str}   "
+        f"{num(status.get('folds'))} folds   "
+        f"{num(status.get('running'))} running / "
+        f"{num(status.get('workers'))} workers   {best_str}{eta_str}"
+    )
+
+
+def run_evalgrid_top(
+    path: str,
+    interval_s: float = 2.0,
+    iterations: int | None = None,
+    json_mode: bool = False,
+    out: Callable[[str], None] = print,
+    sleep: Callable[[float], None] = time.sleep,
+) -> int:
+    """Poll-and-render loop over an eval-grid status file — the
+    batchpredict loop's twin: a missing/torn file degrades to an
+    'unreadable' line and the loop keeps polling (the writer is atomic,
+    so torn means 'not started yet')."""
+    import json as _json
+
+    n = 0
+    try:
+        while iterations is None or n < iterations:
+            try:
+                with open(path) as fh:
+                    status = _json.load(fh)
+            except (OSError, ValueError) as exc:
+                if json_mode:
+                    out(_json.dumps({"evalgrid": path, "error": str(exc)}))
+                else:
+                    out(f"pio top — eval grid: {path} unreadable ({exc})")
+            else:
+                if json_mode:
+                    out(_json.dumps({"evalgrid": path, **status}))
+                else:
+                    out(render_evalgrid(status))
+            n += 1
+            if iterations is None or n < iterations:
+                sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def fetch_telemetry_window(
     url: str, window_s: float, timeout_s: float = 5.0
 ) -> list[dict[str, Any]]:

@@ -1,0 +1,55 @@
+"""The evaluation grid: parallel, resumable fold×params hyperparameter
+search whose winner ships through the model registry (docs/evaluation.md).
+
+MLlib made CrossValidator-style grid search a first-class pipeline stage of
+the Spark substrate this project replaces (PAPERS.md 1505.06807); DrJAX
+gives the map-reduce shape for fanning independent fold×params cells over
+workers. Here the grid is built from an EngineParamsGenerator × k-fold
+splits (:mod:`~predictionio_tpu.tuning.grid`), each cell is trained and
+scored through the offline mega-batch path
+(:meth:`Engine.dispatch_batch` → the fused ``ops/topk`` kernels,
+:mod:`~predictionio_tpu.tuning.cells`), finished cells land in a durable
+JSONL trial ledger (:mod:`~predictionio_tpu.tuning.ledger`) so a killed run
+resumes retraining zero finished cells, and the winning params' full-data
+refit is published to the registry as a CANDIDATE carrying the full grid
+evidence — hyperparameter search under the same bake-gate discipline as
+every other model change (:mod:`~predictionio_tpu.tuning.runner`).
+"""
+
+from predictionio_tpu.tuning.grid import (
+    CellKey,
+    EventStoreSplitter,
+    GridSpec,
+    build_cells,
+    cell_id_of,
+    clamp_folds,
+)
+from predictionio_tpu.tuning.ledger import TrialLedger
+from predictionio_tpu.tuning.metrics import (
+    NDCGAtK,
+    PrecisionAtK,
+    RecallAtK,
+)
+from predictionio_tpu.tuning.runner import (
+    EvalGridInstruments,
+    GridReport,
+    register_eval_metrics,
+    run_grid,
+)
+
+__all__ = [
+    "CellKey",
+    "EvalGridInstruments",
+    "EventStoreSplitter",
+    "GridReport",
+    "GridSpec",
+    "NDCGAtK",
+    "PrecisionAtK",
+    "RecallAtK",
+    "TrialLedger",
+    "build_cells",
+    "cell_id_of",
+    "clamp_folds",
+    "register_eval_metrics",
+    "run_grid",
+]

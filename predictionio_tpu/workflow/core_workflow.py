@@ -307,6 +307,89 @@ def load_models_for_instance(
     return engine.prepare_deploy(ctx, engine_params, persisted)
 
 
+def run_grid_evaluation(
+    evaluation_source: "Any",
+    ctx: WorkflowContext | None = None,
+    storage: Storage | None = None,
+    batch: str = "",
+    **grid_kwargs: Any,
+) -> tuple[str, Any]:
+    """Run an Evaluation through the parallel, resumable evaluation grid
+    (predictionio_tpu/tuning, docs/evaluation.md) with the same
+    EvaluationInstance bookkeeping as :func:`run_evaluation`: the
+    metadata store keeps its one-liner/JSON/HTML results row, the grid
+    keeps its durable cell ledger, and (when publishing) the winner
+    rides the registry as a candidate. Returns (instance_id, GridReport).
+    """
+    from predictionio_tpu.tuning import run_grid
+    from predictionio_tpu.tuning.cells import resolve_evaluation
+
+    storage = storage or Storage.instance()
+    ctx = ctx or WorkflowContext(mode="evaluation", _storage=storage, batch=batch)
+    evaluation = grid_kwargs.pop("evaluation", None) or resolve_evaluation(
+        evaluation_source
+    )
+    instances = storage.get_meta_data_evaluation_instances()
+    instance = EvaluationInstance(
+        id="",
+        status=EvaluationInstanceStatus.INIT,
+        start_time=_dt.datetime.now(tz=UTC),
+        end_time=_dt.datetime.now(tz=UTC),
+        evaluation_class=type(evaluation).__module__
+        + "."
+        + type(evaluation).__qualname__,
+        batch=batch,
+    )
+    instance_id = ""
+
+    def record_start() -> None:
+        # inserted only AFTER run_grid's argument/ledger validation: a
+        # flag typo (ledger-exists-without-resume, missing registry for
+        # --publish, ...) must not leave a forever-EVALUATING zombie row
+        # in the metadata store on every retry
+        nonlocal instance_id
+        instance_id = instances.insert(instance)
+        instance.status = EvaluationInstanceStatus.EVALUATING
+        instances.update(instance)
+
+    try:
+        # workers>0 rebuild the evaluation by name in each process — hand
+        # the original source through; the resolved instance serves the
+        # in-process path
+        source = (
+            evaluation_source
+            if isinstance(evaluation_source, str)
+            or not hasattr(evaluation_source, "run")
+            else evaluation
+        )
+        report = run_grid(
+            source,
+            ctx=ctx,
+            storage=storage,
+            evaluation=evaluation,
+            on_validated=record_start,
+            **grid_kwargs,
+        )
+    except BaseException:
+        # stays EVALUATING — never EVALCOMPLETED; the ledger holds the
+        # finished cells for a --resume
+        if instance_id:
+            instance.end_time = _dt.datetime.now(tz=UTC)
+            instances.update(instance)
+        CleanupFunctions.run()
+        raise
+    result = report.evaluator_result
+    instance.status = EvaluationInstanceStatus.EVALCOMPLETED
+    instance.end_time = _dt.datetime.now(tz=UTC)
+    instance.evaluator_results = report.one_liner()
+    if result is not None:
+        instance.evaluator_results_json = json.dumps(result.to_json_dict())
+        instance.evaluator_results_html = result.to_html()
+    instances.update(instance)
+    CleanupFunctions.run()
+    return instance_id, report
+
+
 def run_evaluation(
     evaluation: "Any",
     ctx: WorkflowContext | None = None,

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""CI smoke for the evaluation grid (ISSUE 15, docs/evaluation.md).
+
+Proves the two acceptance rails end to end on a tiny corpus, with REAL
+process death in the loop:
+
+1. a 2 params × 2 folds grid runs to completion and its winner is staged
+   as a registry CANDIDATE carrying the grid evidence, and
+2. a run SIGKILLed mid-grid, resumed with ``--resume``, retrains ZERO
+   finished cells (the durable ledger is the resume contract).
+
+Parent mode orchestrates; ``--child`` mode runs the grid in a separate OS
+process so the SIGKILL is a real kill (no atexit, no finally blocks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from predictionio_tpu.controller import (  # noqa: E402
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Engine,
+    EngineParams,
+    Params,
+)
+from predictionio_tpu.eval import AverageMetric, Evaluation  # noqa: E402
+
+N_FOLDS = 2
+N_PARAMS = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class SmokeParams(Params):
+    weight: float = 1.0
+
+
+class SmokeDataSource(BaseDataSource):
+    def read_training(self, ctx):
+        return list(range(20))
+
+    def read_eval(self, ctx):
+        for fold in range(N_FOLDS):
+            yield list(range(20)), {"fold": fold}, [
+                (i, i) for i in range(6)
+            ]
+
+
+class SmokePreparator(BasePreparator):
+    def prepare(self, ctx, td):
+        return td
+
+
+class SmokeAlgo(BaseAlgorithm):
+    params_class = SmokeParams
+    params: SmokeParams
+
+    def train(self, ctx, pd):
+        time.sleep(float(os.environ.get("EG_SMOKE_SLEEP", "0")))
+        return {"weight": self.params.weight}
+
+    def predict(self, model, query):
+        return query * model["weight"]
+
+
+class SmokeServing(BaseServing):
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class SmokeMetric(AverageMetric):
+    def calculate_score(self, ei, q, p, a) -> float:
+        return float(p)
+
+
+def smoke_params(weight: float) -> EngineParams:
+    return EngineParams(
+        data_source=("", None),
+        preparator=("", None),
+        algorithms=[("", SmokeParams(weight=weight))],
+        serving=("", None),
+    )
+
+
+def make_engine() -> Engine:
+    return Engine(SmokeDataSource, SmokePreparator, SmokeAlgo, SmokeServing)
+
+
+def make_evaluation() -> Evaluation:
+    return Evaluation(
+        engine=make_engine(),
+        metric=SmokeMetric(),
+        engine_params_generator=[smoke_params(1.0), smoke_params(3.0)],
+    )
+
+
+def _manifest():
+    from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+    return EngineManifest(
+        engine_id="evalgrid-smoke",
+        version="1",
+        variant="engine.json",
+        engine_factory="scripts.evalgrid_smoke.make_engine",
+        description="",
+        variant_json={},
+        engine_dir=".",
+    )
+
+
+def child(workdir: str, registry_dir: str, resume: bool) -> int:
+    from predictionio_tpu.tuning import run_grid
+
+    report = run_grid(
+        make_evaluation(),
+        workdir=workdir,
+        workers=0,
+        resume=resume,
+        publish=resume,  # the resumed run ships the winner
+        registry_dir=registry_dir,
+        engine_manifest=_manifest() if resume else None,
+        stage_fraction=0.5,
+    )
+    print("CHILD_REPORT " + json.dumps(report.to_json_dict()))
+    return 0
+
+
+def _ledger_lines(path: str) -> int:
+    if not os.path.exists(path):
+        return 0
+    n = 0
+    with open(path) as fh:
+        for line in fh:
+            try:
+                json.loads(line)
+                n += 1
+            except ValueError:
+                pass
+    return n
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        i = sys.argv.index("--child")
+        workdir, registry_dir, resume = (
+            sys.argv[i + 1],
+            sys.argv[i + 2],
+            "--resume" in sys.argv,
+        )
+        return child(workdir, registry_dir, resume)
+
+    tmp = tempfile.mkdtemp(prefix="pio_evalgrid_smoke_")
+    workdir = os.path.join(tmp, "grid")
+    registry_dir = os.path.join(tmp, "registry")
+    env = {
+        k: v for k, v in os.environ.items() if not k.startswith("PIO_STORAGE_")
+    }
+    env.update({"PIO_FS_BASEDIR": os.path.join(tmp, "store"),
+                "JAX_PLATFORMS": "cpu"})
+
+    # a v1 stable to canary the grid winner against
+    os.environ.update(env)
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.workflow.core_workflow import run_train
+
+    storage = Storage(env=env)
+    run_train(
+        make_engine(),
+        _manifest(),
+        smoke_params(1.0),
+        storage=storage,
+        registry_dir=registry_dir,
+    )
+
+    # run 1: SIGKILL mid-grid (1 ledger line = at least one finished cell)
+    ledger = os.path.join(workdir, "ledger.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", workdir,
+         registry_dir],
+        env={**env, "EG_SMOKE_SLEEP": "0.8"},
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    deadline = time.monotonic() + 120
+    try:
+        while _ledger_lines(ledger) < 1:
+            if proc.poll() is not None:
+                print("grid finished before the kill:", file=sys.stderr)
+                print(proc.stdout.read().decode(errors="replace")[-2000:],
+                      file=sys.stderr)
+                return 1
+            if time.monotonic() > deadline:
+                print("no ledger line in 120s", file=sys.stderr)
+                proc.kill()
+                return 1
+            time.sleep(0.05)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    finished = _ledger_lines(ledger)
+    total = N_PARAMS * N_FOLDS
+    assert 1 <= finished < total, finished
+
+    # run 2: --resume completes, retraining zero finished cells, and
+    # stages the winner as a candidate with the grid evidence
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", workdir,
+         registry_dir, "--resume"],
+        env={**env, "EG_SMOKE_SLEEP": "0"},
+        capture_output=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stdout.decode()[-2000:] + out.stderr.decode()[-2000:]
+    report = json.loads(
+        next(
+            line for line in out.stdout.decode().splitlines()
+            if line.startswith("CHILD_REPORT ")
+        ).split(" ", 1)[1]
+    )
+    assert report["cells_total"] == total
+    assert report["cells_skipped"] == finished, report
+    assert report["cells_run"] == total - finished, report
+    assert report["best_params_index"] == 1  # weight 3.0 wins
+
+    from predictionio_tpu.registry import ArtifactStore
+
+    store = ArtifactStore(registry_dir)
+    state = store.get_state("evalgrid-smoke")
+    assert state.stable == "v000001", state
+    assert state.candidate == report["published_version"] == "v000002", state
+    evidence = store.get_manifest("evalgrid-smoke", "v000002").eval_evidence
+    assert evidence["cellsTotal"] == total
+    assert evidence["ledgerSha256"] == report["ledger_sha256"]
+    print(
+        f"evalgrid smoke: SIGKILL after {finished}/{total} cells -> resume "
+        f"retrained {report['cells_run']} (zero finished cells), winner "
+        f"v000002 staged as candidate with grid evidence"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,6 +142,15 @@ PYEOF
   fi
   echo "pio top --batchpredict renders from the run's status file"
 
+  # --- evalgrid smoke (ISSUE 15, docs/evaluation.md): 2 params x 2 folds
+  #     on a tiny corpus with a REAL SIGKILL mid-grid — the resumed run
+  #     must retrain zero finished cells (the durable-ledger contract)
+  #     and stage the winner as a registry candidate carrying the grid
+  #     evidence (scores table + ledger sha). The lint pass above already
+  #     holds the scoring-path rails statically (serving-host-roundtrip /
+  #     train-unaccounted-sync / eval-per-query-predict over tuning/).
+  env JAX_PLATFORMS=cpu python scripts/evalgrid_smoke.py
+
   # --- ANN smoke (ISSUE 10, docs/ann.md): build a small clustered index,
   #     serve a real engine through it via the registry attach path, and
   #     hold the two acceptance rails by measurement: recall@10 >= 0.95

@@ -1,0 +1,49 @@
+"""A self-contained, picklable Evaluation over the sample engine — the
+grid runner's process workers rebuild it by dotted path
+(``tests.sample_evaluation.make_evaluation``)."""
+
+from __future__ import annotations
+
+from predictionio_tpu.controller import EmptyParams, Engine, EngineParams
+from predictionio_tpu.eval import AverageMetric, Evaluation
+from tests.sample_engine import (
+    Algo0,
+    AlgoParams,
+    DataSource0,
+    DSParams,
+    Preparator0,
+    Serving0,
+)
+
+
+class AlgoIdMetric(AverageMetric):
+    """Score = the prediction's algo id (deterministic, param-sensitive)."""
+
+    def calculate_score(self, ei, q, p, a) -> float:
+        return float(p.algo_id)
+
+
+def sample_params(algo_id: int, n_queries: int = 3) -> EngineParams:
+    return EngineParams(
+        data_source=("ds", DSParams(id=1, n_queries=n_queries)),
+        preparator=("prep", DSParams(id=2)),
+        algorithms=[("a", AlgoParams(id=algo_id))],
+        serving=("s", EmptyParams()),
+    )
+
+
+def make_evaluation() -> Evaluation:
+    return Evaluation(
+        engine=Engine(
+            {"ds": DataSource0},
+            {"prep": Preparator0},
+            {"a": Algo0},
+            {"s": Serving0},
+        ),
+        metric=AlgoIdMetric(),
+        engine_params_generator=[
+            sample_params(3),
+            sample_params(9),
+            sample_params(5),
+        ],
+    )

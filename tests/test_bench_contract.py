@@ -166,6 +166,8 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
             return {"serving_local_e2e_p50_ms": 6.0}, None
         if name == "batchpredict":
             return {"batchpredict_offline_qps": 9000.0}, None  # CPU phase
+        if name == "evalgrid":
+            return {"evalgrid_cells_per_hour": 2000.0}, None  # CPU phase
         if name == "elastic":
             return {"fleet_trace_p95_ms": 45.0}, None  # CPU fleet: still runs
         if name in ("ann", "secondary"):
@@ -187,7 +189,8 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     # run: never a device phase itself, and never a per-phase re-probe
     names = [c[0] for c in calls]
     assert [n for n in names if n != "probe"] == [
-        "serving_local", "batchpredict", "ann", "secondary", "elastic",
+        "serving_local", "batchpredict", "ann", "evalgrid", "secondary",
+        "elastic",
     ]
     assert names.count("probe") == 2  # initial + the single late retry
     assert out["preflight_attempts"] == 2
@@ -214,6 +217,8 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
             return {"serving_local_e2e_p50_ms": 6.0}, None
         if name == "batchpredict":
             return {"batchpredict_offline_qps": 9000.0}, None  # CPU phase
+        if name == "evalgrid":
+            return {"evalgrid_cells_per_hour": 2000.0}, None  # CPU phase
         if name == "elastic":
             return {"fleet_trace_p95_ms": 45.0}, None  # CPU fleet: still runs
         if name in ("ann", "secondary"):
@@ -233,7 +238,8 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0  # a requested CPU-only run that shipped numbers is healthy
     assert calls == [
-        "serving_local", "batchpredict", "ann", "secondary", "elastic",
+        "serving_local", "batchpredict", "ann", "evalgrid", "secondary",
+        "elastic",
     ]
     assert out["preflight_attempts"] == 0
     assert out["bench_cpu_only"] is True
@@ -279,6 +285,7 @@ def test_failed_serving_retry_keeps_random_label(monkeypatch, capsys):
             "batchpredict": ({"batchpredict_offline_qps": 9000.0}, None),
             "twotower": ({}, None),
             "ann": ({}, None),
+            "evalgrid": ({}, None),
             "secondary": ({}, None),
             "elastic": ({}, None),
         }
@@ -392,6 +399,7 @@ def test_dead_then_alive_device_recovers_the_capture(monkeypatch, capsys):
             "batchpredict": ({"batchpredict_offline_qps": 9000.0}, None),
             "twotower": ({"twotower_recall_at_10": 0.45, "twotower_recall_gate_ok": True}, None),
             "ann": ({"serving_ann_recall_at_10": 0.99}, None),
+            "evalgrid": ({"evalgrid_cells_per_hour": 2000.0}, None),
             "secondary": ({"naive_bayes_train_ms": 50.0}, None),
             "elastic": ({"fleet_trace_p95_ms": 45.0}, None),
         }
@@ -573,6 +581,27 @@ class TestCompareBench:
             verdict["compare_regressions"][0]["field"]
             == "batchpredict_phase_dispatch_p50_ms"
         )
+
+    def test_evalgrid_fields_are_gated(self):
+        # ISSUE 15: search throughput, the measured advantage over the
+        # sequential MetricEvaluator, and the searched optimum's quality
+        # are all higher-is-better gates
+        for field in (
+            "evalgrid_cells_per_hour",
+            "evalgrid_speedup_x",
+            "evalgrid_winner_score",
+        ):
+            base = {**BASE, field: 10.0}
+            cur = {**base, field: 5.0}
+            verdict = bench.compare_bench(cur, [base])
+            assert verdict["compare_ok"] is False, field
+            assert verdict["compare_regressions"][0]["field"] == field
+        # improvements never trip
+        verdict = bench.compare_bench(
+            {**BASE, "evalgrid_speedup_x": 20.0},
+            [{**BASE, "evalgrid_speedup_x": 10.0}],
+        )
+        assert verdict["compare_ok"] is True
 
     def test_batchpredict_users_per_s_is_gated(self):
         base = {**BASE, "batchpredict_offline_users_per_s": 10_000.0}

@@ -89,6 +89,34 @@ class TestKFold:
         with pytest.raises(ValueError):
             k_fold_split([1], 0)
 
+    def test_k_beyond_data_rejected(self):
+        """k > len(data) silently yielded empty test folds that score as
+        degenerate 0/NaN cells in a grid search — now a hard error (the
+        grid clamps first via tuning.grid.clamp_folds)."""
+        with pytest.raises(ValueError, match="empty test folds"):
+            k_fold_split([1, 2, 3], 4)
+        # k == len(data) (leave-one-out) stays legal: every test fold
+        # has exactly one element
+        folds = k_fold_split([1, 2, 3], 3)
+        assert [test for _, test in folds] == [[1], [2], [3]]
+
+    def test_clamp_folds_warns_and_clamps(self, caplog):
+        import logging
+
+        from predictionio_tpu.tuning.grid import clamp_folds
+
+        with caplog.at_level(logging.WARNING):
+            assert clamp_folds(10, 4) == 4
+        assert any("clamping" in r.message for r in caplog.records)
+        caplog.clear()
+        with caplog.at_level(logging.WARNING):
+            assert clamp_folds(3, 10) == 3  # no-op, no warning
+        assert not caplog.records
+        with pytest.raises(ValueError):
+            clamp_folds(0, 5)
+        with pytest.raises(ValueError):
+            clamp_folds(2, 0)
+
 
 class TestNumericNB:
     def test_separates_classes(self):

@@ -12,6 +12,7 @@ from predictionio_tpu.eval import (
     FastEvalEngine,
     MetricEvaluator,
     OptionAverageMetric,
+    OptionStdevMetric,
     StdevMetric,
     SumMetric,
     ZeroMetric,
@@ -99,6 +100,83 @@ class TestMetrics:
         assert ZeroMetric().calculate(data) == 0.0
 
 
+class TestMetricContracts:
+    """Satellite coverage (ISSUE 15): comparator direction on every
+    shipped metric, None-score filtering, and NaN semantics."""
+
+    def _data(self, values):
+        return [(None, [(0, v, 0) for v in values])]
+
+    def test_compare_direction_all_shipped_metrics(self):
+        """Default ordering is bigger-is-better on every shipped metric
+        (ref Metric.scala:56-66) — including the ranking metrics the
+        grid search optimizes. A metric wanting smaller-is-better must
+        override compare; none of the shipped ones do."""
+        from predictionio_tpu.eval.metric import (
+            AverageMetric,
+            Metric,
+            OptionAverageMetric,
+            OptionStdevMetric,
+            StdevMetric,
+            SumMetric,
+            ZeroMetric,
+        )
+        from predictionio_tpu.tuning.metrics import (
+            NDCGAtK,
+            PrecisionAtK,
+            RecallAtK,
+        )
+
+        shipped = [
+            Metric(),
+            AverageMetric(),
+            OptionAverageMetric(),
+            StdevMetric(),
+            OptionStdevMetric(),
+            SumMetric(),
+            ZeroMetric(),
+            PrecisionAtK(5),
+            RecallAtK(5),
+            NDCGAtK(5),
+        ]
+        for m in shipped:
+            name = type(m).__name__
+            assert m.compare(2.0, 1.0) > 0, name
+            assert m.compare(1.0, 2.0) < 0, name
+            assert m.compare(1.5, 1.5) == 0, name
+
+    def test_option_metrics_filter_none(self):
+        class Avg(OptionAverageMetric):
+            def calculate_score(self, ei, q, p, a):
+                return p
+
+        class Std(OptionStdevMetric):
+            def calculate_score(self, ei, q, p, a):
+                return p
+
+        data = self._data([1.0, None, 3.0, None])
+        assert Avg().calculate(data) == 2.0
+        assert Std().calculate(data) == 1.0
+        # all-None pools to NaN (not a crash, not 0.0)
+        all_none = self._data([None, None])
+        assert Avg().calculate(all_none) != Avg().calculate(all_none)
+        assert Std().calculate(all_none) != Std().calculate(all_none)
+
+    def test_empty_set_semantics(self):
+        class Avg(AverageMetric):
+            def calculate_score(self, ei, q, p, a):
+                return p
+
+        class Sum(SumMetric):
+            def calculate_score(self, ei, q, p, a):
+                return p
+
+        empty = [(None, [])]
+        assert Avg().calculate(empty) != Avg().calculate(empty)  # NaN
+        assert Sum().calculate(empty) == 0.0  # sum of nothing is zero
+        assert ZeroMetric().calculate(empty) == 0.0
+
+
 class TestMetricEvaluator:
     def test_tracks_best(self, tmp_path):
         evaluator = MetricEvaluator(
@@ -124,6 +202,25 @@ class TestMetricEvaluator:
     def test_empty_params_list_rejected(self):
         with pytest.raises(ValueError):
             MetricEvaluator(QidMetric()).evaluate_base(CTX, make_engine(), [])
+
+    def test_tie_break_first_seen_wins_stable(self):
+        """Equal best scores keep the FIRST-seen params set (compare must
+        be strictly positive to displace) — and the pick is stable across
+        repeated runs, so a grid resume or re-run can never flip the
+        winner between tied candidates."""
+
+        class TiedMetric(AverageMetric):
+            def calculate_score(self, ei, q, p, a) -> float:
+                return 7.0 if p.algo_id in (9, 5) else float(p.algo_id)
+
+        grid = [params(3), params(9), params(5)]
+        picks = [
+            MetricEvaluator(TiedMetric())
+            .evaluate_base(CTX, make_engine(), grid)
+            .best_index
+            for _ in range(3)
+        ]
+        assert picks == [1, 1, 1]  # params(9) seen first among the tie
 
     def test_nan_score_never_wins(self):
         """A NaN score in slot 0 must be displaced by any finite score:
@@ -225,3 +322,26 @@ class TestFastEval:
         plain_result = QidMetric().calculate(plain.eval(CTX, ep))
         fast_result = QidMetric().calculate(fast.eval(CTX, ep))
         assert plain_result == fast_result
+
+    def test_cache_stats_and_models_only_clear(self):
+        """The hit/miss counters the grid workers assert on, and the
+        ``keep_data`` clear the scheduler uses between params groups:
+        models drop (memory bound), data caches survive (prefix
+        sharing)."""
+        engine = make_engine(FastEvalEngine)
+        engine.eval(CTX, params(1))
+        engine.eval(CTX, params(1))  # full prefix reuse
+        stats = engine.cache_stats
+        assert stats["read_misses"] == 1 and stats["read_hits"] >= 1
+        assert stats["prepare_misses"] == 1 and stats["prepare_hits"] >= 1
+        assert stats["train_misses"] == 2  # 2 folds, once each
+        assert stats["train_hits"] == 2  # second eval reused both
+        engine.clear_caches(keep_data=True)
+        assert stats["model_clears"] == 1
+        assert not engine._model_cache
+        assert engine._eval_data_cache and engine._prepared_cache
+        engine.eval(CTX, params(1))
+        assert stats["read_misses"] == 1  # data cache survived the clear
+        assert stats["train_misses"] == 4  # models had to retrain
+        engine.clear_caches()
+        assert not engine._eval_data_cache and not engine._prepared_cache

@@ -721,6 +721,89 @@ class TestServingRoundtripRule:
         )
         assert rule_ids(active) == ["serving-host-roundtrip"] * 2
 
+    def test_tuning_scoring_path_covered(self):
+        # ISSUE 15: the evaluation grid's cell scoring rides the same
+        # fused mega-batch contract — globs extended to tuning/*.py.
+        # (tuning is ALSO in train_globs, so the bare one-arg asarray
+        # additionally fires train-unaccounted-sync — both rails hold.)
+        active, _ = lint_snippet(
+            """
+            import numpy as np
+
+            def dispatch_scores(engine, algos, serving, models, queries):
+                scores = np.asarray(models[0].device_scores)
+                return np.argsort(-scores)
+            """,
+            display_path="pkg/tuning/cells.py",
+        )
+        ids = rule_ids(active)
+        assert ids.count("serving-host-roundtrip") == 2
+        assert "train-unaccounted-sync" in ids
+
+
+class TestEvalPerQueryPredictRule:
+    """ISSUE 15 acceptance: no per-query predict loop on the grid's
+    scoring path — held statically."""
+
+    def test_predict_loop_in_scoring_fires(self):
+        active, _ = lint_snippet(
+            """
+            def dispatch_scores(engine, algos, serving, models, queries):
+                return [algos[0].predict(models[0], q) for q in queries]
+            """,
+            display_path="pkg/tuning/cells.py",
+        )
+        assert rule_ids(active) == ["eval-per-query-predict"]
+        assert active[0].severity == Severity.ERROR
+
+    def test_nested_helper_covered(self):
+        active, _ = lint_snippet(
+            """
+            def score_cell(self, key):
+                def slow_path():
+                    return [self.algo.predict(self.model, q) for q in self.qs]
+
+                return slow_path()
+            """,
+            display_path="pkg/tuning/cells.py",
+        )
+        assert rule_ids(active) == ["eval-per-query-predict"]
+
+    def test_batched_entries_quiet(self):
+        active, _ = lint_snippet(
+            """
+            def dispatch_scores(engine, algos, serving, models, queries):
+                fin = engine.dispatch_batch(algos, serving, models, queries)
+                extra = algos[0].predict_batch(models[0], queries)
+                more = algos[0].batch_predict(models[0], list(enumerate(queries)))
+                return fin() + extra + more
+            """,
+            display_path="pkg/tuning/cells.py",
+        )
+        assert active == []
+
+    def test_outside_scoring_functions_quiet(self):
+        # the rule scopes to the scoring path, not the whole module: a
+        # diagnostic helper may predict one query
+        active, _ = lint_snippet(
+            """
+            def debug_one(algo, model, q):
+                return algo.predict(model, q)
+            """,
+            display_path="pkg/tuning/cells.py",
+        )
+        assert active == []
+
+    def test_outside_tuning_quiet(self):
+        active, _ = lint_snippet(
+            """
+            def dispatch_scores(engine, algos, serving, models, queries):
+                return [algos[0].predict(models[0], q) for q in queries]
+            """,
+            display_path="pkg/eval/evaluator.py",
+        )
+        assert active == []
+
 
 # ---------------------------------------------------------------------------
 # engine mechanics: suppression, severity, parse errors

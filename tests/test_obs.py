@@ -1281,6 +1281,11 @@ class TestMetricsContract:
         )
 
         registered.update(BatchPredictInstruments().registry._metrics)
+        # the evaluation-grid family rides the grid run's own registry
+        # (docs/evaluation.md)
+        from predictionio_tpu.tuning import EvalGridInstruments
+
+        registered.update(EvalGridInstruments().registry._metrics)
         # the fleet family lives on the gateway/supervisor registry (the
         # `pio deploy --fleet` parent), not on any worker's — including
         # the flight-recorder instruments (telemetry ring + incidents)

@@ -1,0 +1,1012 @@
+"""The evaluation grid (ISSUE 15, docs/evaluation.md): grid construction +
+content-addressed cells, the event-store sticky-hash splitter, the durable
+trial ledger, prefix-cached cell scoring through Engine.dispatch_batch,
+the parallel scheduler, winner publication with registry evidence, the
+`pio top --eval` line — and the e2e rail: ingest → `pio eval` over a real
+2 params × 2 folds grid → SIGKILL mid-grid → `--resume` retrains zero
+finished cells → winner staged as a candidate carrying grid evidence →
+bake gate auto-promotes it."""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from predictionio_tpu.controller import EmptyParams, Engine, EngineParams
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.eval import Evaluation, MetricEvaluator
+from predictionio_tpu.tuning import (
+    EvalGridInstruments,
+    EventStoreSplitter,
+    GridSpec,
+    TrialLedger,
+    build_cells,
+    cell_id_of,
+    run_grid,
+)
+from predictionio_tpu.tuning.cells import CellScorer, dispatch_scores
+from predictionio_tpu.tuning.grid import CellKey
+from predictionio_tpu.tuning.runner import aggregate_params, pick_best
+from tests.sample_engine import (
+    Algo0,
+    AlgoParams,
+    DataSource0,
+    DSParams,
+    Preparator0,
+    Serving0,
+)
+from tests.sample_evaluation import AlgoIdMetric, make_evaluation, sample_params
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PIO = os.path.join(REPO, "pio")
+
+
+def make_eval(params_sets=(3, 9, 5)):
+    return Evaluation(
+        engine=Engine(
+            {"ds": DataSource0},
+            {"prep": Preparator0},
+            {"a": Algo0},
+            {"s": Serving0},
+        ),
+        metric=AlgoIdMetric(),
+        engine_params_generator=[sample_params(i) for i in params_sets],
+    )
+
+
+# ---------------------------------------------------------------------------
+# grid construction
+# ---------------------------------------------------------------------------
+
+
+class TestGridConstruction:
+    def test_cell_ids_content_addressed(self):
+        import dataclasses
+
+        ep_a, ep_b = sample_params(1), sample_params(2)
+        span = {"app": "x"}
+        a = cell_id_of(ep_a, 0, 2, span)
+        # identical inputs -> identical id (across processes/runs)
+        assert a == cell_id_of(ep_a, 0, 2, span)
+        # any identity input re-keys the cell
+        assert a != cell_id_of(ep_b, 0, 2, span)  # params
+        assert a != cell_id_of(ep_a, 1, 2, span)  # fold
+        assert a != cell_id_of(ep_a, 0, 3, span)  # fold layout
+        assert a != cell_id_of(ep_a, 0, 2, {"app": "y"})  # data span
+        # component NAMES are identity too: the flat params JSON carries
+        # only algorithm names, so two params sets differing in e.g. the
+        # serving component would otherwise collide and share ledger
+        # records (code-review r2)
+        for field in ("data_source", "preparator", "serving"):
+            renamed = dataclasses.replace(
+                ep_a, **{field: ("other", getattr(ep_a, field)[1])}
+            )
+            assert a != cell_id_of(renamed, 0, 2, span), field
+
+    def test_build_cells_params_major(self):
+        spec = GridSpec([sample_params(1), sample_params(2)])
+        cells = build_cells(spec, 3)
+        assert [(c.params_index, c.fold) for c in cells] == [
+            (0, 0), (0, 1), (0, 2), (1, 0), (1, 1), (1, 2),
+        ]
+        assert len({c.cell_id for c in cells}) == 6
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            GridSpec([])
+        with pytest.raises(ValueError):
+            GridSpec([sample_params(1)], folds=0)
+
+
+# ---------------------------------------------------------------------------
+# event-store splitter
+# ---------------------------------------------------------------------------
+
+
+def _seed_events(storage, n_users=10, n_items=6, app_name="splitapp"):
+    app_id = storage.get_meta_data_apps().insert(App(0, app_name))
+    events = []
+    for u in range(n_users):
+        for i in range(n_items):
+            if (u + i) % 2:
+                continue
+            events.append(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 4.0}),
+                )
+            )
+    storage.get_l_events().insert_batch(events, app_id)
+    return app_id
+
+
+class TestEventStoreSplitter:
+    def test_sticky_assignment_deterministic_and_complete(self, memory_storage):
+        app_id = _seed_events(memory_storage)
+        sp = EventStoreSplitter(memory_storage.get_l_events(), app_id, k=3)
+        # assignment is a pure function of (user, salt, k): two splitter
+        # instances (two processes, a resumed run) agree with no state
+        sp2 = EventStoreSplitter(memory_storage.get_l_events(), app_id, k=3)
+        for u in range(10):
+            assert sp.fold_of(f"u{u}") == sp2.fold_of(f"u{u}")
+            assert 0 <= sp.fold_of(f"u{u}") < 3
+        # every user lands in exactly one fold; held-out sets partition
+        all_users = {f"u{u}" for u in range(10)}
+        heldout_users: set[str] = set()
+        for fold in range(3):
+            qs, _ = sp.heldout_fold(fold)
+            users = {q["user"] for q in qs}
+            assert not users & heldout_users  # disjoint across folds
+            heldout_users |= users
+            pred = sp.keep_for_training(fold)
+            # training predicate is the exact complement of held-out
+            assert {u for u in all_users if not pred(u)} == users
+        assert heldout_users == all_users
+        assert sum(sp.fold_sizes()) == 10
+
+    def test_heldout_actuals_stream_off_find_after(self, memory_storage):
+        app_id = _seed_events(memory_storage, n_users=6, n_items=4)
+        levents = memory_storage.get_l_events()
+        sp = EventStoreSplitter(levents, app_id, k=2, num=7, page=3)
+        for fold in range(2):
+            for q, actual in sp.iter_heldout(fold):
+                u = int(q["user"][1:])
+                expected = {f"i{i}" for i in range(4) if (u + i) % 2 == 0}
+                assert actual == expected
+                assert q["num"] == 7
+
+    def test_event_name_filter_and_bounds(self, memory_storage):
+        app_id = _seed_events(memory_storage, n_users=4, n_items=3)
+        levents = memory_storage.get_l_events()
+        # a non-matching event filter holds out nothing
+        sp = EventStoreSplitter(
+            levents, app_id, k=2, event_names=("buy",)
+        )
+        assert sum(sp.fold_sizes()) == 0
+        with pytest.raises(ValueError):
+            EventStoreSplitter(levents, app_id, k=0)
+        sp = EventStoreSplitter(levents, app_id, k=2)
+        with pytest.raises(ValueError):
+            list(sp.iter_heldout(2))
+
+    def test_empty_store(self, memory_storage):
+        app_id = memory_storage.get_meta_data_apps().insert(App(0, "emptyapp"))
+        sp = EventStoreSplitter(memory_storage.get_l_events(), app_id, k=2)
+        assert sp.fold_sizes() == [0, 0]
+        assert sp.heldout_fold(0) == ([], [])
+
+
+# ---------------------------------------------------------------------------
+# trial ledger
+# ---------------------------------------------------------------------------
+
+
+class TestTrialLedger:
+    def test_append_load_roundtrip(self, tmp_path):
+        ledger = TrialLedger(str(tmp_path / "ledger.jsonl"))
+        with ledger:
+            ledger.append({"cellId": "a", "score": 1.0})
+            ledger.append({"cellId": "b", "score": 2.0})
+        loaded = ledger.load()
+        assert set(loaded) == {"a", "b"}
+        assert loaded["b"]["score"] == 2.0
+
+    def test_torn_tail_skipped(self, tmp_path):
+        path = tmp_path / "ledger.jsonl"
+        path.write_text(
+            json.dumps({"cellId": "a", "score": 1.0})
+            + "\n"
+            + '{"cellId": "b", "sco'  # SIGKILL mid-append
+        )
+        loaded = TrialLedger(str(path)).load()
+        assert set(loaded) == {"a"}
+
+    def test_missing_cell_id_rejected(self, tmp_path):
+        ledger = TrialLedger(str(tmp_path / "l.jsonl"))
+        with pytest.raises(ValueError):
+            ledger.append({"score": 1.0})
+
+    def test_sha_tracks_content(self, tmp_path):
+        ledger = TrialLedger(str(tmp_path / "l.jsonl"))
+        empty = ledger.sha256()
+        with ledger:
+            ledger.append({"cellId": "a"})
+        assert ledger.sha256() != empty
+        assert ledger.sha256() == TrialLedger(str(tmp_path / "l.jsonl")).sha256()
+
+
+# ---------------------------------------------------------------------------
+# cell scoring
+# ---------------------------------------------------------------------------
+
+
+class TestCellScorer:
+    def test_matches_sequential_metric_evaluator(self):
+        """The grid's mega-batch scoring path must agree exactly with the
+        sequential MetricEvaluator it replaces."""
+        from predictionio_tpu.workflow.context import WorkflowContext
+
+        ev = make_eval()
+        seq = MetricEvaluator(AlgoIdMetric()).evaluate_base(
+            WorkflowContext(mode="evaluation"),
+            make_eval().engine,
+            list(ev.params_list()),
+        )
+        scorer = CellScorer.from_evaluation(make_eval())
+        for pi in range(3):
+            for fold in range(2):
+                rec = scorer.score_cell(CellKey(f"c{pi}{fold}", pi, fold))
+                assert not rec.get("error"), rec
+                assert rec["score"] == seq.engine_params_scores[pi].score
+                assert rec["queries"] == 3
+                assert rec["trainProfile"]["wallClockS"] >= 0
+
+    def test_prefix_cache_hits_and_group_clear(self):
+        """Cells sharing a data_source/preparator prefix read+prepare once
+        per worker; the model cache is cleared between params groups to
+        bound memory (data caches survive)."""
+        scorer = CellScorer.from_evaluation(make_eval())
+        cells = build_cells(GridSpec(scorer.params_list), 2)
+        for c in cells:
+            rec = scorer.score_cell(c)
+            assert not rec.get("error"), rec
+        stats = scorer.engine.cache_stats
+        assert stats["read_misses"] == 1  # one ds params across the grid
+        assert stats["read_hits"] >= 5
+        assert stats["prepare_misses"] == 1  # one (ds, prep) pair
+        # every params group has distinct algo params -> model cache
+        # cleared on each group boundary (2 boundaries for 3 groups)
+        assert stats["model_clears"] == 2
+        # each (params, fold) trained exactly once: 3 params x 2 folds
+        assert stats["train_misses"] == 6
+
+    def test_adjacent_shared_algo_params_reuse_models(self):
+        """Two params sets differing only in non-algo params share trained
+        models (the FastEvalEngine prefix contract) when adjacent."""
+        a = sample_params(3)
+        b = EngineParams(  # same ds/prep/algo, different serving params
+            data_source=a.data_source,
+            preparator=a.preparator,
+            algorithms=a.algorithms,
+            serving=("s", EmptyParams()),
+        )
+        ev = make_eval()
+        ev.engine_params_generator = [a, b]
+        scorer = CellScorer.from_evaluation(ev)
+        for c in build_cells(GridSpec(scorer.params_list), 2):
+            scorer.score_cell(c)
+        stats = scorer.engine.cache_stats
+        assert stats["train_misses"] == 2  # folds, not params x folds
+        assert stats["train_hits"] == 2
+        assert stats["model_clears"] == 0
+
+    def test_failed_cell_is_a_record(self):
+        class BoomMetric(AlgoIdMetric):
+            def calculate(self, data):
+                raise RuntimeError("boom")
+
+        ev = make_eval()
+        ev.metric = BoomMetric()
+        scorer = CellScorer.from_evaluation(ev)
+        rec = scorer.score_cell(CellKey("x", 0, 0))
+        assert "boom" in rec["error"]
+        assert math.isnan(rec["score"])
+
+    def test_dispatch_scores_chunks_preserve_order(self):
+        """Mega-batch chunking at any batch size returns query-aligned
+        results (the two-slot overlap must not reorder)."""
+        ev = make_eval()
+        scorer = CellScorer.from_evaluation(ev, batch_size=2)
+        engine = scorer.engine
+        ep = scorer.params_list[0]
+        folds = engine._eval_folds(scorer.ctx, ep)
+        td, ei, qa = folds[0]
+        from predictionio_tpu.controller.base import Doer
+
+        algo = Algo0(AlgoParams(id=3))
+        model = algo.train(scorer.ctx, Preparator0(DSParams(id=2)).prepare(scorer.ctx, td))
+        serving = Serving0()
+        queries = [q for q, _ in qa]
+        for bs in (1, 2, 7):
+            served = dispatch_scores(
+                engine, [algo], serving, [model], queries, batch_size=bs
+            )
+            assert [p.qid for p in served] == [q.qid for q in queries]
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+
+class TestAggregation:
+    def _cells(self):
+        return [CellKey(f"c{p}{f}", p, f) for p in range(2) for f in range(2)]
+
+    def test_query_weighted_mean(self):
+        records = {
+            "c00": {"cellId": "c00", "paramsIndex": 0, "fold": 0, "score": 1.0, "queries": 30, "otherScores": []},
+            "c01": {"cellId": "c01", "paramsIndex": 0, "fold": 1, "score": 4.0, "queries": 10, "otherScores": []},
+            "c10": {"cellId": "c10", "paramsIndex": 1, "fold": 0, "score": 2.0, "queries": 1, "otherScores": []},
+            "c11": {"cellId": "c11", "paramsIndex": 1, "fold": 1, "score": 2.0, "queries": 1, "otherScores": []},
+        }
+        agg = aggregate_params(records, self._cells(), 2)
+        assert agg[0].score == pytest.approx((1.0 * 30 + 4.0 * 10) / 40)
+        assert agg[1].score == 2.0
+        assert agg[0].fold_scores == [1.0, 4.0]
+
+    def test_nan_cells_excluded_but_counted(self):
+        nan = float("nan")
+        records = {
+            "c00": {"cellId": "c00", "paramsIndex": 0, "fold": 0, "score": nan, "queries": 10, "otherScores": [], "error": "x"},
+            "c01": {"cellId": "c01", "paramsIndex": 0, "fold": 1, "score": 3.0, "queries": 10, "otherScores": []},
+            "c10": {"cellId": "c10", "paramsIndex": 1, "fold": 0, "score": nan, "queries": 10, "otherScores": [], "error": "x"},
+            "c11": {"cellId": "c11", "paramsIndex": 1, "fold": 1, "score": nan, "queries": 10, "otherScores": [], "error": "x"},
+        }
+        agg = aggregate_params(records, self._cells(), 2)
+        assert agg[0].score == 3.0 and agg[0].failed_cells == 1
+        assert math.isnan(agg[1].score) and agg[1].failed_cells == 2
+        # NaN params can never win; finite first-seen wins ties
+        assert pick_best(agg, AlgoIdMetric()) == 0
+
+    def test_tie_break_first_seen(self):
+        records = {
+            "c00": {"cellId": "c00", "paramsIndex": 0, "fold": 0, "score": 5.0, "queries": 1, "otherScores": []},
+            "c01": {"cellId": "c01", "paramsIndex": 0, "fold": 1, "score": 5.0, "queries": 1, "otherScores": []},
+            "c10": {"cellId": "c10", "paramsIndex": 1, "fold": 0, "score": 5.0, "queries": 1, "otherScores": []},
+            "c11": {"cellId": "c11", "paramsIndex": 1, "fold": 1, "score": 5.0, "queries": 1, "otherScores": []},
+        }
+        agg = aggregate_params(records, self._cells(), 2)
+        assert pick_best(agg, AlgoIdMetric()) == 0
+
+
+# ---------------------------------------------------------------------------
+# the runner
+# ---------------------------------------------------------------------------
+
+
+class TestGridRunner:
+    def test_full_run_and_resume_zero_retrains(self, tmp_path):
+        ev = make_eval()
+        r = run_grid(ev, workdir=str(tmp_path), workers=0)
+        assert r.best_score == 9.0 and r.best_params_index == 1
+        assert r.cells_total == 6 and r.cells_run == 6
+        assert r.folds == 2 and r.cells_per_hour > 0
+        assert len(r.scores) == 3
+        assert r.ledger_sha256
+        # resume over a complete ledger: zero cells retrained
+        trains = {"n": 0}
+
+        class CountingAlgo(Algo0):
+            def train(self, ctx, pd):
+                trains["n"] += 1
+                return super().train(ctx, pd)
+
+        ev2 = make_eval()
+        ev2.engine = Engine(
+            {"ds": DataSource0},
+            {"prep": Preparator0},
+            {"a": CountingAlgo},
+            {"s": Serving0},
+        )
+        r2 = run_grid(ev2, workdir=str(tmp_path), workers=0, resume=True)
+        assert r2.cells_run == 0 and r2.cells_skipped == 6
+        assert trains["n"] == 0
+        assert r2.best_score == 9.0
+        assert r2.ledger_sha256 == r.ledger_sha256
+
+    def test_partial_ledger_resumes_only_missing(self, tmp_path):
+        ev = make_eval()
+        r = run_grid(ev, workdir=str(tmp_path / "a"), workers=0)
+        # copy 4 of 6 ledger lines into a fresh workdir = a killed run
+        lines = open(r.ledger_path).read().strip().splitlines()
+        os.makedirs(tmp_path / "b")
+        with open(tmp_path / "b" / "ledger.jsonl", "w") as fh:
+            fh.write("\n".join(lines[:4]) + "\n")
+        r2 = run_grid(make_eval(), workdir=str(tmp_path / "b"), workers=0, resume=True)
+        assert r2.cells_skipped == 4 and r2.cells_run == 2
+        assert r2.best_score == r.best_score
+
+    def test_existing_ledger_without_resume_rejected(self, tmp_path):
+        run_grid(make_eval(), workdir=str(tmp_path), workers=0)
+        with pytest.raises(ValueError, match="resume"):
+            run_grid(make_eval(), workdir=str(tmp_path), workers=0)
+
+    def test_foreign_ledger_entries_ignored(self, tmp_path):
+        """Content addressing: a ledger from a DIFFERENT grid shares the
+        workdir without being trusted — its cells don't match."""
+        run_grid(make_eval(params_sets=(1, 2)), workdir=str(tmp_path), workers=0)
+        r = run_grid(make_eval(), workdir=str(tmp_path), workers=0, resume=True)
+        assert r.cells_skipped == 0 and r.cells_run == 6
+
+    def test_status_file_and_instruments(self, tmp_path):
+        inst = EvalGridInstruments()
+        status_path = str(tmp_path / "status.json")
+        r = run_grid(
+            make_eval(),
+            workdir=str(tmp_path),
+            workers=0,
+            status_path=status_path,
+            instruments=inst,
+        )
+        status = json.load(open(status_path))
+        assert status["state"] == "done"
+        assert status["cellsDone"] == 6 and status["cellsTotal"] == 6
+        assert status["bestScore"] == 9.0 and status["metric"] == "AlgoIdMetric"
+        assert inst.cells.value() == 6
+        assert inst.queries.value() == 18  # 3 queries x 6 cells
+        assert inst.active.value() == 0.0  # reset after the run
+        assert inst.best_score.value() == 9.0
+        assert r.evaluator_result is not None
+        assert r.evaluator_result.best_index == 1
+
+    def test_failed_cells_dont_kill_the_grid(self, tmp_path):
+        class FoldBombDS(DataSource0):
+            def read_eval(self, ctx):
+                for fold, (td, ei, qa) in enumerate(super().read_eval(ctx)):
+                    if fold == 1:
+                        yield td, ei, [("not", "a", "query")]  # breaks scoring
+                    else:
+                        yield td, ei, qa
+
+        ev = make_eval()
+        ev.engine = Engine(
+            {"ds": FoldBombDS},
+            {"prep": Preparator0},
+            {"a": Algo0},
+            {"s": Serving0},
+        )
+        r = run_grid(ev, workdir=str(tmp_path), workers=0)
+        assert r.cells_failed == 3  # fold 1 of each params set
+        assert r.best_score == 9.0  # fold 0 still decides
+
+    def test_live_instance_rejected_for_process_workers(self, tmp_path):
+        with pytest.raises(ValueError, match="dotted path"):
+            run_grid(make_eval(), workdir=str(tmp_path), workers=2)
+
+    def test_publish_requires_identity_and_registry(self, tmp_path):
+        with pytest.raises(ValueError, match="engine_manifest"):
+            run_grid(make_eval(), workdir=str(tmp_path), workers=0, publish=True)
+
+    def test_output_path_written(self, tmp_path):
+        """Reference parity (MetricEvaluator.scala outputPath): an
+        Evaluation carrying output_path gets its best-params JSON from
+        the grid path too — code-review r1 caught the old evaluator's
+        contract silently dropped."""
+        ev = make_eval()
+        ev.output_path = str(tmp_path / "out" / "best.json")
+        run_grid(ev, workdir=str(tmp_path / "grid"), workers=0)
+        best = json.load(open(ev.output_path))
+        assert best["score"] == 9.0
+        assert (
+            best["engineParams"]["algorithms_params"][0]["params"]["id"] == 9
+        )
+
+    def test_oversized_folds_fail_the_run_not_the_ledger(self, tmp_path):
+        """`--folds 5` against a 2-fold read_eval is a CONFIG error: the
+        run aborts at the first out-of-range cell instead of durably
+        ledgering never-retried failed cells and publishing anyway
+        (code-review r2). In-range cells finished before the abort stay
+        in the ledger for a corrected resume."""
+        from predictionio_tpu.tuning.cells import FoldRangeError
+
+        with pytest.raises(FoldRangeError, match="out of range"):
+            run_grid(make_eval(), workdir=str(tmp_path), workers=0, folds=5)
+        lines = open(tmp_path / "ledger.jsonl").read().strip().splitlines()
+        assert len(lines) == 2  # folds 0-1 of params 0 finished; fold 2 aborted
+        # a corrected fold count CHANGES the fold layout, so content
+        # addressing re-keys every cell: the bad run's lines are ignored
+        # (not trusted for a different membership), the grid runs clean
+        r = run_grid(
+            make_eval(), workdir=str(tmp_path), workers=0, folds=2, resume=True
+        )
+        assert r.cells_skipped == 0 and r.cells_run == 6
+        assert r.best_score == 9.0
+
+    def test_failed_validation_leaves_no_evaluation_row(
+        self, tmp_path, memory_storage
+    ):
+        """A flag typo (ledger-exists-without-resume) must not pollute the
+        metadata store with a forever-EVALUATING row (code-review r2)."""
+        from predictionio_tpu.workflow.core_workflow import run_grid_evaluation
+
+        run_grid(make_eval(), workdir=str(tmp_path), workers=0)
+        with pytest.raises(ValueError, match="resume"):
+            run_grid_evaluation(
+                make_eval(),
+                storage=memory_storage,
+                workdir=str(tmp_path),
+                workers=0,
+            )
+        # no row at all — not even an INIT/EVALUATING zombie
+        instances = memory_storage.get_meta_data_evaluation_instances()
+        assert instances.get_all() == []
+
+    def test_fakerun_style_evaluation_rejected_cleanly(self, tmp_path):
+        """An Evaluation-shaped object without engine/metric (FakeRun)
+        must get the clean ValueError the CLI routes on, never an
+        AttributeError (cmd_eval keeps FakeRun on the sequential path)."""
+        from predictionio_tpu.workflow.fake_workflow import FakeRun
+
+        with pytest.raises(ValueError, match="engine and metric"):
+            run_grid(FakeRun(lambda ctx: 42), workdir=str(tmp_path), workers=0)
+
+
+@pytest.mark.slow
+class TestProcessPool:
+    def test_pool_workers_match_sequential(self, tmp_path):
+        r = run_grid(
+            "tests.sample_evaluation.make_evaluation",
+            workdir=str(tmp_path),
+            workers=2,
+            cwd=REPO,
+        )
+        assert r.best_score == 9.0 and r.cells_run == 6
+        # a second pool run resumes everything
+        r2 = run_grid(
+            "tests.sample_evaluation.make_evaluation",
+            workdir=str(tmp_path),
+            workers=2,
+            cwd=REPO,
+            resume=True,
+        )
+        assert r2.cells_run == 0 and r2.cells_skipped == 6
+
+
+# ---------------------------------------------------------------------------
+# winner publication
+# ---------------------------------------------------------------------------
+
+
+class TestWinnerPublication:
+    def _manifest(self):
+        from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+        return EngineManifest(
+            engine_id="gridtest",
+            version="1",
+            variant="engine.json",
+            engine_factory="tests.sample_evaluation.make_evaluation",
+            description="",
+            variant_json={},
+            engine_dir=".",
+        )
+
+    def test_winner_published_staged_with_evidence(self, tmp_path, memory_storage):
+        from predictionio_tpu.registry import ArtifactStore
+        from predictionio_tpu.workflow.core_workflow import run_train
+
+        registry_dir = str(tmp_path / "registry")
+        # a prior stable to canary against
+        run_train(
+            make_eval().engine,
+            self._manifest(),
+            sample_params(3),
+            storage=memory_storage,
+            registry_dir=registry_dir,
+        )
+        r = run_grid(
+            make_eval(),
+            workdir=str(tmp_path / "grid"),
+            workers=0,
+            publish=True,
+            registry_dir=registry_dir,
+            engine_manifest=self._manifest(),
+            storage=memory_storage,
+            stage_fraction=0.5,
+        )
+        assert r.published_version == "v000002"
+        store = ArtifactStore(registry_dir)
+        state = store.get_state("gridtest")
+        assert state.stable == "v000001"
+        assert state.candidate == "v000002"  # bake gates decide from here
+        assert state.mode == "canary" and state.fraction == 0.5
+        m = store.get_manifest("gridtest", "v000002")
+        ev = m.eval_evidence
+        assert ev["metric"] == "AlgoIdMetric"
+        assert ev["folds"] == 2 and ev["cellsTotal"] == 6
+        assert ev["bestParamsIndex"] == 1 and ev["bestScore"] == 9.0
+        assert len(ev["scoresTable"]) == 3 and len(ev["cells"]) == 6
+        assert ev["ledgerSha256"] == r.ledger_sha256
+        # the winner's blob is the REFIT on full data, with lineage
+        assert m.parent_version == "v000001"
+        assert m.train_profile  # run_train attached training evidence
+        assert m.data_span.get("batch") == "evalgrid"
+
+    def test_first_version_becomes_stable_not_candidate(self, tmp_path, memory_storage):
+        from predictionio_tpu.registry import ArtifactStore
+
+        registry_dir = str(tmp_path / "registry")
+        r = run_grid(
+            make_eval(),
+            workdir=str(tmp_path / "grid"),
+            workers=0,
+            publish=True,
+            registry_dir=registry_dir,
+            engine_manifest=self._manifest(),
+            storage=memory_storage,
+        )
+        assert r.published_version == "v000001"
+        state = ArtifactStore(registry_dir).get_state("gridtest")
+        assert state.stable == "v000001" and state.candidate == ""
+
+    def test_nan_winner_refuses_publish(self, tmp_path, memory_storage):
+        class NanMetric(AlgoIdMetric):
+            def calculate(self, data):
+                return float("nan")
+
+        ev = make_eval()
+        ev.metric = NanMetric()
+        r = run_grid(
+            ev,
+            workdir=str(tmp_path / "grid"),
+            workers=0,
+            publish=True,
+            registry_dir=str(tmp_path / "registry"),
+            engine_manifest=self._manifest(),
+            storage=memory_storage,
+        )
+        assert r.published_version == ""
+        assert not os.path.isdir(str(tmp_path / "registry")) or not os.listdir(
+            str(tmp_path / "registry")
+        )
+
+
+# ---------------------------------------------------------------------------
+# run_grid_evaluation (metadata-store parity) + pio top --eval
+# ---------------------------------------------------------------------------
+
+
+class TestGridEvaluationWorkflow:
+    def test_persists_evaluation_instance(self, tmp_path, memory_storage):
+        from predictionio_tpu.workflow.core_workflow import run_grid_evaluation
+
+        iid, report = run_grid_evaluation(
+            make_eval(),
+            storage=memory_storage,
+            workdir=str(tmp_path),
+            workers=0,
+        )
+        inst = memory_storage.get_meta_data_evaluation_instances().get(iid)
+        assert inst.status == "EVALCOMPLETED"
+        assert "best: 9.0" in inst.evaluator_results
+        assert json.loads(inst.evaluator_results_json)["bestScore"] == 9.0
+        assert inst.evaluator_results_html.startswith("<h2>")
+        assert report.best_score == 9.0
+
+
+class TestTopEvalLine:
+    STATUS = {
+        "state": "running",
+        "pid": 4242,
+        "metric": "precision@5",
+        "cellsDone": 3,
+        "cellsTotal": 8,
+        "cellsSkipped": 2,
+        "cellsFailed": 1,
+        "running": 2,
+        "workers": 4,
+        "folds": 2,
+        "bestScore": 0.4321,
+        "bestParams": 1,
+        "etaS": 42.0,
+    }
+
+    def test_render(self):
+        from predictionio_tpu.tools.top import render_evalgrid
+
+        line = render_evalgrid(self.STATUS)
+        assert "3/8 cells" in line
+        assert "2 resumed" in line and "1 FAILED" in line
+        assert "2 running / 4 workers" in line
+        assert "best 0.4321 (params 1)" in line
+        assert "eta 42s" in line
+        assert "precision@5" in line
+
+    def test_render_no_best_yet(self):
+        from predictionio_tpu.tools.top import render_evalgrid
+
+        status = {**self.STATUS, "bestScore": None, "state": "done"}
+        line = render_evalgrid(status)
+        assert "best —" in line
+        assert "eta" not in line  # no ETA once not running
+
+    def test_loop_json_and_unreadable(self, tmp_path):
+        from predictionio_tpu.tools.top import run_evalgrid_top
+
+        path = str(tmp_path / "status.json")
+        out: list[str] = []
+        rc = run_evalgrid_top(path, iterations=1, json_mode=True, out=out.append)
+        assert rc == 0 and "error" in json.loads(out[0])
+        json.dump(self.STATUS, open(path, "w"))
+        out.clear()
+        run_evalgrid_top(path, iterations=1, json_mode=True, out=out.append)
+        snap = json.loads(out[0])
+        assert snap["cellsDone"] == 3 and snap["evalgrid"] == path
+        out.clear()
+        run_evalgrid_top(path, iterations=1, out=out.append)
+        assert "3/8 cells" in out[0]
+
+
+# ---------------------------------------------------------------------------
+# e2e: ingest -> pio eval -> SIGKILL -> resume -> candidate -> bake gate
+# ---------------------------------------------------------------------------
+
+E2E_APP = "evalgride2e"
+
+_EVAL_MODULE = '''
+"""Grid evaluation over the recommendation engine (e2e fixture)."""
+import os, time
+
+from predictionio_tpu.controller import Engine, EngineParams
+from predictionio_tpu.eval import Evaluation
+from predictionio_tpu.models.recommendation.engine import (
+    ALSAlgorithm, ALSAlgorithmParams, DataSource, DataSourceParams,
+    EvalParams, Preparator, Query, Serving,
+)
+from predictionio_tpu.tuning.metrics import PrecisionAtK
+
+
+class SlowALS(ALSAlgorithm):
+    """Real ALS, slowed + logged so the e2e can SIGKILL mid-grid and
+    count retrains."""
+
+    def train(self, ctx, pd):
+        log = os.environ.get("GRID_TRAIN_LOG")
+        if log:
+            with open(log, "a") as fh:
+                fh.write(f"{self.params.rank}\\n")
+        time.sleep(float(os.environ.get("GRID_TRAIN_SLEEP", "0")))
+        return super().train(ctx, pd)
+
+
+def make_params(rank):
+    return EngineParams(
+        data_source=("", DataSourceParams(
+            app_name="%s", eval_params=EvalParams(k_fold=2, query_num=5))),
+        preparator=("", None),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=rank, num_iterations=2, lambda_=0.1, seed=3))],
+        serving=("", None),
+    )
+
+
+def make_evaluation():
+    return Evaluation(
+        engine=Engine(DataSource, Preparator, {"als": SlowALS}, Serving,
+                      query_class=Query),
+        metric=PrecisionAtK(5),
+        engine_params_generator=[make_params(4), make_params(8)],
+    )
+''' % E2E_APP
+
+
+def _subproc_env(base_dir: str) -> dict:
+    env = dict(os.environ)
+    for k in [k for k in env if k.startswith("PIO_STORAGE_")]:
+        del env[k]
+    env.update({"PIO_FS_BASEDIR": base_dir, "JAX_PLATFORMS": "cpu"})
+    return env
+
+
+def _pio(env, cwd, *args, timeout=240):
+    return subprocess.run(
+        [PIO, *args], env=env, cwd=cwd, capture_output=True, timeout=timeout
+    )
+
+
+def _ledger_lines(path: str) -> int:
+    if not os.path.exists(path):
+        return 0
+    n = 0
+    with open(path) as fh:
+        for line in fh:
+            try:
+                json.loads(line)
+                n += 1
+            except ValueError:
+                pass
+    return n
+
+
+def test_e2e_grid_sigkill_resume_publish_bake(tmp_path):
+    """The acceptance rail (ISSUE 15): ingest -> `pio eval` over 2 params
+    x 2 folds -> SIGKILL mid-grid -> `--resume` completes retraining ZERO
+    finished cells -> winner published as a registry candidate carrying
+    the grid evidence -> the PR-4 bake gate auto-promotes it."""
+    base = str(tmp_path / "store")
+    env = _subproc_env(base)
+    project = tmp_path / "project"
+    project.mkdir()
+    (project / "grid_eval.py").write_text(_EVAL_MODULE)
+
+    # --- app + ingest (the quickstart rating shape) ---------------------
+    out = _pio(env, str(project), "app", "new", E2E_APP)
+    assert out.returncode == 0, out.stderr.decode()[-2000:]
+    events_file = tmp_path / "events.jsonl"
+    with open(events_file, "w") as fh:
+        for u in range(12):
+            for i in range(8):
+                if (u + i) % 3 == 2:
+                    continue
+                fh.write(
+                    json.dumps(
+                        {
+                            "event": "rate",
+                            "entityType": "user",
+                            "entityId": f"u{u}",
+                            "targetEntityType": "item",
+                            "targetEntityId": f"i{i}",
+                            "properties": {"rating": float(1 + (u * i) % 5)},
+                        }
+                    )
+                    + "\n"
+                )
+    out = _pio(env, str(project), "import", "--appname", E2E_APP,
+               "--input", str(events_file))
+    assert out.returncode == 0, out.stderr.decode()[-2000:]
+
+    # --- engine variant: registry identity + a v1 stable to bake against -
+    variant = json.load(
+        open(os.path.join(REPO, "predictionio_tpu", "models",
+                          "recommendation", "engine.json"))
+    )
+    variant["id"] = "evalgrid-e2e"
+    variant["datasource"]["params"]["appName"] = E2E_APP
+    variant["algorithms"][0]["params"].update(rank=4, numIterations=2)
+    (project / "engine.json").write_text(json.dumps(variant))
+    registry_dir = str(tmp_path / "registry")
+    engine_dir = os.path.join(REPO, "predictionio_tpu", "models", "recommendation")
+    out = _pio(env, str(project), "train", "--engine-dir", engine_dir,
+               "--variant", str(project / "engine.json"),
+               "--registry-dir", registry_dir)
+    assert out.returncode == 0, out.stderr.decode()[-3000:]
+
+    # --- run 1: SIGKILL mid-grid ----------------------------------------
+    workdir = str(tmp_path / "grid")
+    ledger_path = os.path.join(workdir, "ledger.jsonl")
+    status_path = str(tmp_path / "status.json")
+    env1 = {**env, "GRID_TRAIN_SLEEP": "1.0",
+            "GRID_TRAIN_LOG": str(tmp_path / "trains1.log")}
+    proc = subprocess.Popen(
+        [PIO, "eval", "grid_eval.make_evaluation", "--workdir", workdir,
+         "--workers", "0", "--status-file", status_path, "--no-publish"],
+        env=env1, cwd=str(project),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    deadline = time.monotonic() + 180
+    try:
+        while _ledger_lines(ledger_path) < 1:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "grid finished before the kill:\n"
+                    + proc.stdout.read().decode(errors="replace")[-3000:]
+                )
+            assert time.monotonic() < deadline, "no ledger line in 180s"
+            time.sleep(0.05)
+    finally:
+        if proc.poll() is None:
+            proc.kill()  # SIGKILL: no cleanup, no atexit — the hard case
+            proc.wait(timeout=30)
+    finished_at_kill = _ledger_lines(ledger_path)
+    assert 1 <= finished_at_kill < 4, finished_at_kill
+
+    # --- run 2: --resume completes, publishes, stages ---------------------
+    report_path = str(tmp_path / "report.json")
+    train_log2 = str(tmp_path / "trains2.log")
+    env2 = {**env, "GRID_TRAIN_SLEEP": "0", "GRID_TRAIN_LOG": train_log2}
+    out = _pio(
+        env2, str(project), "eval", "grid_eval.make_evaluation",
+        "--workdir", workdir, "--workers", "0", "--resume",
+        "--engine-dir", ".", "--variant", "engine.json",
+        "--registry-dir", registry_dir, "--stage-fraction", "1.0",
+        "--status-file", status_path, "--out", report_path,
+        timeout=300,
+    )
+    assert out.returncode == 0, (
+        out.stdout.decode()[-2000:] + out.stderr.decode()[-3000:]
+    )
+    report = json.load(open(report_path))
+    assert report["cells_total"] == 4 and report["folds"] == 2
+    assert report["cells_skipped"] == finished_at_kill
+    assert report["cells_run"] == 4 - finished_at_kill
+    assert report["cells_failed"] == 0
+    # ZERO finished cells retrained: run 2 trained exactly the remaining
+    # cells plus the winner's full-data refit
+    trains2 = len(open(train_log2).read().strip().splitlines())
+    assert trains2 == (4 - finished_at_kill) + 1
+
+    # --- registry: candidate with the full grid evidence ------------------
+    from predictionio_tpu.registry import ArtifactStore
+
+    store = ArtifactStore(registry_dir)
+    state = store.get_state("evalgrid-e2e")
+    assert state.stable == "v000001"
+    winner = report["published_version"]
+    assert winner == "v000002" == state.candidate
+    assert state.fraction == 1.0
+    manifest = store.get_manifest("evalgrid-e2e", winner)
+    ev = manifest.eval_evidence
+    assert ev["metric"] == "precision@5"
+    assert ev["folds"] == 2 and ev["cellsTotal"] == 4
+    assert len(ev["scoresTable"]) == 2 and len(ev["cells"]) == 4
+    assert ev["ledgerSha256"] == report["ledger_sha256"]
+    assert manifest.parent_version == "v000001"
+
+    # --- pio top --eval renders the finished run's status file ------------
+    out = _pio(env2, str(project), "top", "--eval", status_path, "--once")
+    assert out.returncode == 0
+    assert b"4/4 cells" in out.stdout and b"eval grid" in out.stdout
+
+    # --- bake gate: the staged winner auto-promotes under traffic ---------
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.models.recommendation import engine_factory
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        _query_server_from_registry,
+    )
+    from predictionio_tpu.workflow.engine_loader import load_manifest
+
+    # the zero-config sqlite store the subprocess runs wrote into
+    storage = Storage(env={"PIO_FS_BASEDIR": base})
+    manifest = load_manifest(str(project), str(project / "engine.json"))
+    assert manifest.engine_id == "evalgrid-e2e"
+    config = ServerConfig(
+        bake_window_s=0.05,
+        bake_min_requests=5,
+        bake_check_interval_s=0.02,
+        max_p95_ratio=1000.0,
+        request_timeout_s=10.0,
+        # the staged candidate predates the server: the fleet-sync loop
+        # adopts it on its first tick (the CLI-staged-rollout path)
+        registry_sync_interval_s=0.05,
+    )
+    server = _query_server_from_registry(
+        engine_factory(), manifest, store, "v000001", storage, config
+    )
+
+    async def body():
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            deadline = time.monotonic() + 15.0
+            while server._candidate is None:
+                assert time.monotonic() < deadline, (
+                    "sync loop never adopted the staged candidate"
+                )
+                await asyncio.sleep(0.02)
+            for i in range(8):
+                resp = await client.post(
+                    "/queries.json", json={"user": f"u{i % 12}", "num": 3}
+                )
+                assert resp.status == 200, await resp.text()
+            while server.model_version != winner:
+                assert time.monotonic() < deadline, "auto-promote never fired"
+                await asyncio.sleep(0.05)
+            while store.get_state("evalgrid-e2e").stable != winner:
+                assert time.monotonic() < deadline, "registry pin never moved"
+                await asyncio.sleep(0.05)
+        finally:
+            await client.close()
+
+    asyncio.run(body())
+    final = store.get_state("evalgrid-e2e")
+    assert final.stable == winner and final.candidate == ""
+    assert final.previous_stable == "v000001"
