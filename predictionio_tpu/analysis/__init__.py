@@ -7,10 +7,19 @@ built per request, mutable closure capture), host-sync stalls on the serving
 path, unlocked shared state in threaded modules, and storage backends that
 drift from the ``storage/base.py`` abstract contract.
 
+Since ISSUE 16 the engine is whole-program: a cross-file call graph
+(``callgraph.py``) plus reachability from declared entry points
+(``reachability.py``, ``LintConfig.entry_points``) scope the
+context-sensitive rules, and two new families guard the pod-scale work:
+``mesh-*`` (axis-name agreement, single-host materialization, per-shard
+top-k merging) and ``async-blocking-call`` (blocking I/O on fleet event
+loops, transitively through the call graph).
+
 Public surface:
 
 - :func:`analyze_paths` / :func:`analyze_source` — run the rule registry.
-- :class:`Finding`, :class:`Severity`, :class:`LintConfig`, :class:`Report`.
+- :class:`Finding`, :class:`Severity`, :class:`LintConfig`,
+  :class:`Report`, :class:`EntryPoint`.
 - ``predictionio_tpu.analysis.cli:main`` — the ``pio lint`` / ``lint``
   console entry point.
 
@@ -32,12 +41,15 @@ from predictionio_tpu.analysis.core import (
     analyze_paths,
     analyze_source,
 )
+from predictionio_tpu.analysis.reachability import EntryPoint
 
 # importing the rule modules registers their checkers
 from predictionio_tpu.analysis import (  # noqa: F401  (registration side effect)
+    rules_async,
     rules_concurrency,
     rules_fleet,
     rules_hostsync,
+    rules_mesh,
     rules_obs,
     rules_recompile,
     rules_storage,
@@ -47,6 +59,7 @@ from predictionio_tpu.analysis import (  # noqa: F401  (registration side effect
 )
 
 __all__ = [
+    "EntryPoint",
     "Finding",
     "LintConfig",
     "Report",

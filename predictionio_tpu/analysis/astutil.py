@@ -204,6 +204,25 @@ def is_mutable_factory_call(node: ast.AST) -> bool:
     return False
 
 
+def module_level_statements(tree: ast.Module) -> list[ast.stmt]:
+    """Statements that execute at import time: module body plus class
+    bodies, excluding every function body (functions are call-graph nodes
+    and get reachability-scoped treatment instead)."""
+    out: list[ast.stmt] = []
+
+    def collect(body: list[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                collect(stmt.body)
+                continue
+            out.append(stmt)
+
+    collect(tree.body)
+    return out
+
+
 def walk_skipping_nested_functions(body: list[ast.stmt]):
     """Yield every node in ``body`` without descending into nested
     function/class definitions (their scopes are analyzed separately)."""

@@ -1,13 +1,16 @@
-"""Host-sync rules for serving-path modules.
+"""Host-sync rules for the serving / predict / eval reachability scopes.
 
 ``block_until_ready``, ``jax.device_get`` and ``np.asarray`` on a device
 array all stall the caller until the device round-trip completes. In a
-training script that's a benchmark tool; in the asyncio serving hot path
-(`controller/serving.py`, `workflow/create_server.py`, `data/api/`) it
+training script that's a benchmark tool; on the asyncio serving hot path it
 parks the event loop behind TPU latency and the p99 collapses under load.
+
+Since ISSUE 16 these rules are reachability-targeted: they fire in ANY
+function the call graph can reach from a declared entry point of the
+matching category (``LintConfig.entry_points``) — a helper three calls
+below ``predict_batch_dispatch`` in a module no glob names is in scope.
 Legitimate syncs (startup warm-up, final response materialization) get an
-inline suppression with a reason, or live in a function named in
-``LintConfig.hostsync_allow_functions``.
+inline suppression with a reason.
 """
 
 from __future__ import annotations
@@ -23,14 +26,19 @@ from predictionio_tpu.analysis.core import (
     register_checker,
     register_rule,
 )
+from predictionio_tpu.analysis.reachability import (
+    CATEGORY_EVAL,
+    CATEGORY_PREDICT,
+    CATEGORY_SERVING,
+)
 
 register_rule(
     "hostsync-serving-path",
     "hostsync",
     Severity.ERROR,
     "blocking device->host sync (block_until_ready/device_get/np.asarray) "
-    "in a serving-path module; move it off the request path or suppress "
-    "with a reason",
+    "in a function reachable from a serving entry point; move it off the "
+    "request path or suppress with a reason",
 )
 
 _SYNC_METHODS = frozenset({"block_until_ready"})
@@ -118,35 +126,29 @@ def _roundtrip_label(call: ast.Call) -> str | None:
 @register_checker
 def check_serving_roundtrip(ctx: FileContext):
     """The engines' predict paths must route score+select through the
-    fused top-k helper: flag the full-fetch/host-sort endings inside the
-    predict-path functions (LintConfig.serving_predict_functions),
-    including their nested helpers (a dispatch's ``finalize``)."""
-    cfg = ctx.config
-    if not matches_any_glob(
-        ctx.path or ctx.display_path, cfg.serving_predict_globs
-    ):
-        return []
-    predict_names = set(cfg.serving_predict_functions)
+    fused top-k helper: flag the full-fetch/host-sort endings in every
+    function reachable from a predict entry point (the declared roots —
+    ``Engine.dispatch_batch``, the batchpredict drain, the ann search
+    path, the eval-grid cell scorers — plus everything they call,
+    including nested ``finalize`` helpers)."""
+    state = ctx.project()
     findings: list[Finding] = []
-    seen: set[int] = set()
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if node.name not in predict_names:
-            continue
-        for sub in ast.walk(node):  # includes nested functions by design
-            if not isinstance(sub, ast.Call) or id(sub) in seen:
+    for fn, origin in state.reach.iter_reachable_in_file(
+        ctx.graph_path, CATEGORY_PREDICT
+    ):
+        note = state.reach.reach_note(fn, origin)
+        for sub in astutil.walk_skipping_nested_functions(fn.node.body):
+            if not isinstance(sub, ast.Call):
                 continue
-            seen.add(id(sub))
             label = _roundtrip_label(sub)
             if label:
                 findings.append(
                     ctx.finding(
                         "serving-host-roundtrip",
                         sub,
-                        f"{label} in {node.name!r} round-trips host-side; "
+                        f"{label} in {fn.name!r} round-trips host-side; "
                         "route score+select through ops/topk "
-                        "(fused top-k / host_top_k)",
+                        f"(fused top-k / host_top_k){note}",
                     )
                 )
     return findings
@@ -167,36 +169,30 @@ register_rule(
 def check_eval_per_query_predict(ctx: FileContext):
     """The grid's whole reason to exist is deleting the sequential
     MetricEvaluator's per-query device round-trips; hold that property
-    statically: inside the cell-scoring functions (and their nested
-    helpers), any ``X.predict(...)`` attribute call is an error.
-    ``predict_batch``/``predict_batch_dispatch``/``batch_predict`` (the
-    batched entries dispatch_batch composes) are the sanctioned
-    spellings."""
-    cfg = ctx.config
-    if not matches_any_glob(ctx.path or ctx.display_path, cfg.tuning_globs):
-        return []
-    scoring_names = set(cfg.eval_scoring_functions)
+    statically: in any function reachable from a declared cell-scoring
+    entry (``dispatch_scores``/``score_cell``), a ``X.predict(...)``
+    attribute call is an error. ``predict_batch``/
+    ``predict_batch_dispatch``/``batch_predict`` (the batched entries
+    dispatch_batch composes) are the sanctioned spellings."""
+    state = ctx.project()
     findings: list[Finding] = []
-    seen: set[int] = set()
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if node.name not in scoring_names:
-            continue
-        for sub in ast.walk(node):  # nested helpers covered by design
-            if not isinstance(sub, ast.Call) or id(sub) in seen:
+    for fn, origin in state.reach.iter_reachable_in_file(
+        ctx.graph_path, CATEGORY_EVAL
+    ):
+        note = state.reach.reach_note(fn, origin)
+        for sub in astutil.walk_skipping_nested_functions(fn.node.body):
+            if not isinstance(sub, ast.Call):
                 continue
-            seen.add(id(sub))
             func = sub.func
             if isinstance(func, ast.Attribute) and func.attr == "predict":
                 findings.append(
                     ctx.finding(
                         "eval-per-query-predict",
                         sub,
-                        f".predict() inside {node.name!r} scores one query "
+                        f".predict() inside {fn.name!r} scores one query "
                         "per device round-trip; route the batch through "
                         "Engine.dispatch_batch (tuning/cells."
-                        "dispatch_scores)",
+                        f"dispatch_scores){note}",
                     )
                 )
     return findings
@@ -204,44 +200,42 @@ def check_eval_per_query_predict(ctx: FileContext):
 
 @register_checker
 def check_hostsync(ctx: FileContext):
-    cfg = ctx.config
-    # match on the absolute path when we have one: the display path is
-    # cwd-relative and would silently miss the globs when linting from
-    # inside the package tree
-    if not matches_any_glob(ctx.path or ctx.display_path, cfg.serving_globs):
-        return []
+    """Serving-path host syncs: module-level statements in the declared
+    serving entry modules, plus every function reachable from a serving
+    entry point — wherever it lives."""
+    state = ctx.project()
     findings: list[Finding] = []
-    allow = set(cfg.hostsync_allow_functions)
-
-    def visit(body: list[ast.stmt], fn_stack: tuple[str, ...]):
-        for stmt in body:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                visit(stmt.body, fn_stack + (stmt.name,))
-                continue
-            if isinstance(stmt, ast.ClassDef):
-                visit(stmt.body, fn_stack)
-                continue
-            if fn_stack and fn_stack[-1] in allow:
-                continue
-            for node in astutil.walk_skipping_nested_functions([stmt]):
-                if isinstance(node, ast.Call):
-                    label = _sync_call_label(node)
-                    if label:
-                        where = (
-                            f" in {fn_stack[-1]!r}" if fn_stack else " at module level"
+    serving_globs = state.reach.entry_module_globs(CATEGORY_SERVING)
+    if matches_any_glob(ctx.graph_path, serving_globs):
+        for node in astutil.walk_skipping_nested_functions(
+            astutil.module_level_statements(ctx.tree)
+        ):
+            if isinstance(node, ast.Call):
+                label = _sync_call_label(node)
+                if label:
+                    findings.append(
+                        ctx.finding(
+                            "hostsync-serving-path",
+                            node,
+                            f"{label} blocks on a device->host sync at "
+                            "module level on the serving path",
                         )
-                        findings.append(
-                            ctx.finding(
-                                "hostsync-serving-path",
-                                node,
-                                f"{label} blocks on a device->host sync"
-                                f"{where} on the serving path",
-                            )
-                        )
-                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    visit(node.body, fn_stack + (node.name,))
-                elif isinstance(node, ast.ClassDef):
-                    visit(node.body, fn_stack)
-
-    visit(ctx.tree.body, ())
+                    )
+    for fn, origin in state.reach.iter_reachable_in_file(
+        ctx.graph_path, CATEGORY_SERVING
+    ):
+        note = state.reach.reach_note(fn, origin)
+        for node in astutil.walk_skipping_nested_functions(fn.node.body):
+            if not isinstance(node, ast.Call):
+                continue
+            label = _sync_call_label(node)
+            if label:
+                findings.append(
+                    ctx.finding(
+                        "hostsync-serving-path",
+                        node,
+                        f"{label} blocks on a device->host sync in "
+                        f"{fn.name!r} on the serving path{note}",
+                    )
+                )
     return findings

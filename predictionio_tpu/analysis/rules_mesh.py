@@ -1,0 +1,415 @@
+"""Mesh/sharding rules: the bug classes that pass every CPU unit test and
+only detonate on a pod.
+
+Four rules, all conservative — they fire only on *literal* axis names and
+provably-sharded values, because a false positive here would train people
+to suppress the family before the pod-scale code even lands:
+
+- ``mesh-unknown-axis``: a literal axis name in a ``PartitionSpec``/``P``
+  does not exist on any mesh the project constructs. XLA raises this at
+  runtime on the pod; the linter raises it on the laptop.
+- ``mesh-collective-axis``: a literal axis name passed to a ``lax``
+  collective (``psum``/``pmean``/``all_gather``/…) that no mesh declares —
+  the collective would fail to find the mapped axis inside ``shard_map``.
+- ``mesh-host-materialize``: ``jax.device_get`` / one-arg ``np.asarray``
+  of a value produced by a sharded call inside ``parallel/`` or
+  ``ops/*_sharded.py``. On a multi-host mesh a single-host materialization
+  either crashes (non-addressable shards) or silently gathers the world to
+  host 0. The sanctioned fetch is ``multihost_utils.process_allgather`` +
+  ``obs.xray.device_fetch``.
+- ``mesh-topk-unmerged``: a per-shard ``lax.top_k`` in a sharded module
+  whose enclosing top-level function never routes results through the
+  ``ops/topk`` pack format (``pack_batch``/``host_top_k``/…): per-shard
+  winners that never merge are silently wrong answers, not errors.
+
+Axis names are *declared* by literal ``Mesh(devs, ("data",…))`` /
+``MeshSpec(…)`` constructions, ``MeshSpec.parse("data=8,model=2")`` /
+``make_mesh("…")`` spec strings, ``axis="data"``-style parameter defaults,
+and ``AXIS = "data"`` constants — collected over the whole project, so a
+kernel file using ``P("model")`` is fine as long as ANY module constructs a
+mesh with a ``model`` axis. When the project declares no axis names at all,
+the axis rules stay silent (nothing to check against).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    ProjectState,
+    Severity,
+    matches_any_glob,
+    register_checker,
+    register_rule,
+)
+
+register_rule(
+    "mesh-unknown-axis",
+    "mesh",
+    Severity.ERROR,
+    "PartitionSpec names a mesh axis no Mesh/MeshSpec in the project "
+    "declares; in_specs/out_specs axis names must exist on the "
+    "constructing mesh",
+)
+
+register_rule(
+    "mesh-collective-axis",
+    "mesh",
+    Severity.ERROR,
+    "lax collective (psum/pmean/all_gather/...) names a mesh axis no "
+    "Mesh/MeshSpec in the project declares; the axis name must match a "
+    "mapped mesh axis",
+)
+
+register_rule(
+    "mesh-host-materialize",
+    "mesh",
+    Severity.ERROR,
+    "jax.device_get / one-arg np.asarray of a sharded value in a sharded "
+    "module single-host-materializes a global array; fetch through "
+    "multihost_utils.process_allgather + obs.xray.device_fetch",
+)
+
+register_rule(
+    "mesh-topk-unmerged",
+    "mesh",
+    Severity.ERROR,
+    "per-shard lax.top_k whose results never merge through the ops/topk "
+    "pack format (pack_batch/host_top_k); per-shard winners are not "
+    "global winners",
+)
+
+
+_SPEC_NAMES = frozenset({"PartitionSpec", "P"})
+_MESH_NAMES = frozenset({"Mesh"})
+_MESHSPEC_NAMES = frozenset({"MeshSpec"})
+_SPEC_STRING_FNS = frozenset({"make_mesh", "parse"})
+# collective -> positional index of axis_name in its signature
+_COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "psum_scatter": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "pbroadcast": 1,
+    "axis_index": 0,
+}
+_AXIS_PARAM_NAMES = frozenset({"axis", "axis_name", "axis_names", "axes"})
+_TOPK_MERGE_FNS = frozenset(
+    {
+        "pack_batch",
+        "unpack_batch",
+        "fetch_topk",
+        "host_top_k",
+        "merge_topk",
+        "topk_merge",
+        "merge_shards",
+    }
+)
+
+
+def _str_constants(node: ast.AST) -> list[tuple[str, ast.AST]]:
+    return [
+        (n.value, n)
+        for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    ]
+
+
+def _spec_string_axes(value: str) -> list[str]:
+    """Axis names out of a "data=8,model=2" mesh-spec string."""
+    out = []
+    for part in value.split(","):
+        name = part.partition("=")[0].strip()
+        if name.isidentifier():
+            out.append(name)
+    return out
+
+
+def _collect_declared_axes(tree: ast.Module) -> set[str]:
+    """Literal axis names this file declares (see module docstring)."""
+    axes: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            last = astutil.last_component(node.func)
+            if last in _MESH_NAMES:
+                # Mesh(devices, ("data", "model")) or axis_names= kwarg
+                sources = list(node.args[1:2]) + [
+                    kw.value
+                    for kw in node.keywords
+                    if kw.arg in ("axis_names", "names")
+                ]
+                for src in sources:
+                    axes.update(v for v, _ in _str_constants(src))
+            elif last in _MESHSPEC_NAMES:
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    axes.update(v for v, _ in _str_constants(arg))
+            elif last in _SPEC_STRING_FNS:
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ):
+                        axes.update(_spec_string_axes(arg.value))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            pos = list(args.posonlyargs) + list(args.args)
+            for a, default in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+                if a.arg in _AXIS_PARAM_NAMES:
+                    axes.update(v for v, _ in _str_constants(default))
+            for a, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is not None and a.arg in _AXIS_PARAM_NAMES:
+                    axes.update(v for v, _ in _str_constants(default))
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and "axis" in tgt.id.lower()
+                    and isinstance(node.value, (ast.Constant, ast.Tuple, ast.List))
+                ):
+                    axes.update(v for v, _ in _str_constants(node.value))
+    return axes
+
+
+def _declared_axes(ctx: FileContext, state: ProjectState) -> set[str]:
+    """Project-wide union of declared axis names, cached per graph."""
+    if ctx.cache.get("_mesh_axes_graph") is state.graph:
+        return ctx.cache["_mesh_axes"]
+    axes: set[str] = set()
+    for _path, tree in state.graph.file_trees():
+        axes |= _collect_declared_axes(tree)
+    ctx.cache["_mesh_axes"] = axes
+    ctx.cache["_mesh_axes_graph"] = state.graph
+    return axes
+
+
+# a file can only fire the axis rules if one of these appears textually
+# (P( covers `from jax.sharding import PartitionSpec as P` call sites);
+# the substring gate skips the full-tree walk for files with none
+_AXIS_NEEDLES = ("PartitionSpec", "P(") + tuple(_COLLECTIVES)
+
+
+@register_checker
+def check_mesh_axis_names(ctx: FileContext):
+    """mesh-unknown-axis + mesh-collective-axis: literal axis names at use
+    sites must be declared by SOME mesh construction in the project."""
+    if not any(n in ctx.source for n in _AXIS_NEEDLES):
+        return []
+    state = ctx.project()
+    declared = _declared_axes(ctx, state)
+    if not declared:
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        last = astutil.last_component(node.func)
+        if last in _SPEC_NAMES:
+            for arg in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                for value, where in _str_constants(arg):
+                    if value not in declared:
+                        findings.append(
+                            ctx.finding(
+                                "mesh-unknown-axis",
+                                where,
+                                f"PartitionSpec axis {value!r} is not "
+                                "declared by any Mesh/MeshSpec in the "
+                                f"project (declared: "
+                                f"{sorted(declared)})",
+                            )
+                        )
+        elif last in _COLLECTIVES:
+            idx = _COLLECTIVES[last]
+            axis_args = [
+                kw.value for kw in node.keywords if kw.arg == "axis_name"
+            ]
+            if not axis_args and len(node.args) > idx:
+                axis_args = [node.args[idx]]
+            for arg in axis_args:
+                # literal names only: a variable axis arg is unknowable
+                if not isinstance(arg, (ast.Constant, ast.Tuple, ast.List)):
+                    continue
+                for value, where in _str_constants(arg):
+                    if value not in declared:
+                        findings.append(
+                            ctx.finding(
+                                "mesh-collective-axis",
+                                where,
+                                f"collective {last}() names axis "
+                                f"{value!r}, which no Mesh/MeshSpec in "
+                                "the project declares (declared: "
+                                f"{sorted(declared)})",
+                            )
+                        )
+    return findings
+
+
+_SHARDED_WRAPPERS = frozenset({"shard_map", "pjit"})
+_MATERIALIZE_ASARRAY = frozenset(
+    {("np", "asarray"), ("numpy", "asarray"), ("onp", "asarray")}
+)
+
+
+def _is_sharded_producer_call(
+    call: ast.Call, producer_names: frozenset[str]
+) -> bool:
+    """Does this call expression yield a sharded array?"""
+    func = call.func
+    # shard_map(f, ...)(args) / pjit(f, ...)(args)
+    if isinstance(func, ast.Call):
+        inner = astutil.last_component(func.func)
+        if inner in _SHARDED_WRAPPERS:
+            return True
+    last = astutil.last_component(func)
+    if last == "make_array_from_process_local_data":
+        return True
+    if last == "device_put" and len(call.args) >= 2:
+        return True  # device_put with an explicit sharding
+    if isinstance(func, ast.Name) and func.id in producer_names:
+        return True
+    return False
+
+
+def _project_producer_names(
+    ctx: FileContext, state: ProjectState
+) -> frozenset[str]:
+    """Top-level functions whose bodies apply shard_map/pjit — calling
+    them yields sharded arrays (e.g. ``_als_sharded_step``)."""
+    if ctx.cache.get("_mesh_producers_graph") is state.graph:
+        return ctx.cache["_mesh_producers"]
+    names: set[str] = set()
+    for fn in state.graph.functions.values():
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                inner = astutil.last_component(node.func)
+                if inner in _SHARDED_WRAPPERS:
+                    names.add(fn.name)
+                    break
+    out = frozenset(names)
+    ctx.cache["_mesh_producers"] = out
+    ctx.cache["_mesh_producers_graph"] = state.graph
+    return out
+
+
+def _materialize_label(call: ast.Call) -> str | None:
+    func = call.func
+    d = astutil.dotted(func)
+    if d:
+        parts = tuple(d.split("."))
+        if len(parts) >= 2:
+            if parts[-2:] == ("jax", "device_get"):
+                return d + "()"
+            if (
+                parts[-2:] in _MATERIALIZE_ASARRAY
+                and len(call.args) == 1
+                and not call.keywords
+            ):
+                return d + "()"
+    elif isinstance(func, ast.Name) and func.id == "device_get":
+        return "device_get()"
+    return None
+
+
+@register_checker
+def check_mesh_host_materialize(ctx: FileContext):
+    state = ctx.project()
+    if not matches_any_glob(ctx.graph_path, ctx.config.mesh_sharded_globs):
+        return []
+    producers = _project_producer_names(ctx, state)
+    findings: list[Finding] = []
+
+    def scan(body: list[ast.stmt]) -> None:
+        tainted: set[str] = set()
+        nodes = list(astutil.walk_skipping_nested_functions(body))
+        for node in nodes:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if _is_sharded_producer_call(node.value, producers):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            tainted.add(tgt.id)
+                        elif isinstance(tgt, (ast.Tuple, ast.List)):
+                            tainted.update(
+                                e.id
+                                for e in tgt.elts
+                                if isinstance(e, ast.Name)
+                            )
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            label = _materialize_label(node)
+            if label is None:
+                continue
+            arg = node.args[0] if node.args else None
+            hit = False
+            if isinstance(arg, ast.Name) and arg.id in tainted:
+                hit = True
+            elif isinstance(arg, ast.Call) and _is_sharded_producer_call(
+                arg, producers
+            ):
+                hit = True
+            if hit:
+                findings.append(
+                    ctx.finding(
+                        "mesh-host-materialize",
+                        node,
+                        f"{label} materializes a sharded array on one "
+                        "host; on a multi-host mesh this crashes or "
+                        "gathers the world to host 0 — fetch through "
+                        "multihost_utils.process_allgather + "
+                        "obs.xray.device_fetch, or keep it on device",
+                    )
+                )
+
+    scan(astutil.module_level_statements(ctx.tree))
+    for fn in state.graph.functions_in(ctx.graph_path):
+        scan(fn.node.body)
+    return findings
+
+
+@register_checker
+def check_mesh_topk_unmerged(ctx: FileContext):
+    """Per-shard top-k in sharded modules must meet the ops/topk pack
+    format somewhere in the same top-level function (the merge point)."""
+    if not matches_any_glob(ctx.graph_path, ctx.config.mesh_sharded_globs):
+        return []
+    findings: list[Finding] = []
+    for node in ctx.tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        topk_calls = []
+        merges = False
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            last = astutil.last_component(sub.func)
+            if last == "top_k":
+                topk_calls.append(sub)
+            elif last in _TOPK_MERGE_FNS:
+                merges = True
+        if topk_calls and not merges:
+            for call in topk_calls:
+                findings.append(
+                    ctx.finding(
+                        "mesh-topk-unmerged",
+                        call,
+                        "per-shard top_k result never merges through the "
+                        "ops/topk pack format (pack_batch/host_top_k): "
+                        "each shard's local winners are not the global "
+                        "top-k — gather and re-select, or return packed "
+                        "candidates",
+                    )
+                )
+    return findings

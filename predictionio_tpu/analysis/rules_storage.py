@@ -170,6 +170,8 @@ _PICKLE_ALLOWED_SUFFIXES = (
 
 @register_checker
 def check_raw_pickle(ctx: FileContext):
+    if "ickle" not in ctx.source:  # pickle / cPickle / _pickle
+        return []
     path = (ctx.path or ctx.display_path).replace("/", os.sep)
     if any(path.endswith(suffix) for suffix in _PICKLE_ALLOWED_SUFFIXES):
         return []

@@ -16,8 +16,12 @@ roofline math in docs/PERF.md silently rots. Sanctioned forms:
   (e.g. ``ops/als.fetch_barrier``) or host-side ``np.asarray`` the
   heuristic can't prove harmless.
 
-Heuristic scope: files matching ``LintConfig.train_globs``. ``np.asarray``
-is only flagged in its one-argument form — the two-argument
+Scope (since ISSUE 16): every function REACHABLE from a declared train
+entry point (``LintConfig.entry_points``, category ``train`` — the
+training-loop modules seed every def), plus module-level statements in
+those modules. A sync inside a helper another module provides to the train
+loop is in scope even though no glob names it. ``np.asarray`` is only
+flagged in its one-argument form — the two-argument
 ``np.asarray(x, np.float32)`` idiom is how this codebase converts *host*
 inputs (a dtype on a device fetch would be a copy anyway), while the bare
 one-argument form is exactly the device-readback idiom.
@@ -36,6 +40,7 @@ from predictionio_tpu.analysis.core import (
     register_checker,
     register_rule,
 )
+from predictionio_tpu.analysis.reachability import CATEGORY_TRAIN
 
 register_rule(
     "train-unaccounted-sync",
@@ -89,24 +94,47 @@ def _sync_label(call: ast.Call) -> str | None:
     return None
 
 
+_MESSAGE = (
+    "is an unaccounted device->host sync on the "
+    "training path; device time leaks out of the train "
+    "profile — use timed_block_until_ready / "
+    "obs.xray.device_fetch (or suppress with a reason)"
+)
+
+
 @register_checker
 def check_train_unaccounted_sync(ctx: FileContext):
-    if not matches_any_glob(ctx.path or ctx.display_path, ctx.config.train_globs):
-        return []
+    state = ctx.project()
     findings: list[Finding] = []
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.Call):
-            continue
-        label = _sync_label(node)
-        if label:
-            findings.append(
-                ctx.finding(
-                    "train-unaccounted-sync",
-                    node,
-                    f"{label} is an unaccounted device->host sync on the "
-                    "training path; device time leaks out of the train "
-                    "profile — use timed_block_until_ready / "
-                    "obs.xray.device_fetch (or suppress with a reason)",
+    train_globs = state.reach.entry_module_globs(CATEGORY_TRAIN)
+    if matches_any_glob(ctx.graph_path, train_globs):
+        for node in astutil.walk_skipping_nested_functions(
+            astutil.module_level_statements(ctx.tree)
+        ):
+            if isinstance(node, ast.Call):
+                label = _sync_label(node)
+                if label:
+                    findings.append(
+                        ctx.finding(
+                            "train-unaccounted-sync",
+                            node,
+                            f"{label} {_MESSAGE}",
+                        )
+                    )
+    for fn, origin in state.reach.iter_reachable_in_file(
+        ctx.graph_path, CATEGORY_TRAIN
+    ):
+        note = state.reach.reach_note(fn, origin)
+        for node in astutil.walk_skipping_nested_functions(fn.node.body):
+            if not isinstance(node, ast.Call):
+                continue
+            label = _sync_label(node)
+            if label:
+                findings.append(
+                    ctx.finding(
+                        "train-unaccounted-sync",
+                        node,
+                        f"{label} {_MESSAGE}{note}",
+                    )
                 )
-            )
     return findings

@@ -190,11 +190,15 @@ def _assign(vecs: np.ndarray, centroids, chunk: int) -> np.ndarray:
         d = (c * c).sum(axis=1)[None, :] - 2.0 * (x @ c.T)
         return jnp.argmin(d, axis=1).astype(jnp.int32)
 
+    from predictionio_tpu.obs import xray
+
     c_dev = jnp.asarray(centroids)
     out = np.empty(len(vecs), np.int32)
     for start in range(0, len(vecs), chunk):
         sl = vecs[start : start + chunk]
-        out[start : start + len(sl)] = np.asarray(nearest(jnp.asarray(sl), c_dev))
+        out[start : start + len(sl)] = xray.device_fetch(
+            nearest(jnp.asarray(sl), c_dev), "ann-assign"
+        )
     return out
 
 

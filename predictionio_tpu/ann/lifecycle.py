@@ -86,8 +86,10 @@ def item_vectors_of(model: Any) -> np.ndarray | None:
     """The model's item-vector table, or None for model types ANN does not
     apply to (popularity/cooccurrence/NB...)."""
     if hasattr(model, "item_embeddings"):  # two-tower
+        # pio-lint: disable=hostsync-serving-path -- one-time lane-load/refresh materialization feeding the host-side ANN build, not per-request
         return np.asarray(model.item_embeddings, np.float32)
     if hasattr(model, "item_factors"):  # SimilarModel / ALSModel
+        # pio-lint: disable=hostsync-serving-path -- one-time lane-load/refresh materialization feeding the host-side ANN build, not per-request
         return np.asarray(model.item_factors, np.float32)
     return None
 

@@ -80,8 +80,10 @@ from aiohttp import web
 
 from predictionio_tpu.fleet.federation import federate_metrics
 from predictionio_tpu.fleet.supervisor import REPLICA_CLASS_CPU
+from predictionio_tpu.obs.incidents import IncidentRecorder
 from predictionio_tpu.obs.metrics import MetricsRegistry
 from predictionio_tpu.obs.slo import DEFAULT_WINDOWS, SLOEngine
+from predictionio_tpu.obs.tsring import TelemetryRing
 from predictionio_tpu.obs.tracing import (
     TRACE_HEADER,
     Tracer,
@@ -202,8 +204,8 @@ class Gateway:
         config: GatewayConfig,
         metrics: MetricsRegistry | None = None,
         tracer: Tracer | None = None,
-        telemetry: Any | None = None,  # obs.tsring.TelemetryRing
-        incidents: Any | None = None,  # obs.incidents.IncidentRecorder
+        telemetry: TelemetryRing | None = None,
+        incidents: IncidentRecorder | None = None,
     ):
         if not config.replica_urls:
             raise ValueError("gateway needs at least one replica URL")
@@ -445,6 +447,7 @@ class Gateway:
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
+            # pio-lint: disable=async-blocking-call -- RuntimeError branch: no loop is running here, inline capture cannot stall one
             self.incidents.trigger(kind, context=context)
             return
         loop.run_in_executor(
@@ -1102,7 +1105,11 @@ class Gateway:
             if now_alerting and not was:
                 self._trigger_incident("slo-alert", {"slo": name, **state})
         if self.telemetry is not None:
-            self.telemetry.append(record)
+            # ring append is locked file I/O; the ring is thread-safe, so
+            # hand it off rather than stall every in-flight proxy
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.telemetry.append, record
+            )
             self._m_telemetry_snapshots.inc()
         # ONLY the telemetry tick consumes the inflight high-water mark
         # (reset to the current level so a sustained plateau stays
@@ -1136,7 +1143,11 @@ class Gateway:
             return web.json_response(
                 {"message": "s must be a number"}, status=400
             )
-        records = self.telemetry.window(seconds)
+        # window() replays on-disk segments (open + json decode); keep the
+        # history endpoint off the proxy loop
+        records = await asyncio.get_running_loop().run_in_executor(
+            None, self.telemetry.window, seconds
+        )
         return web.json_response(
             {"windowS": seconds, "records": records}
         )

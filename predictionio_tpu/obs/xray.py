@@ -479,6 +479,7 @@ def device_fetch(x: Any, where: str = "train") -> Any:
     if prof is None:
         import numpy as np
 
+        # pio-lint: disable=train-unaccounted-sync -- device_fetch IS the accounted fetch; unprofiled runs have no profile to account into
         return np.asarray(x)
     return prof.device_fetch(x, where)
 

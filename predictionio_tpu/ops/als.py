@@ -937,7 +937,7 @@ def upload(x, dtype=None):
     H2D transfer is a copy regardless."""
     if isinstance(x, jax.Array):
         return x
-    # pio-lint: disable=train-unaccounted-sync -- host staging array, never a device handle
+    # pio-lint: disable=train-unaccounted-sync,serving-host-roundtrip -- host staging array (device handles returned above), never a device round-trip
     arr = np.asarray(x) if dtype is None else np.asarray(x, dtype)
     return jnp.asarray(arr, copy=True)
 

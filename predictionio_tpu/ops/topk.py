@@ -196,7 +196,7 @@ def fetch_topk(handle) -> tuple[np.ndarray, np.ndarray]:
     Returns ([B,k] float32 scores, [B,k] int32 indices)."""
     from predictionio_tpu.ops.als import ServingIndex
 
-    # pio-lint: disable=train-unaccounted-sync -- serving-path k-only fetch, accounted by the request waterfall
+    # pio-lint: disable=serving-host-roundtrip -- the ONE sanctioned fetch: O(batch*k) packed result, accounted by the request waterfall
     packed = np.asarray(handle)
     if packed.ndim == 2:  # single-query [2,k]
         packed = packed[None]
@@ -235,7 +235,9 @@ def host_top_k(
     k = min(int(k), scores.shape[0])
     if k <= 0:
         return np.empty(0), np.empty(0, np.int64)
+    # pio-lint: disable=serving-host-roundtrip -- host-born scores (popularity/cooccurrence): this IS the sanctioned host ending, no device round-trip
     idx = np.argpartition(-scores, k - 1)[:k]
+    # pio-lint: disable=serving-host-roundtrip -- host-born scores: same sanctioned host ending
     idx = idx[np.argsort(-scores[idx])]
     finite = np.isfinite(scores[idx])
     idx = idx[finite]

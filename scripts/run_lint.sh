@@ -12,6 +12,25 @@ if [[ "${1:-}" == "--ci" ]]; then
   shift
   python -m predictionio_tpu.analysis.cli "$@"
 
+  # --- lint artifacts (ISSUE 16): machine-readable SARIF for code-scanning
+  #     upload, the git-scoped mode PR branches use (whole-program call
+  #     graph, only changed files reported), and the suppression inventory
+  #     (every pio-lint disable site with its reason; stale ones warn in
+  #     the main pass above).
+  python -m predictionio_tpu.analysis.cli --format sarif > /tmp/pio_lint.sarif
+  python - <<'PYEOF'
+import json
+d = json.load(open("/tmp/pio_lint.sarif"))
+assert d["version"] == "2.1.0", d["version"]
+assert d["runs"][0]["tool"]["driver"]["name"] == "pio-lint"
+print(f"sarif artifact: {len(d['runs'][0]['results'])} result(s), "
+      f"{len(d['runs'][0]['tool']['driver']['rules'])} rules declared")
+PYEOF
+  python -m predictionio_tpu.analysis.cli --changed
+  python -m predictionio_tpu.analysis.cli --report-suppressions \
+    > /tmp/pio_lint_suppressions.txt
+  echo "suppression inventory: $(tail -n 1 /tmp/pio_lint_suppressions.txt)"
+
   # --- perf-regression gate (docs/observability.md, ROADMAP item 5) -------
   # 1. the gate must PASS an unchanged run ...
   baseline="tests/fixtures/bench_baseline.json"
