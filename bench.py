@@ -1208,10 +1208,22 @@ def _bench_gateway_hop(
             lat.append(time.perf_counter() - t0)
         conn.close()
         gw_p50 = float(np.percentile(np.asarray(lat) * 1e3, 50))
+        # pooled-upstream attribution: the warmed requests above ran
+        # through the gateway's keep-alive TCPConnector — record that the
+        # pool was live (per-host cap + keepalive window configured) so a
+        # hop-p50 regression can be told apart from a pooling regression
+        session = getattr(box["gw"], "_session", None)
+        connector = getattr(session, "connector", None)
+        pooled = float(
+            connector is not None
+            and getattr(connector, "limit_per_host", 0) > 0
+            and getattr(connector, "keepalive_timeout", 0) > 0
+        )
         return {
             "serving_fleet_replicas": 1.0,
             "serving_gateway_p50_ms": gw_p50,
             "serving_gateway_hop_p50_ms": max(0.0, gw_p50 - direct_p50_ms),
+            "serving_gateway_pooled": pooled,
         }
     except Exception as exc:  # noqa: BLE001 - missing hop evidence, never fatal
         # no string fields in the stats dict: every non-bool value is

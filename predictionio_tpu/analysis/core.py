@@ -187,6 +187,7 @@ class LintConfig:
         "*/fleet/supervisor.py",
         "*/fleet/launch.py",
         "*/fleet/autoscaler.py",
+        "*/fleet/hostrt.py",
     )
     # modules holding sharded kernels: the mesh-* family guards axis-name
     # agreement and single-host materialization here

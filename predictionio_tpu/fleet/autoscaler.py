@@ -512,7 +512,20 @@ class Autoscaler:
     def _ring_records(self) -> list[dict[str, Any]]:
         if self.ring is None:
             return []
-        return self.ring.window(self.policy.config.lookback_s)
+        records = self.ring.window(self.policy.config.lookback_s)
+        # multi-gateway tier: every peer writes fleet snapshots into the
+        # shared ring (namespaced segments). The policy's windows assume
+        # one snapshot per tick, so scale off the PRIMARY gateway's view
+        # — conservative under a balancer that splits traffic evenly,
+        # and correct for membership because resizes fan out to peers.
+        gw_id = getattr(
+            getattr(self.gateway, "config", None), "gateway_id", None
+        )
+        if gw_id:
+            records = [
+                r for r in records if r.get("gateway") in (None, gw_id)
+            ]
+        return records
 
     def _record_decision(self, decision: Decision, shape: FleetShape) -> None:
         """Scaling decisions are telemetry: appended to the SAME ring the

@@ -159,6 +159,17 @@ class GatewayConfig:
     # shrink these so post-spike burn decays inside the run instead of
     # pinning the autoscaler's idle detector for five minutes
     slo_windows: tuple[tuple[float, float], ...] | None = None
+    # upstream connection pool: keep-alive connections per replica (the
+    # proxy hop must not pay a TCP handshake per query) and how long an
+    # idle pooled connection survives
+    upstream_pool_per_host: int = 32
+    upstream_keepalive_s: float = 30.0
+    # shared-nothing gateway tier (--gateways N): this gateway's stable
+    # id (telemetry-ring writer namespace, peer attribution) and its
+    # peers' base URLs for /traces/recent + /slo fan-in. Peers share the
+    # replica set behind any TCP balancer; they never share state.
+    gateway_id: str = "g0"
+    peer_urls: tuple[str, ...] = ()
 
 
 class Replica:
@@ -320,6 +331,10 @@ class Gateway:
         # cleared on fetch failure — a dead replica's final spans are
         # exactly the evidence an incident bundle needs.
         self._replica_spans: dict[str, list[dict[str, Any]]] = {}
+        # gateway-peer fan-in cache (--gateways N): peer base url ->
+        # spans it served on its LOCAL /traces/recent. Same
+        # keep-on-failure rule — a dead peer's last view is evidence.
+        self._peer_spans: dict[str, list[dict[str, Any]]] = {}
         self._session: aiohttp.ClientSession | None = None
         self._probe_task: asyncio.Task | None = None
         self._telemetry_task: asyncio.Task | None = None
@@ -360,10 +375,20 @@ class Gateway:
 
     def _http(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
+            # pooled keep-alive upstream connector: the proxy hop's
+            # budget is ~1 ms, a TCP handshake per forward would be most
+            # of it. Bounded per replica so one slow backend can't
+            # starve the pool fleet-wide; unbounded overall because the
+            # replica set itself is the bound.
             self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(
+                    limit=0,
+                    limit_per_host=self.config.upstream_pool_per_host,
+                    keepalive_timeout=self.config.upstream_keepalive_s,
+                ),
                 timeout=aiohttp.ClientTimeout(
                     total=self.config.request_timeout_s
-                )
+                ),
             )
         return self._session
 
@@ -997,17 +1022,71 @@ class Gateway:
         if isinstance(spans, list):
             self._replica_spans[replica.name] = spans
 
+    async def _fetch_peer_traces(self, peer_url: str) -> None:
+        """Refresh one gateway peer's span cache from its LOCAL view
+        (``?local=1`` stops the fan-in recursing peer->peer->peer).
+        Failures keep the stale cache: a lost peer's final spans are the
+        gateway-peer-loss evidence, not staleness."""
+        try:
+            # peer fan-in fetch, same health-plane exemption as _fetch_traces
+            # pio-lint: disable=fleet-unattributed-proxy -- gateway-peer trace fan-in
+            async with self._http().get(
+                f"{peer_url}/traces/recent"
+                f"?limit={TRACE_FANIN_LIMIT}&local=1",
+                timeout=aiohttp.ClientTimeout(total=self.config.probe_timeout_s),
+            ) as resp:
+                if resp.status != 200:
+                    return
+                data = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            return
+        spans = data.get("spans")
+        if isinstance(spans, list):
+            self._peer_spans[peer_url] = spans
+
+    def _peer_cached_spans(self) -> list[dict[str, Any]]:
+        out: list[dict[str, Any]] = []
+        for url, spans in list(self._peer_spans.items()):
+            out.extend(
+                {**s, "gatewayPeer": url} if "gatewayPeer" not in s else s
+                for s in spans
+            )
+        return out
+
     async def merged_recent(
-        self, limit: int = 100, trace_id: str | None = None
+        self,
+        limit: int = 100,
+        trace_id: str | None = None,
+        peers: bool = True,
     ) -> list[dict[str, Any]]:
         """The fan-in merged trace view: gateway ring + every replica's,
         refreshed live from healthy replicas (dead ones serve from the
-        telemetry tick's cache). With ``trace_id``, the assembled
-        cross-tier waterfall: that trace's spans only, oldest first."""
-        await asyncio.gather(
-            *(self._fetch_traces(r) for r in self.replicas if r.healthy)
-        )
+        telemetry tick's cache), plus — in a multi-gateway tier — every
+        peer gateway's local view, so one ``/traces/recent`` answers for
+        the whole tier no matter which gateway the balancer picked. With
+        ``trace_id``, the assembled cross-tier waterfall: that trace's
+        spans only, oldest first."""
+        fetches = [self._fetch_traces(r) for r in self.replicas if r.healthy]
+        if peers:
+            fetches += [
+                self._fetch_peer_traces(u) for u in self.config.peer_urls
+            ]
+        await asyncio.gather(*fetches)
         merged = self.cached_spans()
+        if peers and self.config.peer_urls:
+            # peers also fan in from the shared replica set; drop spans
+            # this gateway already holds (same trace id + name + start)
+            seen = {
+                (s.get("traceId"), s.get("name"), s.get("startTime"))
+                for s in merged
+            }
+            merged += [
+                s
+                for s in self._peer_cached_spans()
+                if (s.get("traceId"), s.get("name"), s.get("startTime"))
+                not in seen
+            ]
+            merged.sort(key=lambda s: s.get("startTime", 0.0), reverse=True)
         if trace_id is not None:
             waterfall = [s for s in merged if s.get("traceId") == trace_id]
             waterfall.sort(key=lambda s: s.get("startTime", 0.0))
@@ -1022,7 +1101,10 @@ class Gateway:
                 {"message": "limit must be an integer"}, status=400
             )
         trace_id = request.query.get("trace_id") or None
-        spans = await self.merged_recent(limit=limit, trace_id=trace_id)
+        local = request.query.get("local") not in (None, "", "0")
+        spans = await self.merged_recent(
+            limit=limit, trace_id=trace_id, peers=not local
+        )
         return web.json_response({"spans": spans})
 
     # ----------------------------------------------------- telemetry ring
@@ -1072,6 +1154,7 @@ class Gateway:
             }
         return {
             "kind": "fleet",
+            "gateway": self.config.gateway_id,
             "replicas": {
                 r.name: {
                     "healthy": r.healthy,
@@ -1153,7 +1236,35 @@ class Gateway:
         )
 
     async def handle_slo(self, request: web.Request) -> web.Response:
-        return slo_response(self.slo)
+        local = request.query.get("local") not in (None, "", "0")
+        if local or not self.config.peer_urls:
+            return slo_response(self.slo)
+        # multi-gateway tier: each peer rates the traffic the balancer
+        # sent IT; the fan-in view answers for the tier from any member.
+        # A peer that cannot answer is reported, not hidden — a silent
+        # gap here is exactly the balancer-misroute blind spot.
+        report = self.slo.report()
+        report["gateway"] = self.config.gateway_id
+        peers: dict[str, Any] = {}
+        for url in self.config.peer_urls:
+            try:
+                # peer fan-in fetch, same health-plane exemption as the
+                # trace fan-in: an SLO scrape is not client traffic
+                # pio-lint: disable=fleet-unattributed-proxy -- gateway-peer /slo fan-in
+                async with self._http().get(
+                    f"{url}/slo?local=1",
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.config.probe_timeout_s
+                    ),
+                ) as resp:
+                    if resp.status == 200:
+                        peers[url] = await resp.json()
+                    else:
+                        peers[url] = {"error": f"status {resp.status}"}
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as exc:
+                peers[url] = {"error": type(exc).__name__}
+        report["peers"] = peers
+        return web.json_response(report)
 
     async def handle_healthz(self, request: web.Request) -> web.Response:
         healthy = sum(1 for r in self.replicas if r.healthy)
@@ -1344,4 +1455,36 @@ def _bare(content_type: str) -> str:
     return content_type.split(";", 1)[0].strip() or "application/json"
 
 
-__all__ = ["Gateway", "GatewayConfig", "Replica", "RETRIABLE_STATUSES"]
+class GatewayGroup:
+    """The autoscaler's view of a multi-gateway tier: membership changes
+    (add/retire) fan out to EVERY gateway — all peers route over the
+    same replica set, so a scale-out a single gateway learned about
+    would silently halve itself behind the balancer. Everything else
+    (ring reads, shape) delegates to the primary. Shared-nothing
+    otherwise: peers never exchange routing state."""
+
+    def __init__(self, gateways: list[Gateway]):
+        if not gateways:
+            raise ValueError("GatewayGroup needs at least one gateway")
+        self.gateways = list(gateways)
+        self.primary = gateways[0]
+
+    def add_replica(self, url: str, worker_class: str = "device") -> None:
+        for gw in self.gateways:
+            gw.add_replica(url, worker_class)
+
+    def retire_replica(self, url_or_name: str) -> None:
+        for gw in self.gateways:
+            gw.retire_replica(url_or_name)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.primary, name)
+
+
+__all__ = [
+    "Gateway",
+    "GatewayConfig",
+    "GatewayGroup",
+    "Replica",
+    "RETRIABLE_STATUSES",
+]

@@ -30,7 +30,14 @@ import signal
 import subprocess
 import sys
 
-from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig
+from predictionio_tpu.fleet.gateway import Gateway, GatewayConfig, GatewayGroup
+from predictionio_tpu.fleet.hostrt import (
+    DRIVER_CONTAINER,
+    DRIVER_SSH,
+    HostRuntime,
+    assign_hosts,
+    parse_hosts,
+)
 from predictionio_tpu.fleet.supervisor import (
     REPLICA_CLASS_CPU,
     Supervisor,
@@ -60,6 +67,9 @@ _STRIP_FLAGS = {
     "--fleet-max": True,
     "--cpu-fallback-max": True,
     "--autoscale-interval": True,
+    # multi-host / multi-gateway topology flags are parent-only too
+    "--hosts": True,
+    "--gateways": True,
 }
 
 
@@ -67,12 +77,15 @@ def worker_argv(
     cli_argv: list[str],
     port: int,
     sync_interval_s: float,
+    bind_ip: str = "127.0.0.1",
 ) -> list[str]:
     """Child process argv for one worker, derived from the parent's CLI
     argv (everything after the program name, i.e. starting at the
     ``deploy`` subcommand). Strips the fleet/port flags (both
     ``--flag value`` and ``--flag=value`` spellings) and appends the
-    worker's own port + registry sync cadence."""
+    worker's own port + registry sync cadence. Workers on a REMOTE host
+    bind all interfaces (the gateway dials them across the wire);
+    same-box workers stay loopback-only."""
     out: list[str] = [sys.executable, "-m", "predictionio_tpu.tools.cli"]
     skip = False
     for arg in cli_argv:
@@ -86,7 +99,7 @@ def worker_argv(
         out.append(arg)
     out += [
         "--ip",
-        "127.0.0.1",  # workers face only the gateway
+        bind_ip,
         "--port",
         str(port),
         "--registry-sync-interval",
@@ -115,9 +128,9 @@ def run_fleet(args, cli_argv: list[str]) -> int:
     # disables the sync loop, exactly as the help text promises
     sync_arg = getattr(args, "registry_sync_interval", None)
     sync_s = 1.0 if sync_arg is None else float(sync_arg)
-    specs = [
-        WorkerSpec(name=f"w{i}", port=args.port + 1 + i) for i in range(n)
-    ]
+    n_gateways = int(getattr(args, "gateways", 1) or 1)
+    if n_gateways < 1:
+        raise ValueError("--gateways needs at least 1 gateway")
     metrics = MetricsRegistry()
     obs = build_obs_plane(
         getattr(args, "obs_dir", "pio_obs"),
@@ -126,25 +139,53 @@ def run_fleet(args, cli_argv: list[str]) -> int:
     )
     logbook = obs.get("logbook")
 
-    # scale-out slot allocator: names/ports after the boot-time range,
-    # monotonic so a retired slot is never reused while its old process
-    # could still be draining
-    next_slot = [n]
-
-    def spec_factory(worker_class: str) -> WorkerSpec:
-        i = next_slot[0]
-        next_slot[0] += 1
-        prefix = "c" if worker_class == REPLICA_CLASS_CPU else "w"
-        return WorkerSpec(
-            name=f"{prefix}{i}",
-            port=args.port + 1 + i,
-            worker_class=worker_class,
-        )
+    # host inventory (--hosts): the declared boxes workers place across;
+    # unset collapses to the classic single-box deploy (no runtime, no
+    # probes — byte-for-byte the pre-multi-host behavior)
+    hosts_arg = getattr(args, "hosts", None)
+    runtime = None
+    if hosts_arg:
+        host_specs = parse_hosts(hosts_arg)
+        runtime = HostRuntime(host_specs, logbook=logbook)
+        placement = assign_hosts(n, host_specs)
+        specs = [
+            WorkerSpec(
+                name=f"w{i}",
+                port=args.port + n_gateways + i,
+                host=placement[i],
+                addr=runtime.host(placement[i]).connect_ip,
+            )
+            for i in range(n)
+        ]
+    else:
+        # gateways occupy ports base..base+G-1; workers follow. With the
+        # default single gateway that is exactly the old port+1+i scheme.
+        specs = [
+            WorkerSpec(name=f"w{i}", port=args.port + n_gateways + i)
+            for i in range(n)
+        ]
 
     def spawn(spec: WorkerSpec):
+        cpu = spec.worker_class == REPLICA_CLASS_CPU
+        if runtime is not None:
+            host = runtime.host(spec.host)
+            remote = host.driver in (DRIVER_SSH, DRIVER_CONTAINER)
+            argv = worker_argv(
+                cli_argv,
+                spec.port,
+                sync_s,
+                bind_ip="0.0.0.0" if remote else "127.0.0.1",
+            )
+            if remote:
+                # remote spawns export ONLY what the worker needs; the
+                # parent's whole environment does not belong on the wire
+                env = {"JAX_PLATFORMS": "cpu"} if cpu else None
+            else:
+                env = {**os.environ, "JAX_PLATFORMS": "cpu"} if cpu else None
+            return runtime.spawn_worker(spec.host, spec.name, argv, env)
         argv = worker_argv(cli_argv, spec.port, sync_s)
         env = None
-        if spec.worker_class == REPLICA_CLASS_CPU:
+        if cpu:
             # the cpu-fallback class IS the cheap tier: same server
             # stack, CPU backend — overflow degrades to slower answers
             # instead of competing for the accelerator
@@ -153,6 +194,20 @@ def run_fleet(args, cli_argv: list[str]) -> int:
             return spawn_with_log(argv, logbook, spec.name, env=env)
         return subprocess.Popen(argv, env=env)
 
+    def on_host_down(info: dict) -> None:
+        # ONE bundle per host death (the supervisor already folded every
+        # resident worker into this single transition); each dead
+        # worker's log tail lands as its own text part
+        incidents = obs.get("incidents")
+        if incidents is None:
+            return
+        texts = {}
+        for winfo in info.get("workers", []):
+            tail = winfo.pop("logTail", "")
+            if tail:
+                texts[f"log_tail_{winfo['replica']}"] = tail
+        incidents.trigger("host-death", context=info, texts=texts)
+
     supervisor = Supervisor(
         spawn=spawn,
         specs=specs,
@@ -160,22 +215,79 @@ def run_fleet(args, cli_argv: list[str]) -> int:
         metrics=metrics,
         logbook=logbook,
         on_crash=obs.get("on_crash"),
+        runtime=runtime,
+        on_host_down=on_host_down,
     )
-    gateway = Gateway(
-        GatewayConfig(
-            ip=args.ip,
-            port=args.port,
-            replica_urls=tuple(s.url for s in specs),
-            probe_interval_s=getattr(args, "fleet_probe_interval", 1.0),
-            request_timeout_s=args.request_timeout,
-            breaker_threshold=args.breaker_threshold,
-            breaker_recovery_s=args.breaker_recovery,
-            sticky_key_field=args.sticky_key,
-        ),
-        metrics=metrics,  # one registry: supervisor counters federate too
-        telemetry=obs.get("telemetry"),
-        incidents=obs.get("incidents"),
-    )
+
+    # scale-out slot allocator: names/ports after the boot-time range,
+    # monotonic so a retired slot is never reused while its old process
+    # could still be draining. Placement is host-aware: the supervisor
+    # picks the UP host with the most free slots (how the autoscaler
+    # restores capacity on the survivor after a host death).
+    next_slot = [n]
+
+    def spec_factory(worker_class: str) -> WorkerSpec:
+        i = next_slot[0]
+        next_slot[0] += 1
+        prefix = "c" if worker_class == REPLICA_CLASS_CPU else "w"
+        kw = {}
+        if runtime is not None:
+            host = supervisor.pick_host()
+            if host is None:
+                raise RuntimeError(
+                    "scale-out wanted but no live host has a free slot "
+                    "(grow --hosts)"
+                )
+            kw = {"host": host, "addr": runtime.host(host).connect_ip}
+        return WorkerSpec(
+            name=f"{prefix}{i}",
+            port=args.port + n_gateways + i,
+            worker_class=worker_class,
+            **kw,
+        )
+
+    gateways: list[Gateway] = []
+    rings = [obs.get("telemetry")]
+    for g in range(n_gateways):
+        if g == 0:
+            ring_g = obs.get("telemetry")
+        elif obs.get("dir"):
+            # peer gateways write the SAME ring directory under their own
+            # writer namespace — never interleaving a segment file
+            ring_g = TelemetryRing(
+                os.path.join(obs["dir"], "telemetry"), writer_id=f"g{g}"
+            )
+            rings.append(ring_g)
+        else:
+            ring_g = None
+        gateways.append(
+            Gateway(
+                GatewayConfig(
+                    ip=args.ip,
+                    port=args.port + g,
+                    replica_urls=tuple(s.url for s in specs),
+                    probe_interval_s=getattr(args, "fleet_probe_interval", 1.0),
+                    request_timeout_s=args.request_timeout,
+                    breaker_threshold=args.breaker_threshold,
+                    breaker_recovery_s=args.breaker_recovery,
+                    sticky_key_field=args.sticky_key,
+                    gateway_id=f"g{g}",
+                    peer_urls=tuple(
+                        f"http://127.0.0.1:{args.port + p}"
+                        for p in range(n_gateways)
+                        if p != g
+                    ),
+                ),
+                # one registry for the primary: supervisor counters
+                # federate through it exactly as before. Peers are
+                # shared-nothing — their own registries, their own
+                # /metrics (the balancer's scrape view per member).
+                metrics=metrics if g == 0 else MetricsRegistry(),
+                telemetry=ring_g,
+                incidents=obs.get("incidents") if g == 0 else None,
+            )
+        )
+    gateway = gateways[0]
     wire_incident_sources(obs.get("incidents"), gateway, supervisor)
 
     autoscaler = None
@@ -186,8 +298,13 @@ def run_fleet(args, cli_argv: list[str]) -> int:
                 "--autoscale reads the telemetry ring; it cannot run with "
                 "the flight recorder disabled (--obs-dir '')"
             )
+        # membership changes (add/retire) must land on EVERY gateway —
+        # the group fans those two calls out and reads from the primary
+        scale_target = (
+            GatewayGroup(gateways) if len(gateways) > 1 else gateway
+        )
         autoscaler = build_autoscaler(
-            args, supervisor, gateway, spec_factory, ring, metrics, obs
+            args, supervisor, scale_target, spec_factory, ring, metrics, obs
         )
 
     async def main() -> None:
@@ -199,13 +316,28 @@ def run_fleet(args, cli_argv: list[str]) -> int:
             if autoscaler is not None
             else None
         )
+
+        def drain_all() -> None:
+            for gw in gateways:
+                gw.begin_drain()
+
         try:
-            loop.add_signal_handler(signal.SIGTERM, gateway.begin_drain)
+            loop.add_signal_handler(signal.SIGTERM, drain_all)
         except (NotImplementedError, RuntimeError):
             pass  # non-POSIX loop: Ctrl-C still stops via KeyboardInterrupt
+        # peers first (g1..gN-1 on port+1..), then the primary's serve
+        # loop blocks until drain; each peer is its own shared-nothing
+        # listener over the identical replica set
+        for gw in gateways[1:]:
+            await gw.start()
         try:
             await gateway.run_until_stopped()
         finally:
+            for gw in gateways[1:]:
+                try:
+                    await gw.stop()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    logger.exception("peer gateway stop failed")
             tasks = [t for t in (sup_task, auto_task) if t is not None]
             for t in tasks:
                 t.cancel()
@@ -214,10 +346,23 @@ def run_fleet(args, cli_argv: list[str]) -> int:
             # supervisor escalates to SIGKILL only past the grace window
             await loop.run_in_executor(None, supervisor.stop)
 
-    print(
-        f"Fleet gateway starting on {args.ip}:{args.port} "
-        f"({n} workers on ports {specs[0].port}-{specs[-1].port}) ..."
-    )
+    if n_gateways > 1:
+        print(
+            f"Fleet gateways starting on {args.ip}:{args.port}-"
+            f"{args.port + n_gateways - 1} ({n_gateways} shared-nothing "
+            f"listeners; put any TCP balancer in front) "
+            f"({n} workers on ports {specs[0].port}-{specs[-1].port}) ..."
+        )
+    else:
+        print(
+            f"Fleet gateway starting on {args.ip}:{args.port} "
+            f"({n} workers on ports {specs[0].port}-{specs[-1].port}) ..."
+        )
+    if runtime is not None:
+        census = ", ".join(
+            f"{h.name}[{h.driver}]x{h.slots}" for h in runtime.hosts()
+        )
+        print(f"Host inventory: {census} (docs/fleet.md §Multi-host)")
     if obs.get("dir"):
         print(
             f"Fleet flight recorder in {obs['dir']} "
@@ -234,9 +379,9 @@ def run_fleet(args, cli_argv: list[str]) -> int:
     try:
         asyncio.run(main())
     finally:
-        ring = obs.get("telemetry")
-        if ring is not None:
-            ring.close()
+        for ring in rings:
+            if ring is not None:
+                ring.close()
     return 0
 
 

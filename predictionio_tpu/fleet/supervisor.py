@@ -26,9 +26,11 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import signal as _signal
 import time
 from typing import Any, Callable, Protocol
 
+from predictionio_tpu.fleet.hostrt import HostRuntime
 from predictionio_tpu.fleet.worklog import WorkerLogBook
 from predictionio_tpu.obs.metrics import MetricsRegistry
 
@@ -57,15 +59,20 @@ class WorkerSpec:
     the port its QueryServer binds, and its replica class — ``device``
     (accelerator-bound, the latency path) or ``cpu-fallback`` (cheap
     overflow capacity the gateway routes to only when the device class
-    is saturated; docs/fleet.md §Autoscaling)."""
+    is saturated; docs/fleet.md §Autoscaling). ``host`` is the worker's
+    home in the fleet inventory (``--hosts``; the default single-box
+    deploy puts everything on ``local``) and ``addr`` is where the
+    gateway dials it — loopback unless the host lives elsewhere."""
 
     name: str
     port: int
     worker_class: str = REPLICA_CLASS_DEVICE
+    host: str = "local"
+    addr: str = "127.0.0.1"
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        return f"http://{self.addr}:{self.port}"
 
 
 @dataclasses.dataclass
@@ -85,6 +92,11 @@ class SupervisorConfig:
     crash_loop_budget: int = 5
     # graceful stop: SIGTERM (workers drain), wait this long, then SIGKILL
     term_grace_s: float = 15.0
+    # multi-host: host liveness probe cadence and how many consecutive
+    # probe failures declare the host dead (every resident worker marked
+    # crashed in ONE transition; docs/fleet.md §Multi-host)
+    host_probe_interval_s: float = 5.0
+    host_probe_failures: int = 1
 
 
 class _Worker:
@@ -116,6 +128,30 @@ class _Worker:
         self.retire_deadline = 0.0
 
 
+class _Host:
+    """Per-host supervision state: the liveness verdict and the host's
+    own crash ladder (host DEATHS back off like worker crashes do — a
+    box that flaps shouldn't get its whole worker complement respawned
+    at full speed every time the probe blips back)."""
+
+    __slots__ = (
+        "name",
+        "up",
+        "deaths",
+        "probe_failures",
+        "last_probe_at",
+        "down_since",
+    )
+
+    def __init__(self, name: str):
+        self.name = name
+        self.up = True
+        self.deaths = 0  # up->down transitions (the host crash ladder)
+        self.probe_failures = 0  # consecutive
+        self.last_probe_at = 0.0
+        self.down_since = 0.0
+
+
 class Supervisor:
     """Spawn, watch, restart. ``tick()`` is the whole policy — drive it
     from an asyncio loop (:meth:`run`) or directly from tests with a
@@ -130,6 +166,8 @@ class Supervisor:
         clock: Callable[[], float] = time.monotonic,
         logbook: WorkerLogBook | None = None,
         on_crash: Callable[[dict[str, Any]], None] | None = None,
+        runtime: HostRuntime | None = None,
+        on_host_down: Callable[[dict[str, Any]], None] | None = None,
     ):
         self._spawn = spawn
         self.config = config or SupervisorConfig()
@@ -141,6 +179,14 @@ class Supervisor:
         # dict per exit with the tail attached
         self.logbook = logbook
         self._on_crash = on_crash
+        # multi-host: the runtime carries the inventory + drivers; None
+        # means the classic single-box deploy (every spec homes on
+        # "local", no probes, behavior identical to pre-PR-17)
+        self._runtime = runtime
+        self._on_host_down = on_host_down
+        self._hosts: dict[str, _Host] = {}
+        if runtime is not None:
+            self._hosts = {h.name: _Host(h.name) for h in runtime.hosts()}
         m = metrics or MetricsRegistry()
         self.metrics = m
         self._m_restarts = m.counter(
@@ -181,6 +227,37 @@ class Supervisor:
             "respawned), by replica class",
             labelnames=("worker_class",),
         )
+        self._m_host_up = m.gauge(
+            "pio_fleet_host_up",
+            "1 while the host's liveness probe passes; 0 marks a host "
+            "death (every resident worker crashed in one transition)",
+            labelnames=("host",),
+        )
+        self._m_host_slots = m.gauge(
+            "pio_fleet_host_slots",
+            "worker slots the host offers in the --hosts inventory",
+            labelnames=("host",),
+        )
+        self._m_host_deaths = m.counter(
+            "pio_fleet_host_deaths_total",
+            "host up->down transitions (probe failed / all residents "
+            "gone); one incident bundle each, not one per worker",
+            labelnames=("host",),
+        )
+        self._m_worker_host = m.gauge(
+            "pio_fleet_worker_host_info",
+            "1 per supervised worker; the `host` label is its home in "
+            "the fleet inventory (`pio top --fleet` groups by it)",
+            labelnames=("replica", "host"),
+        )
+        if runtime is not None:
+            for h in runtime.hosts():
+                self._m_host_up.set(1.0, host=h.name)
+                self._m_host_slots.set(float(h.slots), host=h.name)
+        for w in self._workers:
+            self._m_worker_host.set(
+                1.0, replica=w.spec.name, host=w.spec.host
+            )
         if self.logbook is not None:
             for w in self._workers:
                 self._m_log_info.set(
@@ -215,16 +292,25 @@ class Supervisor:
         )
 
     def tick(self) -> None:
-        """One supervision pass: reap exits, schedule/execute restarts,
-        escalate and reap retiring (scale-in) workers."""
+        """One supervision pass: probe host liveness, reap exits,
+        schedule/execute restarts, escalate and reap retiring (scale-in)
+        workers. Runs on an executor thread in production (:meth:`run`)
+        — host probes and remote spawns block."""
         if self._stopping:
             return
         now = self._clock()
+        self._probe_hosts(now)
+        exited_by_host: dict[str, list[tuple[_Worker, int | None]]] = {}
         for w in list(self._workers):
             if w.retiring:
                 self._tick_retiring(w, now)
                 continue
             if w.parked:
+                continue
+            host = self._hosts.get(w.spec.host)
+            if host is not None and not host.up:
+                # residents of a dead host wait for the probe to pass
+                # again; their restart clock starts at revival
                 continue
             if w.proc is None:
                 if now >= w.next_restart_at:
@@ -244,7 +330,204 @@ class Supervisor:
                 "worker %s (port %d) exited rc=%s", w.spec.name, w.spec.port, rc
             )
             w.proc = None
-            self._record_crash(w, rc=rc)
+            if host is not None:
+                # defer the crash verdict: simultaneous exits on one box
+                # smell like a host death, and a host death must be ONE
+                # transition, not N worker crashes
+                exited_by_host.setdefault(w.spec.host, []).append((w, rc))
+            else:
+                self._record_crash(w, rc=rc)
+        self._judge_exits(exited_by_host, now)
+
+    # -------------------------------------------------------------- hosts
+    def _judge_exits(
+        self,
+        exited_by_host: dict[str, list[tuple[_Worker, int | None]]],
+        now: float,
+    ) -> None:
+        """Exits observed this pass, grouped by host: any resident dying
+        triggers an immediate liveness probe; a failed probe converts
+        the whole group (plus anything still resident) into one
+        host-death transition — even when the dying residents straddled
+        two poll ticks, the first lone exit already flips the verdict.
+        A passing probe means the workers really did crash
+        individually."""
+        for host_name, group in exited_by_host.items():
+            host = self._hosts[host_name]
+            if group and not self._probe_once(host, now):
+                self._host_down(host, now, exited=group)
+                continue
+            for w, rc in group:
+                self._record_crash(w, rc=rc)
+
+    def _probe_once(self, host: _Host, now: float) -> bool:
+        ok = self._runtime.probe(host.name)
+        host.last_probe_at = now
+        if ok:
+            host.probe_failures = 0
+        else:
+            host.probe_failures += 1
+        return ok
+
+    def _probe_hosts(self, now: float) -> None:
+        """Periodic host liveness: a host failing
+        ``host_probe_failures`` consecutive probes is declared dead; a
+        dead host whose probe passes again is readmitted and its
+        residents respawn up the host's backoff ladder."""
+        if self._runtime is None:
+            return
+        for host in self._hosts.values():
+            if now - host.last_probe_at < self.config.host_probe_interval_s:
+                continue
+            ok = self._probe_once(host, now)
+            if host.up and not ok:
+                if host.probe_failures >= self.config.host_probe_failures:
+                    self._host_down(host, now)
+            elif not host.up and ok:
+                self._host_up(host, now)
+
+    def _host_down(
+        self,
+        host: _Host,
+        now: float,
+        exited: list[tuple[_Worker, int | None]] | None = None,
+    ) -> None:
+        """The host-death transition: every resident worker is marked
+        crashed HERE, in one pass — one `on_host_down` notification (one
+        incident bundle) carrying every dead worker's log tail, instead
+        of N interleaved worker-crash bundles. Residents do NOT climb
+        their own crash ladders (the box died, not their code); the
+        ladder that moves is the host's."""
+        if not host.up:
+            return
+        host.up = False
+        host.deaths += 1
+        host.down_since = now
+        self._m_host_up.set(0.0, host=host.name)
+        self._m_host_deaths.inc(host=host.name)
+        dead: list[dict[str, Any]] = []
+        exited_names = {w.spec.name for w, _ in (exited or [])}
+        for w, rc in exited or []:
+            dead.append(self._host_death_entry(w, rc))
+        for w in self._workers:
+            if (
+                w.spec.host != host.name
+                or w.retiring
+                or w.parked
+                or w.spec.name in exited_names
+            ):
+                continue
+            rc = None
+            if w.proc is not None:
+                # best-effort reap/kill of whatever handle survives (an
+                # ssh client to a dead box, a local proc on a fake host)
+                try:
+                    rc = w.proc.poll()
+                    if rc is None:
+                        w.proc.kill()
+                except (OSError, ValueError):
+                    pass
+                w.proc = None
+            dead.append(self._host_death_entry(w, rc))
+        # restart clock: residents become eligible once the host probe
+        # passes again, after the host's own backoff ladder
+        backoff = min(
+            self.config.backoff_max_s,
+            self.config.backoff_base_s
+            * self.config.backoff_multiplier ** max(0, host.deaths - 1),
+        )
+        for w in self._workers:
+            if w.spec.host == host.name and not w.retiring and not w.parked:
+                w.next_restart_at = now + backoff
+                self._m_last_crash.set(time.time(), replica=w.spec.name)
+        logger.error(
+            "host %s DOWN: %d resident workers marked crashed in one "
+            "transition (host death #%d)",
+            host.name,
+            len(dead),
+            host.deaths,
+        )
+        if self._on_host_down is not None:
+            info = {
+                "host": host.name,
+                "deaths": host.deaths,
+                "workers": dead,
+            }
+            try:
+                self._on_host_down(info)
+            except Exception:
+                logger.exception("on_host_down hook failed for %s", host.name)
+
+    def _host_death_entry(
+        self, w: _Worker, rc: int | None
+    ) -> dict[str, Any]:
+        entry: dict[str, Any] = {
+            "replica": w.spec.name,
+            "port": w.spec.port,
+            "workerClass": w.spec.worker_class,
+            "rc": rc,
+        }
+        if self._runtime is not None:
+            try:
+                entry["logTail"] = self._runtime.log_tail(
+                    w.spec.host, w.spec.name
+                )
+            except Exception:
+                entry["logTail"] = ""
+        return entry
+
+    def _host_up(self, host: _Host, now: float) -> None:
+        host.up = True
+        host.down_since = 0.0
+        self._m_host_up.set(1.0, host=host.name)
+        logger.warning(
+            "host %s readmitted after probe recovery; residents respawn "
+            "on their restart clocks",
+            host.name,
+        )
+
+    def host_census(self) -> dict[str, dict[str, Any]]:
+        """Inventory view for placement and `pio top`: per host, the
+        declared slots, liveness, death count, and resident workers
+        (parked included — they hold a slot until retired)."""
+        if self._runtime is None:
+            return {}
+        out: dict[str, dict[str, Any]] = {}
+        for h in self._runtime.hosts():
+            st = self._hosts[h.name]
+            resident = [
+                w.spec.name
+                for w in self._workers
+                if w.spec.host == h.name and not w.retiring
+            ]
+            out[h.name] = {
+                "slots": h.slots,
+                "driver": h.driver,
+                "up": st.up,
+                "deaths": st.deaths,
+                "resident": resident,
+            }
+        return out
+
+    def pick_host(self) -> str | None:
+        """Host-aware placement for scale-out: the UP host with the most
+        free slot headroom (ties by name). None when every live host is
+        full — the autoscaler's envelope should treat that as saturated.
+        Without a runtime there is no inventory and placement is the
+        caller's 'local' default."""
+        if self._runtime is None:
+            return None
+        best: tuple[float, str] | None = None
+        for name, info in self.host_census().items():
+            if not info["up"]:
+                continue
+            free = info["slots"] - len(info["resident"])
+            if free <= 0:
+                continue
+            score = (len(info["resident"]) / info["slots"], name)
+            if best is None or score < best:
+                best = score
+        return best[1] if best else None
 
     def _tick_retiring(self, w: _Worker, now: float) -> None:
         """Drive one retiring worker: already gone -> reap; past the
@@ -259,10 +542,7 @@ class Supervisor:
                 w.spec.name,
                 self.config.term_grace_s,
             )
-            try:
-                w.proc.kill()
-            except Exception:
-                pass
+            self._deliver(w, _signal.SIGKILL)
             # one more grace slice for the SIGKILL to be reaped
             w.retire_deadline = now + self.config.poll_interval_s
 
@@ -283,8 +563,13 @@ class Supervisor:
         worker."""
         if any(w.spec.name == spec.name for w in self._workers):
             raise ValueError(f"worker {spec.name!r} already supervised")
+        if spec.host not in self._hosts and self._runtime is not None:
+            raise ValueError(
+                f"worker {spec.name!r} homed on unknown host {spec.host!r}"
+            )
         w = _Worker(spec)
         self._workers.append(w)
+        self._m_worker_host.set(1.0, replica=spec.name, host=spec.host)
         if self.logbook is not None:
             self._m_log_info.set(
                 1.0, replica=spec.name, path=self.logbook.path(spec.name)
@@ -310,10 +595,7 @@ class Supervisor:
             self._m_retired.inc(worker_class=w.spec.worker_class)
             w.retire_deadline = self._clock() + self.config.term_grace_s
             if w.proc is not None and w.proc.poll() is None:
-                try:
-                    w.proc.terminate()
-                except Exception:
-                    pass
+                self._deliver(w, _signal.SIGTERM)
             else:
                 # nothing running (crashed/parked): reap immediately
                 self._reap_retired(w, None)
@@ -339,6 +621,7 @@ class Supervisor:
             self._m_parked,
             self._m_last_crash,
             self._m_log_info,
+            self._m_worker_host,
         ):
             gauge.prune("replica", live)
 
@@ -399,13 +682,35 @@ class Supervisor:
         except Exception:
             logger.exception("on_crash hook failed for %s", w.spec.name)
 
+    def _deliver(self, w: _Worker, sig: int) -> None:
+        """Route a signal through the worker's host driver: a remote
+        worker needs the FAR side signalled (ssh pkill, container kill),
+        not just its local client handle."""
+        if w.proc is None:
+            return
+        try:
+            if self._runtime is not None:
+                self._runtime.signal_worker(
+                    w.spec.host, w.spec.name, w.proc, sig
+                )
+            elif sig == _signal.SIGKILL:
+                w.proc.kill()
+            else:
+                w.proc.terminate()
+        except (OSError, ValueError):
+            pass
+
     async def run(self) -> None:
-        """Asyncio driver for :meth:`tick` (process polls are non-blocking,
-        so ticking on the event loop is fine)."""
+        """Asyncio driver for :meth:`tick`, each pass on an EXECUTOR
+        thread: a multi-host tick blocks (ssh probes, container spawns),
+        and even the local driver's spawn is a fork — none of it belongs
+        on the serving event loop (the same rule the autoscaler and
+        incident captures follow)."""
         import asyncio
 
+        loop = asyncio.get_running_loop()
         while not self._stopping:
-            self.tick()
+            await loop.run_in_executor(None, self.tick)
             await asyncio.sleep(self.config.poll_interval_s)
 
     def stop(self) -> None:
@@ -415,10 +720,7 @@ class Supervisor:
         self._stopping = True
         live = [w for w in self._workers if w.proc is not None]
         for w in live:
-            try:
-                w.proc.terminate()
-            except Exception:
-                pass
+            self._deliver(w, _signal.SIGTERM)
         deadline = self._clock() + self.config.term_grace_s
         while self._clock() < deadline:
             if all(w.proc is None or w.proc.poll() is not None for w in live):
@@ -431,10 +733,7 @@ class Supervisor:
                     w.spec.name,
                     self.config.term_grace_s,
                 )
-                try:
-                    w.proc.kill()
-                except Exception:
-                    pass
+                self._deliver(w, _signal.SIGKILL)
 
     # ------------------------------------------------------------- queries
     def _collect(self) -> None:
@@ -448,6 +747,7 @@ class Supervisor:
             {
                 "name": w.spec.name,
                 "port": w.spec.port,
+                "host": w.spec.host,
                 "pid": getattr(w.proc, "pid", None) if w.proc else None,
                 "up": w.proc is not None and w.proc.poll() is None,
                 "parked": w.parked,

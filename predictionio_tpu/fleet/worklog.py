@@ -76,7 +76,6 @@ class WorkerLogBook:
 
 def _tail_bytes(path: str, n: int) -> bytes:
     try:
-        # pio-lint: disable=async-blocking-call -- bounded (<=8KiB) local read, fires only on the rare worker-crash path; not worth an executor hop
         with open(path, "rb") as fh:
             fh.seek(0, os.SEEK_END)
             size = fh.tell()

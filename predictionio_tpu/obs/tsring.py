@@ -56,14 +56,24 @@ class TelemetryRing:
         dir_path: str,
         segment_records: int = 256,
         segments: int = 8,
+        writer_id: str = "",
     ):
         if segments < 2:
             raise ValueError("ring needs at least 2 segments to rotate")
         if segment_records < 1:
             raise ValueError("segment_records must be >= 1")
+        if writer_id and not writer_id.replace("-", "").isalnum():
+            raise ValueError("writer_id must be alphanumeric/dashes")
         self.dir = dir_path
         self.segment_records = int(segment_records)
         self.segments = int(segments)
+        # writer namespace (--gateways N): each writer owns its own
+        # segment files (``seg-<writer>-NNNNN.jsonl``) so two gateways
+        # sharing one obs dir never interleave — or truncate — one
+        # segment. The default "" keeps the classic single-writer names.
+        # READS merge every writer's segments (ordered by time), so the
+        # autoscaler and `pio top --history` see the whole tier.
+        self.writer_id = writer_id
         self._lock = threading.Lock()
         os.makedirs(self.dir, exist_ok=True)
         self._fh = None  # lazily (re)opened append handle
@@ -71,8 +81,9 @@ class TelemetryRing:
 
     # ------------------------------------------------------------------ io
     def _segment_path(self, index: int) -> str:
+        mid = f"{self.writer_id}-" if self.writer_id else ""
         return os.path.join(
-            self.dir, f"{_SEGMENT_PREFIX}{index:05d}{_SEGMENT_SUFFIX}"
+            self.dir, f"{_SEGMENT_PREFIX}{mid}{index:05d}{_SEGMENT_SUFFIX}"
         )
 
     @staticmethod
@@ -145,6 +156,8 @@ class TelemetryRing:
             rec = dict(record)
             rec["seq"] = seq
             rec.setdefault("t", time.time())
+            if self.writer_id:
+                rec.setdefault("writer", self.writer_id)
             if self._fh is None:
                 self._open_active(truncate=self._active_count < 0)
             elif self._active_count >= self.segment_records:
@@ -176,12 +189,46 @@ class TelemetryRing:
         return min(self._next_seq, capacity)
 
     # -------------------------------------------------------------- reading
+    def _all_segment_paths(self) -> dict[str, list[str]]:
+        """Every writer's segment files in the directory, keyed by
+        writer id ('' = the default single-writer namespace)."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return {}
+        out: dict[str, list[str]] = {}
+        for n in sorted(names):
+            if not (
+                n.startswith(_SEGMENT_PREFIX) and n.endswith(_SEGMENT_SUFFIX)
+            ):
+                continue
+            stem = n[len(_SEGMENT_PREFIX):-len(_SEGMENT_SUFFIX)]
+            writer, _sep, idx = stem.rpartition("-")
+            if not idx.isdigit():
+                continue
+            out.setdefault(writer, []).append(os.path.join(self.dir, n))
+        return out
+
     def records(self) -> list[dict[str, Any]]:
-        """Every live record, oldest first (seq order across segments)."""
-        out: list[dict[str, Any]] = []
-        for i in range(self.segments):
-            out.extend(self._read_segment(self._segment_path(i)))
-        out.sort(key=lambda r: int(r["seq"]))
+        """Every live record, oldest first. A single-writer directory
+        reads in seq order exactly as before; a multi-writer one (the
+        --gateways tier sharing an obs dir) merges every writer's
+        segments ordered by record time (seqs are per-writer and tie
+        within a writer)."""
+        by_writer = self._all_segment_paths()
+        recs_per: list[list[dict[str, Any]]] = []
+        for paths in by_writer.values():
+            recs: list[dict[str, Any]] = []
+            for path in paths:
+                recs.extend(self._read_segment(path))
+            recs.sort(key=lambda r: int(r["seq"]))
+            recs_per.append(recs)
+        if not recs_per:
+            return []
+        if len(recs_per) == 1:
+            return recs_per[0]
+        out = [r for recs in recs_per for r in recs]
+        out.sort(key=lambda r: (float(r.get("t", 0.0)), int(r["seq"])))
         return out
 
     def window(

@@ -36,6 +36,7 @@ import os
 import re
 import tempfile
 import threading
+import time
 from typing import Any
 
 try:
@@ -43,6 +44,7 @@ try:
 except ImportError:  # non-POSIX: in-process serialization only
     fcntl = None  # type: ignore[assignment]
 
+from predictionio_tpu.registry import lease as lease_mod
 from predictionio_tpu.registry.manifest import ModelManifest
 
 logger = logging.getLogger(__name__)
@@ -144,6 +146,15 @@ class ArtifactStore:
         # (rollback nests unstage); guarded by self._lock
         self._flock_depth: dict[str, int] = {}
         self._flock_fd: dict[str, int] = {}
+        # cross-HOST transition lock (lease.py); one mutex per engine,
+        # acquired under the flock so same-host processes never contend
+        # on it. Guarded by self._lock.
+        self._leases: dict[str, "lease_mod.LeaseMutex"] = {}
+        # highest rollout generation this store instance has ever read or
+        # written, per engine key — the floor state_generation() reports
+        # when a concurrent tmp+rename makes the state file momentarily
+        # unreadable (a spurious 0 would make every fleet worker reload)
+        self._gen_seen: dict[str, int] = {}
 
     # ------------------------------------------------------------- layout
     @staticmethod
@@ -168,25 +179,44 @@ class ArtifactStore:
     def _state_path(self, engine_id: str) -> str:
         return os.path.join(self._engine_dir(engine_id), "state.json")
 
+    def _lease_for(self, engine_id: str) -> "lease_mod.LeaseMutex":
+        key = self.engine_key(engine_id)
+        with self._lock:
+            mx = self._leases.get(key)
+            if mx is None:
+                mx = lease_mod.LeaseMutex(
+                    os.path.join(self._engine_dir(engine_id), "state.lease"),
+                    ttl_s=lease_mod.lease_ttl_s(),
+                )
+                self._leases[key] = mx
+            return mx
+
     @contextlib.contextmanager
     def _state_mutex(self, engine_id: str):
-        """Cross-PROCESS transition lock: an advisory ``flock`` on the
-        engine's ``state.lock``, held for the whole read-modify-write.
-        Fleet workers are concurrent registry writers (bake gates,
-        breaker rollbacks, the CLI); without this, two simultaneous
-        transitions read the same state, one write is lost, and both
-        land on the same generation number — a replica that already saw
-        that generation never adopts the surviving write. The in-process
-        RLock (always held around this) serializes threads; the flock
-        serializes processes and releases automatically if one dies.
-        Reentrant per store (``rollback`` nests ``unstage``)."""
-        if fcntl is None:
-            yield
-            return
+        """Cross-process AND cross-host transition lock, held for the
+        whole read-modify-write. Two layers:
+
+        - Same-host fast path: an advisory ``flock`` on the engine's
+          ``state.lock`` — kernel-speed, zero polling, auto-released on
+          holder death. Fleet workers on one box are concurrent registry
+          writers (bake gates, breaker rollbacks, the CLI); without
+          this, two simultaneous transitions read the same state, one
+          write is lost, and both land on the same generation number.
+        - Cross-host layer: a lease file with TTL expiry + fencing
+          tokens (:mod:`~predictionio_tpu.registry.lease`), acquired
+          UNDER the flock so only one process per host ever contends on
+          it. ``flock`` is host-bound (and a no-op on many network
+          mounts), so a registry on shared storage needs the lease for
+          hosts the way it needs the flock for processes.
+          ``PIO_REGISTRY_LEASE=0`` disables this layer.
+
+        The in-process RLock (always held around this) serializes
+        threads. Reentrant per store (``rollback`` nests ``unstage``) —
+        both layers acquire at depth 0 only."""
         key = self.engine_key(engine_id)
         with self._lock:
             depth = self._flock_depth.get(key, 0)
-            if depth == 0:
+            if depth == 0 and fcntl is not None:
                 path = os.path.join(self._engine_dir(engine_id), "state.lock")
                 os.makedirs(os.path.dirname(path), exist_ok=True)
                 fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
@@ -194,18 +224,29 @@ class ArtifactStore:
                 fcntl.flock(fd, fcntl.LOCK_EX)
                 self._flock_fd[key] = fd
             self._flock_depth[key] = depth + 1
+        lease_held = False
         try:
+            if depth == 0 and lease_mod.lease_enabled():
+                mx = self._lease_for(engine_id)
+                mx.acquire(timeout_s=max(60.0, 2.0 * mx.ttl_s))
+                lease_held = True
             yield
         finally:
+            if lease_held:
+                try:
+                    self._leases[key].release()
+                except OSError:
+                    logger.exception("lease release failed for %s", key)
             with self._lock:
                 self._flock_depth[key] -= 1
                 if self._flock_depth[key] == 0:
                     del self._flock_depth[key]
-                    fd = self._flock_fd.pop(key)
-                    try:
-                        fcntl.flock(fd, fcntl.LOCK_UN)
-                    finally:
-                        os.close(fd)
+                    fd2 = self._flock_fd.pop(key, None)
+                    if fd2 is not None:
+                        try:
+                            fcntl.flock(fd2, fcntl.LOCK_UN)
+                        finally:
+                            os.close(fd2)
 
     def engines(self) -> list[str]:
         """Engine keys present in the registry (directory names; the
@@ -481,26 +522,65 @@ class ArtifactStore:
         return self.state_by_key(self.engine_key(engine_id))
 
     def state_by_key(self, engine_key: str) -> RolloutState:
+        """Unlocked read of the persisted rollout state. A concurrent
+        writer is mid-``tmp+rename`` at any moment, so a torn or
+        momentarily-missing read is expected operation, not corruption:
+        retry once after a beat before concluding anything. Only a state
+        file that stays unreadable is treated as fresh."""
         path = os.path.join(self.base_dir, engine_key, "state.json")
-        if not os.path.exists(path):
-            return RolloutState()
-        try:
-            with open(path, encoding="utf-8") as fh:
-                return RolloutState.from_json_dict(json.load(fh))
-        except (OSError, ValueError, TypeError):
-            logger.warning(
-                "unreadable rollout state for %s; starting fresh", engine_key
-            )
-            return RolloutState()
+        for attempt in (0, 1):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    state = RolloutState.from_json_dict(json.load(fh))
+                with self._lock:
+                    if state.generation > self._gen_seen.get(engine_key, 0):
+                        self._gen_seen[engine_key] = state.generation
+                return state
+            except FileNotFoundError:
+                # genuinely absent (fresh engine) unless this store has
+                # already seen state here — then it's the rename window
+                with self._lock:
+                    seen = self._gen_seen.get(engine_key, 0)
+                if not seen or attempt:
+                    return RolloutState()
+            except (OSError, ValueError, TypeError):
+                if attempt:
+                    logger.warning(
+                        "unreadable rollout state for %s; starting fresh",
+                        engine_key,
+                    )
+                    return RolloutState()
+            time.sleep(0.01)
+        return RolloutState()
 
     def state_generation(self, engine_id: str) -> int:
         """Cheap monotonic change detector for cross-process coordination:
         the ``generation`` counter of the persisted rollout state (0 when
         no state exists yet). One state-file read — callers poll this and
-        only pay :meth:`get_state` + reconciliation when it moved."""
-        return self.get_state(engine_id).generation
+        only pay :meth:`get_state` + reconciliation when it moved.
+
+        Never goes backwards within a store instance: when a concurrent
+        transition makes the file momentarily unreadable, the last
+        generation this store saw is the answer — a spurious 0 here
+        would make every fleet worker's sync loop reload at once."""
+        key = self.engine_key(engine_id)
+        gen = self.state_by_key(key).generation
+        with self._lock:
+            floor = self._gen_seen.get(key, 0)
+            if gen >= floor:
+                self._gen_seen[key] = gen
+                return gen
+            return floor
 
     def _save_state(self, engine_id: str, state: RolloutState) -> None:
+        key = self.engine_key(engine_id)
+        with self._lock:
+            mx = self._leases.get(key)
+        if mx is not None and mx.held:
+            # fencing: a holder whose lease expired mid-transition must
+            # NOT persist — a newer token exists and its owner may have
+            # already written. Raises LeaseLostError before any mutation.
+            mx.verify()
         state.updated_at = ModelManifest.now_iso()
         state.generation += 1
         state.history = state.history[-_HISTORY_LIMIT:]
@@ -508,6 +588,9 @@ class ArtifactStore:
             self._state_path(engine_id),
             json.dumps(state.to_json_dict(), indent=1).encode("utf-8"),
         )
+        with self._lock:
+            if state.generation > self._gen_seen.get(key, 0):
+                self._gen_seen[key] = state.generation
 
     @staticmethod
     def _record(state: RolloutState, action: str, **fields: Any) -> None:

@@ -552,6 +552,16 @@ def cmd_deploy(args) -> int:
             "--autoscale requires --fleet N (the autoscaler drives the "
             "fleet supervisor; docs/fleet.md §Autoscaling)"
         )
+    if getattr(args, "hosts", None) and not args.fleet:
+        return _die(
+            "--hosts requires --fleet N (host placement is the fleet "
+            "supervisor's job; docs/fleet.md §Multi-host)"
+        )
+    if getattr(args, "gateways", 1) != 1 and not args.fleet:
+        return _die(
+            "--gateways requires --fleet N (peer gateways front the "
+            "fleet's replica set; docs/fleet.md §Gateway tier)"
+        )
     if args.fleet:
         # N supervised worker processes behind a gateway (docs/fleet.md):
         # the gateway takes --port, workers take port+1..port+N and get a
@@ -1887,6 +1897,26 @@ def build_parser() -> argparse.ArgumentParser:
         type=float,
         default=None,
         help="autoscaler control-loop cadence in seconds (default 5)",
+    )
+    x.add_argument(
+        "--hosts",
+        default=None,
+        metavar="SPEC",
+        help="multi-host worker placement: comma list of "
+        "[driver@]host:slots entries (drivers: local, ssh, container; "
+        "e.g. 'local:4,ssh@gpu-2:8'); workers spread across the "
+        "inventory and a dead host's capacity respawns on survivors "
+        "(docs/fleet.md §Multi-host)",
+    )
+    x.add_argument(
+        "--gateways",
+        type=int,
+        default=1,
+        metavar="N",
+        help="run N shared-nothing gateways on ports PORT..PORT+N-1 over "
+        "the same replica set (put any TCP balancer in front); each peer "
+        "serves its own /metrics, /traces/recent and /slo fan in across "
+        "peers (default 1)",
     )
     x.add_argument(
         "--obs-dir",

@@ -396,11 +396,47 @@ def _fleet_summary(metrics: Metrics) -> dict[str, Any] | None:
         if rep and v > 0 and labels.get("path"):
             replicas.setdefault(rep, {})["log_path"] = labels["path"]
     up = sum(1 for info in replicas.values() if info.get("up"))
+    # multi-host inventory (--hosts): per-host up/slots/death gauges plus
+    # the replica->host info series group the replica list by box; a
+    # single-box fleet exports none of these and `hosts` stays empty
+    hosts: dict[str, dict[str, Any]] = {}
+    for name, field, cast in (
+        ("pio_fleet_host_up", "up", lambda v: bool(v)),
+        ("pio_fleet_host_slots", "slots", float),
+        ("pio_fleet_host_deaths_total", "deaths", float),
+    ):
+        for labels, v in metrics.get(name, ()):
+            host = labels.get("host")
+            if host:
+                hosts.setdefault(host, {"residents": []})[field] = cast(v)
+    for labels, v in metrics.get("pio_fleet_worker_host_info", ()):
+        rep, host = labels.get("replica"), labels.get("host")
+        if rep and host and v > 0:
+            if rep in replicas:
+                replicas[rep]["host"] = host
+            if host in hosts:
+                hosts[host]["residents"].append(rep)
+    # resident liveness comes from the SUPERVISOR's worker-named series
+    # (`pio_fleet_worker_up{replica="w0"}`) — the gateway's replica rows
+    # above are keyed by address, so a name lookup there always misses
+    worker_up = {
+        labels["replica"]: bool(v)
+        for labels, v in metrics.get("pio_fleet_worker_up", ())
+        if labels.get("replica")
+    }
+    for info in hosts.values():
+        info["residents"].sort()
+        info["residents_up"] = sum(
+            1
+            for rep in info["residents"]
+            if worker_up.get(rep, bool(replicas.get(rep, {}).get("up")))
+        )
     return {
         "replicas_total": _total(metrics, "pio_fleet_replicas")
         or float(len(replicas)),
         "replicas_up": float(up),
         "replicas": replicas,
+        "hosts": hosts,
         "retries_total": _total(metrics, "pio_fleet_retries_total"),
         "no_replica_total": _total(metrics, "pio_fleet_no_replica_total"),
         "ejections_total": _total(metrics, "pio_fleet_ejections_total"),
@@ -655,6 +691,28 @@ def render(summary: dict[str, Any], url: str) -> str:
         if fleet.get("gateway_p50_ms"):
             line += f"   gw p50 {fleet['gateway_p50_ms']:.2f} ms"
         lines.append(line)
+        for host, hinfo in sorted((fleet.get("hosts") or {}).items()):
+            # one line per declared host: replicas grouped by box, the
+            # up/slots census, and a shouting marker when the whole box
+            # is gone (the per-replica DOWNs above are its symptoms)
+            residents = hinfo.get("residents") or []
+            rep_up = hinfo.get("residents_up")
+            if rep_up is None:
+                rep_up = sum(
+                    1
+                    for rep in residents
+                    if (fleet.get("replicas") or {}).get(rep, {}).get("up")
+                )
+            hline = (
+                f"  host       {host}  {num(float(rep_up))}/"
+                f"{num(hinfo.get('slots'))} slots  "
+                + ("  ".join(residents) or "(empty)")
+            )
+            if not hinfo.get("up", True):
+                hline += "   HOST-DOWN"
+            if hinfo.get("deaths"):
+                hline += f"   deaths {num(hinfo['deaths'])}"
+            lines.append(hline)
         for rep, info in sorted((fleet.get("replicas") or {}).items()):
             # the last-crash excerpt: which replica died and where its
             # captured stderr tail lives (the incident bundle's source)

@@ -976,6 +976,12 @@ class QueryServer:
         m.register_collector(self.compile_watcher.sample)
         m.register_collector(self._breaker_instruments.collect)
         m.register_collector(self.slo.collect)
+        # registry lease-mutex counters (registry/lease.py): every server
+        # that can stage/promote through the shared-storage registry
+        # exports its acquire/steal/fencing-loss tallies
+        from predictionio_tpu.registry.lease import register_lease_metrics
+
+        register_lease_metrics(m)
         self._runner: web.AppRunner | None = None
         self._stop_event = asyncio.Event()
         # strong refs to fire-and-forget tasks (the loop keeps only weak ones)

@@ -1,0 +1,285 @@
+"""CI multi-host smoke (run_lint.sh --ci): two fake-driver hosts, kill one.
+
+The two-"host" survive-host-death gate on one machine (ISSUE 17,
+docs/fleet.md §Multi-host): four real worker processes partitioned
+under two fake host names by :class:`FakeHostDriver`, fronted by the
+fleet gateway under live traffic. ``kill_host`` pulls one box's cord —
+SIGKILLs every resident AND fails the host's liveness probe, which is
+what a kernel panic looks like from the supervisor's chair — and the
+smoke then asserts:
+
+1. zero failed queries through the kill (the surviving host's workers
+   absorb the traffic inside the gateway's probe window);
+2. the supervisor folded the whole box into ONE host-death transition:
+   exactly one ``host-death`` incident bundle, carrying every dead
+   worker's captured log tail, and NO per-worker crash bundles;
+3. ``pio top --fleet`` renders the host census with the ``HOST-DOWN``
+   marker from the federated /metrics;
+4. the host-aware scale-out path (``pick_host`` -> ``add_worker`` ->
+   gateway admission) restores capacity on the survivor.
+
+Workers are ``scripts/fleet_smoke.py --worker`` processes — the same
+self-contained QueryServer the single-box fleet smoke drives. Exit 0 =
+all held; any assertion exits nonzero and fails CI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def orchestrate(obs_dir: str) -> int:
+    import aiohttp
+
+    from predictionio_tpu.fleet import (
+        Gateway,
+        GatewayConfig,
+        Supervisor,
+        SupervisorConfig,
+        WorkerSpec,
+    )
+    from predictionio_tpu.fleet.hostrt import (
+        DRIVER_FAKE,
+        FakeHostDriver,
+        HostRuntime,
+        HostSpec,
+        assign_hosts,
+    )
+    from predictionio_tpu.fleet.launch import (
+        build_obs_plane,
+        wire_incident_sources,
+    )
+    from predictionio_tpu.obs.incidents import list_bundles, load_bundle
+    from predictionio_tpu.obs.metrics import MetricsRegistry
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    metrics = MetricsRegistry()
+    obs = build_obs_plane(obs_dir, metrics)
+
+    fake = FakeHostDriver(obs["logbook"])
+    runtime = HostRuntime(
+        [
+            HostSpec("ha", 2, driver=DRIVER_FAKE),
+            HostSpec("hb", 3, driver=DRIVER_FAKE),
+        ],
+        logbook=obs["logbook"],
+        drivers={DRIVER_FAKE: fake},
+    )
+    placement = assign_hosts(4, runtime.hosts())
+    specs = [
+        WorkerSpec(f"w{i}", _free_port(), host=placement[i]) for i in range(4)
+    ]
+    worker_script = os.path.join(REPO, "scripts", "fleet_smoke.py")
+
+    def spawn(spec):
+        return runtime.spawn_worker(
+            spec.host,
+            spec.name,
+            [sys.executable, worker_script, "--worker", str(spec.port)],
+            env=env,
+        )
+
+    def on_host_down(info: dict) -> None:
+        texts = {}
+        for winfo in info.get("workers", []):
+            tail = winfo.pop("logTail", "")
+            if tail:
+                texts[f"log_tail_{winfo['replica']}"] = tail
+        obs["incidents"].trigger("host-death", context=info, texts=texts)
+
+    sup = Supervisor(
+        spawn,
+        specs,
+        SupervisorConfig(
+            poll_interval_s=0.1,
+            backoff_base_s=0.2,
+            term_grace_s=8.0,
+            host_probe_interval_s=0.5,
+        ),
+        metrics=metrics,
+        logbook=obs["logbook"],
+        on_crash=obs["on_crash"],
+        runtime=runtime,
+        on_host_down=on_host_down,
+    )
+    gw_port = _free_port()
+    gw = Gateway(
+        GatewayConfig(
+            ip="127.0.0.1",
+            port=gw_port,
+            replica_urls=tuple(s.url for s in specs),
+            probe_interval_s=0.2,
+            probe_timeout_s=1.0,
+            request_timeout_s=8.0,
+            telemetry_interval_s=0.2,
+        ),
+        metrics=metrics,
+        telemetry=obs["telemetry"],
+        incidents=obs["incidents"],
+    )
+    wire_incident_sources(obs["incidents"], gw, sup)
+    gw_url = f"http://127.0.0.1:{gw_port}"
+    sup.start()
+    sup_task = asyncio.ensure_future(sup.run())
+    await gw.start()
+    session = aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(total=10))
+
+    async def healthy_count() -> int:
+        async with session.get(f"{gw_url}/healthz") as resp:
+            return (await resp.json()).get("replicasHealthy", 0)
+
+    async def wait_for(cond, message: str, deadline_s: float) -> None:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                if await cond():
+                    return
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, message
+            await asyncio.sleep(0.2)
+
+    async def query(i: int) -> int:
+        async with session.post(
+            f"{gw_url}/queries.json", json={"user": f"u{i % 50}", "num": 5}
+        ) as resp:
+            await resp.read()
+            return resp.status
+
+    try:
+        # 1. all four workers come up across both hosts
+        await wait_for(
+            lambda: _is(healthy_count, 4), "workers never became ready", 180.0
+        )
+        for i in range(10):
+            assert await query(i) == 200, "fleet did not answer pre-kill"
+        # 2. pull host ha's cord: both residents die, the probe fails
+        dead = [s.name for s in specs if s.host == "ha"]
+        killed = fake.kill_host("ha")
+        assert killed == len(dead), f"kill_host reaped {killed} != {len(dead)}"
+        await wait_for(
+            lambda: _is(healthy_count, 2),
+            "dead host's replicas never ejected",
+            10.0,
+        )
+        failures = 0
+        for i in range(20):
+            if await query(100 + i) != 200:
+                failures += 1
+        assert failures == 0, f"{failures}/20 queries failed after host kill"
+        # 3. ONE host-death bundle, every dead worker's tail, no crash
+        # bundles (run the listing off-loop: it stats files)
+        refs = await asyncio.get_running_loop().run_in_executor(
+            None, list_bundles, os.path.join(obs_dir, "incidents")
+        )
+        host_deaths = [r for r in refs if r.trigger == "host-death"]
+        assert len(host_deaths) == 1, (
+            f"expected ONE host-death bundle, got "
+            f"{[r.trigger for r in refs]}"
+        )
+        assert not [r for r in refs if r.trigger == "worker-crash"], (
+            "host death leaked per-worker crash bundles"
+        )
+        bundle = load_bundle(
+            os.path.join(obs_dir, "incidents"), host_deaths[0].bundle_id
+        )
+        ctx = bundle["manifest"]["context"]
+        assert ctx["host"] == "ha", ctx
+        assert sorted(w["replica"] for w in ctx["workers"]) == sorted(dead)
+        for name in dead:
+            tail = bundle["texts"].get(f"log_tail_{name}", "")
+            assert "fleet smoke worker serving" in tail, (
+                f"{name}'s log tail missing from the bundle: {tail!r}"
+            )
+        # 4. pio top --fleet shows the host census with the DOWN marker
+        top = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: subprocess.run(
+                [
+                    os.path.join(REPO, "pio"),
+                    "top",
+                    "--fleet",
+                    "--once",
+                    "--url",
+                    gw_url,
+                ],
+                capture_output=True,
+                timeout=60,
+                env=env,
+            ),
+        )
+        screen = top.stdout.decode(errors="replace")
+        assert top.returncode == 0, top.stderr.decode(errors="replace")[-500:]
+        assert "HOST-DOWN" in screen, (
+            f"no HOST-DOWN marker in pio top output:\n{screen}"
+        )
+        assert "hb" in screen, screen
+        # 5. host-aware scale-out restores capacity on the survivor
+        target = sup.pick_host()
+        assert target == "hb", f"pick_host chose {target!r}, not the survivor"
+        replacement = WorkerSpec("w4", _free_port(), host=target)
+        await asyncio.get_running_loop().run_in_executor(
+            None, sup.add_worker, replacement
+        )
+        gw.add_replica(replacement.url, replacement.worker_class)
+        await wait_for(
+            lambda: _is(healthy_count, 3),
+            "replacement capacity never came up on the survivor",
+            180.0,
+        )
+        for i in range(10):
+            assert await query(200 + i) == 200, "fleet failed after scale-out"
+        print(
+            json.dumps(
+                {
+                    "hostrt_smoke": "ok",
+                    "hosts": {h.name: h.slots for h in runtime.hosts()},
+                    "killed_host": "ha",
+                    "dead_workers": dead,
+                    "host_death_bundle": host_deaths[0].bundle_id,
+                    "top_shows_host_down": True,
+                    "replacement_on": target,
+                }
+            )
+        )
+        return 0
+    finally:
+        sup_task.cancel()
+        await asyncio.gather(sup_task, return_exceptions=True)
+        await session.close()
+        await gw.stop()
+        await asyncio.get_running_loop().run_in_executor(None, sup.stop)
+        obs["telemetry"].close()
+
+
+async def _is(fn, expect) -> bool:
+    return (await fn()) == expect
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="pio_hostrt_smoke_obs_") as d:
+        return asyncio.run(orchestrate(d))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,6 +28,15 @@
 # drain-based scale-in both zero-5xx, scaling decisions in the
 # telemetry ring, an autoscaler-saturated incident bundle at the
 # envelope, and retired replicas' gauges dropped from the exposition.
+# The multi-host stage (tests/test_hostrt.py, incl. the slow-marked
+# kill-a-host e2e) pulls an entire fake-driver host's cord mid-rollout:
+# zero client-visible 5xx, ONE host-death incident bundle carrying every
+# dead worker's log tail, the registry lease stolen from the dead
+# host's holder with a fresh fencing token, and capacity restored on
+# the survivor through the host-aware spawn path. The lease stage
+# (tests/test_lease.py) proves the shared-storage mutex itself:
+# TTL-expiry steals, fencing on save, and a two-process hammer with no
+# lost transitions and no token reuse.
 # See docs/resilience.md, docs/observability.md, docs/model_registry.md,
 # docs/streaming.md, docs/fleet.md.
 # Usage: scripts/run_chaos.sh [extra pytest args...]
@@ -39,5 +48,5 @@ cd "$repo_root"
 exec env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_resilience.py tests/test_obs.py tests/test_registry.py \
   tests/test_stream.py tests/test_fleet.py tests/test_flightrec.py \
-  tests/test_autoscaler.py -q \
+  tests/test_autoscaler.py tests/test_hostrt.py tests/test_lease.py -q \
   -p no:cacheprovider "$@"

@@ -239,6 +239,18 @@ PYEOF
   env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
   echo "fleet smoke: gateway survives replica kill, pio top --fleet renders, incident bundle captured, scale-out/scale-in cycle clean"
 
+  # --- multi-host smoke (ISSUE 17, docs/fleet.md §Multi-host): two fake-
+  #     driver hosts, four workers, kill one host mid-traffic — zero
+  #     failed queries, ONE host-death incident bundle carrying every
+  #     dead worker's log tail (no per-worker crash bundles), pio top
+  #     --fleet shows the HOST-DOWN census, and the host-aware scale-out
+  #     path restores capacity on the survivor. The full kill-a-host
+  #     chaos e2e (mid-ROLLOUT, lease steal from the dead holder) is the
+  #     slow-marked stage in tests/test_hostrt.py, run by the chaos gate
+  #     below.
+  env JAX_PLATFORMS=cpu python scripts/hostrt_smoke.py
+  echo "hostrt smoke: host death survived with zero failed queries, one host-death bundle, HOST-DOWN census rendered, capacity restored on survivor"
+
   # chaos gate includes the observability suite (tests/test_obs.py):
   # counters moving under faults + trace propagation are CI-asserted
   exec "$repo_root/scripts/run_chaos.sh"

@@ -1,0 +1,1000 @@
+"""Multi-host fleet (fleet/hostrt.py + supervisor host supervision +
+the shared-nothing gateway tier): inventory parsing, driver contracts,
+host-death detection as ONE transition, host-aware placement, telemetry
+ring writer namespacing, gateway peer fan-in — and the PR-17 acceptance
+gate: a two-"host" (fake-driver) chaos e2e that kills an entire host
+mid-rollout and demands zero client-visible 5xx, capacity restored on
+the survivor, the registry lease surviving the dead host's held mutex,
+and one host-death incident bundle carrying every dead worker's log
+tail (docs/fleet.md §Multi-host)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal as _signal
+import socket
+import sys
+import time
+
+import pytest
+
+from predictionio_tpu.fleet.gateway import (
+    Gateway,
+    GatewayConfig,
+    GatewayGroup,
+)
+from predictionio_tpu.fleet.hostrt import (
+    DRIVER_CONTAINER,
+    DRIVER_FAKE,
+    DRIVER_LOCAL,
+    DRIVER_SSH,
+    ContainerHostDriver,
+    FakeHostDriver,
+    HostDriver,
+    HostRuntime,
+    HostSpec,
+    LocalHostDriver,
+    SshHostDriver,
+    assign_hosts,
+    make_driver,
+    parse_hosts,
+)
+from predictionio_tpu.fleet.supervisor import (
+    Supervisor,
+    SupervisorConfig,
+    WorkerSpec,
+)
+from predictionio_tpu.fleet.worklog import WorkerLogBook
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tsring import TelemetryRing
+from tests.test_fleet import FakeClock, FakeProc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# inventory parsing + boot-time placement
+# ---------------------------------------------------------------------------
+
+
+class TestParseHosts:
+    def test_bare_entry_means_local_driver(self):
+        (h,) = parse_hosts("box:2")
+        assert h == HostSpec(name="box", slots=2, driver=DRIVER_LOCAL)
+        assert h.connect_ip == "127.0.0.1"
+
+    def test_mixed_inventory(self):
+        hosts = parse_hosts("local:2, ssh@node1:4 ,container@pio-img:1,fake@b:3")
+        assert [h.driver for h in hosts] == [
+            DRIVER_LOCAL,
+            DRIVER_SSH,
+            DRIVER_CONTAINER,
+            DRIVER_FAKE,
+        ]
+        assert [h.slots for h in hosts] == [2, 4, 1, 3]
+
+    def test_ssh_user_at_host_keeps_user_in_address_only(self):
+        (h,) = parse_hosts("ssh@deploy@node1:4")
+        assert h.address == "deploy@node1"  # what ssh dials
+        assert h.name == "node1"  # metric label / placement identity
+        assert h.connect_ip == "node1"  # where the gateway connects
+
+    def test_container_entry_names_the_image_on_loopback(self):
+        (h,) = parse_hosts("container@pio-worker:2")
+        assert h.address == "pio-worker" and h.name == "pio-worker"
+        assert h.connect_ip == "127.0.0.1"  # --network host
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "box",  # no slots
+            "box:none",  # non-integer slots
+            "box:0",  # slots must be >= 1
+            "warp@box:2",  # unknown driver
+            "a:1,a:2",  # duplicate names
+            "@:2",  # empty host
+            "",  # empty inventory
+        ],
+    )
+    def test_malformed_entries_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_hosts(bad)
+
+
+class TestAssignHosts:
+    H = [HostSpec("a", 2), HostSpec("b", 2), HostSpec("c", 4)]
+
+    def test_breadth_first_fills_evenly_by_load_ratio(self):
+        # c has double the slots, so it absorbs workers at half the
+        # ratio cost: 6 workers land 2/2/2 before anyone overfills
+        got = assign_hosts(6, self.H)
+        assert sorted(got) == ["a", "a", "b", "b", "c", "c"]
+        assert got[0] == "a"  # ties break by name
+
+    def test_taken_counts_preexisting_residents(self):
+        got = assign_hosts(2, self.H, taken={"a": 2, "b": 2})
+        assert got == ["c", "c"]
+
+    def test_overfull_inventory_refuses_to_boot(self):
+        with pytest.raises(ValueError, match="slots"):
+            assign_hosts(9, self.H)
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+class TestLocalDriver:
+    def test_spawn_captures_output_in_logbook(self, tmp_path):
+        logbook = WorkerLogBook(str(tmp_path))
+        drv = LocalHostDriver(logbook)
+        host = HostSpec("local", 1)
+        proc = drv.spawn(
+            host, "w0", [sys.executable, "-c", "print('hello from w0')"]
+        )
+        assert proc.wait(timeout=30) == 0
+        assert "hello from w0" in drv.fetch_log_tail(host, "w0")
+
+    def test_probe_never_fails(self):
+        assert LocalHostDriver().probe(HostSpec("local", 1))
+
+
+class TestSshDriver:
+    def test_remote_cmd_tags_worker_and_quotes_env(self):
+        drv = SshHostDriver()
+        cmd = drv._remote_cmd(
+            "w1", ["python", "-m", "pio", "--x", "a b"], {"K": "v w"}
+        )
+        assert cmd.startswith("exec env PIO_WORKER_NAME=w1 ")
+        assert "K='v w'" in cmd and "'a b'" in cmd
+
+    def test_signal_pkills_by_worker_tag(self, monkeypatch):
+        calls: list[list[str]] = []
+
+        def fake_run(argv, **kw):
+            calls.append(list(argv))
+
+            class R:
+                returncode = 0
+
+            return R()
+
+        monkeypatch.setattr(
+            "predictionio_tpu.fleet.hostrt.subprocess.run", fake_run
+        )
+        drv = SshHostDriver()
+        host = HostSpec("node1", 2, driver=DRIVER_SSH, address="u@node1")
+        proc = FakeProc()
+        proc.send_signal = lambda sig: None
+        drv.signal(host, "w3", proc, _signal.SIGTERM)
+        assert calls and calls[0][-2] == "u@node1"
+        assert calls[0][-1] == "pkill -TERM -f PIO_WORKER_NAME=w3"
+
+    def test_probe_false_when_ssh_unreachable(self, monkeypatch):
+        def boom(argv, **kw):
+            raise OSError("no ssh")
+
+        monkeypatch.setattr(
+            "predictionio_tpu.fleet.hostrt.subprocess.run", boom
+        )
+        assert not SshHostDriver().probe(HostSpec("gone", 1, driver=DRIVER_SSH))
+
+
+class TestContainerDriver:
+    def test_container_name_is_engine_safe(self):
+        host = HostSpec("img:tag/x", 1, driver=DRIVER_CONTAINER)
+        assert ContainerHostDriver.container_name(host, "w0") == (
+            "pio-img-tag-x-w0"
+        )
+
+    def test_spawn_argv_runs_the_image(self, monkeypatch):
+        argvs: list[list[str]] = []
+
+        def fake_popen(argv, **kw):
+            argvs.append(list(argv))
+            return FakeProc()
+
+        monkeypatch.setattr(
+            "predictionio_tpu.fleet.hostrt.subprocess.Popen", fake_popen
+        )
+        drv = ContainerHostDriver(engine="docker")
+        host = HostSpec(
+            "pio-img", 1, driver=DRIVER_CONTAINER, address="pio-img"
+        )
+        drv.spawn(host, "w0", ["python", "-m", "pio"], env={"A": "1"})
+        (argv,) = argvs
+        assert argv[:3] == ["docker", "run", "--rm"]
+        assert "pio-img" in argv and "-e" in argv and "A=1" in argv
+        # image before the worker argv
+        assert argv.index("pio-img") < argv.index("python")
+
+
+class TestFakeDriver:
+    def _sleeper(self, drv, host, name):
+        return drv.spawn(
+            host, name, [sys.executable, "-c", "import time; time.sleep(60)"]
+        )
+
+    def test_kill_host_kills_residents_and_fails_probe(self):
+        drv = FakeHostDriver()
+        ha, hb = HostSpec("ha", 2, driver=DRIVER_FAKE), HostSpec(
+            "hb", 2, driver=DRIVER_FAKE
+        )
+        pa = self._sleeper(drv, ha, "w0")
+        pb = self._sleeper(drv, hb, "w1")
+        try:
+            assert drv.probe(ha) and drv.probe(hb)
+            assert drv.kill_host("ha") == 1
+            assert pa.wait(timeout=10) == -_signal.SIGKILL
+            assert pb.poll() is None  # the other host is untouched
+            assert not drv.probe(ha) and drv.probe(hb)
+            with pytest.raises(OSError):
+                self._sleeper(drv, ha, "w2")  # dead boxes refuse spawns
+            drv.revive_host("ha")
+            assert drv.probe(ha)
+            self._sleeper(drv, ha, "w2").kill()
+        finally:
+            pb.kill()
+            pb.wait(timeout=10)
+
+
+class TestHostRuntime:
+    def test_one_shared_driver_instance_per_kind(self):
+        rt = HostRuntime(
+            [
+                HostSpec("a", 1, driver=DRIVER_FAKE),
+                HostSpec("b", 1, driver=DRIVER_FAKE),
+                HostSpec("local", 1),
+            ]
+        )
+        # the fake driver's kill switch must cover both fake hosts
+        assert rt.driver_for("a") is rt.driver_for("b")
+        assert rt.driver_for("local") is not rt.driver_for("a")
+        assert rt.total_slots() == 3
+
+    def test_unknown_host_raises(self):
+        rt = HostRuntime([HostSpec("a", 1)])
+        with pytest.raises(KeyError, match="unknown host"):
+            rt.host("zz")
+
+    def test_probe_wraps_driver_exceptions_as_down(self):
+        class Exploding(HostDriver):
+            def probe(self, host):
+                raise RuntimeError("driver bug")
+
+        rt = HostRuntime(
+            [HostSpec("a", 1, driver=DRIVER_FAKE)],
+            drivers={DRIVER_FAKE: Exploding()},
+        )
+        assert rt.probe("a") is False
+
+    def test_make_driver_rejects_unknown(self):
+        with pytest.raises(ValueError):
+            make_driver("warp")
+
+
+# ---------------------------------------------------------------------------
+# supervisor host supervision (fake clock, no real processes)
+# ---------------------------------------------------------------------------
+
+
+class SwitchDriver(HostDriver):
+    """Probe/tail/signal controlled by the test; spawning goes through
+    the supervisor's own spawn callable, exactly like launch.py."""
+
+    kind = DRIVER_FAKE
+
+    def __init__(self):
+        self.alive: dict[str, bool] = {}
+        self.signals: list[tuple[str, str, int]] = []
+
+    def signal(self, host, name, handle, sig):
+        self.signals.append((host.name, name, sig))
+        if sig == _signal.SIGKILL:
+            handle.kill()
+        else:
+            handle.terminate()
+
+    def fetch_log_tail(self, host, name, max_bytes=8192):
+        return f"dying words of {name}"
+
+    def probe(self, host):
+        return self.alive.get(host.name, True)
+
+
+def _host_sup(placement=("ha", "ha", "hb"), **cfg_kw):
+    cfg = SupervisorConfig(
+        poll_interval_s=0.1,
+        backoff_base_s=1.0,
+        backoff_multiplier=2.0,
+        backoff_max_s=60.0,
+        crash_loop_window_s=1e9,
+        crash_loop_budget=99,
+        healthy_reset_s=1e9,
+        host_probe_interval_s=5.0,
+        **cfg_kw,
+    )
+    clock = FakeClock()
+    drv = SwitchDriver()
+    rt = HostRuntime(
+        [
+            HostSpec("ha", 2, driver=DRIVER_FAKE),
+            HostSpec("hb", 2, driver=DRIVER_FAKE),
+        ],
+        drivers={DRIVER_FAKE: drv},
+    )
+    spawned: list[FakeProc] = []
+
+    def spawn(spec):
+        p = FakeProc()
+        spawned.append(p)
+        return p
+
+    deaths: list[dict] = []
+    crashes: list[dict] = []
+    sup = Supervisor(
+        spawn,
+        [
+            WorkerSpec(f"w{i}", 9000 + i, host=h)
+            for i, h in enumerate(placement)
+        ],
+        cfg,
+        clock=clock,
+        runtime=rt,
+        on_crash=crashes.append,
+        on_host_down=deaths.append,
+    )
+    return sup, spawned, clock, drv, deaths, crashes
+
+
+class TestSupervisorHostDeath:
+    def test_host_death_is_one_transition_with_every_resident(self):
+        sup, spawned, clock, drv, deaths, crashes = _host_sup()
+        sup.start()
+        assert len(spawned) == 3
+        # pull host ha's cord: both residents die in the same tick and
+        # the immediate probe fails
+        drv.alive["ha"] = False
+        spawned[0].exit(-9)
+        spawned[1].exit(-9)
+        clock.advance(0.1)
+        sup.tick()
+        assert len(deaths) == 1, "host death must be ONE notification"
+        info = deaths[0]
+        assert info["host"] == "ha" and info["deaths"] == 1
+        assert sorted(w["replica"] for w in info["workers"]) == ["w0", "w1"]
+        for w in info["workers"]:
+            assert w["logTail"] == f"dying words of {w['replica']}"
+        assert crashes == [], "residents must not file individual crashes"
+        census = sup.host_census()
+        assert not census["ha"]["up"] and census["ha"]["deaths"] == 1
+        assert census["hb"]["up"]
+        # residents of the dead box are NOT respawned while it is down,
+        # even after their restart clocks elapse
+        clock.advance(1.5)
+        sup.tick()
+        assert len(spawned) == 3
+        text = sup.metrics.render_prometheus()
+        assert 'pio_fleet_host_up{host="ha"} 0' in text
+        assert 'pio_fleet_host_deaths_total{host="ha"} 1' in text
+
+    def test_probe_recovery_readmits_and_respawns_residents(self):
+        sup, spawned, clock, drv, deaths, _ = _host_sup()
+        sup.start()
+        drv.alive["ha"] = False
+        spawned[0].exit(-9)
+        spawned[1].exit(-9)
+        clock.advance(0.1)
+        sup.tick()
+        assert len(deaths) == 1
+        drv.alive["ha"] = True
+        clock.advance(5.1)  # past the periodic probe interval + backoff
+        sup.tick()
+        census = sup.host_census()
+        assert census["ha"]["up"]
+        assert len(spawned) == 5  # both residents respawned
+
+    def test_host_backoff_ladder_escalates_with_deaths(self):
+        sup, spawned, clock, drv, deaths, _ = _host_sup()
+        sup.start()
+        for expected_backoff in (1.0, 2.0, 4.0):  # base * mult^(deaths-1)
+            drv.alive["ha"] = False
+            for w in sup._workers:
+                if w.spec.host == "ha" and w.proc is not None:
+                    w.proc.exit(-9)
+            clock.advance(0.1)
+            sup.tick()
+            t_death = clock.now
+            for w in sup._workers:
+                if w.spec.host == "ha":
+                    assert w.next_restart_at == pytest.approx(
+                        t_death + expected_backoff
+                    )
+            drv.alive["ha"] = True
+            clock.advance(5.1 + expected_backoff)
+            sup.tick()  # readmit + respawn for the next round
+        assert len(deaths) == 3 and deaths[-1]["deaths"] == 3
+
+    def test_single_exit_on_live_host_is_a_plain_crash(self):
+        sup, spawned, clock, drv, deaths, crashes = _host_sup()
+        sup.start()
+        spawned[0].exit(1)
+        clock.advance(0.1)
+        sup.tick()
+        assert deaths == []
+        assert len(crashes) == 1 and crashes[0]["replica"] == "w0"
+        assert sup.host_census()["ha"]["up"]
+
+    def test_simultaneous_exits_with_passing_probe_are_crashes(self):
+        # both residents die together but the box answers its probe:
+        # that is two worker crashes, not a host death
+        sup, spawned, clock, drv, deaths, crashes = _host_sup()
+        sup.start()
+        spawned[0].exit(1)
+        spawned[1].exit(1)
+        clock.advance(0.1)
+        sup.tick()
+        assert deaths == []
+        assert sorted(c["replica"] for c in crashes) == ["w0", "w1"]
+
+
+class TestSupervisorHostPlacement:
+    def test_pick_host_prefers_free_headroom_on_up_hosts(self):
+        sup, spawned, clock, drv, _, _ = _host_sup(placement=("ha", "ha", "hb"))
+        sup.start()
+        assert sup.pick_host() == "hb"  # ha is full (2/2)
+        drv.alive["hb"] = False
+        clock.advance(5.1)
+        sup.tick()  # periodic probe declares hb down
+        assert sup.pick_host() is None  # only full ha remains up
+        # the fleet refuses to place on a dead or unknown box
+        with pytest.raises(ValueError, match="unknown host"):
+            sup.add_worker(WorkerSpec("w9", 9999, host="zz"))
+
+    def test_scale_out_on_picked_host_is_supervised(self):
+        sup, spawned, clock, drv, _, _ = _host_sup(placement=("ha", "ha"))
+        sup.start()
+        target = sup.pick_host()
+        assert target == "hb"
+        sup.add_worker(WorkerSpec("w9", 9999, host=target))
+        assert len(spawned) == 3
+        census = sup.host_census()
+        assert census["hb"]["resident"] == ["w9"]
+        text = sup.metrics.render_prometheus()
+        assert 'pio_fleet_worker_host_info{replica="w9",host="hb"} 1' in text
+
+    def test_signals_route_through_the_host_driver(self):
+        sup, spawned, clock, drv, _, _ = _host_sup(placement=("ha", "hb"))
+        sup.start()
+        sup.stop()
+        sent = {(h, n) for h, n, sig in drv.signals if sig == _signal.SIGTERM}
+        assert sent == {("ha", "w0"), ("hb", "w1")}
+
+    def test_snapshot_carries_the_home_host(self):
+        sup, _, _, _, _, _ = _host_sup(placement=("ha", "hb"))
+        sup.start()
+        assert [s["host"] for s in sup.snapshot()] == ["ha", "hb"]
+
+
+# ---------------------------------------------------------------------------
+# gateway tier: ring writer namespacing, group fan-out, peer fan-in
+# ---------------------------------------------------------------------------
+
+
+class TestRingWriterNamespacing:
+    def test_two_writers_never_share_a_segment_file(self, tmp_path):
+        d = str(tmp_path)
+        g0 = TelemetryRing(d, segment_records=2, writer_id="g0")
+        g1 = TelemetryRing(d, segment_records=2, writer_id="g1")
+        g0.append({"t": 1.0, "v": "a"})
+        g1.append({"t": 2.0, "v": "b"})
+        g0.append({"t": 3.0, "v": "c"})
+        g1.append({"t": 4.0, "v": "d"})
+        g0.close()
+        g1.close()
+        names = sorted(os.listdir(d))
+        assert all("-g0-" in n or "-g1-" in n for n in names), names
+        # a fresh reader merges every writer's segments by record time
+        merged = TelemetryRing(d).records()
+        assert [r["v"] for r in merged] == ["a", "b", "c", "d"]
+        assert {r["writer"] for r in merged} == {"g0", "g1"}
+
+    def test_single_writer_layout_is_unchanged(self, tmp_path):
+        d = str(tmp_path)
+        ring = TelemetryRing(d, segment_records=4)
+        for i in range(3):
+            ring.append({"v": i})
+        ring.close()
+        (name,) = os.listdir(d)
+        assert name == "seg-00000.jsonl"  # pre-PR-17 naming, byte-for-byte
+        assert [r["v"] for r in TelemetryRing(d).records()] == [0, 1, 2]
+
+    def test_writer_id_must_be_label_safe(self, tmp_path):
+        with pytest.raises(ValueError):
+            TelemetryRing(str(tmp_path), writer_id="g0/../../etc")
+
+
+class TestGatewayGroup:
+    def _gw(self, port):
+        return Gateway(
+            GatewayConfig(
+                ip="127.0.0.1",
+                port=port,
+                replica_urls=("http://127.0.0.1:1",),
+            ),
+            metrics=MetricsRegistry(),
+        )
+
+    def test_membership_changes_fan_out_to_every_gateway(self):
+        g0, g1 = self._gw(free_port()), self._gw(free_port())
+        group = GatewayGroup([g0, g1])
+        group.add_replica("http://127.0.0.1:2", worker_class="device")
+        assert len(g0.replicas) == len(g1.replicas) == 2
+        group.retire_replica("http://127.0.0.1:2")
+        assert len(g0.replicas) == len(g1.replicas) == 1
+
+    def test_everything_else_delegates_to_the_primary(self):
+        g0, g1 = self._gw(free_port()), self._gw(free_port())
+        group = GatewayGroup([g0, g1])
+        assert group.primary is g0
+        assert group.config is g0.config
+        with pytest.raises(ValueError):
+            GatewayGroup([])
+
+
+class TestGatewayPeerFanIn:
+    def test_slo_fans_in_peers_and_reports_lost_ones(self):
+        # two shared-nothing gateways behind an imaginary balancer: /slo
+        # on either answers for the tier; a dead peer is REPORTED as an
+        # error entry, never silently dropped (the balancer-misroute /
+        # gateway-peer-loss evidence row in docs/fleet.md)
+        p0, p1 = free_port(), free_port()
+        backend = f"http://127.0.0.1:{free_port()}"
+
+        def gw(port, gid, peer_port):
+            return Gateway(
+                GatewayConfig(
+                    ip="127.0.0.1",
+                    port=port,
+                    replica_urls=(backend,),
+                    probe_interval_s=30.0,
+                    probe_timeout_s=1.0,
+                    telemetry_interval_s=0,
+                    gateway_id=gid,
+                    peer_urls=(f"http://127.0.0.1:{peer_port}",),
+                ),
+                metrics=MetricsRegistry(),
+            )
+
+        g0, g1 = gw(p0, "g0", p1), gw(p1, "g1", p0)
+
+        async def body():
+            import aiohttp
+
+            await g0.start()
+            await g1.start()
+            session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=5)
+            )
+            try:
+                async with session.get(
+                    f"http://127.0.0.1:{p0}/slo"
+                ) as resp:
+                    tier = await resp.json()
+                assert tier["gateway"] == "g0"
+                peer_key = f"http://127.0.0.1:{p1}"
+                assert "error" not in tier["peers"][peer_key], tier["peers"]
+                # ?local=1 answers without recursing into peers
+                async with session.get(
+                    f"http://127.0.0.1:{p0}/slo?local=1"
+                ) as resp:
+                    local = await resp.json()
+                assert "peers" not in local
+                # traces fan-in stays well-formed with peers configured
+                async with session.get(
+                    f"http://127.0.0.1:{p0}/traces/recent?limit=5"
+                ) as resp:
+                    assert isinstance((await resp.json())["spans"], list)
+                # kill the peer: the tier view must surface the loss
+                await g1.stop()
+                async with session.get(
+                    f"http://127.0.0.1:{p0}/slo"
+                ) as resp:
+                    tier = await resp.json()
+                assert "error" in tier["peers"][peer_key]
+            finally:
+                await session.close()
+                await g0.stop()
+                try:
+                    await g1.stop()
+                except Exception:
+                    pass
+
+        asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# pio top --fleet: the host census block
+# ---------------------------------------------------------------------------
+
+
+class TestTopHostCensus:
+    # mirrors the real exposition: the GATEWAY keys replica rows by
+    # address, the SUPERVISOR keys worker rows by name — the census must
+    # read liveness from the worker-named series (live-fleet regression)
+    TEXT = (
+        "pio_fleet_replicas 3\n"
+        'pio_fleet_replica_up{replica="127.0.0.1:8101"} 0\n'
+        'pio_fleet_replica_up{replica="127.0.0.1:8102"} 0\n'
+        'pio_fleet_replica_up{replica="127.0.0.1:8103"} 1\n'
+        'pio_fleet_worker_up{replica="w0"} 0\n'
+        'pio_fleet_worker_up{replica="w1"} 0\n'
+        'pio_fleet_worker_up{replica="w2"} 1\n'
+        'pio_fleet_host_up{host="ha"} 0\n'
+        'pio_fleet_host_slots{host="ha"} 2\n'
+        'pio_fleet_host_deaths_total{host="ha"} 1\n'
+        'pio_fleet_host_up{host="hb"} 1\n'
+        'pio_fleet_host_slots{host="hb"} 2\n'
+        'pio_fleet_worker_host_info{replica="w0",host="ha"} 1\n'
+        'pio_fleet_worker_host_info{replica="w1",host="ha"} 1\n'
+        'pio_fleet_worker_host_info{replica="w2",host="hb"} 1\n'
+    )
+
+    def test_summary_groups_replicas_by_host(self):
+        from predictionio_tpu.tools.top import parse_prometheus, summarize
+
+        fleet = summarize(parse_prometheus(self.TEXT))["fleet"]
+        assert fleet["hosts"]["ha"] == {
+            "residents": ["w0", "w1"],
+            "residents_up": 0,
+            "up": False,
+            "slots": 2.0,
+            "deaths": 1.0,
+        }
+        assert fleet["hosts"]["hb"]["up"] is True
+        assert fleet["hosts"]["hb"]["residents_up"] == 1
+
+    def test_render_marks_the_dead_host(self):
+        from predictionio_tpu.tools.top import (
+            parse_prometheus,
+            render,
+            summarize,
+        )
+
+        screen = render(
+            summarize(parse_prometheus(self.TEXT)), "http://gw:8000"
+        )
+        (ha_line,) = [
+            ln for ln in screen.splitlines() if ln.strip().startswith("host")
+            and " ha " in ln
+        ]
+        assert "HOST-DOWN" in ha_line and "deaths 1" in ha_line
+        assert "0/2 slots" in ha_line
+        (hb_line,) = [
+            ln for ln in screen.splitlines() if ln.strip().startswith("host")
+            and " hb " in ln
+        ]
+        assert "HOST-DOWN" not in hb_line and "1/2 slots" in hb_line
+
+
+# ---------------------------------------------------------------------------
+# e2e: kill an entire host mid-rollout (the PR-17 acceptance gate)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestKillHostMidRolloutE2E:
+    """Two fake-driver hosts, four real worker processes, a real gateway
+    under real load. Pull host ha's cord mid-bake: the surviving lane
+    must never 5xx, the supervisor must fold both deaths into ONE
+    host-death incident bundle carrying each dead worker's log tail,
+    the host-aware scale-out path must restore capacity on the
+    survivor, and a registry transition must steal the lease the dead
+    host's holder never released."""
+
+    def test_kill_host_mid_rollout(self, tmp_path, monkeypatch):
+        from predictionio_tpu.data.storage.registry import Storage
+        from predictionio_tpu.registry.store import ArtifactStore
+        from tests.test_registry import _train_version
+
+        monkeypatch.setenv("PIO_REGISTRY_LEASE_TTL", "2.0")
+        basedir = str(tmp_path / "store")
+        registry_dir = str(tmp_path / "registry")
+        storage = Storage(env={"PIO_FS_BASEDIR": basedir})
+        _train_version(storage, registry_dir, algo_id=3)  # v000001 stable
+        _train_version(storage, registry_dir, algo_id=5)  # v000002
+        store = ArtifactStore(registry_dir)
+
+        from predictionio_tpu.fleet.launch import (
+            build_obs_plane,
+            wire_incident_sources,
+        )
+
+        metrics = MetricsRegistry()
+        obs_dir = str(tmp_path / "obs")
+        obs = build_obs_plane(obs_dir, metrics, registry_dir=registry_dir)
+
+        fake = FakeHostDriver(obs["logbook"])
+        runtime = HostRuntime(
+            [
+                HostSpec("ha", 2, driver=DRIVER_FAKE),
+                HostSpec("hb", 3, driver=DRIVER_FAKE),
+            ],
+            logbook=obs["logbook"],
+            drivers={DRIVER_FAKE: fake},
+        )
+        placement = assign_hosts(4, runtime.hosts())
+        specs = [
+            WorkerSpec(f"w{i}", free_port(), host=placement[i])
+            for i in range(4)
+        ]
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            # long enough that the host death lands MID-bake
+            "FLEET_BAKE_WINDOW": "30.0",
+            "FLEET_BAKE_MIN": "100000",
+            "PIO_FS_BASEDIR": basedir,
+            "PIO_REGISTRY_LEASE_TTL": "2.0",
+        }
+
+        def spawn(spec):
+            return runtime.spawn_worker(
+                spec.host,
+                spec.name,
+                [
+                    sys.executable,
+                    os.path.join(REPO, "tests", "fleet_worker.py"),
+                    registry_dir,
+                    str(spec.port),
+                    basedir,
+                ],
+                env=env,
+            )
+
+        def on_host_down(info: dict) -> None:
+            # mirror of launch.py's closure: ONE bundle per host death,
+            # each dead worker's tail as its own text part
+            texts = {}
+            for winfo in info.get("workers", []):
+                tail = winfo.pop("logTail", "")
+                if tail:
+                    texts[f"log_tail_{winfo['replica']}"] = tail
+            obs["incidents"].trigger("host-death", context=info, texts=texts)
+
+        sup = Supervisor(
+            spawn,
+            specs,
+            SupervisorConfig(
+                poll_interval_s=0.1,
+                backoff_base_s=0.2,
+                term_grace_s=8.0,
+                host_probe_interval_s=0.5,
+            ),
+            metrics=metrics,
+            logbook=obs["logbook"],
+            on_crash=obs["on_crash"],
+            runtime=runtime,
+            on_host_down=on_host_down,
+        )
+        gw = Gateway(
+            GatewayConfig(
+                ip="127.0.0.1",
+                port=free_port(),
+                replica_urls=tuple(s.url for s in specs),
+                probe_interval_s=0.2,
+                probe_timeout_s=1.0,
+                request_timeout_s=8.0,
+                telemetry_interval_s=0.2,
+            ),
+            metrics=metrics,
+            telemetry=obs["telemetry"],
+            incidents=obs["incidents"],
+        )
+        wire_incident_sources(obs["incidents"], gw, sup)
+        results: dict = {"statuses": [], "errors": []}
+        try:
+            asyncio.run(
+                self._drive(sup, gw, store, runtime, fake, results, specs)
+            )
+        finally:
+            sup.stop()
+            obs["telemetry"].close()
+        fivexx = [s for s in results["statuses"] if s >= 500]
+        assert fivexx == [], (
+            f"{len(fivexx)} 5xx under host loss "
+            f"(of {len(results['statuses'])} requests): "
+            f"{results.get('bodies_5xx', [])[:5]}"
+        )
+        assert results["errors"] == []
+        assert len(results["statuses"]) > 50
+        # the lease the dead host's holder never released was stolen
+        # with a fresh fencing token, and the transition went through
+        assert results["lease_gen_after"] > results["lease_gen_foreign"]
+        self._assert_host_death_bundle(obs_dir, results["dead"])
+        text = metrics.render_prometheus()
+        assert 'pio_fleet_host_up{host="ha"} 0' in text
+        assert 'pio_fleet_host_deaths_total{host="ha"} 1' in text
+
+    def _assert_host_death_bundle(self, obs_dir, dead_names) -> None:
+        from predictionio_tpu.obs.incidents import list_bundles, load_bundle
+
+        inc_dir = os.path.join(obs_dir, "incidents")
+        refs = list_bundles(inc_dir)
+        host_deaths = [r for r in refs if r.trigger == "host-death"]
+        assert len(host_deaths) == 1, (
+            f"expected ONE host-death bundle, got "
+            f"{[r.trigger for r in refs]}"
+        )
+        bundle = load_bundle(inc_dir, host_deaths[0].bundle_id)
+        ctx = bundle["manifest"]["context"]
+        assert ctx["host"] == "ha" and ctx["deaths"] == 1
+        assert sorted(w["replica"] for w in ctx["workers"]) == sorted(
+            dead_names
+        )
+        for name in dead_names:
+            tail = bundle["texts"].get(f"log_tail_{name}", "")
+            assert "fleet worker serving" in tail, (
+                f"{name}'s dying words missing from the bundle: {tail!r}"
+            )
+        # the host death must NOT also file per-worker crash bundles
+        crash = [r for r in refs if r.trigger == "worker-crash"]
+        assert crash == [], "host death leaked individual crash bundles"
+
+    async def _drive(
+        self, sup, gw, store, runtime, fake, results, specs
+    ) -> None:
+        import aiohttp
+
+        from predictionio_tpu.registry.lease import LeaseMutex, LeaseRecord
+
+        sup.start()
+        sup_task = asyncio.ensure_future(sup.run())
+        await gw.start()
+        gw_url = f"http://127.0.0.1:{gw.config.port}"
+        session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=10)
+        )
+        stop_load = asyncio.Event()
+        load_task = None
+        try:
+            for spec in sup.workers:
+                await self._wait_ready(session, spec.url, 120.0)
+            load_task = asyncio.ensure_future(
+                self._load(session, gw_url, stop_load, results)
+            )
+            await asyncio.sleep(0.3)
+            # stage the canary THROUGH the gateway: the host death must
+            # land mid-rollout
+            async with session.post(
+                f"{gw_url}/models/candidate",
+                json={"version": "v000002", "mode": "canary", "fraction": 0.4},
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+            # a holder on the soon-to-die host grabbed the registry
+            # lease and will never release it
+            lease_path = store._lease_for("regtest").path
+            foreign = LeaseMutex(lease_path, owner="ha-holder", ttl_s=2.0)
+            cur = foreign.read()
+            rec = LeaseRecord(
+                owner="ha-holder",
+                generation=cur.generation + 1,
+                acquired_at=time.time(),
+                ttl_s=2.0,
+                host="host-ha",  # not this box: no same-host fast steal
+                pid=999999,
+            )
+            foreign._write(rec)
+            results["lease_gen_foreign"] = rec.generation
+            # pull the cord on ha
+            dead = [s.name for s in specs if s.host == "ha"]
+            results["dead"] = dead
+            assert fake.kill_host("ha") == len(dead)
+            # the gateway ejects both residents inside the probe window
+            survivors = len(specs) - len(dead)
+            await self._poll_async(
+                lambda: self._gw_healthy_count(session, gw_url, survivors),
+                "dead host's replicas never ejected",
+                10.0,
+            )
+            # the survivor-side transition steals the dead holder's
+            # lease (TTL expiry) instead of deadlocking on it
+            def transition() -> int:
+                with store._state_mutex("regtest"):
+                    mx = store._leases[store.engine_key("regtest")]
+                    return mx.generation
+
+            gen = await asyncio.get_running_loop().run_in_executor(
+                None, transition
+            )
+            results["lease_gen_after"] = gen
+            # host-aware scale-out restores capacity on the survivor
+            await self._poll_async_sync(
+                lambda: sup.pick_host() == "hb",
+                "pick_host never settled on the survivor",
+                10.0,
+            )
+            replacement = WorkerSpec("w4", free_port(), host="hb")
+            await asyncio.get_running_loop().run_in_executor(
+                None, sup.add_worker, replacement
+            )
+            gw.add_replica(replacement.url, replacement.worker_class)
+            await self._poll_async(
+                lambda: self._gw_healthy_count(
+                    session, gw_url, survivors + 1
+                ),
+                "replacement capacity never came up on the survivor",
+                120.0,
+            )
+        finally:
+            stop_load.set()
+            if load_task is not None:
+                await asyncio.gather(load_task, return_exceptions=True)
+            sup_task.cancel()
+            await asyncio.gather(sup_task, return_exceptions=True)
+            await session.close()
+            await gw.stop()
+
+    async def _load(self, session, gw_url, stop, results) -> None:
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                async with session.post(
+                    f"{gw_url}/queries.json",
+                    json={"qid": i, "user": f"u{i % 40}"},
+                ) as resp:
+                    body = await resp.read()
+                    results["statuses"].append(resp.status)
+                    if resp.status >= 500:
+                        results.setdefault("bodies_5xx", []).append(
+                            body[:120].decode("utf-8", "replace")
+                        )
+            except Exception as exc:  # the gateway must never drop us
+                results["errors"].append(repr(exc))
+            await asyncio.sleep(0.01)
+
+    async def _wait_ready(self, session, url, deadline_s) -> None:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                async with session.get(f"{url}/healthz") as resp:
+                    if resp.status == 200:
+                        return
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, f"{url} never became ready"
+            await asyncio.sleep(0.25)
+
+    async def _poll_async(self, cond, message, deadline_s) -> None:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                ok = await cond()
+            except Exception:
+                ok = False
+            if ok:
+                return
+            assert time.monotonic() < deadline, message
+            await asyncio.sleep(0.1)
+
+    async def _poll_async_sync(self, cond, message, deadline_s) -> None:
+        deadline = time.monotonic() + deadline_s
+        while not cond():
+            assert time.monotonic() < deadline, message
+            await asyncio.sleep(0.1)
+
+    async def _gw_healthy_count(self, session, gw_url, expect) -> bool:
+        async with session.get(f"{gw_url}/healthz") as resp:
+            data = await resp.json()
+            return data.get("replicasHealthy") == expect
