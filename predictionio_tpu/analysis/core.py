@@ -142,6 +142,12 @@ _ASYNC_ENTRY_GLOBS = (
     "*/fleet/*.py",
     "*/data/api/*.py",
     "*/workflow/create_server.py",
+    # the profiling plane (ISSUE 18): capture/publish do real file I/O and
+    # the sampler walks every thread's frames — any async def that grows
+    # here (or any handler that calls into them without an executor hop)
+    # must prove its blocking work runs off the event loop
+    "*/obs/profiler.py",
+    "*/obs/sampler.py",
 )
 
 DEFAULT_ENTRY_POINTS: tuple[EntryPoint, ...] = (

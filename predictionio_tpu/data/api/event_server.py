@@ -33,6 +33,7 @@ import base64
 import contextvars
 import dataclasses
 import logging
+import os
 import time
 from typing import Any
 
@@ -44,6 +45,12 @@ from predictionio_tpu.data.event import Event, parse_event_time
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.data.storage.traced import trace_dao
 from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.profiler import (
+    ProfileBusyError,
+    ProfileSession,
+    ProfileStore,
+)
+from predictionio_tpu.obs.sampler import HostSampler
 from predictionio_tpu.obs.tracing import (
     TRACE_HEADER,
     Tracer,
@@ -117,6 +124,15 @@ class EventServerConfig:
     # of collection-API answers, evaluated as multi-window burn rates on
     # /slo and the pio_slo_* gauges
     slo_availability_objective: float = 0.999
+    # -- profiling plane (docs/observability.md §Profiling plane) ----------
+    # the event server carries the same POST /profile/capture + GET
+    # /profile/stacks surface as the query server: ingest stalls profile
+    # the same way serving stalls do
+    profile_dir: str = "pio_obs/profiles"
+    profile_max_bundles: int = 20
+    profile_default_ms: int = 500
+    profile_max_ms: int = 10_000
+    sampler_period_s: float = 0.05
 
     def ssl_context(self):
         from predictionio_tpu.utils.tls import server_ssl_context
@@ -220,6 +236,63 @@ class EventServer:
             ),
         )
         self.metrics.register_collector(self.slo.collect)
+        # profiling plane (obs/profiler + obs/sampler): the ingest tier's
+        # host threads (event loop + executor pool) sample into the same
+        # folded-stack format the query server exports
+        self.sampler = HostSampler(
+            period_s=self.config.sampler_period_s
+            if self.config.sampler_period_s > 0
+            else 0.05,
+            metrics=self.metrics,
+        )
+        self.profiler = ProfileSession(
+            ProfileStore(
+                self.config.profile_dir, self.config.profile_max_bundles
+            ),
+            default_ms=self.config.profile_default_ms,
+            max_ms=self.config.profile_max_ms,
+            context_fn=lambda: {"server": "event", "port": self.config.port},
+            metrics=self.metrics,
+        )
+
+    def _capture_profile(self, ms: int | None) -> str:
+        # executor-thread side: trace sleep + bundle file writes stay off
+        # the event loop
+        return self.profiler.capture(
+            ms=ms, trigger="manual", parts={"stacks": self.sampler.snapshot()}
+        )
+
+    async def handle_profile_capture(self, request: web.Request) -> web.Response:
+        raw_ms = request.query.get("ms")
+        try:
+            ms = int(raw_ms) if raw_ms is not None else None
+        except ValueError:
+            return _json_error(400, "ms must be an integer")
+        try:
+            path = await asyncio.get_running_loop().run_in_executor(
+                None, self._capture_profile, ms
+            )
+        except ProfileBusyError:
+            return _json_error(409, "a profile capture is already in flight")
+        except Exception as exc:  # noqa: BLE001 - surface, don't 500-blank
+            logger.exception("profile capture failed")
+            return _json_error(500, f"capture failed: {exc}")
+        return web.json_response(
+            {
+                "bundle": os.path.basename(path),
+                "path": path,
+                "durationMs": self.profiler.clamp_ms(ms),
+            }
+        )
+
+    async def handle_profile_stacks(self, request: web.Request) -> web.Response:
+        if request.query.get("format") == "json":
+            body = self.sampler.snapshot()
+            body["hotspots"] = self.sampler.hotspots()
+            return web.json_response(body)
+        return web.Response(
+            text=self.sampler.folded(), content_type="text/plain"
+        )
 
     @staticmethod
     def _route_label(request: web.Request) -> str:
@@ -639,6 +712,8 @@ class EventServer:
                 web.get("/metrics", self.handle_metrics),
                 web.get("/slo", self.handle_slo),
                 web.get("/traces/recent", self.handle_traces_recent),
+                web.post("/profile/capture", self.handle_profile_capture),
+                web.get("/profile/stacks", self.handle_profile_stacks),
                 web.post("/events.json", self.handle_post_event),
                 web.get("/events.json", self.handle_get_events),
                 web.get("/events/{event_id}.json", self.handle_get_event),
@@ -665,11 +740,14 @@ class EventServer:
             ssl_context=self.config.ssl_context(),
         )
         await site.start()
+        if self.config.sampler_period_s > 0:
+            self.sampler.start()
         logger.info(
             "Event server started on %s:%d", self.config.ip, self.config.port
         )
 
     async def stop(self) -> None:
+        self.sampler.stop()
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

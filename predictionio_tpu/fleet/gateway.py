@@ -82,6 +82,7 @@ from predictionio_tpu.fleet.federation import federate_metrics
 from predictionio_tpu.fleet.supervisor import REPLICA_CLASS_CPU
 from predictionio_tpu.obs.incidents import IncidentRecorder
 from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.sampler import HostSampler
 from predictionio_tpu.obs.slo import DEFAULT_WINDOWS, SLOEngine
 from predictionio_tpu.obs.tsring import TelemetryRing
 from predictionio_tpu.obs.tracing import (
@@ -326,6 +327,10 @@ class Gateway:
         self._add_fleet_slos()
         m.register_collector(self.slo.collect)
         self._slo_alerting: dict[str, bool] = {}
+        # the gateway tier samples its own host threads (event loop +
+        # executor pool): GET /profile/stacks answers "is the gateway or
+        # the replica slow" without touching a replica
+        self.sampler = HostSampler(metrics=m)
         # trace fan-in cache: replica name -> last fetched span dicts.
         # Refreshed per telemetry tick and on /traces/recent; NEVER
         # cleared on fetch failure — a dead replica's final spans are
@@ -469,14 +474,19 @@ class Gateway:
         fall back to inline capture."""
         if self.incidents is None:
             return
+        # profile-on-alert: the incident leaves with the gateway's folded
+        # host stacks attached — snapshotted NOW (cheap, in-memory), not
+        # on the executor, so the stacks show the moment of the alert
+        texts = {"stacks_folded": self.sampler.folded()}
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             # pio-lint: disable=async-blocking-call -- RuntimeError branch: no loop is running here, inline capture cannot stall one
-            self.incidents.trigger(kind, context=context)
+            self.incidents.trigger(kind, context=context, texts=texts)
             return
         loop.run_in_executor(
-            None, lambda: self.incidents.trigger(kind, context=context)
+            None,
+            lambda: self.incidents.trigger(kind, context=context, texts=texts),
         )
 
     def _on_breaker_transition(self, name: str, old: str, new: str) -> None:
@@ -948,6 +958,27 @@ class Gateway:
             return web.json_response({"message": "unknown action"}, status=404)
         return await self._proxy_admin(request, "POST", f"/models/{action}")
 
+    async def handle_profile_capture(self, request: web.Request) -> web.Response:
+        """Fan a device capture out to exactly ONE replica (the
+        single-flight lives server-side; a broadcast would trip every
+        replica's 409 rail at once). ``?ms=`` and friends pass through."""
+        path = "/profile/capture"
+        if request.query_string:
+            path += "?" + request.query_string
+        return await self._proxy_admin(request, "POST", path)
+
+    async def handle_profile_stacks(self, request: web.Request) -> web.Response:
+        """The GATEWAY's own host stacks (folded; ``?format=json`` for
+        the structured view) — replica stacks live on each replica's own
+        /profile/stacks."""
+        if request.query.get("format") == "json":
+            body = self.sampler.snapshot()
+            body["hotspots"] = self.sampler.hotspots()
+            return web.json_response(body)
+        return web.Response(
+            text=self.sampler.folded(), content_type="text/plain"
+        )
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """Federated fleet scrape: every reachable replica's /metrics
         merged (counters summed, histogram buckets added) plus the
@@ -1361,17 +1392,21 @@ class Gateway:
                 web.post("/queries.json", self.handle_queries),
                 web.get("/models", self.handle_models),
                 web.post("/models/{action}", self.handle_models_post),
+                web.post("/profile/capture", self.handle_profile_capture),
+                web.get("/profile/stacks", self.handle_profile_stacks),
                 web.post("/stop", self.handle_stop),
             ]
         )
 
         async def _start_loops(app: web.Application) -> None:
+            self.sampler.start()
             self._probe_task = asyncio.ensure_future(self._probe_loop())
             self._telemetry_task = asyncio.ensure_future(
                 self._telemetry_loop()
             )
 
         async def _cleanup(app: web.Application) -> None:
+            self.sampler.stop()
             tasks = [self._probe_task, self._telemetry_task]
             self._probe_task = None
             self._telemetry_task = None

@@ -529,6 +529,9 @@ def wire_incident_sources(
     incidents.add_source("traces", lambda: gateway.cached_spans()[:400])
     incidents.add_source("fleet", gateway.fleet_snapshot)
     incidents.add_source("supervisor", supervisor.snapshot)
+    # profile-on-alert (obs/sampler): every incident kind — not just the
+    # gateway's own slo-alert path — carries the gateway host-stack view
+    incidents.add_source("hoststacks", gateway.sampler.snapshot)
 
 
 __all__ = [

@@ -23,11 +23,22 @@ be *operable* at fleet scale (see ``docs/observability.md``):
   per-iteration step profiler (``pio_train_*`` metrics, ``train.step``
   spans, profiles attached to registry manifests), the HBM capacity
   planner behind ``pio doctor --capacity``, and the sharding inspector.
+- :mod:`predictionio_tpu.obs.profiler` — on-demand XLA device-trace
+  capture: single-flight, duration-bounded, published as
+  content-addressed profile bundles (``POST /profile/capture``,
+  ``pio profile``); absorbs the ``PIO_PROFILE_DIR`` training gate.
+- :mod:`predictionio_tpu.obs.sampler` — always-on host stack sampler
+  with thread-role attribution and folded-stack output
+  (``GET /profile/stacks``, ``pio top --hotspots``), self-measured to
+  stay under 1% CPU.
+- :mod:`predictionio_tpu.obs.costmodel` — device-free roofline from
+  ``compiled.cost_analysis()`` flops/bytes per registered jit bucket
+  (``pio doctor --roofline``, ``roofline_*`` bench fields).
 
-``metrics``, ``tracing``, ``waterfall``, and ``slo`` are stdlib-only;
-``jaxprof`` and ``xray`` import jax lazily — so the event server,
-``pio top``, and the lint CLI can use this package without dragging in
-an accelerator runtime.
+``metrics``, ``tracing``, ``waterfall``, ``slo``, and ``sampler`` are
+stdlib-only; ``jaxprof``, ``xray``, ``profiler``, and ``costmodel``
+import jax lazily — so the event server, ``pio top``, and the lint CLI
+can use this package without dragging in an accelerator runtime.
 """
 
 from predictionio_tpu.obs.jaxprof import (
@@ -48,8 +59,15 @@ from predictionio_tpu.obs.slo import (
     histogram_threshold_source,
     paired_counter_source,
 )
+from predictionio_tpu.obs.profiler import (
+    ProfileBusyError,
+    ProfileSession,
+    ProfileStore,
+    maybe_profile_train,
+)
+from predictionio_tpu.obs.sampler import HostSampler
 from predictionio_tpu.obs.waterfall import PHASES, PhaseWaterfall, phase_tags_ms
-from predictionio_tpu.obs import xray
+from predictionio_tpu.obs import costmodel, xray
 from predictionio_tpu.obs.tracing import (
     TRACE_HEADER,
     Span,
@@ -70,13 +88,19 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "HostSampler",
     "MetricsRegistry",
     "PhaseWaterfall",
+    "ProfileBusyError",
+    "ProfileSession",
+    "ProfileStore",
     "SLOEngine",
     "Span",
     "Tracer",
+    "costmodel",
     "counter_ratio_source",
     "histogram_threshold_source",
+    "maybe_profile_train",
     "paired_counter_source",
     "phase_tags_ms",
     "current_trace_id",

@@ -1,0 +1,441 @@
+"""On-demand device trace capture into content-addressed profile bundles.
+
+``obs/xray`` tells you the *shape* of a train (phases, steps, memory) and
+``obs/waterfall`` the shape of a query — this module captures the ground
+truth underneath both: the XLA device trace (``jax.profiler.start_trace``
+/ ``stop_trace``), bounded in duration and published as a **profile
+bundle** with the same content-addressed layout as the incident flight
+recorder (``obs/incidents``): JSON parts + raw texts + a ``trace/``
+subtree of device artifacts under ``<dir>/<utc-stamp>-<sha12>/``, written
+tmp+rename so a half-capture is never mistaken for a whole one, GC'd to
+the newest ``max_bundles``.
+
+The manifest carries what a trace viewer cannot: the trigger, the engine
+and model version that was serving, the registry generation, and the
+phase-waterfall snapshot at capture time — so a trace pulled off a 3am
+incident still says *which* model produced it. Because the layout is the
+incident layout, ``list_bundles``/``load_bundle``/``export_bundle`` from
+``obs/incidents`` work unchanged; ``pio profile list|show|export`` are
+thin wrappers over them.
+
+Capture is **single-flight**: ``jax.profiler`` keeps one global trace
+session per process, so a second concurrent ``POST /profile/capture``
+gets :class:`ProfileBusyError` (HTTP 409), never a corrupted trace.
+Everything here is blocking by design — the HTTP handlers hand capture
+to ``run_in_executor`` (held by the async-blocking lint family, which
+names this module an entry point).
+
+``PIO_PROFILE_DIR`` compatibility: :func:`maybe_profile_train` replaces
+the old ``_maybe_profile`` wrapper in ``workflow/core_workflow`` — same
+env gate, but the trace now lands as a content-addressed bundle (with
+manifest + GC) instead of a bare artifact directory, cross-linking the
+xray TrainProfile trainer when one is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Callable
+
+from predictionio_tpu.obs.incidents import (
+    MANIFEST_NAME,
+    BundleRef,
+    _jsonable,
+    export_bundle,
+    list_bundles,
+    load_bundle,
+)
+
+logger = logging.getLogger(__name__)
+
+PROFILE_DIR_ENV = "PIO_PROFILE_DIR"
+
+# duration rails for HTTP-triggered captures: the trace buffers device
+# events in memory and writes multi-MB artifacts, so an unbounded ms
+# parameter is a self-DoS — clamp, don't trust
+DEFAULT_CAPTURE_MS = 500
+MAX_CAPTURE_MS = 10_000
+
+
+class ProfileBusyError(RuntimeError):
+    """A capture is already in flight (jax keeps ONE global trace
+    session per process); surfaces as HTTP 409."""
+
+
+class ProfileStore:
+    """Content-addressed profile bundles under one directory.
+
+    Same bundle grammar as :class:`obs.incidents.IncidentRecorder` plus a
+    ``trace/`` subtree for the raw XLA artifacts; the manifest inventories
+    every trace file (name, bytes, sha256) so ``pio profile show`` can
+    verify what it prints without parsing protobufs.
+    """
+
+    def __init__(self, dir_path: str, max_bundles: int = 20):
+        self.dir = dir_path
+        self.max_bundles = int(max_bundles)
+
+    def ensure_dir(self) -> str:
+        """Lazy creation: constructing a server must not scatter empty
+        obs directories; the first capture makes it."""
+        os.makedirs(self.dir, exist_ok=True)
+        return self.dir
+
+    # -------------------------------------------------------------- publish
+    def publish(
+        self,
+        trigger: str,
+        context: dict[str, Any] | None = None,
+        parts: dict[str, Any] | None = None,
+        texts: dict[str, str] | None = None,
+        trace_dir: str | None = None,
+    ) -> str:
+        """Write one bundle; returns its path. ``trace_dir`` (the raw
+        ``jax.profiler`` output tree) is *moved* into the bundle's
+        ``trace/`` subtree. Blocking file I/O — callers on an event loop
+        must hand this to an executor."""
+        self.ensure_dir()
+        captured_at = time.time()
+        parts = {k: _jsonable(v) for k, v in (parts or {}).items()}
+        texts = dict(texts or {})
+        trace_files = self._trace_inventory(trace_dir)
+        manifest: dict[str, Any] = {
+            "trigger": trigger,
+            "capturedAt": captured_at,
+            "capturedAtMonotonic": time.monotonic(),
+            "context": _jsonable(context or {}),
+            "parts": sorted(parts),
+            "texts": sorted(texts),
+            "trace": trace_files,
+        }
+        hasher = hashlib.sha256()
+        hasher.update(json.dumps(manifest, sort_keys=True).encode())
+        for name in sorted(parts):
+            hasher.update(json.dumps(parts[name], sort_keys=True).encode())
+        for name in sorted(texts):
+            hasher.update(texts[name].encode("utf-8", errors="replace"))
+        digest = hasher.hexdigest()
+        manifest["sha256"] = digest
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime(captured_at))
+        bundle_id = f"{stamp}-{digest[:12]}"
+        final = os.path.join(self.dir, bundle_id)
+        tmp = os.path.join(self.dir, f".tmp-{bundle_id}-{os.getpid()}")
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            for name, value in parts.items():
+                with open(
+                    os.path.join(tmp, f"{name}.json"), "w", encoding="utf-8"
+                ) as fh:
+                    json.dump(value, fh, indent=2, sort_keys=True)
+            for name, text in texts.items():
+                with open(
+                    os.path.join(tmp, f"{name}.txt"),
+                    "w",
+                    encoding="utf-8",
+                    errors="replace",
+                ) as fh:
+                    fh.write(text)
+            if trace_dir is not None and os.path.isdir(trace_dir):
+                shutil.move(trace_dir, os.path.join(tmp, "trace"))
+            with open(
+                os.path.join(tmp, MANIFEST_NAME), "w", encoding="utf-8"
+            ) as fh:
+                json.dump(manifest, fh, indent=2, sort_keys=True)
+            if os.path.isdir(final):
+                shutil.rmtree(tmp)  # identical evidence already captured
+            else:
+                os.rename(tmp, final)
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._gc()
+        logger.info("profile bundle published: %s (%s)", bundle_id, trigger)
+        return final
+
+    @staticmethod
+    def _trace_inventory(trace_dir: str | None) -> list[dict[str, Any]]:
+        if trace_dir is None or not os.path.isdir(trace_dir):
+            return []
+        inventory: list[dict[str, Any]] = []
+        for root, _dirs, files in os.walk(trace_dir):
+            for name in files:
+                path = os.path.join(root, name)
+                rel = os.path.relpath(path, trace_dir)
+                h = hashlib.sha256()
+                try:
+                    with open(path, "rb") as fh:
+                        for chunk in iter(lambda: fh.read(1 << 20), b""):
+                            h.update(chunk)
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                inventory.append(
+                    {"name": rel, "bytes": size, "sha256": h.hexdigest()}
+                )
+        inventory.sort(key=lambda e: e["name"])
+        return inventory
+
+    def _gc(self) -> None:
+        refs = list_bundles(self.dir)
+        for ref in refs[: max(0, len(refs) - self.max_bundles)]:
+            shutil.rmtree(ref.path, ignore_errors=True)
+
+    # ------------------------------------------------------------ inspection
+    def list(self) -> list[BundleRef]:
+        return list_bundles(self.dir)
+
+    def load(self, bundle_id: str) -> dict[str, Any]:
+        return load_bundle(self.dir, bundle_id)
+
+    def export(self, bundle_id: str, dest: str) -> str:
+        return export_bundle(self.dir, bundle_id, dest)
+
+
+class ProfileSession:
+    """Single-flight device-trace capture publishing into a store.
+
+    One session per server process; ``capture()`` raises
+    :class:`ProfileBusyError` when a capture is already running. Alert
+    paths use ``capture_alert()`` — rate-limited per trigger kind (a
+    breaker flapping at dispatch rate must produce a few bundles, not
+    thousands) and never raising.
+    """
+
+    def __init__(
+        self,
+        store: ProfileStore,
+        *,
+        default_ms: int = DEFAULT_CAPTURE_MS,
+        max_ms: int = MAX_CAPTURE_MS,
+        alert_min_interval_s: float = 60.0,
+        alert_trace_ms: int = 0,
+        context_fn: Callable[[], dict[str, Any]] | None = None,
+        metrics: Any | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.store = store
+        self.default_ms = int(default_ms)
+        self.max_ms = int(max_ms)
+        self.alert_min_interval_s = float(alert_min_interval_s)
+        self.alert_trace_ms = int(alert_trace_ms)
+        # manifest enrichment (engine/model version, registry generation,
+        # waterfall snapshot) supplied by the owning server at capture time
+        self.context_fn = context_fn
+        self._clock = clock
+        self._flight = threading.Lock()
+        self._last_alert: dict[str, float] = {}
+        self._alert_lock = threading.Lock()
+        if metrics is not None:
+            self._m_captures = metrics.counter(
+                "pio_profile_captures_total",
+                "profile bundles captured, by trigger kind (manual / "
+                "slo-alert / breaker-trip / train)",
+                labelnames=("trigger",),
+            )
+            self._m_busy = metrics.counter(
+                "pio_profile_capture_busy_total",
+                "capture requests rejected because one was already in "
+                "flight (the single-flight rail; HTTP 409)",
+            )
+            self._m_errors = metrics.counter(
+                "pio_profile_capture_errors_total",
+                "captures that failed (tracer unavailable, publish error)",
+            )
+            self._m_last_ms = metrics.gauge(
+                "pio_profile_last_capture_ms",
+                "requested duration of the most recent device capture",
+            )
+            self._m_bundles = metrics.gauge(
+                "pio_profile_bundles",
+                "profile bundles currently on disk in this server's store",
+            )
+            self._m_bundles.set_function(lambda: float(len(self.store.list())))
+        else:
+            self._m_captures = self._m_busy = None
+            self._m_errors = self._m_last_ms = None
+
+    def clamp_ms(self, ms: int | None) -> int:
+        if ms is None:
+            return self.default_ms
+        return max(0, min(int(ms), self.max_ms))
+
+    def _base_context(self) -> dict[str, Any]:
+        if self.context_fn is None:
+            return {}
+        try:
+            return dict(self.context_fn())
+        except Exception as exc:  # noqa: BLE001 - context must not sink capture
+            return {"contextError": f"{type(exc).__name__}: {exc}"}
+
+    # -------------------------------------------------------------- capture
+    def capture(
+        self,
+        ms: int | None = None,
+        trigger: str = "manual",
+        context: dict[str, Any] | None = None,
+        parts: dict[str, Any] | None = None,
+        texts: dict[str, str] | None = None,
+    ) -> str:
+        """Capture a bounded device trace (``ms`` clamped to
+        ``[0, max_ms]``; 0 skips the device trace and publishes a
+        host-only bundle) and publish it. Blocking — run on an executor.
+        Raises :class:`ProfileBusyError` when a capture is in flight."""
+        if not self._flight.acquire(blocking=False):
+            if self._m_busy is not None:
+                self._m_busy.inc()
+            raise ProfileBusyError("a profile capture is already in flight")
+        try:
+            duration_ms = self.clamp_ms(ms)
+            ctx = {**self._base_context(), **(context or {})}
+            ctx["durationMs"] = duration_ms
+            trace_dir: str | None = None
+            if duration_ms > 0:
+                trace_dir = tempfile.mkdtemp(
+                    prefix=".trace-", dir=self.store.ensure_dir()
+                )
+                import jax
+
+                jax.profiler.start_trace(trace_dir)
+                try:
+                    time.sleep(duration_ms / 1000.0)
+                finally:
+                    jax.profiler.stop_trace()
+            path = self.store.publish(
+                trigger,
+                context=ctx,
+                parts=parts,
+                texts=texts,
+                trace_dir=trace_dir,
+            )
+            if self._m_captures is not None:
+                self._m_captures.inc(trigger=trigger)
+                self._m_last_ms.set(float(duration_ms))
+            return path
+        except ProfileBusyError:
+            raise
+        except Exception:
+            if self._m_errors is not None:
+                self._m_errors.inc()
+            raise
+        finally:
+            self._flight.release()
+
+    def capture_alert(
+        self,
+        trigger: str,
+        context: dict[str, Any] | None = None,
+        parts: dict[str, Any] | None = None,
+        texts: dict[str, str] | None = None,
+    ) -> str | None:
+        """The profile-on-alert entry: rate-limited per trigger kind,
+        never raises (a broken profiler must not take down the failure
+        path that called it). Device trace only when ``alert_trace_ms``
+        > 0 — the host-stack snapshot in ``parts``/``texts`` is the
+        always-available evidence."""
+        now = self._clock()
+        with self._alert_lock:
+            last = self._last_alert.get(trigger)
+            if last is not None and now - last < self.alert_min_interval_s:
+                return None
+            self._last_alert[trigger] = now
+        try:
+            return self.capture(
+                ms=self.alert_trace_ms,
+                trigger=trigger,
+                context=context,
+                parts=parts,
+                texts=texts,
+            )
+        except ProfileBusyError:
+            return None
+        except Exception:
+            logger.exception("profile-on-alert capture failed (%s)", trigger)
+            return None
+
+    @contextlib.contextmanager
+    def trace(
+        self,
+        trigger: str = "train",
+        context: dict[str, Any] | None = None,
+        parts_fn: Callable[[], dict[str, Any]] | None = None,
+    ):
+        """Single-flight device trace around a long-running body (a
+        train): unbounded by ``max_ms`` — the body's wall clock *is* the
+        duration. Yields a result box whose ``"path"`` key holds the
+        bundle path after exit; ``parts_fn`` is called at exit so the
+        bundle can embed state that only exists once the body ran (the
+        xray TrainProfile cross-link)."""
+        if not self._flight.acquire(blocking=False):
+            if self._m_busy is not None:
+                self._m_busy.inc()
+            raise ProfileBusyError("a profile capture is already in flight")
+        box: dict[str, Any] = {}
+        try:
+            trace_dir = tempfile.mkdtemp(
+                prefix=".trace-", dir=self.store.ensure_dir()
+            )
+            import jax
+
+            t0 = time.perf_counter()
+            jax.profiler.start_trace(trace_dir)
+            try:
+                yield box
+            finally:
+                jax.profiler.stop_trace()
+            wall_ms = int((time.perf_counter() - t0) * 1000.0)
+            parts = dict(parts_fn() if parts_fn is not None else {})
+            ctx = {**self._base_context(), **(context or {})}
+            ctx["durationMs"] = wall_ms
+            box["path"] = self.store.publish(
+                trigger, context=ctx, parts=parts, trace_dir=trace_dir
+            )
+            if self._m_captures is not None:
+                self._m_captures.inc(trigger=trigger)
+                self._m_last_ms.set(float(wall_ms))
+        finally:
+            self._flight.release()
+
+
+@contextlib.contextmanager
+def maybe_profile_train(
+    context: dict[str, Any] | None = None,
+    parts_fn: Callable[[], dict[str, Any]] | None = None,
+):
+    """``PIO_PROFILE_DIR`` compatibility gate, absorbed from the old
+    ``workflow.core_workflow._maybe_profile``: unset -> no-op (yields
+    ``None``); set -> the train runs inside a device trace whose
+    artifacts land as a content-addressed bundle (manifest + newest-N GC)
+    under that directory. Yields the session's result box (``box["path"]``
+    after exit) so the caller can log/cross-link the bundle."""
+    profile_dir = os.environ.get(PROFILE_DIR_ENV)
+    if not profile_dir:
+        yield None
+        return
+    store = ProfileStore(profile_dir)
+    session = ProfileSession(store)
+    with session.trace(
+        trigger="train", context=context, parts_fn=parts_fn
+    ) as box:
+        yield box
+    logger.info(
+        "XLA training profile bundle written to %s", box.get("path")
+    )
+
+
+__all__ = [
+    "DEFAULT_CAPTURE_MS",
+    "MAX_CAPTURE_MS",
+    "PROFILE_DIR_ENV",
+    "ProfileBusyError",
+    "ProfileSession",
+    "ProfileStore",
+    "maybe_profile_train",
+]

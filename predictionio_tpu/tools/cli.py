@@ -777,6 +777,7 @@ def cmd_top(args) -> int:
         clear_screen=False if args.once else None,
         json_mode=args.json,
         urls=urls or None,
+        hotspots=args.hotspots,
     )
 
 
@@ -843,6 +844,121 @@ def cmd_incidents_export(args) -> int:
 
     try:
         dest = export_bundle(_incidents_dir(args), args.bundle, args.dest)
+    except (FileNotFoundError, ValueError, OSError) as exc:
+        return _die(str(exc))
+    print(f"Exported to {dest}")
+    return 0
+
+
+def _profile_dir(args) -> str:
+    # CLI flag > PIO_PROFILE_DIR (the training compat alias) > the
+    # serving default (ServerConfig.profile_dir)
+    return (
+        args.profile_dir
+        or os.environ.get("PIO_PROFILE_DIR")
+        or "pio_obs/profiles"
+    )
+
+
+def cmd_profile_serve(args) -> int:
+    """Trigger an on-demand device capture on a RUNNING server (query,
+    event, or fleet gateway — the gateway fans out to one replica):
+    ``POST /profile/capture?ms=``. The bundle lands in the server's own
+    profile store; inspect it with ``pio profile list/show`` against
+    that directory."""
+    import urllib.error
+    import urllib.request
+
+    target = args.url.rstrip("/") + f"/profile/capture?ms={args.ms}"
+    req = urllib.request.Request(target, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+            body = json.loads(resp.read().decode("utf-8", errors="replace"))
+    except urllib.error.HTTPError as exc:
+        detail = exc.read().decode("utf-8", errors="replace")[:400]
+        if exc.code == 409:
+            return _die(f"capture already in flight on {args.url}: {detail}")
+        return _die(f"capture failed ({exc.code}): {detail}")
+    except Exception as exc:  # noqa: BLE001 - network errors -> one line
+        return _die(f"server unreachable at {args.url}: {exc}")
+    print(json.dumps(body, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_profile_train(args) -> int:
+    """Train under the device tracer: sets ``PIO_PROFILE_DIR`` (the
+    compatibility gate `obs.profiler.maybe_profile_train` honors) and
+    re-invokes ``pio train`` with the remaining arguments; the trace
+    lands as a content-addressed bundle under the profile dir."""
+    rest = list(args.train_args)
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    os.environ["PIO_PROFILE_DIR"] = _profile_dir(args)
+    return main(["train", *rest])
+
+
+def cmd_profile_list(args) -> int:
+    """Profile bundles (same content-addressed grammar as incident
+    bundles; docs/observability.md §Profiling plane)."""
+    from predictionio_tpu.obs.incidents import list_bundles
+
+    directory = _profile_dir(args)
+    refs = list_bundles(directory)
+    if not refs:
+        print(
+            f"No profile bundles under {directory} "
+            "(POST /profile/capture, `pio profile serve|train`, or "
+            "profile-on-alert write them; --profile-dir points elsewhere)"
+        )
+        return 0
+    print(f"Profiles: {directory}")
+    print(f"{'Bundle':<30} | {'Trigger':<14} | Captured")
+    import time as _time
+
+    for ref in refs:
+        when = _time.strftime(
+            "%Y-%m-%d %H:%M:%S", _time.localtime(ref.captured_at)
+        )
+        print(f"{ref.bundle_id:<30} | {ref.trigger:<14} | {when}")
+    return 0
+
+
+def cmd_profile_show(args) -> int:
+    from predictionio_tpu.obs.incidents import load_bundle
+
+    directory = _profile_dir(args)
+    try:
+        bundle = load_bundle(directory, args.bundle)
+    except (FileNotFoundError, ValueError) as exc:
+        return _die(str(exc))
+    if args.json:
+        print(json.dumps(bundle, indent=2, sort_keys=True, default=repr))
+        return 0
+    manifest = bundle["manifest"]
+    print(f"trigger   {manifest.get('trigger')}")
+    print(f"captured  {manifest.get('capturedAt')}")
+    print(f"sha256    {manifest.get('sha256')}")
+    context = manifest.get("context") or {}
+    if context:
+        print("context   " + json.dumps(context, sort_keys=True))
+    for name, part in sorted(bundle["parts"].items()):
+        size = len(json.dumps(part))
+        print(f"part      {name}.json ({size} bytes)")
+    for name, text in sorted(bundle["texts"].items()):
+        print(f"text      {name}.txt ({len(text)} bytes)")
+    for entry in manifest.get("trace") or []:
+        print(
+            f"trace     {entry.get('name')} ({entry.get('bytes')} bytes, "
+            f"sha256 {str(entry.get('sha256'))[:12]})"
+        )
+    return 0
+
+
+def cmd_profile_export(args) -> int:
+    from predictionio_tpu.obs.incidents import export_bundle
+
+    try:
+        dest = export_bundle(_profile_dir(args), args.bundle, args.dest)
     except (FileNotFoundError, ValueError, OSError) as exc:
         return _die(str(exc))
     print(f"Exported to {dest}")
@@ -929,6 +1045,32 @@ def _parse_bytes(text: str) -> int:
     return int(float(t))
 
 
+def _doctor_roofline(args) -> int:
+    """``pio doctor --roofline``: the device-free roofline — lower and
+    compile every registered jit bucket family, read XLA's own
+    ``cost_analysis()`` flops/bytes into arithmetic intensity and a
+    per-model device cost per 1k queries (obs/costmodel). Runs on the
+    CPU backend; exits nonzero only when NO family produced numbers."""
+    from predictionio_tpu.obs import costmodel
+
+    families = (
+        [f.strip() for f in args.families.split(",") if f.strip()]
+        if getattr(args, "families", None)
+        else None
+    )
+    try:
+        report = costmodel.analyze(
+            families=families,
+            device=args.device or costmodel.DEFAULT_DEVICE,
+        )
+    except ValueError as exc:
+        return _die(str(exc))
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if not report["families"]:
+        return _die("no bucket family produced cost numbers", code=1)
+    return 0
+
+
 def cmd_doctor(args) -> int:
     """Preflight diagnostics. ``--capacity USERS ITEMS K`` runs the HBM
     capacity planner (obs/xray.estimate_factors): will this ALS train fit
@@ -940,6 +1082,8 @@ def cmd_doctor(args) -> int:
     ANN indexes pinned in the registry."""
     from predictionio_tpu.obs import xray
 
+    if getattr(args, "roofline", False):
+        return _doctor_roofline(args)
     if getattr(args, "ann", None) and not args.capacity:
         return _die("--ann needs --capacity USERS ITEMS K (ITEMS and K size the index)")
     if args.capacity:
@@ -2145,6 +2289,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--status-file: cells done/total, running workers, best score "
         "so far, ETA",
     )
+    x.add_argument(
+        "--hotspots",
+        action="store_true",
+        help="append the host-sampler hotspots block (top-of-stack "
+        "frames per thread role + sampler overhead %%) from the "
+        "server's /profile/stacks; an endpoint without the profiling "
+        "plane degrades to one 'unreachable' line",
+    )
     x.set_defaults(fn=cmd_top)
 
     inc = sub.add_parser(
@@ -2178,6 +2330,65 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("dest", help="destination directory")
     x.add_argument("--obs-dir", default="pio_obs")
     x.set_defaults(fn=cmd_incidents_export)
+
+    prof = sub.add_parser(
+        "profile",
+        help="the profiling plane: on-demand device captures against a "
+        "live server, device-traced training, and content-addressed "
+        "profile bundle inspection (docs/observability.md §Profiling "
+        "plane)",
+    ).add_subparsers(dest="subcommand", required=True)
+
+    def profile_dir_arg(x):
+        x.add_argument(
+            "--profile-dir",
+            default=None,
+            help="profile bundle directory (default $PIO_PROFILE_DIR, "
+            "else pio_obs/profiles — the server default)",
+        )
+
+    x = prof.add_parser(
+        "serve",
+        help="POST /profile/capture?ms= on a running server (or a fleet "
+        "gateway, which fans out to one replica)",
+    )
+    x.add_argument("--url", default=_TOP_DEFAULT_URL)
+    x.add_argument(
+        "--ms",
+        type=int,
+        default=500,
+        help="device-trace duration (clamped server-side to its max; "
+        "0 = host-only bundle, no device trace)",
+    )
+    x.add_argument("--timeout", type=float, default=30.0)
+    x.set_defaults(fn=cmd_profile_serve)
+    x = prof.add_parser(
+        "train",
+        help="run `pio train ...` under the device tracer; the trace "
+        "lands as a content-addressed bundle under --profile-dir",
+    )
+    profile_dir_arg(x)
+    x.add_argument(
+        "train_args",
+        nargs=argparse.REMAINDER,
+        help="arguments forwarded to `pio train` (prefix with -- )",
+    )
+    x.set_defaults(fn=cmd_profile_train)
+    x = prof.add_parser("list", help="bundles oldest first")
+    profile_dir_arg(x)
+    x.set_defaults(fn=cmd_profile_list)
+    x = prof.add_parser(
+        "show", help="manifest, parts, and trace inventory of one bundle"
+    )
+    x.add_argument("bundle", help="bundle id (unique prefix accepted)")
+    profile_dir_arg(x)
+    x.add_argument("--json", action="store_true", help="full bundle as JSON")
+    x.set_defaults(fn=cmd_profile_show)
+    x = prof.add_parser("export", help="copy one bundle somewhere shippable")
+    x.add_argument("bundle", help="bundle id (unique prefix accepted)")
+    x.add_argument("dest", help="destination directory")
+    profile_dir_arg(x)
+    x.set_defaults(fn=cmd_profile_export)
 
     x = sub.add_parser(
         "doctor",
@@ -2223,6 +2434,24 @@ def build_parser() -> argparse.ArgumentParser:
         "--registry-dir",
         help="registry to inventory pinned ANN indexes from "
         "(default $PIO_REGISTRY_DIR)",
+    )
+    x.add_argument(
+        "--roofline",
+        action="store_true",
+        help="device-free roofline: compile the registered jit bucket "
+        "families and report cost_analysis flops/bytes, arithmetic "
+        "intensity, and device cost per 1k queries (docs/PERF.md)",
+    )
+    x.add_argument(
+        "--families",
+        help="comma list of bucket families for --roofline "
+        "(default: all of topk,ann,als,twotower)",
+    )
+    x.add_argument(
+        "--device",
+        default=None,
+        help="device spec the roofline prices against "
+        "(tpu-v4/tpu-v5e/tpu-v5p/cpu-host; default tpu-v4)",
     )
     x.set_defaults(fn=cmd_doctor)
 

@@ -767,6 +767,40 @@ def fetch_metrics(url: str, timeout_s: float = 5.0) -> str:
 _SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
 
 
+def fetch_stacks(url: str, timeout_s: float = 5.0) -> dict[str, Any]:
+    """GET ``<url>/profile/stacks?format=json`` — the host sampler's
+    structured snapshot (obs/sampler). Raises on any transport/parse
+    failure; the caller degrades the hotspots line, never the screen."""
+    import json as _json
+
+    target = url.rstrip("/") + "/profile/stacks?format=json"
+    with urllib.request.urlopen(target, timeout=timeout_s) as resp:
+        return _json.loads(resp.read().decode("utf-8", errors="replace"))
+
+
+def render_hotspots(snapshot: dict[str, Any]) -> str:
+    """The ``--hotspots`` block: per-role top-of-stack frames from the
+    always-on sampler, plus its self-measured overhead — the line that
+    says WHICH thread role is hot without leaving the terminal."""
+    if "error" in snapshot:
+        return f"hotspots: unreachable ({snapshot['error']})"
+    overhead = snapshot.get("overheadFrac") or 0.0
+    samples = int(snapshot.get("samples") or 0)
+    lines = [
+        f"hotspots (sampler {overhead * 100:.2f}% ovh, {samples} samples):"
+    ]
+    hotspots = snapshot.get("hotspots") or {}
+    roles = snapshot.get("roles") or {}
+    for role in sorted(hotspots, key=lambda r: -roles.get(r, 0)):
+        tops = "  ".join(
+            f"{e['frame']} {e['frac'] * 100:.0f}%" for e in hotspots[role]
+        )
+        lines.append(f"  {role:<12} {tops}")
+    if len(lines) == 1:
+        lines.append("  (no samples yet)")
+    return "\n".join(lines)
+
+
 def sparkline(values: list[float], width: int = 60) -> str:
     """Downsample to ``width`` columns and render with block glyphs;
     empty input renders as '-'. Scaled to the series max (min pinned at
@@ -1073,6 +1107,8 @@ def run_top(
     sleep: Callable[[float], None] = time.sleep,
     json_mode: bool = False,
     urls: list[str] | None = None,
+    hotspots: bool = False,
+    stacks_fetch: Callable[[str], dict[str, Any]] | None = None,
 ) -> int:
     """Poll-and-render loop. ``iterations=None`` runs until interrupted;
     fetch/out/sleep are injectable so tests drive it without a network.
@@ -1117,6 +1153,14 @@ def run_top(
                     summary = summarize(
                         metrics, prev=prev.get(u), interval_s=dt
                     )
+                    if hotspots:
+                        # degradation contract: an endpoint without the
+                        # profiling plane (older server, proxy) costs one
+                        # "unreachable" line, never the whole refresh
+                        try:
+                            summary["hotspots"] = (stacks_fetch or fetch_stacks)(u)
+                        except Exception as exc:  # noqa: BLE001
+                            summary["hotspots"] = {"error": str(exc)}
                     if json_mode:
                         out(
                             _json.dumps(
@@ -1124,7 +1168,10 @@ def run_top(
                             )
                         )
                     else:
-                        screens.append(render(summary, u))
+                        block = render(summary, u)
+                        if hotspots:
+                            block += "\n" + render_hotspots(summary["hotspots"])
+                        screens.append(block)
                     prev[u], prev_t[u] = metrics, now
             if screens:
                 screen = "\n\n".join(screens)

@@ -29,6 +29,7 @@ from predictionio_tpu.data.storage.base import (
 )
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.obs import xray
+from predictionio_tpu.obs.profiler import maybe_profile_train
 from predictionio_tpu.workflow import model_io
 from predictionio_tpu.workflow.cleanup import CleanupFunctions
 from predictionio_tpu.workflow.context import WorkflowContext
@@ -36,32 +37,6 @@ from predictionio_tpu.workflow.engine_loader import EngineManifest
 
 logger = logging.getLogger(__name__)
 UTC = _dt.timezone.utc
-
-
-@contextlib.contextmanager
-def _maybe_profile():
-    """XLA profiler trace around training, gated by ``PIO_PROFILE_DIR``.
-
-    The reference has no training profiler at all (SURVEY.md §5: "none
-    beyond logging and Spark's own UI"); on TPU the XLA trace is the
-    ground truth for where a train step's device time goes (gather vs
-    scatter vs MXU), viewable in TensorBoard/XProf or with
-    ``jax.profiler``'s trace viewer. Off by default: tracing buffers
-    device events in memory and writes multi-MB artifacts.
-    """
-    trace_dir = os.environ.get("PIO_PROFILE_DIR")
-    if not trace_dir:
-        yield
-        return
-    import jax
-
-    os.makedirs(trace_dir, exist_ok=True)
-    jax.profiler.start_trace(trace_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-        logger.info("XLA profiler trace written to %s", trace_dir)
 
 
 def run_train(
@@ -120,7 +95,16 @@ def run_train(
 
         if jax.process_count() > 1 and jax.process_index() != 0:
             try:
-                with _maybe_profile():
+                # non-coordinator workers profile too (PIO_PROFILE_DIR gate):
+                # their bundle context names the process index so a per-host
+                # straggler is attributable
+                with maybe_profile_train(
+                    context={
+                        "engine": manifest.engine_id,
+                        "engineVersion": manifest.version,
+                        "processIndex": jax.process_index(),
+                    }
+                ):
                     models = engine.train(ctx, engine_params, options)
                 if not (
                     options
@@ -171,7 +155,22 @@ def run_train(
             if profile is not None:
                 scope.enter_context(xray.use_profile(profile))
                 scope.enter_context(profile.measure())
-            with _maybe_profile():
+            # device-trace gate (PIO_PROFILE_DIR): the trace now lands as a
+            # content-addressed profile bundle whose manifest cross-links
+            # the xray TrainProfile running in this same scope
+            with maybe_profile_train(
+                context={
+                    "engine": manifest.engine_id,
+                    "engineVersion": manifest.version,
+                    "batch": batch,
+                    "instanceId": instance_id,
+                },
+                parts_fn=lambda: (
+                    {"xray": profile.to_json_dict()}
+                    if profile is not None
+                    else {}
+                ),
+            ):
                 models = engine.train(ctx, engine_params, options)
             if options and (
                 options.stop_after_read or options.stop_after_prepare

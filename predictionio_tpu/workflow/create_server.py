@@ -33,6 +33,7 @@ import dataclasses
 import datetime as _dt
 import json
 import logging
+import os
 import threading
 import time
 from typing import Any
@@ -46,6 +47,12 @@ from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.registry import Storage
 from predictionio_tpu.obs.jaxprof import CompileWatcher
 from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.profiler import (
+    ProfileBusyError,
+    ProfileSession,
+    ProfileStore,
+)
+from predictionio_tpu.obs.sampler import HostSampler
 from predictionio_tpu.obs.tracing import (
     TRACE_HEADER,
     Tracer,
@@ -239,6 +246,25 @@ class ServerConfig:
     # graceful drain (SIGTERM / supervised restart): how long to wait for
     # queued + in-flight queries to answer after the listener closes
     drain_grace_s: float = 15.0
+    # -- profiling plane (docs/observability.md §Profiling plane) ----------
+    # content-addressed profile bundle store (lazy-created on first
+    # capture; newest-N GC) behind POST /profile/capture + `pio profile`
+    profile_dir: str = "pio_obs/profiles"
+    profile_max_bundles: int = 20
+    # device-capture duration rails: ?ms= defaults/clamps here (the trace
+    # buffers device events in memory — unbounded capture is a self-DoS)
+    profile_default_ms: int = 500
+    profile_max_ms: int = 10_000
+    # always-on host stack sampler (GET /profile/stacks, pio top
+    # --hotspots); <= 0 disables sampling (instruments still registered)
+    sampler_period_s: float = 0.05
+    # profile-on-alert: SLO-alert transitions and candidate-breaker trips
+    # capture a rate-limited host-stack bundle; alert_trace_ms > 0 adds a
+    # short device trace to it (off by default: a wedged device is often
+    # WHY the alert fired, and a trace capture would then hang too)
+    profile_on_alert: bool = True
+    profile_alert_min_interval_s: float = 60.0
+    profile_alert_trace_ms: int = 0
 
     def ssl_context(self):
         from predictionio_tpu.utils.tls import server_ssl_context
@@ -1049,6 +1075,67 @@ class QueryServer:
         # recorded — a slow or crashing candidate cannot touch a response
         self._shadow_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="pio-shadow"
+        )
+        # -- profiling plane (docs/observability.md §Profiling plane) -------
+        # always-on host stack sampler + single-flight device capture. Both
+        # register their pio_profile_* instruments eagerly here so the
+        # family exists from process start (the metrics contract test
+        # resolves every documented metric against a fresh server).
+        self.sampler = HostSampler(
+            period_s=self.config.sampler_period_s
+            if self.config.sampler_period_s > 0
+            else 0.05,
+            metrics=m,
+        )
+        self.profiler = ProfileSession(
+            ProfileStore(
+                self.config.profile_dir, self.config.profile_max_bundles
+            ),
+            default_ms=self.config.profile_default_ms,
+            max_ms=self.config.profile_max_ms,
+            alert_min_interval_s=self.config.profile_alert_min_interval_s,
+            alert_trace_ms=self.config.profile_alert_trace_ms,
+            context_fn=self._profile_context,
+            metrics=m,
+        )
+        # SLO alert-transition tracker for profile-on-alert (the rollout
+        # heartbeat checks it): a long burn must capture ONCE per
+        # transition, not once per tick
+        self._slo_alerting: dict[str, bool] = {}
+
+    def _profile_context(self) -> dict[str, Any]:
+        """Manifest enrichment for every profile bundle: which engine and
+        model were serving, at which registry generation — the trace
+        viewer can't answer that, the manifest must."""
+        generation = None
+        if self.registry_store is not None:
+            try:
+                generation = self.registry_store.state_generation(
+                    self.manifest.engine_id
+                )
+            except Exception:  # noqa: BLE001 - enrichment, not evidence
+                generation = None
+        return {
+            "engine": self.manifest.engine_id,
+            "engineVersion": self.manifest.version,
+            "modelVersion": self._active.version,
+            "instanceId": self.instance_id,
+            "registryGeneration": generation,
+        }
+
+    def _profile_parts(self) -> dict[str, Any]:
+        """Host-side evidence attached to every profile bundle: the phase
+        waterfall at capture time and the sampler's folded stacks."""
+        return {
+            "waterfall": self.waterfall.snapshot(),
+            "stacks": self.sampler.snapshot(),
+        }
+
+    def _capture_profile(self, ms: int | None, trigger: str) -> str:
+        """Blocking capture body (trace sleep + bundle file writes): runs
+        on an executor thread, never on the event loop."""
+        return self.profiler.capture(
+            ms=ms, trigger=trigger, parts=self._profile_parts()
         )
 
     # ---------------------------------------------------------------- routes
@@ -1895,6 +1982,53 @@ class QueryServer:
         guarded and pure attribute writes, so that is safe."""
         if new == OPEN:
             self._rollback_candidate("breaker-trip")
+            # profile-on-alert: attach the host stacks (and optionally a
+            # short device trace) that show WHAT the serving threads were
+            # doing when the candidate died — off the dispatch thread, the
+            # rollback must not wait for bundle file writes
+            self._profile_on_alert(
+                "breaker-trip", {"breaker": name, "from": old, "to": new}
+            )
+
+    def _profile_on_alert(self, trigger: str, context: dict[str, Any]) -> None:
+        """Rate-limited background profile capture for alert paths; never
+        blocks or raises into the caller (the alert path is already a
+        failure path)."""
+        if not self.config.profile_on_alert:
+            return
+        parts = self._profile_parts()
+        texts = {"stacks_folded": self.sampler.folded()}
+        threading.Thread(
+            target=self.profiler.capture_alert,
+            args=(trigger,),
+            kwargs={"context": context, "parts": parts, "texts": texts},
+            name="pio-profile-alert",
+            daemon=True,
+        ).start()
+
+    def _check_slo_alerts(self) -> None:
+        """SLO alert *transitions* capture a profile bundle (level
+        triggers would re-fire every heartbeat of a long burn; the
+        per-kind rate limiter bounds it anyway, but the transition is the
+        incident). Rides the rollout heartbeat."""
+        try:
+            reports = self.slo.evaluate()
+        except Exception:  # noqa: BLE001 - a broken SLO eval must not loop-kill
+            return
+        for rpt in reports:
+            slo_name = rpt.get("name", "?")
+            was = self._slo_alerting.get(slo_name, False)
+            now_alerting = bool(rpt.get("alerting"))
+            self._slo_alerting[slo_name] = now_alerting
+            if now_alerting and not was:
+                self._profile_on_alert(
+                    "slo-alert",
+                    {
+                        "slo": slo_name,
+                        "objective": rpt.get("objective"),
+                        "compliance": rpt.get("compliance"),
+                    },
+                )
 
     def stage_candidate_lane(
         self,
@@ -2057,6 +2191,10 @@ class QueryServer:
         while True:
             await asyncio.sleep(self.config.bake_check_interval_s)
             try:
+                # profile-on-alert rides the same heartbeat: SLO alert
+                # transitions capture host stacks (the eval is counter
+                # math; the capture itself runs on its own thread)
+                self._check_slo_alerts()
                 await self._rollout_tick()
             except asyncio.CancelledError:
                 raise
@@ -2386,6 +2524,56 @@ class QueryServer:
     async def handle_traces_recent(self, request: web.Request) -> web.Response:
         return traces_response(self.tracer, request)
 
+    async def handle_profile_capture(self, request: web.Request) -> web.Response:
+        """On-demand device capture: ``POST /profile/capture?ms=``. The
+        duration is clamped to the configured rails; a capture already in
+        flight answers 409 (single-flight — jax keeps one global trace
+        session per process). The blocking body (trace sleep + bundle
+        writes) runs on an executor, never on the event loop."""
+        raw_ms = request.query.get("ms")
+        try:
+            ms = int(raw_ms) if raw_ms is not None else None
+        except ValueError:
+            return web.json_response(
+                {"message": "ms must be an integer"}, status=400
+            )
+        loop = asyncio.get_running_loop()
+        try:
+            path = await loop.run_in_executor(
+                None, self._capture_profile, ms, "manual"
+            )
+        except ProfileBusyError:
+            return web.json_response(
+                {"message": "a profile capture is already in flight"},
+                status=409,
+            )
+        except Exception as exc:  # noqa: BLE001 - surface, don't 500-blank
+            logger.exception("profile capture failed")
+            return web.json_response(
+                {"message": f"capture failed: {exc}"}, status=500
+            )
+        return web.json_response(
+            {
+                "bundle": os.path.basename(path),
+                "path": path,
+                "durationMs": self.profiler.clamp_ms(ms),
+                "modelVersion": self.model_version,
+            }
+        )
+
+    async def handle_profile_stacks(self, request: web.Request) -> web.Response:
+        """The always-on sampler's folded stacks: flamegraph-ready folded
+        text by default (``stack count`` lines, pipe into flamegraph.pl),
+        the structured snapshot + hotspot table with ``?format=json``
+        (what ``pio top --hotspots`` consumes)."""
+        if request.query.get("format") == "json":
+            body = self.sampler.snapshot()
+            body["hotspots"] = self.sampler.hotspots()
+            return web.json_response(body)
+        return web.Response(
+            text=self.sampler.folded(), content_type="text/plain"
+        )
+
     async def handle_stop(self, request: web.Request) -> web.Response:
         self._stop_event.set()
         return web.json_response({"message": "Stopping."})
@@ -2416,10 +2604,15 @@ class QueryServer:
                 web.post("/stop", self.handle_stop),
                 web.get("/stop", self.handle_stop),
                 web.get("/plugins.json", self.handle_plugins),
+                # profiling plane (docs/observability.md §Profiling plane)
+                web.post("/profile/capture", self.handle_profile_capture),
+                web.get("/profile/stacks", self.handle_profile_stacks),
             ]
         )
 
         async def _start_rollout_loop(app: web.Application) -> None:
+            if self.config.sampler_period_s > 0:
+                self.sampler.start()
             self._rollout_task = asyncio.ensure_future(self._rollout_loop())
             if (
                 self.registry_store is not None
@@ -2430,6 +2623,7 @@ class QueryServer:
                 )
 
         async def _close_batcher(app: web.Application) -> None:
+            self.sampler.stop()
             tasks = [self._rollout_task, self._registry_sync_task]
             self._rollout_task = None
             self._registry_sync_task = None

@@ -1,0 +1,237 @@
+"""CI profile smoke (run_lint.sh --ci): the profiling plane end to end.
+
+Self-contained, one real server process: this script in ``--worker``
+mode serves the recommendation engine over random factors on the CPU
+backend with the profiling plane on (always-on host sampler + on-demand
+capture). The orchestrator then proves the ISSUE 18 acceptance rails
+against the LIVE server:
+
+1. ``pio profile serve`` (the real CLI, urllib POST to
+   ``/profile/capture``) captures a short device trace and returns the
+   bundle id + the serving lane's model version;
+2. the bundle is listed by ``pio profile list``, rendered by
+   ``pio profile show`` (manifest model version MUST match the serving
+   lane), and exported by ``pio profile export``;
+3. ``GET /profile/stacks`` serves non-empty folded host stacks from the
+   always-on sampler;
+4. ``pio doctor --roofline`` exits 0 with finite numbers for every
+   registered bucket family — the device-free cost model runs on the
+   CPU backend in CI on every push.
+
+Exit 0 = all held; any assertion exits nonzero and fails CI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import io
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def worker_main(port: int, profile_dir: str) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.models.recommendation import engine_factory
+    from predictionio_tpu.models.recommendation.engine import ALSModel
+    from predictionio_tpu.workflow.create_server import (
+        QueryServer,
+        ServerConfig,
+    )
+    from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+    rng = np.random.default_rng(0)
+    n_users, n_items, rank = 500, 300, 8
+    model = ALSModel(
+        rng.normal(size=(n_users, rank)).astype("float32"),
+        rng.normal(size=(n_items, rank)).astype("float32"),
+        [f"u{i}" for i in range(n_users)],
+        [f"i{i}" for i in range(n_items)],
+    )
+    engine = engine_factory()
+    ep = engine.engine_params_from_variant(
+        {
+            "datasource": {"params": {"appName": "profsmoke"}},
+            "algorithms": [{"name": "als", "params": {}}],
+        }
+    )
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    server = QueryServer(
+        engine=engine,
+        engine_params=ep,
+        models=[model],
+        manifest=EngineManifest(
+            engine_id="profsmoke",
+            version="1",
+            variant="engine.json",
+            engine_factory="predictionio_tpu.models.recommendation.engine_factory",
+        ),
+        instance_id="profsmoke",
+        storage=storage,
+        config=ServerConfig(
+            ip="127.0.0.1",
+            port=port,
+            max_batch_size=32,
+            profile_dir=profile_dir,
+            sampler_period_s=0.02,
+        ),
+    )
+
+    async def run() -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(signal.SIGTERM, server.begin_drain)
+        except (NotImplementedError, RuntimeError):
+            pass
+        await server.run_until_stopped()
+
+    print(f"profile smoke worker serving on 127.0.0.1:{port}",
+          file=sys.stderr, flush=True)
+    asyncio.run(run())
+    return 0
+
+
+def _cli(argv: list[str]) -> tuple[int, str]:
+    from predictionio_tpu.tools.cli import main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(argv)
+    return rc, buf.getvalue()
+
+
+def orchestrate(profile_dir: str) -> int:
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker", str(port),
+         profile_dir],
+        env=env,
+        cwd=REPO,
+    )
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(f"{url}/healthz", timeout=1.0):
+                    break
+            except OSError:
+                if proc.poll() is not None:
+                    raise AssertionError("worker died before becoming ready")
+                time.sleep(0.2)
+        else:
+            raise AssertionError("worker never became healthy")
+
+        # 1. the real CLI capture path against the live server
+        rc, out = _cli(["profile", "serve", "--url", url, "--ms", "100"])
+        assert rc == 0, f"pio profile serve failed rc={rc}"
+        resp = json.loads(out)
+        bundle_id = resp["bundle"]
+        lane_version = resp["modelVersion"]
+        assert bundle_id and lane_version
+
+        # 2. list / show / export the bundle through the CLI
+        rc, out = _cli(["profile", "list", "--profile-dir", profile_dir])
+        assert rc == 0 and bundle_id in out, "bundle not listed"
+        rc, out = _cli(
+            ["profile", "show", bundle_id, "--profile-dir", profile_dir,
+             "--json"]
+        )
+        assert rc == 0, "pio profile show failed"
+        manifest = json.loads(out)["manifest"]
+        assert manifest["context"]["modelVersion"] == lane_version, (
+            f"bundle model version {manifest['context']['modelVersion']!r} "
+            f"!= serving lane {lane_version!r}"
+        )
+        assert manifest["trace"], "device capture produced no trace artifacts"
+        with tempfile.TemporaryDirectory() as dest:
+            rc, _ = _cli(
+                ["profile", "export", bundle_id, dest, "--profile-dir",
+                 profile_dir]
+            )
+            assert rc == 0
+            assert os.path.exists(
+                os.path.join(dest, bundle_id, "manifest.json")
+            ), "export left no manifest"
+
+        # 3. the always-on sampler serves folded stacks
+        with urllib.request.urlopen(
+            f"{url}/profile/stacks", timeout=5.0
+        ) as r:
+            folded = r.read().decode()
+        assert folded.strip(), "sampler served empty folded stacks"
+
+        print(
+            f"profile smoke: captured {bundle_id} via pio profile serve "
+            f"(model {lane_version}), listed/shown/exported, "
+            f"{len(folded.splitlines())} folded stacks live"
+        )
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+    # 4. the device-free roofline: exit 0 + finite numbers per family
+    rc, out = _cli(["doctor", "--roofline"])
+    assert rc == 0, "pio doctor --roofline exited nonzero"
+    report = json.loads(out)
+    assert not report["errors"], f"roofline errors: {report['errors']}"
+    for family, entry in report["families"].items():
+        for key in ("arithmeticIntensity", "perQueryModelTimeS",
+                    "costPer1kQueriesUsd"):
+            v = entry[key]
+            assert isinstance(v, (int, float)) and math.isfinite(v) and v > 0, (
+                f"{family}.{key} not finite-positive: {v!r}"
+            )
+    fams = ", ".join(
+        f"{f} ai={e['arithmeticIntensity']:.2f}"
+        for f, e in report["families"].items()
+    )
+    print(f"roofline smoke: {fams} on {report['device']['name']}")
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--worker":
+        return worker_main(int(sys.argv[2]), sys.argv[3])
+    with tempfile.TemporaryDirectory() as d:
+        return orchestrate(os.path.join(d, "profiles"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,7 +36,13 @@
 # the survivor through the host-aware spawn path. The lease stage
 # (tests/test_lease.py) proves the shared-storage mutex itself:
 # TTL-expiry steals, fencing on save, and a two-process hammer with no
-# lost transitions and no token reuse.
+# lost transitions and no token reuse. The profiling-plane stage
+# (tests/test_profiler.py) exercises the observability side of failure:
+# single-flight capture under contention (second capture 409s, never
+# queues), profile-on-alert attaching the offending thread's folded host
+# stacks to the bundle off the failure path, alert captures rate-limited
+# and never raising into the serving loop, and the always-on sampler's
+# self-measured overhead staying under 1% while a busy thread churns.
 # See docs/resilience.md, docs/observability.md, docs/model_registry.md,
 # docs/streaming.md, docs/fleet.md.
 # Usage: scripts/run_chaos.sh [extra pytest args...]
@@ -48,5 +54,6 @@ cd "$repo_root"
 exec env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_resilience.py tests/test_obs.py tests/test_registry.py \
   tests/test_stream.py tests/test_fleet.py tests/test_flightrec.py \
-  tests/test_autoscaler.py tests/test_hostrt.py tests/test_lease.py -q \
+  tests/test_autoscaler.py tests/test_hostrt.py tests/test_lease.py \
+  tests/test_profiler.py -q \
   -p no:cacheprovider "$@"

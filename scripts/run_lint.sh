@@ -251,6 +251,15 @@ PYEOF
   env JAX_PLATFORMS=cpu python scripts/hostrt_smoke.py
   echo "hostrt smoke: host death survived with zero failed queries, one host-death bundle, HOST-DOWN census rendered, capacity restored on survivor"
 
+  # --- profile smoke (ISSUE 18, docs/observability.md §Profiling plane):
+  #     one real CPU server with the plane on — `pio profile serve`
+  #     captures a short device trace into a content-addressed bundle,
+  #     the bundle lists/shows/exports through the CLI with the manifest
+  #     model version matching the serving lane, /profile/stacks serves
+  #     the always-on sampler's folded stacks, and `pio doctor
+  #     --roofline` exits 0 with finite numbers for every bucket family.
+  env JAX_PLATFORMS=cpu python scripts/profile_smoke.py
+
   # chaos gate includes the observability suite (tests/test_obs.py):
   # counters moving under faults + trace propagation are CI-asserted
   exec "$repo_root/scripts/run_chaos.sh"

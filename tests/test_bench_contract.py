@@ -170,6 +170,9 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
             return {"evalgrid_cells_per_hour": 2000.0}, None  # CPU phase
         if name == "elastic":
             return {"fleet_trace_p95_ms": 45.0}, None  # CPU fleet: still runs
+        if name == "roofline":
+            return {"roofline_topk_ai": 3.45,
+                    "sampler_overhead_frac": 0.002}, None  # CPU phase
         if name in ("ann", "secondary"):
             # host-side/backed-independent workloads run on the CPU
             # backend instead of being zeroed by the outage
@@ -190,7 +193,7 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     names = [c[0] for c in calls]
     assert [n for n in names if n != "probe"] == [
         "serving_local", "batchpredict", "ann", "evalgrid", "secondary",
-        "elastic",
+        "elastic", "roofline",
     ]
     assert names.count("probe") == 2  # initial + the single late retry
     assert out["preflight_attempts"] == 2
@@ -221,6 +224,9 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
             return {"evalgrid_cells_per_hour": 2000.0}, None  # CPU phase
         if name == "elastic":
             return {"fleet_trace_p95_ms": 45.0}, None  # CPU fleet: still runs
+        if name == "roofline":
+            return {"roofline_topk_ai": 3.45,
+                    "sampler_overhead_frac": 0.002}, None  # CPU phase
         if name in ("ann", "secondary"):
             assert env == {"JAX_PLATFORMS": "cpu"}
             if name == "ann":
@@ -239,7 +245,7 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
     assert rc == 0  # a requested CPU-only run that shipped numbers is healthy
     assert calls == [
         "serving_local", "batchpredict", "ann", "evalgrid", "secondary",
-        "elastic",
+        "elastic", "roofline",
     ]
     assert out["preflight_attempts"] == 0
     assert out["bench_cpu_only"] is True
@@ -288,6 +294,7 @@ def test_failed_serving_retry_keeps_random_label(monkeypatch, capsys):
             "evalgrid": ({}, None),
             "secondary": ({}, None),
             "elastic": ({}, None),
+            "roofline": ({}, None),
         }
         return results[name]
 
@@ -402,6 +409,8 @@ def test_dead_then_alive_device_recovers_the_capture(monkeypatch, capsys):
             "evalgrid": ({"evalgrid_cells_per_hour": 2000.0}, None),
             "secondary": ({"naive_bayes_train_ms": 50.0}, None),
             "elastic": ({"fleet_trace_p95_ms": 45.0}, None),
+            "roofline": ({"roofline_topk_ai": 3.45,
+                          "sampler_overhead_frac": 0.002}, None),
         }
         return results[name]
 
@@ -671,6 +680,34 @@ class TestCompareBench:
         assert (
             verdict["compare_regressions"][0]["field"] == "fleet_peak_replicas"
         )
+
+    def test_roofline_fields_are_gated(self):
+        """ISSUE 18: cost-per-1k and sampler overhead gate lower-is-
+        better; arithmetic intensity gates higher-is-better."""
+        base = {
+            **BASE,
+            "roofline_topk_cost_per_1k_usd": 1.0e-7,
+            "roofline_topk_ai": 3.4,
+            "sampler_overhead_frac": 0.002,
+        }
+        cur = {**base, "roofline_topk_cost_per_1k_usd": 2.0e-7}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is False
+        assert (
+            verdict["compare_regressions"][0]["field"]
+            == "roofline_topk_cost_per_1k_usd"
+        )
+        # AI dropping = the kernel got more memory-bound: a regression
+        cur = {**base, "roofline_topk_ai": 2.0}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is False
+        assert verdict["compare_regressions"][0]["field"] == "roofline_topk_ai"
+        # the sampler getting more expensive trips the always-on budget
+        cur = {**base, "sampler_overhead_frac": 0.009}
+        verdict = bench.compare_bench(cur, [base])
+        assert verdict["compare_ok"] is False
+        # string/untyped roofline metadata never gates
+        assert bench._compare_direction("roofline_device") == 0
 
     def test_elastic_zero_shed_prior_is_degenerate_not_tripping(self):
         # a 0-shed prior cannot form a ratio; the e2e/chaos suite owns
