@@ -129,6 +129,9 @@ _TRAIN_ENTRY_GLOBS = (
     "*/stream/trainers.py",
     "*/stream/pipeline.py",
     "*/tuning/*.py",
+    # the lifecycle controller's tick path reaches the grid runner and
+    # registry — bare device syncs there ride the same accounting rule
+    "*/lifecycle/*.py",
 )
 
 # evaluation grid: held-out scoring must ride Engine.dispatch_batch's
@@ -148,6 +151,9 @@ _ASYNC_ENTRY_GLOBS = (
     # must prove its blocking work runs off the event loop
     "*/obs/profiler.py",
     "*/obs/sampler.py",
+    # the lifecycle controller's async run() shares the fleet parent's
+    # event loop with the gateway — its ticks must stay on the executor
+    "*/lifecycle/*.py",
 )
 
 DEFAULT_ENTRY_POINTS: tuple[EntryPoint, ...] = (

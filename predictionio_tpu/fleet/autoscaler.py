@@ -664,21 +664,10 @@ class Autoscaler:
             await asyncio.sleep(interval)
 
 
-def registry_rollout_probe(registry_dir: str) -> Callable[[], bool]:
-    """True while ANY engine's rollout is mid-bake (mode != off) — the
-    never-resize-mid-bake input, read from the same registry the fleet
-    coordinates through."""
-    from predictionio_tpu.registry.store import ArtifactStore
-
-    store = ArtifactStore(registry_dir)
-
-    def probe() -> bool:
-        return any(
-            store.state_by_key(key).mode != "off" for key in store.engines()
-        )
-
-    return probe
-
+# The never-act-mid-bake probe moved to the registry package (PR 19)
+# so the autoscaler and the lifecycle controller share ONE definition of
+# "rollout active"; re-exported here for existing importers.
+from predictionio_tpu.registry.probe import registry_rollout_probe  # noqa: E402
 
 __all__ = [
     "Autoscaler",

@@ -70,6 +70,15 @@ _STRIP_FLAGS = {
     # multi-host / multi-gateway topology flags are parent-only too
     "--hosts": True,
     "--gateways": True,
+    # the lifecycle controller lives in the fleet parent only (a worker
+    # running its own retune loop would grid-search once per replica)
+    "--lifecycle": True,
+    "--lifecycle-cadence": True,
+    "--lifecycle-cooldown": True,
+    "--lifecycle-workers": True,
+    "--lifecycle-nice": True,
+    "--lifecycle-warm-limit": True,
+    "--lifecycle-app": True,
 }
 
 
@@ -307,6 +316,18 @@ def run_fleet(args, cli_argv: list[str]) -> int:
             args, supervisor, scale_target, spec_factory, ring, metrics, obs
         )
 
+    lifecycle = None
+    if getattr(args, "lifecycle", None):
+        if obs.get("telemetry") is None:
+            raise ValueError(
+                "--lifecycle reads drift signals off the telemetry ring; "
+                "it cannot run with the flight recorder disabled "
+                "(--obs-dir '')"
+            )
+        lifecycle = build_lifecycle(
+            args, metrics, obs, serve_url=f"http://127.0.0.1:{args.port}"
+        )
+
     async def main() -> None:
         supervisor.start()
         loop = asyncio.get_running_loop()
@@ -314,6 +335,11 @@ def run_fleet(args, cli_argv: list[str]) -> int:
         auto_task = (
             asyncio.ensure_future(autoscaler.run())
             if autoscaler is not None
+            else None
+        )
+        life_task = (
+            asyncio.ensure_future(lifecycle.run())
+            if lifecycle is not None
             else None
         )
 
@@ -338,7 +364,9 @@ def run_fleet(args, cli_argv: list[str]) -> int:
                     await gw.stop()
                 except Exception:  # noqa: BLE001 - best-effort teardown
                     logger.exception("peer gateway stop failed")
-            tasks = [t for t in (sup_task, auto_task) if t is not None]
+            tasks = [
+                t for t in (sup_task, auto_task, life_task) if t is not None
+            ]
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
@@ -375,6 +403,18 @@ def run_fleet(args, cli_argv: list[str]) -> int:
             f"Autoscaler on: device envelope [{cfg.min_replicas}.."
             f"{cfg.max_replicas}], cpu-fallback max {cfg.cpu_fallback_max}, "
             f"tick {cfg.tick_interval_s:g}s (docs/fleet.md §Autoscaling)"
+        )
+    if lifecycle is not None:
+        lcfg = lifecycle.policy.config
+        triggers = (
+            f"drift + cadence {lcfg.cadence_s:g}s"
+            if lcfg.cadence_s
+            else "drift/manual"
+        )
+        print(
+            f"Lifecycle controller on: {triggers}, state "
+            f"{lifecycle.state_dir} (`pio lifecycle status`, "
+            "docs/lifecycle.md)"
         )
     try:
         asyncio.run(main())
@@ -451,6 +491,94 @@ def build_autoscaler(
         ),
         metrics=metrics,
         incidents=obs.get("incidents"),
+    )
+
+
+def build_lifecycle(args, metrics: MetricsRegistry, obs: dict, serve_url: str):
+    """Assemble the lifecycle controller from the deploy flags
+    (docs/lifecycle.md): the fleet's own telemetry ring is the drift
+    sensor AND the transition log, its incident recorder snapshots
+    aborts/rollbacks, its metrics registry exports ``pio_lifecycle_*``
+    through the gateway's federated /metrics, and the gateway itself is
+    the cache-warm target (warm queries take the same least-loaded route
+    production traffic does)."""
+    from predictionio_tpu.lifecycle import (
+        LifecycleConfig,
+        LifecycleController,
+        LifecyclePolicy,
+        build_grid_tuner,
+        build_warmer,
+    )
+    from predictionio_tpu.registry.probe import registry_rollout_probe
+    from predictionio_tpu.workflow.engine_loader import load_manifest
+
+    registry_dir = getattr(args, "registry_dir", None) or os.environ.get(
+        "PIO_REGISTRY_DIR"
+    )
+    if not registry_dir:
+        raise ValueError(
+            "--lifecycle stages and promotes through the registry; it "
+            "needs --registry-dir (or $PIO_REGISTRY_DIR)"
+        )
+    manifest = load_manifest(
+        getattr(args, "engine_dir", "."), getattr(args, "variant", None)
+    )
+
+    def flag(name, default, cast):
+        value = getattr(args, name, None)
+        return default if value is None else cast(value)
+
+    config = LifecycleConfig(
+        cadence_s=flag("lifecycle_cadence", 0.0, float),
+        cooldown_s=flag("lifecycle_cooldown", 600.0, float),
+        warm_limit=flag("lifecycle_warm_limit", 256, int),
+    )
+    state_dir = os.path.join(obs["dir"], "lifecycle")
+    cwd = os.getcwd()
+    if cwd not in sys.path:
+        sys.path.insert(0, cwd)
+    tuner = build_grid_tuner(
+        args.lifecycle,
+        workdir=os.path.join(state_dir, "grid"),
+        engine_manifest=manifest,
+        registry_dir=registry_dir,
+        workers=flag("lifecycle_workers", 2, int),
+        nice=flag("lifecycle_nice", 10, int),
+        cwd=cwd,
+        env={k: v for k, v in os.environ.items() if k.startswith("PIO_")},
+    )
+    warmer = None
+    app_name = getattr(args, "lifecycle_app", None)
+    if app_name and config.warm_limit > 0:
+        from predictionio_tpu.lifecycle.warm import event_store_queries
+
+        def query_source():
+            # storage resolves lazily at warm time: the event store may
+            # not even exist when the fleet boots
+            from predictionio_tpu.data.storage import Storage
+            from predictionio_tpu.data.store.event_store import resolve_app
+
+            storage = Storage.instance()
+            app_id, _ = resolve_app(storage, app_name, None)
+            return event_store_queries(
+                storage, app_id, limit=config.warm_limit
+            )
+
+        warmer = build_warmer(serve_url, query_source, limit=config.warm_limit)
+    return LifecycleController(
+        LifecyclePolicy(config),
+        state_dir=state_dir,
+        engine_id=manifest.engine_id,
+        registry_dir=registry_dir,
+        tune=tuner,
+        warm=warmer,
+        rollout_probe=registry_rollout_probe(registry_dir),
+        # the SHARED ring object: drift records written by replicas/obs
+        # plane land where the controller reads, and its transitions land
+        # where `pio top --history` renders
+        ring=obs.get("telemetry"),
+        incidents=obs.get("incidents"),
+        metrics=metrics,
     )
 
 
@@ -536,6 +664,7 @@ def wire_incident_sources(
 
 __all__ = [
     "build_autoscaler",
+    "build_lifecycle",
     "build_obs_plane",
     "run_fleet",
     "wire_incident_sources",

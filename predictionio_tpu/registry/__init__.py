@@ -26,6 +26,7 @@ from predictionio_tpu.registry.manifest import (
     ModelManifest,
     params_hash_of,
 )
+from predictionio_tpu.registry.probe import registry_rollout_probe
 from predictionio_tpu.registry.router import (
     Lane,
     RolloutInstruments,
@@ -51,5 +52,6 @@ __all__ = [
     "RolloutState",
     "default_registry_dir",
     "params_hash_of",
+    "registry_rollout_probe",
     "sticky_bucket",
 ]

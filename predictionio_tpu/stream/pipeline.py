@@ -138,6 +138,8 @@ class StreamPipeline:
         tracer=None,
         stage_hook: Callable[[str, str, float], None] | None = None,
         clock: Callable[[], float] = time.time,
+        ring=None,  # obs.tsring.TelemetryRing
+        incidents=None,  # obs.incidents.IncidentRecorder
     ):
         self.tailer = tailer
         self.trainer = trainer
@@ -151,6 +153,13 @@ class StreamPipeline:
         # live server so the candidate lane starts baking immediately
         self.stage_hook = stage_hook
         self._clock = clock
+        # drift breaches are structured signals, not just a counter: each
+        # one lands on the telemetry ring (kind="drift") where the
+        # lifecycle controller reads it as its primary retune sensor, and
+        # fires a rate-limited incident bundle (the recorder's per-kind
+        # min-interval keeps a flapping guard from flooding the disk)
+        self.ring = ring
+        self.incidents = incidents
         self.cursor = cursors.load(tailer.app_id, tailer.channel_id)
         # Restart rewind: events folded and checkpointed but never
         # PUBLISHED live only in the dead process's trainer, so resume
@@ -322,6 +331,30 @@ class StreamPipeline:
                 return m
         return None
 
+    def _signal_drift(self, report) -> None:
+        """A breached guard is the lifecycle controller's primary sensor:
+        one structured ring record per suppressed publish (engine,
+        trainer, guard, measured-vs-threshold) plus a rate-limited
+        incident bundle. Never raises — the publish suppression already
+        happened and the stream loop must keep folding."""
+        detail = {
+            "engine": self.config.engine_id,
+            "trainer": self.trainer.name,
+            "guard": report.metric,
+            "measured": report.current,
+            "threshold": report.baseline,
+            "reason": report.reason,
+        }
+        if self.ring is not None:
+            try:
+                self.ring.append({"kind": "drift", **detail})
+            except Exception:
+                logger.exception("drift signal: ring append failed")
+        if self.incidents is not None:
+            # trigger() is internally rate-limited per kind and never
+            # raises; the bundle snapshots the ring tail around the breach
+            self.incidents.trigger("stream-drift", context=detail)
+
     def _maybe_publish(self) -> tuple[str | None, bool]:
         cfg = self.config
         span_to = self.cursor.pos()
@@ -340,6 +373,7 @@ class StreamPipeline:
                 logger.warning(
                     "drift guard breached; publish suppressed: %s", report.reason
                 )
+                self._signal_drift(report)
                 return None, True
             existing = self._find_published_span(span_id)
             if existing is not None:

@@ -385,6 +385,22 @@ def _run_stream(args, manifest) -> int:
             )
 
     instruments = StreamInstruments()
+    # --obs-dir: drift breaches become structured signals on the shared
+    # telemetry ring (the lifecycle controller's retune sensor) plus
+    # rate-limited incident bundles, instead of only a counter bump
+    ring = incidents = None
+    obs_dir = getattr(args, "obs_dir", None)
+    if obs_dir:
+        from predictionio_tpu.obs.incidents import IncidentRecorder
+        from predictionio_tpu.obs.tsring import TelemetryRing
+
+        ring = TelemetryRing(
+            os.path.join(obs_dir, "telemetry"), writer_id="stream"
+        )
+        incidents = IncidentRecorder(
+            os.path.join(obs_dir, "incidents"), metrics=instruments.registry
+        )
+        incidents.add_source("telemetry-ring", lambda: ring.tail(200))
     pipeline = StreamPipeline(
         tailer,
         trainer,
@@ -393,6 +409,8 @@ def _run_stream(args, manifest) -> int:
         config,
         instruments=instruments,
         stage_hook=stage_hook,
+        ring=ring,
+        incidents=incidents,
     )
     metrics_server = None
     if getattr(args, "metrics_port", 0):
@@ -520,6 +538,8 @@ def cmd_eval(args) -> int:
             stage_fraction=args.stage_fraction,
             status_path=args.status_file,
             cwd=cwd,
+            nice=args.nice,
+            worker_class=args.worker_class,
         )
     except ValueError as exc:
         return _die(str(exc))
@@ -556,6 +576,12 @@ def cmd_deploy(args) -> int:
         return _die(
             "--hosts requires --fleet N (host placement is the fleet "
             "supervisor's job; docs/fleet.md §Multi-host)"
+        )
+    if getattr(args, "lifecycle", None) and not args.fleet:
+        return _die(
+            "--lifecycle requires --fleet N (the controller rides the "
+            "fleet parent's obs plane; for a single server run "
+            "`pio lifecycle run` alongside it; docs/lifecycle.md)"
         )
     if getattr(args, "gateways", 1) != 1 and not args.fleet:
         return _die(
@@ -732,9 +758,17 @@ def cmd_top(args) -> int:
         run_batchpredict_top,
         run_evalgrid_top,
         run_history,
+        run_lifecycle_top,
         run_top,
     )
 
+    if getattr(args, "lifecycle", None):
+        return run_lifecycle_top(
+            args.lifecycle,
+            interval_s=args.interval,
+            iterations=1 if args.once else args.iterations,
+            json_mode=args.json,
+        )
     if args.eval:
         return run_evalgrid_top(
             args.eval,
@@ -779,6 +813,160 @@ def cmd_top(args) -> int:
         urls=urls or None,
         hotspots=args.hotspots,
     )
+
+
+def _lifecycle_state_dir(args) -> str:
+    return args.state_dir or os.path.join(args.obs_dir, "lifecycle")
+
+
+def cmd_lifecycle_run(args) -> int:
+    """The standalone lifecycle controller (docs/lifecycle.md): watch the
+    obs dir's telemetry ring for drift signals (plus cadence/manual
+    triggers), retune on background cpu-fallback grid workers, stage the
+    winner, watch the bake, warm the cache on promote. `pio deploy
+    --fleet N --lifecycle` embeds the same loop in the fleet parent; this
+    command runs it against an already-running server."""
+    import asyncio
+
+    from predictionio_tpu.lifecycle import (
+        LifecycleConfig,
+        LifecycleController,
+        LifecyclePolicy,
+        build_grid_tuner,
+        build_warmer,
+    )
+    from predictionio_tpu.lifecycle.warm import event_store_queries
+    from predictionio_tpu.obs.incidents import IncidentRecorder
+    from predictionio_tpu.obs.tsring import TelemetryRing
+    from predictionio_tpu.registry import registry_rollout_probe
+    from predictionio_tpu.workflow.engine_loader import load_manifest
+
+    manifest = load_manifest(args.engine_dir, args.variant)
+    registry_dir = args.registry_dir or os.environ.get("PIO_REGISTRY_DIR")
+    if not registry_dir:
+        return _die(
+            "the lifecycle controller needs a registry "
+            "(--registry-dir or $PIO_REGISTRY_DIR)"
+        )
+    state_dir = _lifecycle_state_dir(args)
+    config = LifecycleConfig(
+        cadence_s=args.cadence,
+        drift_window_s=args.drift_window,
+        min_drift_records=args.min_drift_records,
+        cooldown_s=args.cooldown,
+        tune_timeout_s=args.tune_timeout,
+        bake_timeout_s=args.bake_timeout,
+        tick_interval_s=args.tick_interval,
+        warm_limit=args.warm_limit,
+    )
+    ring = TelemetryRing(
+        os.path.join(args.obs_dir, "telemetry"), writer_id="lifecycle"
+    )
+    incidents = IncidentRecorder(os.path.join(args.obs_dir, "incidents"))
+    incidents.add_source("telemetry-ring", lambda: ring.tail(200))
+    cwd = os.getcwd()
+    if cwd not in sys.path:
+        sys.path.insert(0, cwd)
+    tuner = build_grid_tuner(
+        args.evaluation,
+        workdir=args.workdir or os.path.join(state_dir, "grid"),
+        engine_manifest=manifest,
+        registry_dir=registry_dir,
+        workers=args.workers,
+        nice=args.nice,
+        folds=args.folds,
+        stage_mode=args.stage_mode,
+        stage_fraction=args.stage_fraction,
+        cwd=cwd,
+        env={k: v for k, v in os.environ.items() if k.startswith("PIO_")},
+    )
+    warmer = None
+    if args.serve_url and args.app_name:
+        from predictionio_tpu.data.store.event_store import resolve_app
+
+        storage = _storage()
+        app_id, _ = resolve_app(storage, args.app_name, None)
+        warmer = build_warmer(
+            args.serve_url,
+            lambda: event_store_queries(
+                storage, app_id, limit=args.warm_limit
+            ),
+            limit=args.warm_limit,
+        )
+    controller = LifecycleController(
+        LifecyclePolicy(config),
+        state_dir=state_dir,
+        engine_id=manifest.engine_id,
+        registry_dir=registry_dir,
+        tune=tuner,
+        warm=warmer,
+        rollout_probe=registry_rollout_probe(registry_dir),
+        ring=ring,
+        incidents=incidents,
+    )
+    print(
+        f"Lifecycle controller for {manifest.engine_id}: state {state_dir}, "
+        f"registry {registry_dir}, "
+        f"triggers {'cadence %gs' % args.cadence if args.cadence else 'drift/manual'}"
+    )
+    try:
+        asyncio.run(controller.run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_lifecycle_status(args) -> int:
+    """One status line (or JSON) from the controller's durable state
+    file; works whether or not the controller is alive — the file is the
+    interface, exactly like `pio top --lifecycle`."""
+    from predictionio_tpu.lifecycle import read_json_file
+    from predictionio_tpu.lifecycle.controller import STATE_FILE
+    from predictionio_tpu.tools.top import render_lifecycle
+
+    path = os.path.join(_lifecycle_state_dir(args), STATE_FILE)
+    status = read_json_file(path)
+    if status is None:
+        return _die(
+            f"no lifecycle state at {path} (is a controller running with "
+            "this --obs-dir/--state-dir?)"
+        )
+    if args.json:
+        print(json.dumps(status, indent=2, sort_keys=True))
+    else:
+        print(render_lifecycle(status))
+    return 0
+
+
+def cmd_lifecycle_trigger(args) -> int:
+    """Queue one manual retune: bumps the control file's trigger token;
+    the controller consumes it on its next tick (bypassing cooldown —
+    an operator asked — but never an in-flight episode or a live bake)."""
+    from predictionio_tpu.lifecycle import write_control
+
+    data = write_control(_lifecycle_state_dir(args), trigger=True)
+    print(
+        f"Retune queued (trigger token {data['trigger']}); the controller "
+        "starts it on its next tick unless an episode is already running."
+    )
+    return 0
+
+
+def cmd_lifecycle_pause(args) -> int:
+    """Flip automatic triggers off/on. Pause stops NEW episodes only —
+    an in-flight grid, bake, or warm always runs to its outcome (killing
+    half-applied lifecycle work is how registries end up wedged)."""
+    from predictionio_tpu.lifecycle import write_control
+
+    paused = args.subcommand == "pause"
+    write_control(_lifecycle_state_dir(args), paused=paused)
+    print(
+        "Lifecycle paused (automatic triggers off; `pio lifecycle resume` "
+        "re-enables, manual `trigger` still works)."
+        if paused
+        else "Lifecycle resumed (automatic triggers back on)."
+    )
+    return 0
 
 
 def _incidents_dir(args) -> str:
@@ -1743,6 +1931,12 @@ def build_parser() -> argparse.ArgumentParser:
             help="serve the pipeline's pio_stream_* metrics at "
             "http://0.0.0.0:PORT/metrics (for `pio top`); 0 disables",
         )
+        x.add_argument(
+            "--obs-dir",
+            help="observability plane dir: drift-guard breaches land on "
+            "its telemetry ring (kind=drift — the lifecycle controller's "
+            "retune sensor) and snapshot rate-limited incident bundles",
+        )
 
     x = sub.add_parser("build")
     engine_args(x)
@@ -1889,6 +2083,21 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="write throttled atomic progress snapshots here; "
         "`pio top --eval PATH` renders them live",
+    )
+    x.add_argument(
+        "--nice",
+        type=int,
+        default=0,
+        help="re-nice grid worker processes by this amount (background "
+        "retunes yield the CPU to serving; 0 = inherit)",
+    )
+    x.add_argument(
+        "--worker-class",
+        choices=["", "cpu-fallback"],
+        default="",
+        help="fleet replica class the workers run as: cpu-fallback pins "
+        "workers to JAX_PLATFORMS=cpu and bounds --workers so the grid "
+        "never grabs the accelerator from serving",
     )
     x.add_argument(
         "--out", default=None, help="write the grid report JSON here"
@@ -2077,6 +2286,57 @@ def build_parser() -> argparse.ArgumentParser:
         help="poll the registry's state generation on this cadence and "
         "adopt stage/promote/rollback made by other processes (fleet "
         "workers default to 1.0; 0 disables; needs --registry-dir)",
+    )
+    x.add_argument(
+        "--lifecycle",
+        default=None,
+        metavar="EVALUATION",
+        help="run the self-driving lifecycle controller in the fleet "
+        "parent: drift on the telemetry ring (or --lifecycle-cadence) "
+        "triggers a background retune of this dotted Evaluation on "
+        "nice'd cpu-fallback grid workers, the winner bakes through the "
+        "rollout gates, promotes auto-warm the result cache; needs "
+        "--registry-dir and --obs-dir (docs/lifecycle.md)",
+    )
+    x.add_argument(
+        "--lifecycle-cadence",
+        type=float,
+        default=None,
+        help="also retune every N seconds (default 0 = drift/manual only)",
+    )
+    x.add_argument(
+        "--lifecycle-cooldown",
+        type=float,
+        default=None,
+        help="seconds after an episode before auto triggers re-arm "
+        "(default 600)",
+    )
+    x.add_argument(
+        "--lifecycle-workers",
+        type=int,
+        default=None,
+        help="grid worker processes for lifecycle retunes (default 2; "
+        "always the cpu-fallback class)",
+    )
+    x.add_argument(
+        "--lifecycle-nice",
+        type=int,
+        default=None,
+        help="re-nice lifecycle grid workers (default 10)",
+    )
+    x.add_argument(
+        "--lifecycle-warm-limit",
+        type=int,
+        default=None,
+        help="max queries replayed per post-promote cache warm "
+        "(default 256; 0 disables)",
+    )
+    x.add_argument(
+        "--lifecycle-app",
+        default=None,
+        metavar="APP_NAME",
+        help="app whose event store supplies warm-up queries (distinct "
+        "users); unset disables cache warming",
     )
     x.add_argument(
         "--drain-grace",
@@ -2290,6 +2550,14 @@ def build_parser() -> argparse.ArgumentParser:
         "so far, ETA",
     )
     x.add_argument(
+        "--lifecycle",
+        default=None,
+        metavar="STATE_FILE",
+        help="render the lifecycle controller's episode line from its "
+        "durable state file (<state-dir>/lifecycle.json): state, "
+        "trigger, grid progress, candidate baking, last outcome",
+    )
+    x.add_argument(
         "--hotspots",
         action="store_true",
         help="append the host-sampler hotspots block (top-of-stack "
@@ -2330,6 +2598,155 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("dest", help="destination directory")
     x.add_argument("--obs-dir", default="pio_obs")
     x.set_defaults(fn=cmd_incidents_export)
+
+    lc = sub.add_parser(
+        "lifecycle",
+        help="the self-driving model lifecycle: drift → retune → bake → "
+        "promote → warm, zero human commands (docs/lifecycle.md)",
+    ).add_subparsers(dest="subcommand", required=True)
+
+    def lifecycle_dir_args(x):
+        x.add_argument(
+            "--obs-dir",
+            default="pio_obs",
+            help="fleet observability directory (the controller's state "
+            "lives under <obs-dir>/lifecycle by default)",
+        )
+        x.add_argument(
+            "--state-dir",
+            default=None,
+            help="controller state directory override (default "
+            "<obs-dir>/lifecycle)",
+        )
+
+    x = lc.add_parser(
+        "run",
+        help="run the controller against an already-deployed server "
+        "(`pio deploy --fleet N --lifecycle` embeds the same loop)",
+    )
+    x.add_argument("evaluation", help="dotted path to the retune Evaluation")
+    x.add_argument("--engine-dir", default=".")
+    x.add_argument("--variant")
+    x.add_argument(
+        "--registry-dir",
+        help="artifact registry the loop stages/promotes through "
+        "(default: $PIO_REGISTRY_DIR)",
+    )
+    lifecycle_dir_args(x)
+    x.add_argument(
+        "--cadence",
+        type=float,
+        default=0.0,
+        help="scheduled retune every N seconds (0 = drift/manual only)",
+    )
+    x.add_argument(
+        "--drift-window",
+        type=float,
+        default=600.0,
+        help="trailing seconds of ring drift records that count as a "
+        "live signal (default 600)",
+    )
+    x.add_argument(
+        "--min-drift-records",
+        type=int,
+        default=1,
+        help="drift records inside the window needed to trigger "
+        "(default 1 — each breach already suppressed a publish)",
+    )
+    x.add_argument(
+        "--cooldown",
+        type=float,
+        default=600.0,
+        help="seconds after an episode before drift/cadence can "
+        "retrigger (manual `pio lifecycle trigger` bypasses it)",
+    )
+    x.add_argument(
+        "--tune-timeout",
+        type=float,
+        default=7200.0,
+        help="abandon a grid run older than this (its ledger still "
+        "speeds up the next episode)",
+    )
+    x.add_argument(
+        "--bake-timeout",
+        type=float,
+        default=3600.0,
+        help="unstage a candidate no server resolves within this",
+    )
+    x.add_argument(
+        "--tick-interval", type=float, default=2.0, help="control-loop cadence"
+    )
+    x.add_argument(
+        "--workers",
+        type=int,
+        default=2,
+        help="grid worker processes (cpu-fallback class: JAX_PLATFORMS "
+        "pinned to cpu, count bounded)",
+    )
+    x.add_argument(
+        "--nice",
+        type=int,
+        default=10,
+        help="re-nice grid workers (background retunes yield to serving)",
+    )
+    x.add_argument("--folds", type=int, default=None)
+    x.add_argument(
+        "--workdir",
+        default=None,
+        help="grid workdir root, one run-NNNN per episode (default "
+        "<state-dir>/grid); stable across restarts = crash resume",
+    )
+    x.add_argument(
+        "--stage-mode", choices=["canary", "shadow"], default="canary"
+    )
+    x.add_argument("--stage-fraction", type=float, default=0.1)
+    x.add_argument(
+        "--serve-url",
+        default=None,
+        help="server/gateway base URL; promoted models warm their result "
+        "cache by replaying queries here (with --app-name)",
+    )
+    x.add_argument(
+        "--app-name",
+        default=None,
+        help="app whose event store supplies warm-up queries "
+        "(distinct users, the batchpredict --from-events source)",
+    )
+    x.add_argument(
+        "--warm-limit",
+        type=int,
+        default=256,
+        help="max queries replayed per post-promote cache warm "
+        "(0 disables warming)",
+    )
+    x.set_defaults(fn=cmd_lifecycle_run)
+
+    x = lc.add_parser(
+        "status", help="episode state from the controller's durable file"
+    )
+    lifecycle_dir_args(x)
+    x.add_argument("--json", action="store_true")
+    x.set_defaults(fn=cmd_lifecycle_status)
+
+    x = lc.add_parser(
+        "trigger",
+        help="queue one manual retune (bypasses cooldown, never an "
+        "in-flight episode or a live bake)",
+    )
+    lifecycle_dir_args(x)
+    x.set_defaults(fn=cmd_lifecycle_trigger)
+
+    x = lc.add_parser(
+        "pause",
+        help="stop automatic triggers (in-flight episodes finish; "
+        "manual trigger still works)",
+    )
+    lifecycle_dir_args(x)
+    x.set_defaults(fn=cmd_lifecycle_pause)
+
+    x = lc.add_parser("resume", help="re-enable automatic triggers")
+    lifecycle_dir_args(x)
+    x.set_defaults(fn=cmd_lifecycle_pause)
 
     prof = sub.add_parser(
         "profile",

@@ -1047,6 +1047,73 @@ def run_evalgrid_top(
     return 0
 
 
+def render_lifecycle(status: dict[str, Any]) -> str:
+    """The ``pio top --lifecycle`` line, from the controller's durable
+    state file (docs/lifecycle.md): episode state, what triggered it,
+    the grid's progress, the candidate being baked, and the last
+    episode's outcome."""
+    policy = status.get("policy") or {}
+    grid = status.get("grid") or {}
+    state = policy.get("state", "?")
+    parts = [f"pio top — lifecycle {status.get('engine') or '?'}"]
+    if status.get("paused"):
+        parts.append("[PAUSED]")
+    head = " ".join(parts) + f"   {time.strftime('%H:%M:%S')}"
+    detail = [f"  state  {state}"]
+    if policy.get("triggerReason"):
+        detail.append(f"trigger {policy['triggerReason']}")
+    if state == "tuning":
+        detail.append(f"grid {grid.get('state') or 'starting'}")
+        if grid.get("error"):
+            detail.append(f"error {grid['error']}")
+    if state == "baking" and policy.get("stagedVersion"):
+        detail.append(f"candidate {policy['stagedVersion']}")
+    if policy.get("lastOutcome"):
+        detail.append(f"last {policy['lastOutcome']}")
+    last = status.get("lastDecision") or {}
+    if last.get("reason"):
+        detail.append(f"({last.get('action')}: {last.get('reason')})")
+    return head + "\n" + "   ".join(detail)
+
+
+def run_lifecycle_top(
+    path: str,
+    interval_s: float = 2.0,
+    iterations: int | None = None,
+    json_mode: bool = False,
+    out: Callable[[str], None] = print,
+    sleep: Callable[[float], None] = time.sleep,
+) -> int:
+    """Poll-and-render loop over the lifecycle controller's state file —
+    the eval-grid loop's twin: a missing/torn file degrades to an
+    'unreadable' line and the loop keeps polling (the writer is atomic,
+    so torn means 'not started yet')."""
+    import json as _json
+
+    n = 0
+    try:
+        while iterations is None or n < iterations:
+            try:
+                with open(path) as fh:
+                    status = _json.load(fh)
+            except (OSError, ValueError) as exc:
+                if json_mode:
+                    out(_json.dumps({"lifecycle": path, "error": str(exc)}))
+                else:
+                    out(f"pio top — lifecycle: {path} unreadable ({exc})")
+            else:
+                if json_mode:
+                    out(_json.dumps({"lifecycle": path, **status}))
+                else:
+                    out(render_lifecycle(status))
+            n += 1
+            if iterations is None or n < iterations:
+                sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def fetch_telemetry_window(
     url: str, window_s: float, timeout_s: float = 5.0
 ) -> list[dict[str, Any]]:

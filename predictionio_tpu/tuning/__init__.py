@@ -31,13 +31,17 @@ from predictionio_tpu.tuning.metrics import (
     RecallAtK,
 )
 from predictionio_tpu.tuning.runner import (
+    CPU_FALLBACK_MAX_WORKERS,
+    WORKER_CLASS_CPU_FALLBACK,
     EvalGridInstruments,
     GridReport,
+    grid_worker_env,
     register_eval_metrics,
     run_grid,
 )
 
 __all__ = [
+    "CPU_FALLBACK_MAX_WORKERS",
     "CellKey",
     "EvalGridInstruments",
     "EventStoreSplitter",
@@ -47,9 +51,11 @@ __all__ = [
     "PrecisionAtK",
     "RecallAtK",
     "TrialLedger",
+    "WORKER_CLASS_CPU_FALLBACK",
     "build_cells",
     "cell_id_of",
     "clamp_folds",
+    "grid_worker_env",
     "register_eval_metrics",
     "run_grid",
 ]

@@ -114,6 +114,9 @@ class GridJob:
     cwd: str = ""
     env: dict[str, str] = dataclasses.field(default_factory=dict)
     batch_size: int = DEFAULT_CELL_BATCH
+    # worker niceness: a background retune (lifecycle controller) must
+    # lose every scheduling contest against serving; 0 = inherit
+    nice: int = 0
 
 
 class CellScorer:
@@ -291,6 +294,11 @@ def init_worker(job: GridJob) -> None:
     Storage.instance()), then the user's cwd on sys.path (evaluations
     live in engine project dirs), then build this worker's scorer."""
     global _SCORER
+    if job.nice > 0:
+        try:
+            os.nice(job.nice)
+        except OSError:  # pragma: no cover - privilege-restricted hosts
+            pass
     os.environ.update(job.env)
     if job.cwd and job.cwd not in sys.path:
         sys.path.insert(0, job.cwd)

@@ -53,6 +53,28 @@ logger = logging.getLogger(__name__)
 UTC = _dt.timezone.utc
 LEDGER_NAME = "ledger.jsonl"
 
+# Mirrors fleet.supervisor.REPLICA_CLASS_CPU without importing the fleet
+# package (tuning stays import-light); the contract test pins the two
+# strings together.
+WORKER_CLASS_CPU_FALLBACK = "cpu-fallback"
+# a cpu-fallback grid is a background citizen: bounded worker count so a
+# wide grid can't starve the serving host of cores
+CPU_FALLBACK_MAX_WORKERS = 4
+
+
+def grid_worker_env(
+    worker_class: str, env: dict[str, str] | None = None
+) -> dict[str, str]:
+    """The env grid workers boot with for a replica class. Requesting the
+    cpu-fallback class pins ``JAX_PLATFORMS=cpu`` (setdefault: an explicit
+    caller override wins) — the same pin the fleet launcher applies to
+    cpu-fallback serving replicas, so a background retune never initializes
+    the accelerator runtime out from under the serving path."""
+    merged = dict(env or {})
+    if worker_class == WORKER_CLASS_CPU_FALLBACK:
+        merged.setdefault("JAX_PLATFORMS", "cpu")
+    return merged
+
 
 # ---------------------------------------------------------------------------
 # instruments
@@ -315,6 +337,8 @@ def run_grid(
     instruments: EvalGridInstruments | None = None,
     cwd: str = "",
     env: dict[str, str] | None = None,
+    nice: int = 0,
+    worker_class: str = "",
     ctx: Any = None,
     evaluation: Any = None,
     on_validated: Any = None,
@@ -333,6 +357,13 @@ def run_grid(
     every argument/ledger validation passed, just before cells start —
     the hook bookkeeping callers use to avoid recording runs that never
     validated.
+
+    ``nice`` > 0 re-nices every pool worker (a background retune must
+    lose scheduling contests against serving); ``worker_class`` names the
+    fleet replica class the workers should behave as — requesting the
+    cpu-fallback class pins workers to ``JAX_PLATFORMS=cpu`` and bounds
+    ``workers`` at :data:`CPU_FALLBACK_MAX_WORKERS` so a grid can never
+    grab the device out from under the serving path.
     """
     from predictionio_tpu.workflow.batch_predict import StatusFile
 
@@ -342,6 +373,19 @@ def run_grid(
     metric = scorer.metric
     if workers < 0:
         raise ValueError(f"workers must be >= 0, got {workers}")
+    if nice < 0:
+        raise ValueError(f"nice must be >= 0 (priority only drops), got {nice}")
+    env = grid_worker_env(worker_class, env)
+    if (
+        worker_class == WORKER_CLASS_CPU_FALLBACK
+        and workers > CPU_FALLBACK_MAX_WORKERS
+    ):
+        logger.info(
+            "cpu-fallback grid: clamping workers %d -> %d",
+            workers,
+            CPU_FALLBACK_MAX_WORKERS,
+        )
+        workers = CPU_FALLBACK_MAX_WORKERS
     if workers > 0 and not (
         isinstance(source, str) or (callable(source) and not hasattr(source, "run"))
     ):
@@ -503,6 +547,7 @@ def run_grid(
                     cwd=cwd,
                     env=dict(env or {}),
                     batch_size=batch_size,
+                    nice=nice,
                 )
                 # spawn, never fork: workers import jax (and the user's
                 # evaluation module); forking a jax-initialized parent is

@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""CI smoke for the lifecycle controller (ISSUE 19, docs/lifecycle.md).
+
+One full self-driving loop on a tiny corpus, zero human commands after
+setup: a scheduled cadence trigger fires → the REAL eval grid runs
+(workers=0, cpu-fallback class) and stages its winner as a registry
+CANDIDATE → the bake resolves (the smoke promotes the candidate the way
+a serving bake gate would; the full gate-under-traffic rail is the
+slow-marked e2e in tests/test_lifecycle.py, run by the chaos gate) →
+the controller observes the promote and warms the result cache by
+replaying bounded queries over a REAL HTTP socket → the episode closes
+PROMOTED with every transition on the telemetry ring, and `pio
+lifecycle status` renders the durable state file from a separate
+process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.server
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from predictionio_tpu.controller import (  # noqa: E402
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    Engine,
+    EngineParams,
+    Params,
+)
+from predictionio_tpu.eval import AverageMetric, Evaluation  # noqa: E402
+
+ENGINE_ID = "lifecycle-smoke"
+N_FOLDS = 2
+N_PARAMS = 2
+WARM_LIMIT = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class SmokeParams(Params):
+    weight: float = 1.0
+
+
+class SmokeDataSource(BaseDataSource):
+    def read_training(self, ctx):
+        return list(range(20))
+
+    def read_eval(self, ctx):
+        for fold in range(N_FOLDS):
+            yield list(range(20)), {"fold": fold}, [(i, i) for i in range(6)]
+
+
+class SmokePreparator(BasePreparator):
+    def prepare(self, ctx, td):
+        return td
+
+
+class SmokeAlgo(BaseAlgorithm):
+    params_class = SmokeParams
+    params: SmokeParams
+
+    def train(self, ctx, pd):
+        return {"weight": self.params.weight}
+
+    def predict(self, model, query):
+        return query * model["weight"]
+
+
+class SmokeServing(BaseServing):
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class SmokeMetric(AverageMetric):
+    def calculate_score(self, ei, q, p, a) -> float:
+        return float(p)
+
+
+def smoke_params(weight: float) -> EngineParams:
+    return EngineParams(
+        data_source=("", None),
+        preparator=("", None),
+        algorithms=[("", SmokeParams(weight=weight))],
+        serving=("", None),
+    )
+
+
+def make_engine() -> Engine:
+    return Engine(SmokeDataSource, SmokePreparator, SmokeAlgo, SmokeServing)
+
+
+def make_evaluation() -> Evaluation:
+    return Evaluation(
+        engine=make_engine(),
+        metric=SmokeMetric(),
+        engine_params_generator=[smoke_params(1.0), smoke_params(3.0)],
+    )
+
+
+def _manifest():
+    from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+    return EngineManifest(
+        engine_id=ENGINE_ID,
+        version="1",
+        variant="engine.json",
+        engine_factory="scripts.lifecycle_smoke.make_engine",
+        description="",
+        variant_json={},
+        engine_dir=".",
+    )
+
+
+class _WarmTarget(http.server.BaseHTTPRequestHandler):
+    hits: list[dict] = []
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        type(self).hits.append(json.loads(body))
+        payload = b"{}"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *a):
+        pass
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="pio_lifecycle_smoke_")
+    registry_dir = os.path.join(tmp, "registry")
+    obs_dir = os.path.join(tmp, "obs")
+    state_dir = os.path.join(obs_dir, "lifecycle")
+    env = {
+        k: v for k, v in os.environ.items() if not k.startswith("PIO_STORAGE_")
+    }
+    env.update(
+        {"PIO_FS_BASEDIR": os.path.join(tmp, "store"), "JAX_PLATFORMS": "cpu"}
+    )
+    os.environ.update(env)
+
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.lifecycle import (
+        LifecycleConfig,
+        LifecycleController,
+        LifecyclePolicy,
+        build_grid_tuner,
+        build_warmer,
+        read_json_file,
+    )
+    from predictionio_tpu.obs.tsring import TelemetryRing
+    from predictionio_tpu.registry import ArtifactStore, registry_rollout_probe
+    from predictionio_tpu.workflow.core_workflow import run_train
+
+    # setup: a v1 stable for the grid winner to canary against
+    storage = Storage(env=env)
+    run_train(
+        make_engine(),
+        _manifest(),
+        smoke_params(1.0),
+        storage=storage,
+        registry_dir=registry_dir,
+    )
+
+    # the warm target: a real socket standing in for the serving tier
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _WarmTarget)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    serve_url = f"http://127.0.0.1:{srv.server_address[1]}"
+
+    config = LifecycleConfig(
+        cadence_s=0.2,  # the scheduled trigger under test
+        cooldown_s=9999.0,
+        tick_interval_s=0.05,
+        warm_limit=WARM_LIMIT,
+    )
+    ring = TelemetryRing(
+        os.path.join(obs_dir, "telemetry"), writer_id="lifecycle"
+    )
+    controller = LifecycleController(
+        LifecyclePolicy(config),
+        state_dir=state_dir,
+        engine_id=ENGINE_ID,
+        registry_dir=registry_dir,
+        tune=build_grid_tuner(
+            make_evaluation,
+            workdir=os.path.join(state_dir, "grid"),
+            engine_manifest=_manifest(),
+            registry_dir=registry_dir,
+            storage=storage,
+            workers=0,
+            stage_fraction=1.0,
+        ),
+        warm=build_warmer(
+            serve_url,
+            lambda: ({"user": f"u{i}", "num": 1} for i in range(20)),
+            limit=WARM_LIMIT,
+        ),
+        rollout_probe=registry_rollout_probe(registry_dir),
+        ring=ring,
+    )
+
+    # the driver loop, with the smoke acting as the serving bake gate:
+    # the moment the grid's candidate appears, "traffic" promotes it
+    store = ArtifactStore(registry_dir)
+    deadline = time.monotonic() + 120
+    while controller.policy.last_outcome != "promoted":
+        assert time.monotonic() < deadline, (
+            f"loop never promoted; state={controller.policy.state} "
+            f"grid={controller._grid_state!r} err={controller._grid_error!r}"
+        )
+        controller.tick()
+        state = store.get_state(ENGINE_ID)
+        if state.candidate:
+            store.promote(ENGINE_ID)
+        time.sleep(config.tick_interval_s)
+
+    # the loop closed: winner promoted, cache warmed, episode idle
+    final = store.get_state(ENGINE_ID)
+    assert final.stable == "v000002" and final.candidate == "", final
+    assert len(_WarmTarget.hits) == WARM_LIMIT, _WarmTarget.hits
+    assert all(h["num"] == 1 for h in _WarmTarget.hits)
+    m = controller.metrics.get("pio_lifecycle_runs_total")
+    assert m.value(outcome="promoted") == 1.0
+    assert controller.metrics.get("pio_lifecycle_triggers_total").value(
+        reason="cadence"
+    ) == 1.0
+    events = [
+        r["event"] for r in ring.records() if r.get("kind") == "lifecycle"
+    ]
+    assert events == ["triggered", "tuning", "baking", "finished"], events
+
+    # the operator surface reads the same durable file from outside
+    status = read_json_file(os.path.join(state_dir, "lifecycle.json"))
+    assert status["policy"]["lastOutcome"] == "promoted", status
+    out = subprocess.run(
+        [os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "pio"),
+         "lifecycle", "status", "--obs-dir", obs_dir, "--json"],
+        env=env,
+        capture_output=True,
+        timeout=60,
+    )
+    assert out.returncode == 0, out.stderr.decode()[-2000:]
+    assert json.loads(out.stdout)["policy"]["lastOutcome"] == "promoted"
+
+    srv.shutdown()
+    srv.server_close()
+    print(
+        f"lifecycle smoke: cadence trigger -> grid ({N_PARAMS}x{N_FOLDS} "
+        f"cells) -> candidate v000002 baked+promoted -> {len(_WarmTarget.hits)} "
+        "warm queries replayed -> episode closed PROMOTED, "
+        "`pio lifecycle status` renders"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

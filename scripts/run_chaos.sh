@@ -43,8 +43,17 @@
 # stacks to the bundle off the failure path, alert captures rate-limited
 # and never raising into the serving loop, and the always-on sampler's
 # self-measured overhead staying under 1% while a busy thread churns.
+# The lifecycle stage (tests/test_lifecycle.py, incl. the slow-marked
+# e2e) closes the loop with the controller itself in the blast radius:
+# an injected drift breach triggers a background eval grid against a
+# REAL serving process, the controller is SIGKILLed mid-grid and a
+# restarted one resumes the SAME run via the durable ledger (zero
+# retrained cells), the staged winner bakes under live traffic to an
+# auto-promote with zero 5xx throughout, and the promote warms the
+# result cache — plus the pure-policy matrix (defer-mid-bake, timeouts,
+# cooldown, pause/manual-trigger) on a fake clock.
 # See docs/resilience.md, docs/observability.md, docs/model_registry.md,
-# docs/streaming.md, docs/fleet.md.
+# docs/streaming.md, docs/fleet.md, docs/lifecycle.md.
 # Usage: scripts/run_chaos.sh [extra pytest args...]
 set -euo pipefail
 
@@ -55,5 +64,5 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_resilience.py tests/test_obs.py tests/test_registry.py \
   tests/test_stream.py tests/test_fleet.py tests/test_flightrec.py \
   tests/test_autoscaler.py tests/test_hostrt.py tests/test_lease.py \
-  tests/test_profiler.py -q \
+  tests/test_profiler.py tests/test_lifecycle.py -q \
   -p no:cacheprovider "$@"

@@ -170,6 +170,18 @@ PYEOF
   #     train-unaccounted-sync / eval-per-query-predict over tuning/).
   env JAX_PLATFORMS=cpu python scripts/evalgrid_smoke.py
 
+  # --- lifecycle smoke (ISSUE 19, docs/lifecycle.md): one full
+  #     self-driving loop with zero human commands after setup — a
+  #     scheduled cadence trigger fires, the REAL eval grid runs on
+  #     cpu-fallback workers and stages its winner as a registry
+  #     CANDIDATE, the bake resolves to a promote, the controller warms
+  #     the result cache over a real HTTP socket, and the episode closes
+  #     PROMOTED with every transition on the telemetry ring and `pio
+  #     lifecycle status` rendering the durable state file. The
+  #     drift-triggered + SIGKILL-resume rails run in the chaos gate
+  #     (tests/test_lifecycle.py e2e).
+  env JAX_PLATFORMS=cpu python scripts/lifecycle_smoke.py
+
   # --- ANN smoke (ISSUE 10, docs/ann.md): build a small clustered index,
   #     serve a real engine through it via the registry attach path, and
   #     hold the two acceptance rails by measurement: recall@10 >= 0.95

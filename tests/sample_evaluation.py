@@ -47,3 +47,41 @@ def make_evaluation() -> Evaluation:
             sample_params(5),
         ],
     )
+
+
+class EnvProbeAlgo(Algo0):
+    """Records the worker process's environment + niceness into the file
+    named by $GRID_WORKER_PROBE — how the worker-class contract test sees
+    inside a spawn-pool worker."""
+
+    def train(self, ctx, pd):
+        import json
+        import os
+
+        path = os.environ.get("GRID_WORKER_PROBE")
+        if path:
+            with open(path, "a") as fh:
+                fh.write(
+                    json.dumps(
+                        {
+                            "pid": os.getpid(),
+                            "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+                            "nice": os.nice(0),
+                        }
+                    )
+                    + "\n"
+                )
+        return super().train(ctx, pd)
+
+
+def make_probe_evaluation() -> Evaluation:
+    return Evaluation(
+        engine=Engine(
+            {"ds": DataSource0},
+            {"prep": Preparator0},
+            {"a": EnvProbeAlgo},
+            {"s": Serving0},
+        ),
+        metric=AlgoIdMetric(),
+        engine_params_generator=[sample_params(3), sample_params(9)],
+    )

@@ -914,6 +914,24 @@ class TestAutoscalerLoop:
         store.promote("e")
         assert probe() is False  # bake over: deferred resizes may fire
 
+    def test_rollout_probe_is_the_shared_registry_helper(self):
+        """ISSUE 19 satellite: the probe moved to registry/probe.py so the
+        autoscaler and the lifecycle controller share ONE definition of
+        'a rollout is baking'. The autoscaler import path must keep
+        resolving to the same function (existing importers + the fleet
+        launcher), not a diverged copy."""
+        from predictionio_tpu.fleet.autoscaler import (
+            registry_rollout_probe as via_autoscaler,
+        )
+        from predictionio_tpu.registry import (
+            registry_rollout_probe as via_registry,
+        )
+        from predictionio_tpu.registry.probe import (
+            registry_rollout_probe as canonical,
+        )
+
+        assert via_autoscaler is via_registry is canonical
+
     def test_autoscaler_shape_metric_tracks_classes(self):
         run = _autoscaler_rig(max_replicas=1, cpu_fallback_max=2)
 

@@ -1,0 +1,1296 @@
+"""The lifecycle controller (ISSUE 19, docs/lifecycle.md): the pure
+policy's full decision matrix under a fake clock — drift/cadence/manual
+triggers, cooldown and pause, the mid-bake DEFER episode, every TUNING
+and BAKING branch, serialization roundtrip — then the driver tier with a
+real ArtifactStore and injected tune/warm seams (promote loop, rollback,
+aborts with incident bundles, bake-timeout unstage, crash-resume via the
+durable state file), the warm helpers over a real HTTP socket, the CLI
+control surface, and the chaos e2e rail: drift record on the ring → the
+controller launches a grid → SIGKILL the controller mid-grid → restart
+resumes through the PR-14 ledger → winner bakes under live traffic → the
+PR-4 gate auto-promotes → the cache warms — zero human commands, zero
+client-visible 5xx."""
+
+from __future__ import annotations
+
+import http.client
+import http.server
+import json
+import os
+import signal
+import socket
+import subprocess
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.lifecycle import (
+    LifecycleConfig,
+    LifecycleController,
+    LifecycleInputs,
+    LifecyclePolicy,
+    read_json_file,
+    register_lifecycle_metrics,
+    replay_queries,
+    write_control,
+)
+from predictionio_tpu.lifecycle.policy import (
+    BAKE,
+    DEFER,
+    FINISH,
+    GRID_DONE,
+    GRID_FAILED,
+    GRID_RUNNING,
+    HOLD,
+    OUTCOME_ABORTED,
+    OUTCOME_PROMOTED,
+    OUTCOME_ROLLED_BACK,
+    REASON_CADENCE,
+    REASON_DRIFT,
+    REASON_MANUAL,
+    START_TUNE,
+    STATE_BAKING,
+    STATE_IDLE,
+    STATE_TRIGGERED,
+    STATE_TUNING,
+    TRIGGER,
+    WARM,
+)
+from predictionio_tpu.registry import ArtifactStore, ModelManifest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PIO = os.path.join(REPO, "pio")
+
+NOW = 10_000.0
+
+
+def _cfg(**kw) -> LifecycleConfig:
+    kw.setdefault("drift_window_s", 600.0)
+    kw.setdefault("cooldown_s", 600.0)
+    return LifecycleConfig(**kw)
+
+
+def _drift(t: float, seq: int) -> dict:
+    return {"kind": "drift", "t": t, "seq": seq, "engine": "e"}
+
+
+def _inp(**kw) -> LifecycleInputs:
+    return LifecycleInputs(**kw)
+
+
+# ---------------------------------------------------------------------------
+# tier 1: the pure policy, every branch, fake clock
+# ---------------------------------------------------------------------------
+
+
+class TestPolicyTriggers:
+    def test_steady_hold(self):
+        p = LifecyclePolicy(_cfg())
+        d = p.decide(_inp(), NOW)
+        assert d.action == HOLD and d.reason == "steady"
+        assert p.state == STATE_IDLE
+
+    def test_drift_triggers(self):
+        p = LifecyclePolicy(_cfg())
+        d = p.decide(_inp(records=[_drift(NOW - 10, 5)]), NOW)
+        assert d.action == TRIGGER and d.reason == REASON_DRIFT
+
+    def test_drift_outside_window_ignored(self):
+        p = LifecyclePolicy(_cfg(drift_window_s=60.0))
+        d = p.decide(_inp(records=[_drift(NOW - 120, 5)]), NOW)
+        assert d.action == HOLD
+
+    def test_non_drift_records_ignored(self):
+        p = LifecyclePolicy(_cfg())
+        records = [{"kind": "scaling", "t": NOW, "seq": 1}]
+        assert p.decide(_inp(records=records), NOW).action == HOLD
+
+    def test_min_drift_records_gate(self):
+        p = LifecyclePolicy(_cfg(min_drift_records=3))
+        two = [_drift(NOW - i, i) for i in (1, 2)]
+        assert p.decide(_inp(records=two), NOW).action == HOLD
+        three = two + [_drift(NOW - 3, 3)]
+        d = p.decide(_inp(records=three), NOW)
+        assert d.action == TRIGGER and d.reason == REASON_DRIFT
+
+    def test_consumed_drift_seq_never_refires(self):
+        """One breach triggers one episode: after note_triggered consumes
+        the high-water seq, the same records go quiet even though they
+        are still inside the window."""
+        p = LifecyclePolicy(_cfg(cooldown_s=0.0))
+        inp = _inp(records=[_drift(NOW - 10, 7)])
+        assert p.decide(inp, NOW).action == TRIGGER
+        p.note_triggered(REASON_DRIFT, inp, NOW)
+        p.note_tuning(NOW)
+        p.note_finished(OUTCOME_ABORTED, NOW + 1)
+        assert p.decide(inp, NOW + 2).action == HOLD
+        # a NEW breach (higher seq) re-arms the signal
+        fresh = _inp(records=[_drift(NOW - 10, 7), _drift(NOW + 1, 8)])
+        assert p.decide(fresh, NOW + 2).action == TRIGGER
+
+    def test_cadence_anchors_on_started_at(self):
+        p = LifecyclePolicy(_cfg(cadence_s=100.0))
+        assert p.decide(_inp(), NOW).action == HOLD  # first tick anchors
+        assert p.started_at == NOW
+        assert p.decide(_inp(), NOW + 99).action == HOLD
+        d = p.decide(_inp(), NOW + 100)
+        assert d.action == TRIGGER and d.reason == REASON_CADENCE
+
+    def test_cadence_anchors_on_last_done_after_episode(self):
+        p = LifecyclePolicy(_cfg(cadence_s=100.0, cooldown_s=0.0))
+        p.note_started(NOW)
+        p.note_triggered(REASON_CADENCE, _inp(), NOW + 100)
+        p.note_tuning(NOW + 100)
+        p.note_finished(OUTCOME_PROMOTED, NOW + 150)
+        assert p.decide(_inp(), NOW + 249).action == HOLD
+        assert p.decide(_inp(), NOW + 250).action == TRIGGER
+
+    def test_cooldown_suppresses_drift_and_cadence(self):
+        p = LifecyclePolicy(_cfg(cadence_s=10.0, cooldown_s=300.0))
+        p.note_started(NOW)
+        p.note_finished(OUTCOME_ROLLED_BACK, NOW)
+        busy = _inp(records=[_drift(NOW + 10, 1)])
+        assert p.decide(busy, NOW + 299).action == HOLD
+        d = p.decide(busy, NOW + 301)
+        assert d.action == TRIGGER and d.reason == REASON_DRIFT
+
+    def test_manual_bypasses_cooldown(self):
+        p = LifecyclePolicy(_cfg(cooldown_s=300.0))
+        p.note_started(NOW)
+        p.note_finished(OUTCOME_PROMOTED, NOW)
+        d = p.decide(_inp(manual_token=1), NOW + 1)
+        assert d.action == TRIGGER and d.reason == REASON_MANUAL
+
+    def test_paused_suppresses_automatic_but_not_manual(self):
+        p = LifecyclePolicy(_cfg(cadence_s=1.0))
+        p.note_started(NOW)
+        busy = _inp(records=[_drift(NOW + 50, 1)], paused=True)
+        d = p.decide(busy, NOW + 60)
+        assert d.action == HOLD and d.reason == "paused"
+        d = p.decide(_inp(paused=True, manual_token=1), NOW + 60)
+        assert d.action == TRIGGER and d.reason == REASON_MANUAL
+
+    def test_manual_token_consumed_once(self):
+        p = LifecyclePolicy(_cfg(cooldown_s=0.0))
+        inp = _inp(manual_token=3)
+        assert p.decide(inp, NOW).action == TRIGGER
+        p.note_triggered(REASON_MANUAL, inp, NOW)
+        p.note_tuning(NOW)
+        p.note_finished(OUTCOME_ABORTED, NOW + 1)
+        assert p.manual_seq == 3
+        assert p.decide(inp, NOW + 2).action == HOLD  # same token: spent
+        assert p.decide(_inp(manual_token=4), NOW + 2).action == TRIGGER
+
+
+class TestPolicyDeferEpisode:
+    def _triggered(self) -> LifecyclePolicy:
+        p = LifecyclePolicy(_cfg())
+        inp = _inp(records=[_drift(NOW, 1)])
+        p.note_triggered(REASON_DRIFT, inp, NOW)
+        return p
+
+    def test_defer_once_then_hold(self):
+        """The autoscaler's DEFER-as-episode contract: one DEFER decision
+        when the episode starts, HOLD afterwards — the deferred counter
+        counts retunes deferred, not ticks spent baking."""
+        p = self._triggered()
+        d = p.decide(_inp(rollout_active=True), NOW + 1)
+        assert d.action == DEFER and d.reason == "mid-bake"
+        p.note_deferred()
+        for dt in (2, 3, 4):
+            d = p.decide(_inp(rollout_active=True), NOW + dt)
+            assert d.action == HOLD and d.reason == "mid-bake-pending"
+
+    def test_deferred_fires_when_rollout_clears(self):
+        p = self._triggered()
+        p.note_deferred()
+        d = p.decide(_inp(rollout_active=False), NOW + 10)
+        assert d.action == START_TUNE and d.reason == REASON_DRIFT
+
+    def test_clear_rollout_starts_tune_immediately(self):
+        p = self._triggered()
+        d = p.decide(_inp(), NOW + 1)
+        assert d.action == START_TUNE and d.reason == REASON_DRIFT
+
+
+class TestPolicyTuning:
+    def _tuning(self, **cfg_kw) -> LifecyclePolicy:
+        p = LifecyclePolicy(_cfg(**cfg_kw))
+        p.note_triggered(REASON_DRIFT, _inp(records=[_drift(NOW, 1)]), NOW)
+        p.note_tuning(NOW)
+        return p
+
+    def test_holds_while_running(self):
+        p = self._tuning()
+        d = p.decide(_inp(grid_state=GRID_RUNNING), NOW + 10)
+        assert d.action == HOLD and d.reason == "tuning"
+
+    def test_winner_staged_bakes(self):
+        p = self._tuning()
+        d = p.decide(
+            _inp(grid_state=GRID_DONE, grid_staged_version="v000002"), NOW + 10
+        )
+        assert d.action == BAKE and d.reason == "winner-staged"
+
+    def test_no_candidate_aborts(self):
+        p = self._tuning()
+        d = p.decide(_inp(grid_state=GRID_DONE), NOW + 10)
+        assert d.action == FINISH and d.reason == "no-candidate"
+        assert d.outcome == OUTCOME_ABORTED
+
+    def test_grid_failure_aborts(self):
+        p = self._tuning()
+        d = p.decide(_inp(grid_state=GRID_FAILED), NOW + 10)
+        assert d.action == FINISH and d.reason == "grid-failed"
+        assert d.outcome == OUTCOME_ABORTED
+
+    def test_tune_timeout_aborts(self):
+        p = self._tuning(tune_timeout_s=100.0)
+        busy = _inp(grid_state=GRID_RUNNING)
+        assert p.decide(busy, NOW + 100).action == HOLD
+        d = p.decide(busy, NOW + 101)
+        assert d.action == FINISH and d.reason == "tune-timeout"
+        assert d.outcome == OUTCOME_ABORTED
+
+
+class TestPolicyBaking:
+    def _baking(self, **cfg_kw) -> LifecyclePolicy:
+        p = LifecyclePolicy(_cfg(**cfg_kw))
+        p.note_triggered(REASON_DRIFT, _inp(records=[_drift(NOW, 1)]), NOW)
+        p.note_tuning(NOW)
+        p.note_baking("v000002", NOW)
+        return p
+
+    def test_holds_while_candidate_bakes(self):
+        p = self._baking()
+        d = p.decide(
+            _inp(
+                registry_stable="v000001",
+                registry_candidate="v000002",
+                registry_mode="canary",
+            ),
+            NOW + 10,
+        )
+        assert d.action == HOLD and d.reason == "baking"
+
+    def test_promote_observed_warms(self):
+        p = self._baking()
+        d = p.decide(
+            _inp(registry_stable="v000002", registry_mode="off"), NOW + 10
+        )
+        assert d.action == WARM and d.outcome == OUTCOME_PROMOTED
+
+    def test_rollback_observed_finishes(self):
+        p = self._baking()
+        d = p.decide(
+            _inp(registry_stable="v000001", registry_mode="off"), NOW + 10
+        )
+        assert d.action == FINISH and d.reason == "bake-rejected"
+        assert d.outcome == OUTCOME_ROLLED_BACK
+
+    def test_other_candidate_takes_lane_counts_as_rejected(self):
+        """Someone else (a stream publish, an operator) staged a DIFFERENT
+        candidate: our winner is no longer baking — the episode resolves
+        on the stable pin, it never adopts a foreign bake."""
+        p = self._baking()
+        d = p.decide(
+            _inp(
+                registry_stable="v000001",
+                registry_candidate="v000009",
+                registry_mode="canary",
+            ),
+            NOW + 10,
+        )
+        assert d.action == FINISH and d.outcome == OUTCOME_ROLLED_BACK
+
+    def test_bake_timeout_aborts(self):
+        p = self._baking(bake_timeout_s=50.0)
+        busy = _inp(
+            registry_stable="v000001",
+            registry_candidate="v000002",
+            registry_mode="canary",
+        )
+        assert p.decide(busy, NOW + 50).action == HOLD
+        d = p.decide(busy, NOW + 51)
+        assert d.action == FINISH and d.reason == "bake-timeout"
+        assert d.outcome == OUTCOME_ABORTED
+
+
+class TestPolicySerialization:
+    def test_roundtrip_mid_episode(self):
+        p = LifecyclePolicy(_cfg(cadence_s=42.0))
+        p.note_started(NOW)
+        inp = _inp(records=[_drift(NOW, 9)], manual_token=2)
+        p.note_triggered(REASON_MANUAL, inp, NOW)
+        p.note_tuning(NOW + 1)
+        p2 = LifecyclePolicy.from_json_dict(p.to_json_dict(), p.config)
+        assert p2.state == STATE_TUNING
+        assert p2.trigger_reason == REASON_MANUAL
+        assert p2.since == NOW + 1
+        assert p2.drift_seq == 9 and p2.manual_seq == 2
+        assert p2.started_at == NOW
+        assert p2.config.cadence_s == 42.0
+
+    def test_bad_state_falls_back_to_idle(self):
+        p = LifecyclePolicy.from_json_dict({"state": "exploded"})
+        assert p.state == STATE_IDLE
+
+    def test_note_finished_resets_episode(self):
+        p = LifecyclePolicy(_cfg())
+        p.note_triggered(REASON_DRIFT, _inp(records=[_drift(NOW, 1)]), NOW)
+        p.note_tuning(NOW)
+        p.note_baking("v2", NOW)
+        p.note_finished(OUTCOME_PROMOTED, NOW + 5)
+        assert p.state == STATE_IDLE and p.staged_version == ""
+        assert p.since is None and not p.deferred
+        assert p.last_done_at == NOW + 5
+        assert p.last_outcome == OUTCOME_PROMOTED
+
+
+# ---------------------------------------------------------------------------
+# tier 2: the driver over a real registry, fake clock, injected seams
+# ---------------------------------------------------------------------------
+
+
+class Clock:
+    def __init__(self, t: float = NOW):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeRing:
+    """List-backed ring stamping seq/t exactly like TelemetryRing, but
+    from the test's fake clock so window() math stays deterministic."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.records_list: list[dict] = []
+        self._seq = 0
+
+    def append(self, record: dict) -> int:
+        rec = dict(record)
+        rec["seq"] = self._seq
+        self._seq += 1
+        rec.setdefault("t", self.clock())
+        self.records_list.append(rec)
+        return rec["seq"]
+
+    def window(self, seconds: float, now: float | None = None):
+        now = self.clock() if now is None else now
+        return [
+            r for r in self.records_list if float(r.get("t", 0)) >= now - seconds
+        ]
+
+    def tail(self, n: int):
+        return self.records_list[-n:] if n else []
+
+    def kinds(self, kind: str):
+        return [r for r in self.records_list if r.get("kind") == kind]
+
+
+class FakeIncidents:
+    def __init__(self):
+        self.triggers: list[tuple[str, dict | None]] = []
+
+    def add_source(self, name, fn):
+        pass
+
+    def trigger(self, kind, context=None, texts=None):
+        self.triggers.append((kind, context))
+
+
+def _manifest(engine_id="e") -> ModelManifest:
+    return ModelManifest(
+        version="", engine_id=engine_id, engine_version="1", engine_variant="v"
+    )
+
+
+def _registry_with_stable(tmp_path, engine_id="e") -> tuple[ArtifactStore, str]:
+    registry_dir = str(tmp_path / "registry")
+    store = ArtifactStore(registry_dir)
+    store.publish(_manifest(engine_id), b"one")  # v000001 auto-stabilizes
+    return store, registry_dir
+
+
+def _staging_tune(store, engine_id="e"):
+    """A fake tune that does what the real grid does: publish the winner
+    and stage it as the registry CANDIDATE, returning the version."""
+    calls: list[bool] = []
+
+    def tune(resume: bool) -> str:
+        calls.append(resume)
+        m = store.publish(_manifest(engine_id), b"winner")
+        store.stage_candidate(engine_id, m.version, fraction=0.5)
+        return m.version
+
+    return tune, calls
+
+
+def _rig(tmp_path, **kw):
+    """Controller over a real registry with one stable, fake clock/ring."""
+    from predictionio_tpu.registry import registry_rollout_probe
+
+    store, registry_dir = _registry_with_stable(tmp_path)
+    clock = kw.pop("clock", None) or Clock()
+    ring = kw.pop("ring", None) or FakeRing(clock)
+    incidents = kw.pop("incidents", None) or FakeIncidents()
+    cfg = kw.pop("cfg", None) or _cfg()
+    tune = kw.pop("tune", None)
+    ctrl = LifecycleController(
+        LifecyclePolicy(cfg),
+        state_dir=str(tmp_path / "state"),
+        engine_id="e",
+        registry_dir=registry_dir,
+        tune=tune,
+        rollout_probe=registry_rollout_probe(registry_dir),
+        ring=ring,
+        incidents=incidents,
+        clock=clock,
+        **kw,
+    )
+    return ctrl, store, clock, ring, incidents
+
+
+def _join_grid(ctrl, timeout=10.0):
+    t = ctrl._grid_thread
+    assert t is not None, "no grid thread launched"
+    t.join(timeout)
+    assert not t.is_alive(), "grid thread did not finish"
+
+
+class TestLifecycleController:
+    def test_full_promote_loop(self, tmp_path):
+        """drift record → TRIGGER → START_TUNE (grid stages the winner) →
+        BAKE → registry promote → WARM + episode closes PROMOTED, with
+        every transition on the ring and the metric family moving."""
+        warmed: list[str] = []
+
+        def warm(version):
+            warmed.append(version)
+            return {"ok": 3, "error": 1}
+
+        ctrl, store, clock, ring, incidents = _rig(tmp_path, warm=warm)
+        tune, calls = _staging_tune(store)
+        ctrl._tune = tune
+
+        assert ctrl.tick().action == HOLD  # steady
+        ring.append(_drift(clock(), 0))
+        assert ctrl.tick().action == TRIGGER
+        assert ctrl.tick().action == START_TUNE
+        _join_grid(ctrl)
+        assert calls == [False]  # a fresh episode never resumes
+        assert ctrl.tick().action == BAKE
+        assert ctrl.policy.state == STATE_BAKING
+        assert ctrl.policy.staged_version == "v000002"
+        assert ctrl.tick().action == HOLD  # baking
+        store.promote("e")
+        d = ctrl.tick()
+        assert d.action == WARM and d.outcome == OUTCOME_PROMOTED
+        assert warmed == ["v000002"]
+        assert ctrl.policy.state == STATE_IDLE
+        assert ctrl.policy.last_outcome == OUTCOME_PROMOTED
+        # the whole loop is one ring timeline
+        events = [r["event"] for r in ring.kinds("lifecycle")]
+        assert events == ["triggered", "tuning", "baking", "finished"]
+        assert ring.kinds("lifecycle")[-1]["decision"]["outcome"] == "promoted"
+        # metrics
+        assert ctrl._m["triggers"].value(reason="drift") == 1.0
+        assert ctrl._m["runs"].value(outcome="promoted") == 1.0
+        assert ctrl._m["warm_queries"].value(result="ok") == 3.0
+        assert ctrl._m["warm_queries"].value(result="error") == 1.0
+        assert incidents.triggers == []  # promotes are not incidents
+        # durable state closed out
+        status = read_json_file(ctrl.state_path)
+        assert status["policy"]["state"] == "idle"
+        assert status["policy"]["lastOutcome"] == "promoted"
+        assert status["lastDecision"]["action"] == "warm"
+
+    def test_defer_mid_bake_never_concurrent(self, tmp_path):
+        """The never-concurrent rule: a trigger that lands while ANY
+        rollout bakes defers (one DEFER episode, then HOLD), and the grid
+        only launches after the lane clears."""
+        ctrl, store, clock, ring, _ = _rig(tmp_path)
+        tune, calls = _staging_tune(store)
+        ctrl._tune = tune
+        # someone else's candidate is mid-bake
+        m = store.publish(_manifest(), b"other")
+        store.stage_candidate("e", m.version, fraction=0.2)
+
+        ring.append(_drift(clock(), 0))
+        assert ctrl.tick().action == TRIGGER
+        assert ctrl.tick().action == DEFER
+        assert ctrl.tick().action == HOLD  # mid-bake-pending, counted once
+        assert calls == [], "grid launched while a rollout was baking"
+        assert ctrl._m["deferred"].value() == 1.0
+        store.promote("e")  # lane clears
+        assert ctrl.tick().action == START_TUNE
+        _join_grid(ctrl)
+        assert calls == [False]
+
+    def test_rollback_closes_episode_with_incident(self, tmp_path):
+        ctrl, store, clock, ring, incidents = _rig(tmp_path)
+        tune, _ = _staging_tune(store)
+        ctrl._tune = tune
+        ring.append(_drift(clock(), 0))
+        ctrl.tick(), ctrl.tick()
+        _join_grid(ctrl)
+        assert ctrl.tick().action == BAKE
+        store.rollback("e", reason="gates failed")
+        d = ctrl.tick()
+        assert d.action == FINISH and d.outcome == OUTCOME_ROLLED_BACK
+        assert ctrl._m["runs"].value(outcome="rolled-back") == 1.0
+        assert [k for k, _ in incidents.triggers] == ["lifecycle-rolled-back"]
+
+    def test_grid_failure_aborts_with_incident_context(self, tmp_path):
+        def tune(resume):
+            raise RuntimeError("params exploded")
+
+        ctrl, store, clock, ring, incidents = _rig(tmp_path, tune=tune)
+        ring.append(_drift(clock(), 0))
+        ctrl.tick(), ctrl.tick()
+        _join_grid(ctrl)
+        d = ctrl.tick()
+        assert d.action == FINISH and d.reason == "grid-failed"
+        assert ctrl._m["runs"].value(outcome="aborted") == 1.0
+        kind, context = incidents.triggers[0]
+        assert kind == "lifecycle-aborted"
+        assert "params exploded" in context["gridError"]
+        # the ring's finished record carries the grid error too
+        assert "params exploded" in ring.kinds("lifecycle")[-1]["error"]
+
+    def test_no_candidate_aborts(self, tmp_path):
+        ctrl, store, clock, ring, _ = _rig(tmp_path, tune=lambda resume: "")
+        ring.append(_drift(clock(), 0))
+        ctrl.tick(), ctrl.tick()
+        _join_grid(ctrl)
+        d = ctrl.tick()
+        assert d.action == FINISH and d.reason == "no-candidate"
+        assert d.outcome == OUTCOME_ABORTED
+
+    def test_tune_timeout_abandons_grid(self, tmp_path):
+        release = threading.Event()
+
+        def tune(resume):
+            release.wait(20)
+            return ""
+
+        clock = Clock()
+        ctrl, store, _, ring, _ = _rig(
+            tmp_path, tune=tune, clock=clock, cfg=_cfg(tune_timeout_s=100.0)
+        )
+        ring.append(_drift(clock(), 0))
+        ctrl.tick(), ctrl.tick()
+        assert ctrl.tick().action == HOLD  # grid still running
+        clock.advance(101.0)
+        d = ctrl.tick()
+        assert d.action == FINISH and d.reason == "tune-timeout"
+        assert ctrl.policy.state == STATE_IDLE
+        assert ctrl._grid_state == "", "abandoned grid result not discarded"
+        release.set()
+
+    def test_bake_timeout_unstages_candidate(self, tmp_path):
+        clock = Clock()
+        ctrl, store, _, ring, incidents = _rig(
+            tmp_path, clock=clock, cfg=_cfg(bake_timeout_s=50.0)
+        )
+        tune, _ = _staging_tune(store)
+        ctrl._tune = tune
+        ring.append(_drift(clock(), 0))
+        ctrl.tick(), ctrl.tick()
+        _join_grid(ctrl)
+        assert ctrl.tick().action == BAKE
+        clock.advance(51.0)
+        d = ctrl.tick()
+        assert d.action == FINISH and d.reason == "bake-timeout"
+        # the driver unstaged: the candidate lane is free again
+        state = store.get_state("e")
+        assert state.candidate == "" and state.mode == "off"
+        assert state.stable == "v000001"
+        assert [k for k, _ in incidents.triggers] == ["lifecycle-aborted"]
+
+    def test_warm_failure_never_rolls_back_promote(self, tmp_path):
+        def warm(version):
+            raise OSError("server unreachable")
+
+        ctrl, store, clock, ring, incidents = _rig(tmp_path, warm=warm)
+        tune, _ = _staging_tune(store)
+        ctrl._tune = tune
+        ring.append(_drift(clock(), 0))
+        ctrl.tick(), ctrl.tick()
+        _join_grid(ctrl)
+        ctrl.tick()
+        store.promote("e")
+        d = ctrl.tick()
+        assert d.outcome == OUTCOME_PROMOTED  # episode still closes good
+        assert ctrl._m["warm_queries"].value(result="error") == 1.0
+        assert ctrl._m["runs"].value(outcome="promoted") == 1.0
+        assert store.get_state("e").stable == "v000002"
+
+    def test_manual_trigger_and_pause_via_control_file(self, tmp_path):
+        ctrl, store, clock, ring, _ = _rig(tmp_path, tune=lambda r: "")
+        write_control(ctrl.state_dir, paused=True)
+        ring.append(_drift(clock(), 0))
+        d = ctrl.tick()
+        assert d.action == HOLD and d.reason == "paused"
+        assert ctrl._m["paused"].value() == 1.0
+        # an operator's trigger cuts through the pause
+        write_control(ctrl.state_dir, trigger=True)
+        d = ctrl.tick()
+        assert d.action == TRIGGER and d.reason == REASON_MANUAL
+        write_control(ctrl.state_dir, paused=False)
+        ctrl.tick()
+        assert ctrl._m["paused"].value() == 0.0
+
+    def test_sigkill_resume_relaunches_grid_with_resume(self, tmp_path):
+        """The crash rail in miniature: controller 1 dies (is dropped)
+        mid-TUNING; controller 2 on the same state dir restores the
+        episode from lifecycle.json and relaunches the grid with
+        resume=True — the ledger contract the e2e exercises for real."""
+        from predictionio_tpu.registry import registry_rollout_probe
+
+        store, registry_dir = _registry_with_stable(tmp_path)
+        clock = Clock()
+        ring = FakeRing(clock)
+        stall = threading.Event()
+
+        def blocking_tune(resume):
+            stall.wait(20)
+            return ""
+
+        state_dir = str(tmp_path / "state")
+
+        def build(tune, calls_into=None):
+            def recorded(resume):
+                if calls_into is not None:
+                    calls_into.append(resume)
+                return tune(resume)
+
+            return LifecycleController(
+                LifecyclePolicy(_cfg()),
+                state_dir=state_dir,
+                engine_id="e",
+                registry_dir=registry_dir,
+                tune=recorded,
+                rollout_probe=registry_rollout_probe(registry_dir),
+                ring=ring,
+                incidents=FakeIncidents(),
+                clock=clock,
+            )
+
+        c1 = build(blocking_tune)
+        ring.append(_drift(clock(), 0))
+        c1.tick(), c1.tick()
+        assert c1.policy.state == STATE_TUNING
+        assert read_json_file(c1.state_path)["policy"]["state"] == "tuning"
+        # "SIGKILL": c1 is simply never ticked again; its thread is stuck
+
+        recorded: list[bool] = []
+
+        def tune2(resume):
+            m = store.publish(_manifest(), b"winner")
+            store.stage_candidate("e", m.version, fraction=0.5)
+            return m.version
+
+        c2 = build(tune2, calls_into=recorded)
+        assert c2.policy.state == STATE_TUNING, "episode not restored"
+        _join_grid(c2)
+        assert recorded == [True], "restored grid must resume the ledger"
+        assert c2.tick().action == BAKE
+        store.promote("e")
+        assert c2.tick().outcome == OUTCOME_PROMOTED
+        stall.set()
+
+    def test_triggered_and_baking_states_survive_restart(self, tmp_path):
+        ctrl, store, clock, ring, _ = _rig(tmp_path)
+        tune, _ = _staging_tune(store)
+        ctrl._tune = tune
+        ring.append(_drift(clock(), 0))
+        ctrl.tick(), ctrl.tick()
+        _join_grid(ctrl)
+        ctrl.tick()  # BAKE
+        from predictionio_tpu.registry import registry_rollout_probe
+
+        c2 = LifecycleController(
+            LifecyclePolicy(_cfg()),
+            state_dir=ctrl.state_dir,
+            engine_id="e",
+            registry_dir=ctrl.registry_dir,
+            rollout_probe=registry_rollout_probe(ctrl.registry_dir),
+            ring=ring,
+            clock=clock,
+        )
+        assert c2.policy.state == STATE_BAKING
+        assert c2.policy.staged_version == "v000002"
+        store.promote("e")
+        assert c2.tick().outcome == OUTCOME_PROMOTED
+
+    def test_run_loop_counts_errors_and_keeps_ticking(self, tmp_path):
+        import asyncio
+
+        ctrl, store, clock, ring, _ = _rig(
+            tmp_path, cfg=_cfg(tick_interval_s=0.01)
+        )
+        boom = {"n": 0}
+
+        def exploding_tick():
+            boom["n"] += 1
+            raise RuntimeError("tick exploded")
+
+        ctrl.tick = exploding_tick
+
+        async def body():
+            task = asyncio.ensure_future(ctrl.run())
+            try:
+                deadline = time.monotonic() + 5
+                while boom["n"] < 3:
+                    assert time.monotonic() < deadline
+                    await asyncio.sleep(0.01)
+            finally:
+                task.cancel()
+                with pytest.raises(asyncio.CancelledError):
+                    await task
+
+        asyncio.run(body())
+        assert ctrl._m["errors"].value() >= 3.0
+
+
+class TestControlFile:
+    def test_trigger_token_increments(self, tmp_path):
+        d = str(tmp_path)
+        assert write_control(d, trigger=True)["trigger"] == 1
+        assert write_control(d, trigger=True)["trigger"] == 2
+        # pause flips merge without clobbering the token
+        data = write_control(d, paused=True)
+        assert data == {"paused": True, "trigger": 2}
+
+    def test_read_json_file_missing_and_torn(self, tmp_path):
+        assert read_json_file(str(tmp_path / "nope.json")) is None
+        p = tmp_path / "torn.json"
+        p.write_text('{"half":')
+        assert read_json_file(str(p)) is None
+        p.write_text("[1,2]")  # non-dict
+        assert read_json_file(str(p)) is None
+
+
+# ---------------------------------------------------------------------------
+# warm helpers: a real socket, bounded replay, event-store corpus
+# ---------------------------------------------------------------------------
+
+
+class _WarmHandler(http.server.BaseHTTPRequestHandler):
+    hits: list[dict] = []
+    fail = False
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        type(self).hits.append(json.loads(body))
+        code = 500 if type(self).fail else 200
+        payload = b'{"itemScores": []}'
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def warm_server():
+    _WarmHandler.hits = []
+    _WarmHandler.fail = False
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _WarmHandler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}", _WarmHandler
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+class TestWarmHelpers:
+    def test_replay_bounded_and_counted(self, warm_server):
+        url, handler = warm_server
+        queries = ({"user": f"u{i}", "num": 3} for i in range(100))
+        counts = replay_queries(url, queries, limit=5)
+        assert counts == {"ok": 5, "error": 0}
+        assert [q["user"] for q in handler.hits] == [f"u{i}" for i in range(5)]
+
+    def test_replay_counts_errors_never_raises(self, warm_server):
+        url, handler = warm_server
+        handler.fail = True
+        counts = replay_queries(url, [{"user": "u0"}], limit=8)
+        assert counts == {"ok": 0, "error": 1}
+        # a dead server is errors, not an exception
+        counts = replay_queries(
+            "http://127.0.0.1:1", [{"user": "u0"}], timeout_s=0.5
+        )
+        assert counts == {"ok": 0, "error": 1}
+
+    def test_event_store_queries_distinct_users(self, memory_storage):
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.base import App
+        from predictionio_tpu.lifecycle.warm import event_store_queries
+
+        app_id = memory_storage.get_meta_data_apps().insert(App(0, "warmapp"))
+        events = []
+        for u in range(6):
+            for i in range(2):  # duplicates must dedup
+                events.append(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                    )
+                )
+        memory_storage.get_l_events().insert_batch(events, app_id)
+        queries = list(
+            event_store_queries(memory_storage, app_id, num=4, limit=4)
+        )
+        assert queries == [{"user": f"u{u}", "num": 4} for u in range(4)]
+
+    def test_build_warmer_rematerializes_corpus(self, warm_server):
+        from predictionio_tpu.lifecycle.warm import build_warmer
+
+        url, handler = warm_server
+        corpora = [[{"user": "a"}], [{"user": "b"}, {"user": "c"}]]
+        warm = build_warmer(url, lambda: corpora.pop(0), limit=10)
+        assert warm("v1") == {"ok": 1, "error": 0}
+        assert warm("v2") == {"ok": 2, "error": 0}  # fresh corpus per promote
+        assert [q["user"] for q in handler.hits] == ["a", "b", "c"]
+
+
+# ---------------------------------------------------------------------------
+# CLI control surface + top line
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(capsys, *argv):
+    from predictionio_tpu.tools.cli import main
+
+    code = main(list(argv))
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+class TestLifecycleCli:
+    def test_trigger_and_pause_write_control(self, tmp_path, capsys):
+        obs = str(tmp_path / "obs")
+        code, out, _ = _run_cli(
+            capsys, "lifecycle", "trigger", "--obs-dir", obs
+        )
+        assert code == 0 and "token 1" in out
+        control = read_json_file(
+            os.path.join(obs, "lifecycle", "lifecycle-control.json")
+        )
+        assert control == {"trigger": 1}
+        code, out, _ = _run_cli(capsys, "lifecycle", "pause", "--obs-dir", obs)
+        assert code == 0
+        control = read_json_file(
+            os.path.join(obs, "lifecycle", "lifecycle-control.json")
+        )
+        assert control == {"trigger": 1, "paused": True}
+        code, out, _ = _run_cli(capsys, "lifecycle", "resume", "--obs-dir", obs)
+        assert code == 0
+        assert read_json_file(
+            os.path.join(obs, "lifecycle", "lifecycle-control.json")
+        )["paused"] is False
+
+    def test_status_renders_state_file(self, tmp_path, capsys):
+        obs = str(tmp_path / "obs")
+        code, _, err = _run_cli(capsys, "lifecycle", "status", "--obs-dir", obs)
+        assert code != 0 and "no lifecycle state" in err
+        state_dir = os.path.join(obs, "lifecycle")
+        os.makedirs(state_dir)
+        status = {
+            "engine": "myengine",
+            "policy": {"state": "baking", "stagedVersion": "v000007",
+                       "triggerReason": "drift", "lastOutcome": ""},
+            "grid": {"state": "", "stagedVersion": "", "error": ""},
+            "paused": False,
+        }
+        with open(os.path.join(state_dir, "lifecycle.json"), "w") as fh:
+            json.dump(status, fh)
+        code, out, _ = _run_cli(capsys, "lifecycle", "status", "--obs-dir", obs)
+        assert code == 0 and "baking" in out and "v000007" in out
+        code, out, _ = _run_cli(
+            capsys, "lifecycle", "status", "--obs-dir", obs, "--json"
+        )
+        assert json.loads(out)["engine"] == "myengine"
+
+    def test_deploy_lifecycle_requires_fleet(self, capsys):
+        code, _, err = _run_cli(
+            capsys, "deploy", "--lifecycle", "grid_eval.make_evaluation"
+        )
+        assert code != 0 and "--lifecycle requires --fleet" in err
+
+    def test_run_requires_registry(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.delenv("PIO_REGISTRY_DIR", raising=False)
+        monkeypatch.chdir(tmp_path)
+        engine_dir = os.path.join(
+            REPO, "predictionio_tpu", "models", "recommendation"
+        )
+        code, _, err = _run_cli(
+            capsys, "lifecycle", "run", "x.make_eval", "--engine-dir", engine_dir
+        )
+        assert code != 0 and "registry" in err
+
+
+class TestTopLifecycleLine:
+    STATUS = {
+        "engine": "eng",
+        "paused": True,
+        "policy": {
+            "state": "tuning",
+            "triggerReason": "cadence",
+            "lastOutcome": "promoted",
+        },
+        "grid": {"state": "running", "stagedVersion": "", "error": ""},
+        "lastDecision": {"action": "hold", "reason": "tuning"},
+    }
+
+    def test_render(self):
+        from predictionio_tpu.tools.top import render_lifecycle
+
+        text = render_lifecycle(self.STATUS)
+        assert "lifecycle eng" in text and "[PAUSED]" in text
+        assert "state  tuning" in text and "trigger cadence" in text
+        assert "grid running" in text and "last promoted" in text
+
+    def test_loop_json_and_unreadable(self, tmp_path):
+        from predictionio_tpu.tools.top import run_lifecycle_top
+
+        path = str(tmp_path / "lifecycle.json")
+        out: list[str] = []
+        rc = run_lifecycle_top(path, iterations=1, json_mode=True, out=out.append)
+        assert rc == 0 and "error" in json.loads(out[0])
+        json.dump(self.STATUS, open(path, "w"))
+        out.clear()
+        run_lifecycle_top(path, iterations=1, json_mode=True, out=out.append)
+        assert json.loads(out[0])["engine"] == "eng"
+        out.clear()
+        run_lifecycle_top(path, iterations=1, out=out.append)
+        assert "lifecycle eng" in out[0]
+
+    def test_cli_top_lifecycle_flag(self):
+        from predictionio_tpu.tools.cli import build_parser
+
+        args = build_parser().parse_args(
+            ["top", "--lifecycle", "/x/lifecycle.json", "--once"]
+        )
+        assert args.lifecycle == "/x/lifecycle.json" and args.once
+
+
+# ---------------------------------------------------------------------------
+# e2e chaos rail: drift → grid → SIGKILL → resume → bake → promote → warm
+# ---------------------------------------------------------------------------
+
+E2E_APP = "lifecyclee2e"
+E2E_ENGINE = "lifecycle-e2e"
+
+_EVAL_MODULE = '''
+"""Retune grid over the recommendation engine (lifecycle e2e fixture)."""
+import os, time
+
+from predictionio_tpu.controller import Engine, EngineParams
+from predictionio_tpu.eval import Evaluation
+from predictionio_tpu.models.recommendation.engine import (
+    ALSAlgorithm, ALSAlgorithmParams, DataSource, DataSourceParams,
+    EvalParams, Preparator, Query, Serving,
+)
+from predictionio_tpu.tuning.metrics import PrecisionAtK
+
+
+class SlowALS(ALSAlgorithm):
+    """Real ALS, slowed + logged so the e2e can SIGKILL the controller
+    mid-grid and count retrains across the restart."""
+
+    def train(self, ctx, pd):
+        log = os.environ.get("GRID_TRAIN_LOG")
+        if log:
+            with open(log, "a") as fh:
+                fh.write(f"{self.params.rank}\\n")
+        time.sleep(float(os.environ.get("GRID_TRAIN_SLEEP", "0")))
+        return super().train(ctx, pd)
+
+
+def make_params(rank):
+    return EngineParams(
+        data_source=("", DataSourceParams(
+            app_name="%s", eval_params=EvalParams(k_fold=2, query_num=5))),
+        preparator=("", None),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=rank, num_iterations=2, lambda_=0.1, seed=3))],
+        serving=("", None),
+    )
+
+
+def make_evaluation():
+    return Evaluation(
+        engine=Engine(DataSource, Preparator, {"als": SlowALS}, Serving,
+                      query_class=Query),
+        metric=PrecisionAtK(5),
+        engine_params_generator=[make_params(4), make_params(8)],
+    )
+''' % E2E_APP
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http(method, port, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request(method, path, body, {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+def _subproc_env(base_dir: str) -> dict:
+    env = dict(os.environ)
+    for k in [k for k in env if k.startswith("PIO_STORAGE_")]:
+        del env[k]
+    env.update({"PIO_FS_BASEDIR": base_dir, "JAX_PLATFORMS": "cpu"})
+    return env
+
+
+def _pio(env, cwd, *args, timeout=240):
+    return subprocess.run(
+        [PIO, *args], env=env, cwd=cwd, capture_output=True, timeout=timeout
+    )
+
+
+def _ledger_lines(path: str) -> int:
+    if not os.path.exists(path):
+        return 0
+    n = 0
+    with open(path) as fh:
+        for line in fh:
+            try:
+                json.loads(line)
+                n += 1
+            except ValueError:
+                pass
+    return n
+
+
+def _tail(proc) -> str:
+    if proc.stdout is None:
+        return ""
+    try:
+        return proc.stdout.read().decode(errors="replace")[-3000:]
+    except Exception:
+        return ""
+
+
+@pytest.mark.slow
+def test_e2e_lifecycle_closes_loop_through_sigkill(tmp_path):
+    """The acceptance rail (ISSUE 19): a drift record on the telemetry
+    ring is the ONLY input — the controller retunes, the winner bakes
+    under live traffic, the gate promotes, the cache warms, and a SIGKILL
+    mid-grid costs at most one cell. Zero human commands after setup,
+    zero client-visible 5xx throughout."""
+    base = str(tmp_path / "store")
+    env = _subproc_env(base)
+    project = tmp_path / "project"
+    project.mkdir()
+    (project / "grid_eval.py").write_text(_EVAL_MODULE)
+
+    # --- setup: app + ingest + v1 stable (the human's LAST commands) ----
+    out = _pio(env, str(project), "app", "new", E2E_APP)
+    assert out.returncode == 0, out.stderr.decode()[-2000:]
+    events_file = tmp_path / "events.jsonl"
+    with open(events_file, "w") as fh:
+        for u in range(12):
+            for i in range(8):
+                if (u + i) % 3 == 2:
+                    continue
+                fh.write(json.dumps({
+                    "event": "rate",
+                    "entityType": "user", "entityId": f"u{u}",
+                    "targetEntityType": "item", "targetEntityId": f"i{i}",
+                    "properties": {"rating": float(1 + (u * i) % 5)},
+                }) + "\n")
+    out = _pio(env, str(project), "import", "--appname", E2E_APP,
+               "--input", str(events_file))
+    assert out.returncode == 0, out.stderr.decode()[-2000:]
+
+    variant = json.load(open(os.path.join(
+        REPO, "predictionio_tpu", "models", "recommendation", "engine.json")))
+    variant["id"] = E2E_ENGINE
+    variant["datasource"]["params"]["appName"] = E2E_APP
+    variant["algorithms"][0]["params"].update(rank=4, numIterations=2)
+    (project / "engine.json").write_text(json.dumps(variant))
+    registry_dir = str(tmp_path / "registry")
+    engine_dir = os.path.join(
+        REPO, "predictionio_tpu", "models", "recommendation")
+    out = _pio(env, str(project), "train", "--engine-dir", engine_dir,
+               "--variant", str(project / "engine.json"),
+               "--registry-dir", registry_dir)
+    assert out.returncode == 0, out.stderr.decode()[-3000:]
+
+    # --- the serving plane: registry-backed deploy with fast bake gates -
+    port = _free_port()
+    server = subprocess.Popen(
+        [PIO, "deploy", "--engine-dir", engine_dir,
+         "--variant", str(project / "engine.json"),
+         "--ip", "127.0.0.1", "--port", str(port),
+         "--registry-dir", registry_dir,
+         "--bake-window", "0.2", "--bake-min-requests", "5",
+         "--registry-sync-interval", "0.1",
+         "--request-timeout", "30"],
+        env=env, cwd=str(project),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    obs_dir = str(tmp_path / "obs")
+    state_dir = os.path.join(obs_dir, "lifecycle")
+    controller = None
+    try:
+        deadline = time.monotonic() + 90
+        while True:
+            assert server.poll() is None, f"server died:\n{_tail(server)}"
+            try:
+                status, _ = _http("GET", port, "/")
+                if status == 200:
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < deadline, "server never came up"
+            time.sleep(0.3)
+
+        # --- controller 1: drift → grid, SIGKILLed mid-grid -------------
+        trains1 = str(tmp_path / "trains1.log")
+        env1 = {**env, "GRID_TRAIN_SLEEP": "1.0", "GRID_TRAIN_LOG": trains1}
+        ctl_args = [
+            PIO, "lifecycle", "run", "grid_eval.make_evaluation",
+            "--engine-dir", ".", "--variant", "engine.json",
+            "--registry-dir", registry_dir, "--obs-dir", obs_dir,
+            "--workers", "0", "--tick-interval", "0.2",
+            "--cooldown", "9999", "--stage-fraction", "1.0",
+            "--serve-url", f"http://127.0.0.1:{port}",
+            "--app-name", E2E_APP, "--warm-limit", "8",
+        ]
+        controller = subprocess.Popen(
+            ctl_args, env=env1, cwd=str(project),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+
+        # the drift signal: one structured record on the shared ring (what
+        # StreamPipeline._signal_drift writes on a breached guard)
+        from predictionio_tpu.obs.tsring import TelemetryRing
+
+        ring = TelemetryRing(
+            os.path.join(obs_dir, "telemetry"), writer_id="stream"
+        )
+        ring.append({
+            "kind": "drift", "engine": E2E_ENGINE, "trainer": "als",
+            "guard": "divergence", "measured": 9.9, "threshold": 0.5,
+            "reason": "forced breach (e2e)",
+        })
+
+        ledger = os.path.join(state_dir, "grid", "run-0001", "ledger.jsonl")
+        deadline = time.monotonic() + 180
+        while _ledger_lines(ledger) < 1:
+            assert controller.poll() is None, (
+                f"controller died before the kill:\n{_tail(controller)}"
+            )
+            assert time.monotonic() < deadline, "no ledger line in 180s"
+            time.sleep(0.05)
+        controller.send_signal(signal.SIGKILL)  # no cleanup, no atexit
+        controller.wait(timeout=30)
+        finished_at_kill = _ledger_lines(ledger)
+        assert 1 <= finished_at_kill < 4, finished_at_kill
+        state = read_json_file(os.path.join(state_dir, "lifecycle.json"))
+        assert state["policy"]["state"] == "tuning", state
+
+        # --- controller 2: restart resumes via the ledger ----------------
+        trains2 = str(tmp_path / "trains2.log")
+        env2 = {**env, "GRID_TRAIN_SLEEP": "0", "GRID_TRAIN_LOG": trains2}
+        controller = subprocess.Popen(
+            ctl_args, env=env2, cwd=str(project),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+
+        # --- the bake: live canary traffic, zero 5xx allowed -------------
+        winner = "v000002"
+        from predictionio_tpu.registry import ArtifactStore as _Store
+
+        store = _Store(registry_dir)
+        deadline = time.monotonic() + 240
+        i = 0
+        while store.get_state(E2E_ENGINE).stable != winner:
+            assert controller.poll() is None, (
+                f"controller died:\n{_tail(controller)}"
+            )
+            assert time.monotonic() < deadline, (
+                "auto-promote never happened; controller tail:\n"
+                + str(read_json_file(os.path.join(state_dir, "lifecycle.json")))
+            )
+            status, body = _http(
+                "POST", port, "/queries.json",
+                json.dumps({"user": f"u{i % 12}", "num": 3}),
+            )
+            assert status == 200, f"client-visible failure: {status} {body}"
+            i += 1
+            time.sleep(0.1)
+
+        # --- the episode closes PROMOTED, warm ran, grid resumed ----------
+        deadline = time.monotonic() + 60
+        while True:
+            state = read_json_file(os.path.join(state_dir, "lifecycle.json"))
+            if state and state["policy"]["state"] == "idle":
+                break
+            assert time.monotonic() < deadline, f"episode never closed: {state}"
+            time.sleep(0.2)
+        assert state["policy"]["lastOutcome"] == "promoted"
+        assert state["lastDecision"]["action"] == "warm"
+        # resume retrained only the unfinished cells (+ the winner refit)
+        trains = len(open(trains2).read().strip().splitlines())
+        assert trains == (4 - finished_at_kill) + 1, (
+            f"resume retrained finished cells: {trains} trains after "
+            f"{finished_at_kill} cells survived the kill"
+        )
+        final = store.get_state(E2E_ENGINE)
+        assert final.stable == winner and final.candidate == ""
+
+        # the ring carries the whole story: drift then lifecycle episode
+        recs = TelemetryRing(os.path.join(obs_dir, "telemetry")).records()
+        kinds = [(r.get("kind"), r.get("event")) for r in recs]
+        assert ("drift", None) in [(k, None) for k, _ in kinds]
+        lifecycle_events = [e for k, e in kinds if k == "lifecycle"]
+        assert "triggered" in lifecycle_events
+        assert "tuning" in lifecycle_events
+        assert "baking" in lifecycle_events
+        assert "finished" in lifecycle_events
+
+        # `pio lifecycle status` reads the same durable file
+        out = _pio(env, str(project), "lifecycle", "status",
+                   "--obs-dir", obs_dir, "--json")
+        assert out.returncode == 0
+        assert json.loads(out.stdout)["policy"]["lastOutcome"] == "promoted"
+    finally:
+        for proc in (controller, server):
+            if proc is not None and proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in (controller, server):
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()

@@ -1323,6 +1323,11 @@ class TestMetricsContract:
             lambda cls: WorkerSpec(name="w9", port=9),
             metrics=fleet_metrics,
         )
+        # the pio_lifecycle_* family rides the fleet-parent registry too
+        # (or a standalone `pio lifecycle run`'s own — same template)
+        from predictionio_tpu.lifecycle import register_lifecycle_metrics
+
+        register_lifecycle_metrics(fleet_metrics)
         registered.update(fleet_metrics._metrics)
         missing = documented - registered
         assert not missing, f"documented but not registered: {sorted(missing)}"

@@ -467,7 +467,7 @@ class TestStreamingCooccurrence:
 
 
 def _pipeline(tmp_path, levents, trainer, *, registry=None, stable_blob=True,
-              engine_id="streameng", **cfg_kw):
+              engine_id="streameng", ring=None, incidents=None, **cfg_kw):
     """Memory-backed pipeline with a registry holding one stable version."""
     store = ArtifactStore(str(tmp_path / "registry"))
     if stable_blob:
@@ -492,6 +492,8 @@ def _pipeline(tmp_path, levents, trainer, *, registry=None, stable_blob=True,
         store,
         config,
         instruments=instruments,
+        ring=ring,
+        incidents=incidents,
     )
     return pipeline, store, instruments
 
@@ -565,6 +567,53 @@ class TestStreamPipeline:
         # recovery: guard passes again -> the accumulated span publishes
         trainer.ok = True
         assert pipeline.run_once()["published"] == "v000002"
+
+    def test_drift_breach_signals_ring_and_incident(self, tmp_path):
+        """ISSUE 19 satellite: a breached guard is the lifecycle
+        controller's primary sensor — one structured kind="drift" record
+        on the telemetry ring (engine, trainer, guard, measured vs
+        threshold) plus a rate-limited incident bundle, and the stream
+        loop keeps folding regardless."""
+        from predictionio_tpu.obs.tsring import TelemetryRing
+
+        class Incidents:
+            def __init__(self):
+                self.calls = []
+
+            def trigger(self, kind, context=None, texts=None):
+                self.calls.append((kind, context))
+
+        l = _levents()
+        l.init(APP)
+        for i in range(4):
+            l.insert(rate_event(f"u{i}", "i0", 3.0, i), APP)
+        trainer = RecordingTrainer()
+        trainer.ok = False
+        ring = TelemetryRing(str(tmp_path / "telemetry"), writer_id="stream")
+        incidents = Incidents()
+        pipeline, store, ins = _pipeline(
+            tmp_path, l, trainer, ring=ring, incidents=incidents
+        )
+        summary = pipeline.run_once()
+        assert summary["driftSuppressed"] is True
+        drift = [r for r in ring.records() if r.get("kind") == "drift"]
+        assert len(drift) == 1
+        rec = drift[0]
+        assert rec["engine"] == "streameng" and rec["trainer"] == "recording"
+        assert rec["guard"] == "test" and rec["reason"] == "forced breach"
+        assert rec["writer"] == "stream" and "seq" in rec and "t" in rec
+        assert incidents.calls == [("stream-drift", {
+            "engine": "streameng", "trainer": "recording", "guard": "test",
+            "measured": None, "threshold": None, "reason": "forced breach",
+        })]
+        # a ring-less pipeline stays silent (the default wiring)
+        trainer2 = RecordingTrainer()
+        trainer2.ok = False
+        l.insert(rate_event("w0", "i0", 3.0, 9), APP)
+        p2, _, _ = _pipeline(
+            tmp_path / "bare", l, trainer2, engine_id="streameng2"
+        )
+        assert p2.run_once()["driftSuppressed"] is True
 
     def test_crash_restart_resumes_without_skips_or_double_publish(self, tmp_path):
         """The tail-under-chaos rail: kill the pipeline mid-drain under

@@ -545,6 +545,156 @@ class TestGridRunner:
             run_grid(FakeRun(lambda ctx: 42), workdir=str(tmp_path), workers=0)
 
 
+class TestWorkerClassKnobs:
+    """The background-citizen contract (ISSUE 19 satellite): grid workers
+    on the cpu-fallback class inherit JAX_PLATFORMS=cpu and a bounded
+    worker count; `--nice` re-nices every pool worker."""
+
+    def test_cpu_fallback_pins_jax_platforms(self):
+        from predictionio_tpu.tuning import (
+            WORKER_CLASS_CPU_FALLBACK,
+            grid_worker_env,
+        )
+
+        env = grid_worker_env(WORKER_CLASS_CPU_FALLBACK, {"PIO_X": "1"})
+        assert env == {"PIO_X": "1", "JAX_PLATFORMS": "cpu"}
+        # an explicit caller override wins (setdefault, not clobber)
+        env = grid_worker_env(
+            WORKER_CLASS_CPU_FALLBACK, {"JAX_PLATFORMS": "tpu"}
+        )
+        assert env["JAX_PLATFORMS"] == "tpu"
+        # the default class leaves the env alone
+        assert grid_worker_env("", {"A": "b"}) == {"A": "b"}
+        assert grid_worker_env("") == {}
+
+    def test_worker_class_matches_fleet_replica_class(self):
+        """One vocabulary across the fleet and the grid: the lifecycle
+        controller pins retune workers to the SAME class name the fleet
+        supervisor uses for cpu-fallback serving replicas."""
+        from predictionio_tpu.fleet.supervisor import REPLICA_CLASS_CPU
+        from predictionio_tpu.tuning import WORKER_CLASS_CPU_FALLBACK
+
+        assert WORKER_CLASS_CPU_FALLBACK == REPLICA_CLASS_CPU == "cpu-fallback"
+
+    def test_cpu_fallback_clamps_worker_count(self, tmp_path):
+        from predictionio_tpu.tuning import (
+            CPU_FALLBACK_MAX_WORKERS,
+            WORKER_CLASS_CPU_FALLBACK,
+        )
+
+        # workers=0 (in-process) stays in-process; the clamp only caps a
+        # pool bigger than the fallback budget, so run the cheap path and
+        # assert through the report's worker count
+        r = run_grid(
+            make_eval(params_sets=(1,)),
+            workdir=str(tmp_path),
+            workers=0,
+            worker_class=WORKER_CLASS_CPU_FALLBACK,
+        )
+        assert r.cells_total == 2
+        assert CPU_FALLBACK_MAX_WORKERS >= 1
+
+    def test_negative_nice_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="nice"):
+            run_grid(
+                make_eval(), workdir=str(tmp_path), workers=0, nice=-5
+            )
+
+    def test_init_worker_renices_before_env_and_scorer(self, monkeypatch):
+        from predictionio_tpu.tuning import cells
+
+        order: list = []
+        monkeypatch.setattr(os, "nice", lambda n: order.append(("nice", n)))
+        monkeypatch.setattr(
+            cells, "resolve_evaluation", lambda src: order.append(("resolve", src))
+        )
+
+        class FakeScorer:
+            @staticmethod
+            def from_evaluation(ev, batch_size=0):
+                order.append(("scorer", batch_size))
+                return object()
+
+        monkeypatch.setattr(cells, "CellScorer", FakeScorer)
+        job = cells.GridJob(source="x.make_eval", nice=10, batch_size=7)
+        cells.init_worker(job)
+        assert order[0] == ("nice", 10)  # priority drops FIRST
+        assert ("scorer", 7) in order
+
+    def test_init_worker_nice_zero_inherits(self, monkeypatch):
+        from predictionio_tpu.tuning import cells
+
+        called = []
+        monkeypatch.setattr(os, "nice", lambda n: called.append(n))
+        monkeypatch.setattr(cells, "resolve_evaluation", lambda src: None)
+
+        class FakeScorer:
+            @staticmethod
+            def from_evaluation(ev, batch_size=0):
+                return object()
+
+        monkeypatch.setattr(cells, "CellScorer", FakeScorer)
+        cells.init_worker(cells.GridJob(source="x"))
+        assert called == []
+
+    @pytest.mark.slow
+    def test_pool_workers_inherit_cpu_pin_and_nice(self, tmp_path):
+        """Contract: spawn-pool workers on the cpu-fallback class boot
+        with JAX_PLATFORMS=cpu in their environment and a dropped
+        priority — asserted from inside the worker process itself (the
+        probe algo records its env + os.nice(0) per trained cell)."""
+        from predictionio_tpu.tuning import WORKER_CLASS_CPU_FALLBACK
+
+        base_nice = os.nice(0)
+        probe = str(tmp_path / "workers.jsonl")
+        r = run_grid(
+            "tests.sample_evaluation.make_probe_evaluation",
+            workdir=str(tmp_path / "grid"),
+            workers=2,
+            cwd=REPO,
+            env={"GRID_WORKER_PROBE": probe},
+            nice=5,
+            worker_class=WORKER_CLASS_CPU_FALLBACK,
+        )
+        assert r.cells_run == 4
+        records = [
+            json.loads(line) for line in open(probe).read().splitlines()
+        ]
+        assert len(records) == 4
+        assert all(rec["jax_platforms"] == "cpu" for rec in records)
+        assert all(rec["nice"] == base_nice + 5 for rec in records)
+        assert all(rec["pid"] != os.getpid() for rec in records)
+
+    def test_run_grid_builds_niced_cpu_job(self, tmp_path, monkeypatch):
+        """The seam run_grid hands the pool: GridJob carries the nice
+        level and the cpu-pinned env (what init_worker applies)."""
+        from predictionio_tpu.tuning import WORKER_CLASS_CPU_FALLBACK
+        from predictionio_tpu.tuning import runner as runner_mod
+
+        captured = {}
+
+        class FakePool:
+            def __init__(self, max_workers, mp_context=None,
+                         initializer=None, initargs=()):
+                captured["job"] = initargs[0]
+                raise RuntimeError("stop before real workers spawn")
+
+        monkeypatch.setattr(runner_mod, "ProcessPoolExecutor", FakePool)
+        with pytest.raises(RuntimeError, match="stop before"):
+            run_grid(
+                "tests.sample_evaluation.make_evaluation",
+                workdir=str(tmp_path),
+                workers=2,
+                nice=12,
+                worker_class=WORKER_CLASS_CPU_FALLBACK,
+                env={"PIO_FS_BASEDIR": "/x"},
+            )
+        job = captured["job"]
+        assert job.nice == 12
+        assert job.env["JAX_PLATFORMS"] == "cpu"
+        assert job.env["PIO_FS_BASEDIR"] == "/x"
+
+
 @pytest.mark.slow
 class TestProcessPool:
     def test_pool_workers_match_sequential(self, tmp_path):
