@@ -84,6 +84,9 @@ PHASES: list[tuple[str, int]] = [
     # for every registered jit bucket family + the host sampler's
     # self-measured overhead — CPU backend, never needs the device
     ("roofline", 600),
+    # session/next-item serving + bandit hot-path overhead (CPU backend,
+    # never needs the device) — ISSUE 20 acceptance evidence
+    ("sequential", 600),
 ]
 
 # phases that need the accelerator; serving_local forces the CPU backend.
@@ -2551,6 +2554,12 @@ _COMPARE_LOWER_IS_BETTER = frozenset(
         "roofline_als_cost_per_1k_usd",
         "roofline_twotower_cost_per_1k_usd",
         "sampler_overhead_frac",
+        # session/next-item engine + bandit hot-path cost (ISSUE 20): the
+        # attention scorer silently degrading to host scoring, or bandit
+        # impression accounting growing a lock hotspot, must trip the gate
+        "serving_sequential_p50_ms",
+        "serving_sequential_p95_ms",
+        "bandit_pick_overhead_ms",
     }
 )
 # the per-phase waterfall percentiles ride the same gate, whatever phases
@@ -2684,6 +2693,135 @@ def _load_bench_json(path: str) -> dict:
         raise
 
 
+def phase_sequential(ck: _Checkpoint) -> None:
+    """The session/next-item engine + bandit overhead (ISSUE 20): train
+    the sequential engine's attention scorer on synthetic sessions (CPU
+    backend), serve next-item batches through ``Engine.dispatch_batch``
+    into the shared ops/topk pack format, and measure
+
+    - ``serving_sequential_p50_ms`` — per-dispatch next-item latency, and
+    - ``bandit_pick_overhead_ms`` — the per-request cost the bandit adds
+      to the hot path (sticky lane pick + impression accounting),
+
+    both ``--compare``-gated: the attention path quietly falling back to
+    host scoring, or bandit accounting growing a lock hotspot, is a
+    regression even on fast hardware."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _jax_setup()
+    import numpy as np
+
+    from predictionio_tpu.bandit import BanditLoop
+    from predictionio_tpu.models.sequential import (
+        Query,
+        SequentialModel,
+        engine_factory,
+    )
+    from predictionio_tpu.models.sequential.engine import (
+        AttentionAlgorithmParams,
+        TrainingData,
+    )
+    from predictionio_tpu.registry.router import RolloutPlan, choose_lane
+    from predictionio_tpu.controller.engine import EngineParams
+
+    n_items = int(os.environ.get("PIO_BENCH_SEQ_ITEMS", "2000"))
+    n_users = int(os.environ.get("PIO_BENCH_SEQ_USERS", "1500"))
+    sess_len = 12
+    rng = np.random.default_rng(0)
+    # markov-flavored synthetic sessions: each item strongly transitions
+    # to (i + small hop), with noise — gives the scorers real structure
+    sequences = []
+    for _ in range(n_users):
+        s = [int(rng.integers(n_items))]
+        for _ in range(sess_len - 1):
+            if rng.random() < 0.7:
+                s.append((s[-1] + int(rng.integers(1, 4))) % n_items)
+            else:
+                s.append(int(rng.integers(n_items)))
+        sequences.append(np.asarray(s, np.int32))
+    vocab = [f"i{j}" for j in range(n_items)]
+    td = TrainingData(
+        users=[f"u{k}" for k in range(n_users)],
+        sequences=sequences,
+        item_vocab=vocab,
+    )
+
+    engine = engine_factory()
+    ep = EngineParams(
+        data_source=("", None),
+        preparator=("", None),
+        algorithms=[
+            (
+                "attention",
+                AttentionAlgorithmParams(rank=32, num_iterations=3, context=8),
+            )
+        ],
+        serving=("", None),
+    )
+    _, _, algorithms, serving = engine.make_components(ep)
+    from predictionio_tpu.workflow.context import WorkflowContext
+
+    ctx = WorkflowContext(mode="training")
+    t0 = time.perf_counter()
+    model: SequentialModel = algorithms[0].train(ctx, td)
+    ck.save(
+        sequential_train_wall_s=round(time.perf_counter() - t0, 3),
+        sequential_items=n_items,
+        sequential_sessions=n_users,
+    )
+    algorithms[0].warmup_serving(model, 8)
+    batch = 8
+    rounds = int(os.environ.get("PIO_BENCH_SEQ_ROUNDS", "60"))
+    queries = [
+        Query(
+            user=f"u{k}",
+            recent_items=tuple(
+                vocab[int(j)] for j in sequences[k % n_users][-4:]
+            ),
+            num=10,
+        )
+        for k in range(batch)
+    ]
+    lat = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        fin = engine.dispatch_batch(algorithms, serving, [model], queries)
+        results = fin()
+        lat.append((time.perf_counter() - t0) * 1000.0 / batch)
+        assert len(results) == batch and results[0].item_scores
+    lat.sort()
+    ck.save(
+        serving_sequential_p50_ms=round(lat[len(lat) // 2], 4),
+        serving_sequential_p95_ms=round(lat[int(len(lat) * 0.95)], 4),
+        sequential_rounds=rounds,
+        sequential_batch=batch,
+    )
+
+    # bandit pick overhead: the ONLY work the bandit adds per served
+    # request — the sticky lane pick it shares with the plain canary plus
+    # its own impression accounting (lock + bounded trace log + counter)
+    loop = BanditLoop("thompson", seed=0)
+
+    class _Tailer:  # poll is never driven here; begin() just needs a slot
+        def poll(self, impressions):
+            return [], 0
+
+    loop.begin("v1", "v2", _Tailer())
+    plan = RolloutPlan("canary", 0.5, "v2")
+    picks = int(os.environ.get("PIO_BENCH_BANDIT_PICKS", "5000"))
+    t0 = time.perf_counter()
+    for k in range(picks):
+        lane = choose_lane(plan, f"u{k}")
+        loop.record_impression(
+            f"tr-{k}", "candidate" if lane == "candidate" else "stable",
+            "v2" if lane == "candidate" else "v1",
+        )
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    ck.save(
+        bandit_pick_overhead_ms=round(wall_ms / picks, 6),
+        bandit_picks=picks,
+    )
+
+
 def phase_roofline(ck: _Checkpoint) -> None:
     """The analytic device anchor (ISSUE 18): lower+compile the registered
     jit bucket families on the CPU backend and record XLA's own
@@ -2757,6 +2895,7 @@ _PHASE_FNS = {
     "secondary": phase_secondary,
     "elastic": phase_elastic,
     "roofline": phase_roofline,
+    "sequential": phase_sequential,
     "probe": phase_probe,
 }
 

@@ -92,7 +92,18 @@ _SERVING_ENTRY_GLOBS = (
     "*/controller/serving.py",
     "*/workflow/create_server.py",
     "*/data/api/*.py",
+    # bandit accounting rides the query hot path (record_impression) and
+    # the rollout heartbeat — same obs + host-sync discipline as serving.
+    # (models/sequential is NOT a serving root: its engine is covered by
+    # the predict-category roots below, whose rules know the sanctioned
+    # ops/topk endings.)
+    "*/bandit/*.py",
 )
+
+# module-scoped obs rules (print-logging / label cardinality) are not
+# reachability-based: they cover the request-path modules plus the new
+# engine + instrument modules that export pio_* families
+_OBS_MODULE_GLOBS = _SERVING_ENTRY_GLOBS + ("*/models/sequential/*.py",)
 
 # the predict path's named roots: Engine.dispatch_batch / the batchpredict
 # drain / ann search / eval-grid scoring. Reachability covers the helpers
@@ -185,10 +196,16 @@ class LintConfig:
     entry_points: tuple[EntryPoint, ...] = DEFAULT_ENTRY_POINTS
     # modules on the request hot path, used by the module-scoped obs rules
     # (print-logging / label cardinality) which are not reachability-based
-    serving_globs: tuple[str, ...] = _SERVING_ENTRY_GLOBS
+    serving_globs: tuple[str, ...] = _OBS_MODULE_GLOBS
     # modules on the stream (speed-layer) path: event-store reads here
-    # must be bounded (rule stream-unbounded-drain)
-    stream_globs: tuple[str, ...] = ("*/stream/*.py",)
+    # must be bounded (rule stream-unbounded-drain). The bandit reward
+    # tail and the sequential engine's ordered-event pager drain the same
+    # store from long-lived loops, so they ride the same rule.
+    stream_globs: tuple[str, ...] = (
+        "*/stream/*.py",
+        "*/bandit/*.py",
+        "*/models/sequential/*.py",
+    )
     # fleet gateway/supervisor modules: outbound replica calls and
     # replica state transitions must route through the span/telemetry
     # helpers (rule fleet-unattributed-proxy) — an unattributed proxy is

@@ -1,0 +1,46 @@
+"""Contextual-bandit exploration on the rollout machinery (docs/bandit.md).
+
+Arms are registry lanes; assignment is the PR-4 sticky sha256 canary
+bucket; reward is feedback-loop events matched to served impressions by
+trace id; the bake gate doubles as reward accounting."""
+
+from predictionio_tpu.bandit.controller import BanditLoop
+from predictionio_tpu.bandit.metrics import BanditInstruments
+from predictionio_tpu.bandit.policy import (
+    ARM_CANDIDATE,
+    ARM_STABLE,
+    DECIDE_EXPLORE,
+    DECIDE_PROMOTE,
+    DECIDE_RETIRE,
+    ArmState,
+    BanditCriteria,
+    BanditDecision,
+    EpsilonGreedyPolicy,
+    ThompsonPolicy,
+    decide,
+    make_policy,
+    p_candidate_better,
+    regret_proxy,
+)
+from predictionio_tpu.bandit.rewards import ImpressionLog, RewardTailer
+
+__all__ = [
+    "ARM_CANDIDATE",
+    "ARM_STABLE",
+    "DECIDE_EXPLORE",
+    "DECIDE_PROMOTE",
+    "DECIDE_RETIRE",
+    "ArmState",
+    "BanditCriteria",
+    "BanditDecision",
+    "BanditInstruments",
+    "BanditLoop",
+    "EpsilonGreedyPolicy",
+    "ImpressionLog",
+    "RewardTailer",
+    "ThompsonPolicy",
+    "decide",
+    "make_policy",
+    "p_candidate_better",
+    "regret_proxy",
+]

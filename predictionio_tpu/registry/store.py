@@ -408,6 +408,66 @@ class ArtifactStore:
             )
             return manifest
 
+    def save_bandit_state(self, engine_id: str, state: dict[str, Any]) -> str:
+        """Persist per-arm bandit posterior state in the artifact grammar:
+        the canonical JSON payload is written content-addressed into the
+        engine's blob store, and ``bandit.json`` becomes an atomically
+        replaced pointer ``{sha256, bytes, updatedAt}`` — readers see
+        either the previous complete posterior or the new one, never a
+        torn write. The superseded blob is unlinked (no manifest ever
+        references it, so GC would otherwise never reap it). Returns the
+        new content address."""
+        with self._lock, self._state_mutex(engine_id):
+            blob = json.dumps(state, sort_keys=True).encode("utf-8")
+            sha = hashlib.sha256(blob).hexdigest()
+            pointer_path = os.path.join(
+                self._engine_dir(engine_id), "bandit.json"
+            )
+            old_sha = ""
+            try:
+                with open(pointer_path, "rb") as fh:
+                    old_sha = str(json.loads(fh.read()).get("sha256", ""))
+            except (OSError, ValueError):
+                pass
+            blob_path = self._blob_path(engine_id, sha)
+            if not os.path.exists(blob_path):  # dedupe by content address
+                _atomic_write(blob_path, blob)
+            _atomic_write(
+                pointer_path,
+                json.dumps(
+                    {
+                        "sha256": sha,
+                        "bytes": len(blob),
+                        "updatedAt": ModelManifest.now_iso(),
+                    },
+                    indent=1,
+                ).encode("utf-8"),
+            )
+            if old_sha and old_sha != sha:
+                try:
+                    os.unlink(self._blob_path(engine_id, old_sha))
+                except OSError:
+                    pass
+            return sha
+
+    def load_bandit_state(self, engine_id: str) -> dict[str, Any] | None:
+        """Read the bandit posterior back through its pointer; a missing,
+        torn, or digest-mismatched artifact reads as None (the bandit
+        restarts with fresh priors rather than trusting corrupt reward
+        history)."""
+        pointer_path = os.path.join(self._engine_dir(engine_id), "bandit.json")
+        try:
+            with open(pointer_path, "rb") as fh:
+                pointer = json.loads(fh.read().decode("utf-8"))
+            sha = str(pointer["sha256"])
+            with open(self._blob_path(engine_id, sha), "rb") as fh:
+                blob = fh.read()
+            if hashlib.sha256(blob).hexdigest() != sha:
+                return None
+            return json.loads(blob.decode("utf-8"))
+        except (OSError, ValueError, KeyError):
+            return None
+
     def attach_eval_evidence(
         self, engine_id: str, version: str, evidence: dict[str, Any]
     ) -> ModelManifest:

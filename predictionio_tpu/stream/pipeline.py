@@ -121,6 +121,11 @@ class StreamInstruments:
         from predictionio_tpu.ann.metrics import AnnInstruments
 
         self.ann = AnnInstruments(r)
+        # the pio_seq_* family: the stream layer is where sessions fold in
+        # (the sequential trainer binds to this on pipeline construction)
+        from predictionio_tpu.models.sequential.metrics import SeqInstruments
+
+        self.seq = SeqInstruments(r)
 
 
 class StreamPipeline:
@@ -147,6 +152,10 @@ class StreamPipeline:
         self.store = store
         self.config = config
         self.instruments = instruments or StreamInstruments()
+        # bind the pio_seq_* family to a sequential trainer that was built
+        # without one (only SequentialStreamTrainer carries the slot)
+        if getattr(trainer, "_instruments", False) is None:
+            trainer._instruments = self.instruments.seq
         self.tracer = tracer or get_tracer()
         # stage_hook(version, mode, fraction) overrides direct registry
         # staging — `pio stream --notify-url` posts /models/candidate to a
@@ -544,13 +553,18 @@ def trainer_for_models(models: list[Any], **kwargs: Any) -> IncrementalTrainer:
     when no model type has a fold-in implementation."""
     from predictionio_tpu.e2.naive_bayes import CategoricalNaiveBayesModel
     from predictionio_tpu.models.recommendation.engine import ALSModel
+    from predictionio_tpu.models.sequential.engine import SequentialModel
     from predictionio_tpu.models.similarproduct.engine import CooccurrenceModel
     from predictionio_tpu.stream.trainers import (
         FoldInALSTrainer,
+        SequentialStreamTrainer,
         StreamingCooccurrenceTrainer,
         StreamingNaiveBayesTrainer,
     )
 
+    for m in models:
+        if isinstance(m, SequentialModel):
+            return SequentialStreamTrainer(m, **kwargs)
     for m in models:
         if isinstance(m, ALSModel):
             return FoldInALSTrainer(models, **kwargs)
@@ -566,5 +580,6 @@ def trainer_for_models(models: list[Any], **kwargs: Any) -> IncrementalTrainer:
     raise ValueError(
         "no incremental trainer for model types "
         f"{[type(m).__name__ for m in models]}; fold-in is implemented for "
-        "ALSModel, CategoricalNaiveBayesModel, and CooccurrenceModel"
+        "SequentialModel, ALSModel, CategoricalNaiveBayesModel, and "
+        "CooccurrenceModel"
     )

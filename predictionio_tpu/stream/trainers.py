@@ -704,3 +704,186 @@ class StreamingCooccurrenceTrainer:
                 f"{self.drift_hit_drop:g} below baseline {baseline:.3f}"
             ),
         )
+
+
+# ---------------------------------------------------------------------------
+# streaming sequential (session / next-item)
+# ---------------------------------------------------------------------------
+
+
+class SequentialStreamTrainer:
+    """Incremental transition counts over per-user session streams.
+
+    Seeded from the sequential engine's :class:`SequentialModel`: the
+    seed's RAW pair counts (kept on the model precisely for this merge —
+    ``train_markov_chain`` output alone is top-N-truncated) merge with
+    stream counts at snapshot time, and the published model's transition
+    matrix is rebuilt through the EXACT ``e2.markov_chain`` math. The
+    attention factor tables, when present, ride through unchanged — they
+    refresh only at batch retrain (documented in docs/sequential.md); the
+    markov scorer is the live-foldable half.
+
+    Events must arrive in session order (the pipeline's ``find_after``
+    tail guarantees it); each event extends its user's session, bumping
+    one (prev -> item) transition count."""
+
+    name = "sequential-stream"
+
+    def __init__(
+        self,
+        seed_model=None,
+        *,
+        top_n: int | None = None,
+        max_users: int = 100_000,
+        holdout_every: int = 8,
+        holdout_window: int = 256,
+        drift_hit_drop: float = 0.5,
+        drift_min_samples: int = 8,
+        instruments=None,
+    ):
+        self._seed_model = seed_model
+        self.top_n = max(
+            1, top_n if top_n is not None else getattr(seed_model, "top_n", 10)
+        )
+        self.max_users = max(16, max_users)
+        self._pair_counts: Counter = Counter()  # (item_str, item_str) -> n
+        self._user_last: dict[str, str] = {}
+        if seed_model is not None:
+            vocab = seed_model.item_vocab
+            for (i, j), c in seed_model.pair_counts.items():
+                self._pair_counts[(vocab[int(i)], vocab[int(j)])] = float(c)
+            for u, i in seed_model.user_last.items():
+                self._user_last[u] = vocab[int(i)]
+        self.holdout = RollingHoldout(holdout_every, holdout_window)
+        self.drift_hit_drop = drift_hit_drop
+        self.drift_min_samples = max(1, drift_min_samples)
+        self._baseline_hit_rate: float | None = None
+        self._top_cache: dict[str, list[tuple[str, float]]] | None = None
+        self.examples_absorbed = 0
+        self.last_absorb_stats: dict[str, int] = {"rows": 0, "entities": 0}
+        self._instruments = instruments
+
+    def absorb(self, events: Sequence[Event]) -> int:
+        absorbed = 0
+        touched: set[str] = set()
+        for e in events:
+            item = e.target_entity_id
+            if item is None or not e.entity_id:
+                continue
+            user = e.entity_id
+            prev = self._user_last.get(user)
+            if prev is not None and self.holdout.offer((prev, item)):
+                # held-out transitions still advance the session cursor —
+                # the NEXT transition's "from" state must stay truthful
+                self._user_last[user] = item
+                continue
+            if prev is not None:
+                self._pair_counts[(prev, item)] += 1
+                self._top_cache = None
+                absorbed += 1
+                touched.add(item)
+            elif len(self._user_last) >= self.max_users:
+                continue  # bounded session-state map: drop NEW users, not counts
+            self._user_last[user] = item
+        self.last_absorb_stats = {"rows": absorbed, "entities": len(touched)}
+        self.examples_absorbed += absorbed
+        if self._instruments is not None and absorbed:
+            self._instruments.on_absorb(absorbed, len(touched))
+        if self._baseline_hit_rate is None and (
+            len(self.holdout.held) >= self.drift_min_samples
+        ):
+            self._baseline_hit_rate = self._hit_rate()
+        return absorbed
+
+    def top_map(self) -> dict[str, list[tuple[str, float]]]:
+        """Merged top-N transition PROBABILITIES keyed by item string —
+        row-normalized and ranked with the identical tie-break the e2
+        trainer uses, cached until the next counted transition."""
+        if self._top_cache is not None:
+            return self._top_cache
+        per_item: dict[str, dict[str, float]] = {}
+        for (a, b), c in self._pair_counts.items():
+            per_item.setdefault(a, {})[b] = per_item.setdefault(a, {}).get(b, 0.0) + c
+        out: dict[str, list[tuple[str, float]]] = {}
+        for a, row in per_item.items():
+            total = sum(row.values())
+            if total <= 0:
+                continue
+            ranked = sorted(
+                ((b, c / total) for b, c in row.items()),
+                key=lambda t: (-t[1], t[0]),
+            )
+            out[a] = ranked[: self.top_n]
+        self._top_cache = out
+        return out
+
+    def snapshot(self) -> list[Any]:
+        from predictionio_tpu.models.sequential.engine import (
+            SequentialModel,
+            markov_from_counts,
+        )
+
+        seed = self._seed_model
+        vocab = list(seed.item_vocab) if seed is not None else []
+        index = {v: i for i, v in enumerate(vocab)}
+
+        def idx(item: str) -> int:
+            i = index.get(item)
+            if i is None:
+                i = len(vocab)
+                vocab.append(item)
+                index[item] = i
+            return i
+
+        counts = {
+            (idx(a), idx(b)): float(c) for (a, b), c in self._pair_counts.items()
+        }
+        model = SequentialModel(
+            item_vocab=vocab,
+            markov=markov_from_counts(counts, len(vocab), self.top_n),
+            pair_counts=counts,
+            user_last={u: index[i] for u, i in self._user_last.items()},
+            top_n=self.top_n,
+            # attention tables refresh only at batch retrain; stream-only
+            # items score through the markov path until then
+            item_in=getattr(seed, "item_in", None),
+            item_out=getattr(seed, "item_out", None),
+            context=getattr(seed, "context", 8),
+        )
+        if self._instruments is not None:
+            self._instruments.on_snapshot(
+                len(vocab), len(counts), len(self._user_last)
+            )
+        return [model]
+
+    def _hit_rate(self) -> float:
+        top = self.top_map()
+        held = list(self.holdout.held)
+        hits = 0
+        for prev, nxt in held:
+            if any(nxt == b for b, _ in top.get(prev, [])):
+                hits += 1
+        return hits / len(held) if held else 0.0
+
+    def drift(self) -> DriftReport:
+        if len(self.holdout.held) < self.drift_min_samples:
+            return DriftReport(
+                True, "hit-rate", reason="insufficient held-out samples"
+            )
+        current = self._hit_rate()
+        baseline = (
+            self._baseline_hit_rate
+            if self._baseline_hit_rate is not None
+            else current
+        )
+        ok = current >= baseline - self.drift_hit_drop
+        return DriftReport(
+            ok,
+            "hit-rate",
+            baseline=baseline,
+            current=current,
+            reason="" if ok else (
+                f"held-out next-item hit rate {current:.3f} dropped more "
+                f"than {self.drift_hit_drop:g} below baseline {baseline:.3f}"
+            ),
+        )

@@ -627,6 +627,15 @@ def cmd_deploy(args) -> int:
         result_cache_ttl_s=args.result_cache_ttl,
         registry_sync_interval_s=args.registry_sync_interval or 0.0,
         drain_grace_s=args.drain_grace,
+        bandit_policy=args.bandit,
+        bandit_epsilon=args.bandit_epsilon,
+        bandit_min_pulls=args.bandit_min_pulls,
+        bandit_app_name=args.bandit_app_name,
+        bandit_reward_events=tuple(
+            s.strip() for s in args.bandit_reward_event.split(",") if s.strip()
+        )
+        if args.bandit_reward_event
+        else ("reward",),
     )
     print(f"Engine server starting on {args.ip}:{args.port} ...")
     run_query_server(args.engine_dir, args.variant, config=config)
@@ -1648,6 +1657,7 @@ BUNDLED_TEMPLATES = (
     "classification",
     "ecommerce",
     "twotower",
+    "sequential",
 )
 
 
@@ -2179,6 +2189,38 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="gates report 'ready' instead of promoting; an operator "
         "promotes via `pio models promote --url ...`",
+    )
+    x.add_argument(
+        "--bandit",
+        choices=("epsilon", "thompson"),
+        help="steer staged candidates with a contextual-bandit policy: "
+        "arms are the stable/candidate lanes, reward is feedback events "
+        "matched to served impressions by trace id, and the bake gate "
+        "doubles as reward accounting (docs/bandit.md)",
+    )
+    x.add_argument(
+        "--bandit-epsilon",
+        type=float,
+        default=0.1,
+        help="explore share for the epsilon policy (doubles as the "
+        "cold-start fraction for thompson)",
+    )
+    x.add_argument(
+        "--bandit-min-pulls",
+        type=int,
+        default=20,
+        help="per-arm impression floor before the reward posterior may "
+        "promote or retire",
+    )
+    x.add_argument(
+        "--bandit-app-name",
+        help="app whose event stream carries the reward events (required "
+        "with --bandit)",
+    )
+    x.add_argument(
+        "--bandit-reward-event",
+        help="comma-separated event names credited as rewards "
+        "(default: reward)",
     )
     x.add_argument(
         "--result-cache-size",

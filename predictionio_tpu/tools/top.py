@@ -176,6 +176,7 @@ def summarize(
     out["phases"] = _phase_summary(metrics)
     out["cache_hit_ratio"] = _cache_hit_ratio(metrics)
     out["ann"] = _ann_summary(metrics)
+    out["bandit"] = _bandit_summary(metrics)
     out["slo"] = _slo_summary(metrics)
     out["stream"] = _stream_summary(metrics, now)
     out["train"] = _train_summary(metrics)
@@ -275,6 +276,43 @@ def _ann_summary(metrics: Metrics) -> dict[str, Any] | None:
         "refreshes_total": _total(metrics, "pio_ann_refreshes_total"),
         "rebuilds_total": _total(metrics, "pio_ann_rebuilds_total"),
         "indexes": indexes,
+    }
+
+
+def _bandit_summary(metrics: Metrics) -> dict[str, Any] | None:
+    """The bandit line, from the ``pio_bandit_*`` family: per-arm pulls
+    and posterior reward rates, the live traffic split, the promote
+    probability, and the regret proxy. None while the family sits at its
+    eager-registration zero (no policy ever engaged)."""
+    if "pio_bandit_active" not in metrics:
+        return None
+    active = _total(metrics, "pio_bandit_active")
+    pulls = {
+        labels.get("arm", "?"): v
+        for labels, v in metrics.get("pio_bandit_pulls_total", ())
+    }
+    promoted = _total(metrics, "pio_bandit_promotions_total")
+    retired = _total(metrics, "pio_bandit_retirements_total")
+    if not active and not pulls and not promoted and not retired:
+        return None
+    return {
+        "active": bool(active),
+        "pulls": pulls,
+        "reward_rate": {
+            labels.get("arm", "?"): v
+            for labels, v in metrics.get("pio_bandit_reward_rate", ())
+        },
+        "fraction": _total(metrics, "pio_bandit_fraction"),
+        "p_candidate_better": _total(
+            metrics, "pio_bandit_p_candidate_better"
+        ),
+        "regret_pulls": _total(metrics, "pio_bandit_regret_pulls"),
+        "matched_total": _total(metrics, "pio_bandit_matched_rewards_total"),
+        "unmatched_total": _total(
+            metrics, "pio_bandit_unmatched_rewards_total"
+        ),
+        "promotions_total": promoted,
+        "retirements_total": retired,
     }
 
 
@@ -589,6 +627,38 @@ def render(summary: dict[str, Any], url: str) -> str:
                 f"   refreshes {num(ann['refreshes_total'])}"
                 f"/{num(ann['rebuilds_total'])} rebuilt"
             )
+        lines.append(line)
+    bandit = summary.get("bandit")
+    if bandit is not None:
+        arm_parts = []
+        for arm in ("stable", "candidate"):
+            if arm in bandit.get("pulls", {}) or arm in bandit.get(
+                "reward_rate", {}
+            ):
+                rate = bandit.get("reward_rate", {}).get(arm)
+                arm_parts.append(
+                    f"{arm} "
+                    + (f"{rate:.3f}" if rate is not None else "-")
+                    + f" ({num(bandit['pulls'].get(arm, 0))} pulls)"
+                )
+        state = "live" if bandit.get("active") else "idle"
+        line = (
+            f"  bandit     [{state}] "
+            + (" / ".join(arm_parts) or "(no arms)")
+        )
+        if bandit.get("active"):
+            line += f"   split {bandit.get('fraction', 0.0):.2f}"
+            p = bandit.get("p_candidate_better")
+            if p is not None and p >= 0:
+                line += f"   P(cand>stable) {p:.2f}"
+        line += f"   regret {num(bandit.get('regret_pulls'))}"
+        line += f"   matched {num(bandit.get('matched_total'))}"
+        if bandit.get("unmatched_total"):
+            line += f" ({num(bandit['unmatched_total'])} unmatched)"
+        line += (
+            f"   promoted {num(bandit.get('promotions_total'))}"
+            f"   retired {num(bandit.get('retirements_total'))}"
+        )
         lines.append(line)
     slos = summary.get("slo") or {}
     if slos:

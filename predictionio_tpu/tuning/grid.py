@@ -225,6 +225,14 @@ class EventStoreSplitter:
                     return
                 yield e
 
+    def iter_ordered(self) -> Iterator[Any]:
+        """Public ordered pass over the split's event window: the same
+        head-bounded ``find_after`` pager the fold views use, exposed for
+        sequence-aware consumers (the sequential engine's eval reader
+        needs ORDERED per-user sessions, which the set-valued
+        :meth:`iter_heldout` deliberately discards)."""
+        return self._iter_events()
+
     def iter_heldout(
         self, fold: int
     ) -> Iterator[tuple[dict[str, Any], set[str]]]:

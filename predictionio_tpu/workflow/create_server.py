@@ -42,6 +42,16 @@ from aiohttp import web
 
 from predictionio_tpu.ann import lifecycle as ann_lifecycle
 from predictionio_tpu.ann.metrics import AnnInstruments
+from predictionio_tpu.bandit import (
+    ARM_CANDIDATE,
+    ARM_STABLE,
+    DECIDE_PROMOTE,
+    DECIDE_RETIRE,
+    BanditCriteria,
+    BanditInstruments,
+    BanditLoop,
+    RewardTailer,
+)
 from predictionio_tpu.controller.engine import Engine, EngineParams
 from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.registry import Storage
@@ -265,6 +275,28 @@ class ServerConfig:
     profile_on_alert: bool = True
     profile_alert_min_interval_s: float = 60.0
     profile_alert_trace_ms: int = 0
+    # -- bandit exploration lanes (docs/bandit.md) -------------------------
+    # policy steering the candidate traffic fraction while a rollout is
+    # live: "epsilon" | "thompson"; None keeps the plain PR-4 bake gate.
+    # With a policy set, the bandit owns the promote/retire decision (the
+    # bake gate keeps its error/latency/divergence veto) and the plan
+    # fraction follows the reward posterior every bake tick.
+    bandit_policy: str | None = None
+    bandit_epsilon: float = 0.1  # explore share (and cold-start fraction)
+    bandit_min_pulls: int = 20  # per-arm evidence floor before deciding
+    bandit_promote_threshold: float = 0.95  # P(candidate better) to promote
+    bandit_retire_threshold: float = 0.05  # ... to retire the candidate
+    bandit_min_fraction: float = 0.05
+    bandit_max_fraction: float = 0.9
+    # reward source: feedback events tailed from the event store and
+    # matched to impressions by the trace id echoed into properties
+    bandit_app_name: str | None = None  # app whose events carry rewards
+    bandit_channel_name: str | None = None
+    bandit_reward_events: tuple[str, ...] = ("reward",)
+    bandit_trace_property: str = "traceId"
+    bandit_reward_property: str = "reward"
+    bandit_impression_capacity: int = 65536
+    bandit_seed: int = 0
 
     def ssl_context(self):
         from predictionio_tpu.utils.tls import server_ssl_context
@@ -1049,6 +1081,34 @@ class QueryServer:
                 auto_promote=self.config.auto_promote,
             ),
         )
+        # -- bandit exploration lanes (docs/bandit.md): the pio_bandit_*
+        # family registers eagerly (exists at zero with no policy, same
+        # discipline as AnnInstruments); the loop itself only exists when
+        # a policy is configured. It rides the rollout machinery: arms are
+        # the stable/candidate lanes, the policy's actuator is the canary
+        # fraction, and promote/retire route through the existing
+        # transitions — so a losing arm retires with zero client 5xx.
+        self.bandit_instruments = BanditInstruments(m)
+        self.bandit: BanditLoop | None = (
+            BanditLoop(
+                self.config.bandit_policy,
+                epsilon=self.config.bandit_epsilon,
+                criteria=BanditCriteria(
+                    min_pulls=float(self.config.bandit_min_pulls),
+                    promote_threshold=self.config.bandit_promote_threshold,
+                    retire_threshold=self.config.bandit_retire_threshold,
+                    min_fraction=self.config.bandit_min_fraction,
+                    max_fraction=self.config.bandit_max_fraction,
+                ),
+                instruments=self.bandit_instruments,
+                store=self.registry_store,
+                engine_id=self.manifest.engine_id,
+                impression_capacity=self.config.bandit_impression_capacity,
+                seed=self.config.bandit_seed,
+            )
+            if self.config.bandit_policy
+            else None
+        )
         self._reload_lock = asyncio.Lock()
         self._batcher = _MicroBatcher(
             self,
@@ -1366,6 +1426,14 @@ class QueryServer:
             cand is not None and plan.mode == MODE_CANARY and plan.fraction > 0
         )
         shadow = cand is not None and plan.mode == MODE_SHADOW
+        # bandit accounting is snapshotted with the lanes: answered queries
+        # of THIS batch are pulls of THIS rollout's arms (the version check
+        # inside record_impression drops any race with promote/rollback)
+        bandit = (
+            self.bandit
+            if canary and self.bandit is not None and self.bandit.active
+            else None
+        )
         payloads = [it.payload for it in items]
         trace_ids = [it.trace_id for it in items]
         n = len(payloads)
@@ -1490,12 +1558,30 @@ class QueryServer:
                             # consecutive-failure count a failing successor
                             # candidate is accumulating
                             self.candidate_breaker.record_success()
+                        if bandit is not None:
+                            # an answered query is a pull the moment it is
+                            # served; the trace id becomes matchable for
+                            # feedback credit
+                            bandit.record_impression(
+                                trace_ids[i],
+                                ARM_CANDIDATE
+                                if lane_name == LANE_CANDIDATE
+                                else ARM_STABLE,
+                                lane.version,
+                            )
                     except Exception as exc:
                         if lane_name == LANE_CANDIDATE:
                             self._record_candidate_failure(lane.version, gen)
                             outs[i], versions[i] = self._stable_retry(
                                 stable, queries[i], sniffed
                             )
+                            if bandit is not None and not isinstance(
+                                outs[i], BaseException
+                            ):
+                                # re-answered on stable: that's a stable pull
+                                bandit.record_impression(
+                                    trace_ids[i], ARM_STABLE, stable.version
+                                )
                         else:
                             inst.errors.inc(
                                 version=lane.version, lane=lane_name
@@ -1754,6 +1840,9 @@ class QueryServer:
                         else None
                     ),
                 },
+                "bandit": (
+                    self.bandit.snapshot() if self.bandit is not None else None
+                ),
                 "startTime": self.start_time.isoformat(),
                 "requestCount": self.request_count,
                 "avgServingSec": self.avg_serving_sec,
@@ -2030,6 +2119,46 @@ class QueryServer:
                     },
                 )
 
+    def _bandit_tailer(self) -> RewardTailer:
+        """Build the reward tail for one rollout: feedback events of the
+        configured app, matched to served impressions by the trace id
+        echoed into their properties. The cursor seeds at the current
+        sequence head — historical events never retro-credit an arm."""
+        from predictionio_tpu.data.store.event_store import resolve_app
+
+        app_name = self.config.bandit_app_name
+        if not app_name:
+            raise ValueError(
+                "bandit policy configured without bandit_app_name (the app "
+                "whose events carry rewards)"
+            )
+        app_id, channel_id = resolve_app(
+            self.storage, app_name, self.config.bandit_channel_name
+        )
+        return RewardTailer(
+            self.storage.get_l_events(),
+            app_id,
+            channel_id,
+            event_names=tuple(self.config.bandit_reward_events),
+            trace_property=self.config.bandit_trace_property,
+            reward_property=self.config.bandit_reward_property,
+        )
+
+    def _bandit_apply_fraction(self, fraction: float) -> None:
+        """Move the live canary fraction to the policy's choice. The salt
+        (candidate version) is untouched, so the sticky buckets stay
+        fleet-consistent and a fraction change only flips the users whose
+        bucket the boundary crossed."""
+        with self._rollout_mutex:
+            plan = self._plan
+            if self._candidate is None or plan.mode != MODE_CANARY:
+                return
+            if abs(plan.fraction - fraction) < 1e-9:
+                return
+            self._plan = RolloutPlan(MODE_CANARY, fraction, plan.salt)
+            plan = self._plan
+        self._rollout_instruments.set_plan(plan)
+
     def stage_candidate_lane(
         self,
         lane: Lane,
@@ -2055,7 +2184,22 @@ class QueryServer:
             self._plan = RolloutPlan(
                 mode, fraction if mode == MODE_CANARY else 0.0, lane.version
             )
-            self.rollout_controller.begin(self._active.version, lane.version, mode)
+            stable_version = self._active.version
+            self.rollout_controller.begin(stable_version, lane.version, mode)
+        if self.bandit is not None and mode == MODE_CANARY:
+            # engage the two-arm bandit on this rollout; a persisted
+            # posterior for the same version pair resumes. Failure (no
+            # reward app resolvable, storage down) degrades to the plain
+            # bake gate — never blocks the stage itself.
+            try:
+                self.bandit.begin(
+                    stable_version, lane.version, self._bandit_tailer()
+                )
+            except Exception:
+                logger.exception(
+                    "bandit engage failed; plain bake gate governs this "
+                    "rollout"
+                )
         # a RE-staged candidate must not inherit entries from any earlier
         # life of its version (e.g. a prior bake followed by rollback);
         # lookups are bypassed for the whole bake anyway — this flush
@@ -2102,6 +2246,8 @@ class QueryServer:
         self._cache_flush(retired, f"promote {cand.version}")
         self._rollout_instruments.set_plan(PLAN_OFF)
         self._rollout_instruments.promotions.inc()
+        if self.bandit is not None and self.bandit.active:
+            self.bandit.end("promote")
         if persist and self.registry_store is not None:
             try:
                 self.registry_store.promote(self.manifest.engine_id, cand.version)
@@ -2134,6 +2280,10 @@ class QueryServer:
         self._cache_flush(cand.version, f"rollback {cand.version} ({reason})")
         self._rollout_instruments.set_plan(PLAN_OFF)
         self._rollout_instruments.rollbacks.inc(reason=reason)
+        if self.bandit is not None and self.bandit.active:
+            self.bandit.end(
+                "retire" if reason == "bandit-retire" else "rollback"
+            )
         if persist and self.registry_store is not None:
             try:
                 # unstage, never rollback: the store's rollback falls back
@@ -2206,19 +2356,51 @@ class QueryServer:
             return
         verdict, reason = self.rollout_controller.evaluate()
         loop = asyncio.get_running_loop()
-        # promote/rollback persist registry state (fsync'd writes): executor
-        if verdict == VERDICT_PROMOTE:
+        # the bake gate's health veto outranks everything, bandit or not: a
+        # reward-winning arm that 5xxes or blows the latency ratio still
+        # rolls back through the same path
+        if verdict == VERDICT_ROLLBACK:
+            # "error-rate gate: ..." -> label "error-rate", detail = full text
+            await loop.run_in_executor(
+                None, self._rollback_candidate, reason.split(" ")[0], reason
+            )
+            return
+        bandit = self.bandit
+        if bandit is None or not bandit.active:
+            # plain PR-4 bake gate: time + health decide
+            if verdict == VERDICT_PROMOTE:
+                async with self._reload_lock:
+                    version = await loop.run_in_executor(
+                        None, self._promote_candidate
+                    )
+                if version:
+                    logger.info("auto-promoted %s: %s", version, reason)
+            return
+        # bandit engaged: the bake gate doubles as reward accounting. The
+        # tick drains feedback from the event store (blocking reads:
+        # executor), credits the posteriors, and the policy re-chooses the
+        # live traffic fraction. The REWARD posterior owns promote/retire;
+        # the controller's promote verdict acts as the health+window
+        # precondition (both evidence floors must clear).
+        decision = await loop.run_in_executor(None, bandit.tick)
+        if decision is None:
+            return  # rollout flipped underneath the tick
+        if decision.verdict == DECIDE_PROMOTE and verdict == VERDICT_PROMOTE:
             async with self._reload_lock:
                 version = await loop.run_in_executor(
                     None, self._promote_candidate
                 )
             if version:
-                logger.info("auto-promoted %s: %s", version, reason)
-        elif verdict == VERDICT_ROLLBACK:
-            # "error-rate gate: ..." -> label "error-rate", detail = full text
+                logger.info("bandit promoted %s: %s", version, decision.reason)
+        elif decision.verdict == DECIDE_RETIRE:
             await loop.run_in_executor(
-                None, self._rollback_candidate, reason.split(" ")[0], reason
+                None,
+                self._rollback_candidate,
+                "bandit-retire",
+                decision.reason,
             )
+        else:
+            self._bandit_apply_fraction(decision.fraction)
 
     # ------------------------------------------- fleet registry coordination
     async def _registry_sync_loop(self) -> None:

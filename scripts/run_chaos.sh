@@ -52,8 +52,16 @@
 # auto-promote with zero 5xx throughout, and the promote warms the
 # result cache — plus the pure-policy matrix (defer-mid-bake, timeouts,
 # cooldown, pause/manual-trigger) on a fake clock.
+# The bandit stage (tests/test_bandit.py, incl. the slow-marked e2e)
+# drives the full reward loop: ordered sessions ingested through the
+# EventServer, the sequential engine trained and fold-in published with
+# lineage, the candidate staged as a bandit arm, feedback events matched
+# by trace id moving the posterior to an auto-promote, then a starved
+# re-staged arm auto-retired through the rollback machinery — zero
+# client-visible 5xx across both verdicts.
 # See docs/resilience.md, docs/observability.md, docs/model_registry.md,
-# docs/streaming.md, docs/fleet.md, docs/lifecycle.md.
+# docs/streaming.md, docs/fleet.md, docs/lifecycle.md, docs/bandit.md,
+# docs/sequential.md.
 # Usage: scripts/run_chaos.sh [extra pytest args...]
 set -euo pipefail
 
@@ -64,5 +72,6 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_resilience.py tests/test_obs.py tests/test_registry.py \
   tests/test_stream.py tests/test_fleet.py tests/test_flightrec.py \
   tests/test_autoscaler.py tests/test_hostrt.py tests/test_lease.py \
-  tests/test_profiler.py tests/test_lifecycle.py -q \
+  tests/test_profiler.py tests/test_lifecycle.py \
+  tests/test_sequential.py tests/test_bandit.py -q \
   -p no:cacheprovider "$@"

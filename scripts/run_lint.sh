@@ -155,7 +155,9 @@ print(
 print(bp["batchpredict_status_file"])
 PYEOF
   )
-  if ! ./pio top --batchpredict "$bp_status" --once | grep -q "batchpredict"; then
+  # plain grep (not -q): -q exits at first match and SIGPIPEs the still-
+  # writing renderer, which pipefail then reports as a stage failure
+  if ! ./pio top --batchpredict "$bp_status" --once | grep "batchpredict" >/dev/null; then
     echo "pio top --batchpredict did not render the progress line" >&2
     exit 1
   fi
@@ -271,6 +273,17 @@ PYEOF
   #     the always-on sampler's folded stacks, and `pio doctor
   #     --roofline` exits 0 with finite numbers for every bucket family.
   env JAX_PLATFORMS=cpu python scripts/profile_smoke.py
+
+  # --- sequential+bandit smoke (ISSUE 20, docs/sequential.md +
+  #     docs/bandit.md): ingest ordered sessions -> train the sequential
+  #     engine THROUGH the real DataSource (find_after ordered reads) ->
+  #     serve next-item queries through the fleet gateway into a real
+  #     QueryServer with a Thompson bandit engaged on a staged candidate
+  #     -> reward feedback events matched by trace id MOVE the candidate
+  #     arm's posterior -> the reward verdict auto-promotes the winner
+  #     with zero client-visible 5xx. The slow ingest->stream-fold-in->
+  #     retire-loser e2e lives in tests/test_bandit.py (chaos gate).
+  env JAX_PLATFORMS=cpu python scripts/sequential_smoke.py
 
   # chaos gate includes the observability suite (tests/test_obs.py):
   # counters moving under faults + trace propagation are CI-asserted

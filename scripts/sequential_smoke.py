@@ -1,0 +1,287 @@
+"""CI sequential+bandit smoke (run_lint.sh --ci, ISSUE 20).
+
+One process, real sockets: ingest ordered session events into the event
+store -> train the sequential engine's markov scorer THROUGH the real
+DataSource (ordered ``find_after`` reads) -> serve next-item queries
+through the fleet Gateway fronting a real QueryServer with a Thompson
+bandit engaged on a staged candidate -> post reward feedback events
+carrying the served trace ids -> assert the candidate arm's reward
+posterior MOVES and the bake-gate-as-reward-accounting promotes the
+winner with zero client-visible 5xx.
+
+Exit 0 = all held; any assertion exits nonzero and fails CI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as dt
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+APP = "seqsmoke"
+N_USERS = 40
+SESSION = ["i0", "i1", "i2", "i3", "i4"]
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main() -> int:
+    import tempfile
+
+    import numpy as np  # noqa: F401 - jax platform must be set before import
+
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.storage.registry import Storage
+    from predictionio_tpu.models.sequential import engine_factory
+    from predictionio_tpu.workflow.context import WorkflowContext
+    from predictionio_tpu.workflow.create_server import (
+        Lane,
+        QueryServer,
+        ServerConfig,
+    )
+    from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+    storage = Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    storage.get_meta_data_apps().insert(App(0, APP))
+    app_id = storage.get_meta_data_apps().get_by_name(APP).id
+    levents = storage.get_l_events()
+
+    # -- 1. ingest ordered sessions (same creation second: the seq-key
+    #       event-id tiebreak keeps ingest order) --------------------------
+    t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    n = 0
+    for u in range(N_USERS):
+        for item in SESSION:
+            n += 1
+            ts = t0 + dt.timedelta(seconds=n)
+            levents.insert(
+                Event(
+                    event="view",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=item,
+                    properties=DataMap({}),
+                    event_time=ts,
+                    creation_time=ts,
+                ),
+                app_id,
+            )
+
+    # -- 2. train through the real DataSource (ordered find_after reads) --
+    engine = engine_factory()
+    ep = engine.engine_params_from_variant(
+        {
+            "datasource": {"params": {"appName": APP, "page": 16}},
+            "algorithms": [{"name": "markov", "params": {"top_n": 5}}],
+        }
+    )
+    ctx = WorkflowContext(mode="training", _storage=storage, app_name=APP)
+    ds, prep, algorithms, serving = engine.make_components(ep)
+    td = prep.prepare(ctx, ds.read_training(ctx))
+    model = algorithms[0].train(ctx, td)
+    # the ingest order must survive the read: every session is i0..i4, so
+    # the learned top transition from i0 is i1
+    probs = dict(model.markov.transition_probs(model.item_vocab.index("i0")))
+    nxt = max(probs, key=probs.get)
+    assert model.item_vocab[nxt] == "i1", (probs, model.item_vocab)
+
+    registry_dir = tempfile.mkdtemp(prefix="pio_seq_smoke_reg_")
+    port = _free_port()
+    server = QueryServer(
+        engine=engine,
+        engine_params=ep,
+        models=[model],
+        manifest=EngineManifest(
+            engine_id=APP,
+            version="1",
+            variant="engine.json",
+            engine_factory="predictionio_tpu.models.sequential.engine_factory",
+        ),
+        instance_id="seq-v1",
+        storage=storage,
+        config=ServerConfig(
+            ip="127.0.0.1",
+            port=port,
+            registry_dir=registry_dir,
+            bandit_policy="thompson",
+            bandit_app_name=APP,
+            bandit_min_pulls=4,
+            bandit_epsilon=0.5,
+            bake_window_s=0.2,
+            bake_min_requests=8,
+            bake_check_interval_s=0.1,
+            # both lanes run the same model in-process: sub-ms jitter must
+            # not trip the ratio gates before the reward verdict lands
+            max_p95_ratio=50.0,
+            max_error_ratio=100.0,
+            max_batch_size=16,
+        ),
+    )
+    server._active = Lane(algorithms, serving, [model], "v1", "seq-v1", ep)
+    # candidate: the same trained model under a new version — the smoke
+    # injects which arm WINS via rewards, so lane quality is irrelevant
+    _, _, algorithms2, serving2 = engine.make_components(ep)
+    server.stage_candidate_lane(
+        Lane(algorithms2, serving2, [model], "v2", "seq-v2", ep),
+        fraction=0.5,
+        persist=False,
+    )
+    assert server.bandit is not None and server.bandit.active
+
+    return asyncio.run(drive(server, storage, app_id, port))
+
+
+async def drive(server, storage, app_id, port: int) -> int:
+    import aiohttp
+
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.fleet import Gateway, GatewayConfig
+    from predictionio_tpu.obs.metrics import MetricsRegistry
+    from predictionio_tpu.obs.tracing import TRACE_HEADER
+
+    server_task = asyncio.ensure_future(server.run_until_stopped())
+    gw_port = _free_port()
+    gw = Gateway(
+        GatewayConfig(
+            ip="127.0.0.1",
+            port=gw_port,
+            replica_urls=(f"http://127.0.0.1:{port}",),
+            probe_interval_s=0.2,
+            probe_timeout_s=2.0,
+            request_timeout_s=8.0,
+        ),
+        metrics=MetricsRegistry(),
+    )
+    await gw.start()
+    gw_url = f"http://127.0.0.1:{gw_port}"
+    session = aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(total=10))
+    levents = storage.get_l_events()
+    t0 = dt.datetime(2026, 1, 2, tzinfo=dt.timezone.utc)
+    try:
+        # wait for the gateway to probe the replica healthy
+        deadline = time.monotonic() + 15.0
+        while True:
+            try:
+                async with session.get(f"{gw_url}/healthz") as resp:
+                    if (await resp.json()).get("replicasHealthy", 0) >= 1:
+                        break
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, "gateway never went healthy"
+            await asyncio.sleep(0.2)
+
+        # -- 3. serve next-item THROUGH the gateway; collect trace ids ----
+        served = []  # (trace_id, version)
+        failures = 0
+        for k in range(60):
+            trace = f"seq-smoke-{k}"
+            async with session.post(
+                f"{gw_url}/queries.json",
+                json={"user": f"u{k % N_USERS}", "recentItems": ["i0"], "num": 3},
+                headers={TRACE_HEADER: trace},
+            ) as resp:
+                if resp.status != 200:
+                    failures += 1
+                    continue
+                body = await resp.json()
+                assert body["itemScores"], body
+                # top next-item after i0 must be i1 (the learned chain)
+                assert body["itemScores"][0]["item"] == "i1", body
+        assert failures == 0, f"{failures} client-visible failures"
+        snap = server.bandit.snapshot()
+        pulls = {
+            snap["stable"]["arm"]: snap["stable"]["pulls"],
+            snap["candidate"]["arm"]: snap["candidate"]["pulls"],
+        }
+        assert pulls["stable"] >= 4 and pulls["candidate"] >= 4, pulls
+        for k in range(60):
+            trace = f"seq-smoke-{k}"
+            hit = server.bandit.impressions.peek(trace)
+            if hit is not None:
+                served.append((trace, hit[0]))
+
+        # -- 4. feedback: reward EVERY candidate impression, no stable ----
+        k = 0
+        for trace, arm in served:
+            if arm != "candidate":
+                continue
+            k += 1
+            ts = t0 + dt.timedelta(seconds=k)
+            levents.insert(
+                Event(
+                    event="reward",
+                    entity_type="user",
+                    entity_id=f"fb{k}",
+                    properties=DataMap({"traceId": trace, "reward": 1.0}),
+                    event_time=ts,
+                    creation_time=ts,
+                ),
+                app_id,
+            )
+        assert k >= 4, f"only {k} candidate impressions to reward"
+
+        # -- 5. the posterior must move, then the reward verdict promotes -
+        deadline = time.monotonic() + 20.0
+        moved = False
+        while time.monotonic() < deadline:
+            ins = server.bandit_instruments
+            if not moved and ins.matched.value() > 0:
+                moved = True
+                print(
+                    f"sequential smoke: {int(ins.matched.value())} rewards "
+                    "matched; candidate posterior moving"
+                )
+            if server._candidate is None:
+                break
+            await asyncio.sleep(0.2)
+        assert moved, "no reward ever matched an impression"
+        assert server._active.version == "v2", (
+            "reward-winning candidate was not promoted: "
+            f"active={server._active.version} snap={server.bandit.snapshot()}"
+        )
+        assert not server.bandit.active
+        print(
+            "sequential smoke: ingest -> ordered train -> gateway serving -> "
+            f"feedback moved the posterior -> v2 promoted ({k} rewards, "
+            "0 client-visible failures)"
+        )
+        return 0
+    finally:
+        await session.close()
+        await gw.stop()
+        server.begin_drain()
+        try:
+            await asyncio.wait_for(server_task, timeout=10)
+        except (asyncio.TimeoutError, Exception):
+            server_task.cancel()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,837 @@
+"""Contextual-bandit subsystem tests — ISSUE 20.
+
+Units: per-arm Beta posteriors, epsilon-greedy + Thompson fraction
+policies, the evidence-gated promote/retire verdict, the bounded
+impression log (one credit per impression), the ``find_after`` reward
+tailer (cursor seeds at the head — history never retro-credits), and
+posterior persistence through the registry artifact grammar.
+
+Integration: the QueryServer drives the loop from the bake-gate
+heartbeat — impressions recorded per sticky-canary lane, feedback events
+move the posterior, the reward verdict steers the traffic fraction and
+promotes/retires through the existing rollout state machine.
+
+The slow e2e is the acceptance rail: ingest ordered sessions through the
+EventServer -> train the sequential engine (attention scorer, so serving
+compiles through ``ops/topk``) -> stream fold-in publishes a candidate
+with lineage -> the bandit stages it as an arm -> feedback events
+accumulate reward -> the winner auto-promotes, then a deliberately
+starved re-staged arm auto-retires — zero client-visible 5xx throughout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as dt
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from predictionio_tpu.bandit import (
+    ARM_CANDIDATE,
+    ARM_STABLE,
+    DECIDE_EXPLORE,
+    DECIDE_PROMOTE,
+    DECIDE_RETIRE,
+    ArmState,
+    BanditCriteria,
+    BanditInstruments,
+    BanditLoop,
+    EpsilonGreedyPolicy,
+    ImpressionLog,
+    RewardTailer,
+    ThompsonPolicy,
+    decide,
+    make_policy,
+    p_candidate_better,
+    regret_proxy,
+)
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.data.storage.memory import MemoryStorageClient
+from predictionio_tpu.data.storage.registry import Storage
+from predictionio_tpu.obs.tracing import TRACE_HEADER
+from predictionio_tpu.registry import ArtifactStore
+from predictionio_tpu.registry.router import sticky_bucket
+
+UTC = dt.timezone.utc
+APP = 3
+
+
+def t(n: int) -> dt.datetime:
+    return dt.datetime(2024, 7, 1, 0, 0, n, tzinfo=UTC)
+
+
+def reward_event(trace: str | None, n: int, *, reward=None, name="reward"):
+    props = {}
+    if trace is not None:
+        props["traceId"] = trace
+    if reward is not None:
+        props["reward"] = reward
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=f"fb{n}",
+        properties=DataMap(props),
+        event_time=t(n),
+        creation_time=t(n),
+    )
+
+
+def _memory_storage() -> Storage:
+    return Storage(
+        env={
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# posterior + policies
+# ---------------------------------------------------------------------------
+
+
+class TestPosterior:
+    def test_beta_posterior_and_ctr_pull_semantics(self):
+        arm = ArmState("v1", ARM_CANDIDATE, pulls=10.0, rewards=4.0)
+        assert arm.alpha == 5.0 and arm.beta == 7.0
+        assert arm.mean == pytest.approx(5.0 / 12.0)
+        # an unrewarded impression DECAYS the mean (CTR semantics)
+        before = arm.mean
+        arm.pulls += 1.0
+        assert arm.mean < before
+
+    def test_json_roundtrip(self):
+        arm = ArmState("v2", ARM_STABLE, pulls=3.0, rewards=1.5)
+        assert ArmState.from_json_dict(arm.to_json_dict()) == arm
+
+    def test_p_candidate_better_tracks_the_evidence(self):
+        rng = np.random.default_rng(0)
+        strong = ArmState("c", ARM_CANDIDATE, pulls=50, rewards=45)
+        weak = ArmState("s", ARM_STABLE, pulls=50, rewards=5)
+        assert p_candidate_better(weak, strong, rng, 512) > 0.99
+        assert p_candidate_better(strong, weak, rng, 512) < 0.01
+
+
+class TestPolicies:
+    CRIT = BanditCriteria(min_pulls=10, min_fraction=0.05, max_fraction=0.9)
+
+    def test_epsilon_greedy_cold_start_exploit_and_clamp(self):
+        rng = np.random.default_rng(0)
+        pol = EpsilonGreedyPolicy(epsilon=0.2)
+        stable = ArmState("s", ARM_STABLE, pulls=100, rewards=50)
+        cold = ArmState("c", ARM_CANDIDATE, pulls=2, rewards=2)
+        assert pol.fraction(stable, cold, self.CRIT, rng) == 0.2
+        winner = ArmState("c", ARM_CANDIDATE, pulls=50, rewards=45)
+        assert pol.fraction(stable, winner, self.CRIT, rng) == pytest.approx(0.8)
+        loser = ArmState("c", ARM_CANDIDATE, pulls=50, rewards=1)
+        assert pol.fraction(stable, loser, self.CRIT, rng) == pytest.approx(0.2)
+        # the clamp: epsilon 0 still keeps min_fraction exploring
+        pol0 = EpsilonGreedyPolicy(epsilon=0.0)
+        assert pol0.fraction(stable, loser, self.CRIT, rng) == 0.05
+        assert pol0.fraction(stable, winner, self.CRIT, rng) == 0.9
+
+    def test_thompson_is_probability_matching(self):
+        rng = np.random.default_rng(0)
+        pol = ThompsonPolicy(epsilon=0.1)
+        stable = ArmState("s", ARM_STABLE, pulls=100, rewards=50)
+        cold = ArmState("c", ARM_CANDIDATE, pulls=2, rewards=2)
+        assert pol.fraction(stable, cold, self.CRIT, rng) == 0.1
+        winner = ArmState("c", ARM_CANDIDATE, pulls=80, rewards=75)
+        # P(cand > stable) is ~1 here; the clamp caps the split at 0.9
+        assert pol.fraction(stable, winner, self.CRIT, rng) == 0.9
+        even = ArmState("c", ARM_CANDIDATE, pulls=100, rewards=50)
+        frac = pol.fraction(stable, even, self.CRIT, rng)
+        assert 0.2 < frac < 0.8  # evenly matched arms split the traffic
+
+    def test_make_policy(self):
+        assert make_policy("epsilon").name == "epsilon"
+        assert make_policy("thompson").name == "thompson"
+        with pytest.raises(ValueError, match="unknown bandit policy"):
+            make_policy("ucb")
+
+
+class TestDecide:
+    CRIT = BanditCriteria(min_pulls=10)
+
+    def test_no_verdict_before_both_arms_have_evidence(self):
+        rng = np.random.default_rng(0)
+        ready = ArmState("s", ARM_STABLE, pulls=50, rewards=2)
+        cold = ArmState("c", ARM_CANDIDATE, pulls=9, rewards=9)
+        d = decide(ready, cold, self.CRIT, 0.3, rng)
+        assert d.verdict == DECIDE_EXPLORE and d.p_better is None
+        assert "collecting evidence" in d.reason
+        cold_stable = ArmState("s", ARM_STABLE, pulls=5, rewards=5)
+        hot_cand = ArmState("c", ARM_CANDIDATE, pulls=50, rewards=25)
+        d = decide(cold_stable, hot_cand, self.CRIT, 0.3, rng)
+        assert d.verdict == DECIDE_EXPLORE  # min_pulls gates BOTH arms
+
+    def test_promote_and_retire_thresholds(self):
+        rng = np.random.default_rng(0)
+        stable = ArmState("s", ARM_STABLE, pulls=50, rewards=5)
+        winner = ArmState("c", ARM_CANDIDATE, pulls=50, rewards=45)
+        assert decide(stable, winner, self.CRIT, 0.5, rng).verdict == DECIDE_PROMOTE
+        loser = ArmState("c", ARM_CANDIDATE, pulls=50, rewards=0)
+        strong = ArmState("s", ARM_STABLE, pulls=50, rewards=45)
+        assert decide(strong, loser, self.CRIT, 0.5, rng).verdict == DECIDE_RETIRE
+
+    def test_regret_proxy_counts_the_posterior_worse_arms_pulls(self):
+        stable = ArmState("s", ARM_STABLE, pulls=70, rewards=60)
+        loser = ArmState("c", ARM_CANDIDATE, pulls=30, rewards=2)
+        assert regret_proxy(stable, loser) == 30.0
+        assert regret_proxy(loser, stable) == 30.0
+
+
+# ---------------------------------------------------------------------------
+# impression log + reward tailer
+# ---------------------------------------------------------------------------
+
+
+class TestImpressionLog:
+    def test_one_credit_per_impression(self):
+        log = ImpressionLog()
+        log.record("tr-1", ARM_CANDIDATE, "v2")
+        assert log.peek("tr-1") == (ARM_CANDIDATE, "v2")  # non-destructive
+        assert log.match("tr-1") == (ARM_CANDIDATE, "v2")
+        assert log.match("tr-1") is None  # duplicate feedback earns nothing
+        assert log.peek("tr-1") is None
+
+    def test_bounded_fifo_eviction(self):
+        log = ImpressionLog(capacity=16)
+        for i in range(20):
+            log.record(f"tr-{i}", ARM_STABLE, "v1")
+        assert len(log) == 16 and log.evicted == 4
+        assert log.match("tr-0") is None  # oldest aged out
+        assert log.match("tr-19") is not None
+
+    def test_empty_trace_is_ignored(self):
+        log = ImpressionLog()
+        log.record("", ARM_STABLE, "v1")
+        assert len(log) == 0
+
+
+class TestRewardTailer:
+    def _levents(self):
+        l = MemoryStorageClient().l_events()
+        l.init(APP)
+        return l
+
+    def test_cursor_seeds_at_head_so_history_never_credits(self):
+        l = self._levents()
+        l.insert(reward_event("tr-old", 1), APP)
+        tailer = RewardTailer(l, APP)
+        log = ImpressionLog()
+        log.record("tr-old", ARM_CANDIDATE, "v2")
+        credits, unmatched = tailer.poll(log)
+        assert credits == [] and unmatched == 0
+        # ...but events AFTER the bandit engaged do credit
+        l.insert(reward_event("tr-old", 2), APP)
+        credits, unmatched = tailer.poll(log)
+        assert credits == [(ARM_CANDIDATE, "v2", 1.0)] and unmatched == 0
+
+    def test_matching_rules(self):
+        l = self._levents()
+        tailer = RewardTailer(l, APP)
+        log = ImpressionLog()
+        log.record("tr-a", ARM_CANDIDATE, "v2")
+        log.record("tr-b", ARM_STABLE, "v1")
+        l.insert(reward_event("tr-a", 1, reward=0.25), APP)
+        l.insert(reward_event("tr-b", 2, reward=7.5), APP)   # clamped to 1
+        l.insert(reward_event("tr-zz", 3), APP)              # unknown trace
+        l.insert(reward_event(None, 4), APP)                 # no trace prop
+        l.insert(reward_event("tr-a", 5, name="view"), APP)  # not a reward
+        credits, unmatched = tailer.poll(log)
+        assert credits == [
+            (ARM_CANDIDATE, "v2", 0.25),
+            (ARM_STABLE, "v1", 1.0),
+        ]
+        assert unmatched == 2  # unknown trace + missing property
+        # a second feedback event for a consumed impression is unmatched
+        l.insert(reward_event("tr-a", 6), APP)
+        credits, unmatched = tailer.poll(log)
+        assert credits == [] and unmatched == 1
+
+    def test_absent_or_garbage_reward_property_is_full_reward(self):
+        l = self._levents()
+        tailer = RewardTailer(l, APP)
+        log = ImpressionLog()
+        log.record("tr-a", ARM_CANDIDATE, "v2")
+        log.record("tr-b", ARM_CANDIDATE, "v2")
+        l.insert(reward_event("tr-a", 1), APP)  # bare conversion event
+        l.insert(reward_event("tr-b", 2, reward="not-a-number"), APP)
+        credits, _ = tailer.poll(log)
+        assert [c[2] for c in credits] == [1.0, 1.0]
+
+    def test_bounded_pages_leave_the_tail_for_the_next_tick(self):
+        l = self._levents()
+        tailer = RewardTailer(l, APP, page=4, max_pages=2)
+        log = ImpressionLog()
+        for i in range(20):
+            log.record(f"tr-{i}", ARM_CANDIDATE, "v2")
+        for i in range(20):
+            l.insert(reward_event(f"tr-{i}", i + 1), APP)
+        credits, _ = tailer.poll(log)
+        assert len(credits) == 8  # page * max_pages per tick, no more
+        credits, _ = tailer.poll(log)
+        assert len(credits) == 8
+        credits, _ = tailer.poll(log)
+        assert len(credits) == 4  # drained
+
+
+# ---------------------------------------------------------------------------
+# the loop: lifecycle, crediting, persistence
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedTailer:
+    """Stands in for RewardTailer: returns the scripted credit batches."""
+
+    def __init__(self, batches=None):
+        self.batches = list(batches or [])
+
+    def poll(self, impressions):
+        return (self.batches.pop(0) if self.batches else [], 0)
+
+
+class TestBanditLoop:
+    def test_impressions_credit_pulls_and_feedback_credits_rewards(self):
+        loop = BanditLoop("thompson", seed=0)
+        loop.begin(
+            "v1", "v2",
+            _ScriptedTailer([[(ARM_CANDIDATE, "v2", 1.0)]]),
+        )
+        assert loop.active
+        for i in range(6):
+            loop.record_impression(f"tr-{i}", ARM_CANDIDATE, "v2")
+        loop.record_impression("tr-s", ARM_STABLE, "v1")
+        d = loop.tick()
+        assert d.verdict == DECIDE_EXPLORE  # below min_pulls
+        snap = loop.snapshot()
+        assert snap["candidate"]["pulls"] == 6.0
+        assert snap["candidate"]["rewards"] == 1.0
+        assert snap["stable"]["pulls"] == 1.0
+
+    def test_version_mismatch_drops_the_impression(self):
+        loop = BanditLoop("epsilon", seed=0)
+        loop.begin("v1", "v2", _ScriptedTailer())
+        loop.record_impression("tr-x", ARM_CANDIDATE, "v999")  # promote race
+        assert loop.snapshot()["candidate"]["pulls"] == 0.0
+
+    def test_posterior_verdicts_route_through_tick(self):
+        crit = BanditCriteria(min_pulls=5)
+        loop = BanditLoop("thompson", criteria=crit, seed=0)
+        loop.begin("v1", "v2", _ScriptedTailer())
+        loop._stable.pulls, loop._stable.rewards = 40.0, 2.0
+        loop._candidate.pulls, loop._candidate.rewards = 40.0, 38.0
+        d = loop.tick()
+        assert d.verdict == DECIDE_PROMOTE and d.p_better > 0.95
+        loop._candidate.rewards = 0.0
+        loop._stable.rewards = 38.0
+        d = loop.tick()
+        assert d.verdict == DECIDE_RETIRE and d.p_better < 0.05
+
+    def test_end_counts_the_outcome_and_disarms(self):
+        ins = BanditInstruments()
+        loop = BanditLoop("epsilon", instruments=ins, seed=0)
+        loop.begin("v1", "v2", _ScriptedTailer())
+        loop.end("promote")
+        assert not loop.active and ins.promoted.value() == 1
+        loop.begin("v1", "v3", _ScriptedTailer())
+        loop.end("retire")
+        assert ins.retired.value() == 1
+
+    def test_posterior_persists_and_resumes_only_unended_same_pair(self, tmp_path):
+        store = ArtifactStore(str(tmp_path / "reg"))
+        loop = BanditLoop("thompson", store=store, engine_id="e1", seed=0)
+        loop.begin("v1", "v2", _ScriptedTailer())
+        for i in range(7):
+            loop.record_impression(f"tr-{i}", ARM_CANDIDATE, "v2")
+        loop.tick()  # dirty -> persists through the artifact grammar
+        saved = store.load_bandit_state("e1")
+        assert saved["candidate"]["pulls"] == 7.0 and "ended" not in saved
+
+        # a restart mid-experiment resumes the paid-for evidence
+        loop2 = BanditLoop("thompson", store=store, engine_id="e1", seed=0)
+        loop2.begin("v1", "v2", _ScriptedTailer())
+        assert loop2.snapshot()["candidate"]["pulls"] == 7.0
+
+        # a DIFFERENT candidate version starts from fresh priors
+        loop3 = BanditLoop("thompson", store=store, engine_id="e1", seed=0)
+        loop3.begin("v1", "v9", _ScriptedTailer())
+        assert loop3.snapshot()["candidate"]["pulls"] == 0.0
+
+        # a terminal verdict is persisted for audit and never resumed
+        loop2.end("promote")
+        assert store.load_bandit_state("e1")["ended"] == "promote"
+        loop4 = BanditLoop("thompson", store=store, engine_id="e1", seed=0)
+        loop4.begin("v1", "v2", _ScriptedTailer())
+        assert loop4.snapshot()["candidate"]["pulls"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# QueryServer integration: the bake-gate heartbeat drives the loop
+# ---------------------------------------------------------------------------
+
+
+def _bandit_server(storage, tmp_path, **cfg_kw):
+    from predictionio_tpu.workflow.create_server import QueryServer, ServerConfig
+    from predictionio_tpu.workflow.engine_loader import EngineManifest
+    from tests.test_engine import params
+    from tests.test_registry import _mk_engine, _TagModel, _tag_lane
+
+    cfg_kw.setdefault("bake_check_interval_s", 30.0)
+    cfg_kw.setdefault("bandit_policy", "thompson")
+    cfg_kw.setdefault("bandit_app_name", "banditapp")
+    cfg_kw.setdefault("bandit_min_pulls", 4)
+    cfg_kw.setdefault("bake_window_s", 0.01)
+    cfg_kw.setdefault("bake_min_requests", 4)
+    cfg_kw.setdefault("max_p95_ratio", 1000.0)
+    cfg_kw.setdefault("max_error_ratio", 1000.0)
+    cfg_kw.setdefault("registry_dir", str(tmp_path / "registry"))
+    server = QueryServer(
+        engine=_mk_engine(),
+        engine_params=params(),
+        models=[_TagModel("v1")],
+        manifest=EngineManifest(
+            engine_id="bandittest",
+            version="1",
+            variant="engine.json",
+            engine_factory="tests.test_engine.make_engine",
+        ),
+        instance_id="inst-v1",
+        storage=storage,
+        config=ServerConfig(**cfg_kw),
+    )
+    server._active = _tag_lane("v1")
+    return server
+
+
+def _run_server(body_fn, server):
+    async def outer():
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            await body_fn(client)
+        finally:
+            await client.close()
+
+    asyncio.run(outer())
+
+
+class TestServerIntegration:
+    def test_impressions_follow_the_sticky_canary_split(self, tmp_path):
+        from tests.test_registry import _tag_lane
+
+        storage = _memory_storage()
+        storage.get_meta_data_apps().insert(App(0, "banditapp"))
+        server = _bandit_server(storage, tmp_path)
+        server.stage_candidate_lane(_tag_lane("v2"), fraction=0.5, persist=False)
+        assert server.bandit is not None and server.bandit.active
+
+        async def body(client):
+            for i in range(20):
+                resp = await client.post(
+                    "/queries.json",
+                    json={"qid": i, "user": f"u{i}"},
+                    headers={TRACE_HEADER: f"tr-{i}"},
+                )
+                assert resp.status == 200
+                want_lane = (
+                    "candidate" if sticky_bucket(f"u{i}", "v2") < 0.5 else "stable"
+                )
+                assert (await resp.json())["model"] == (
+                    "v2" if want_lane == "candidate" else "v1"
+                )
+                # the served impression is matchable under the client trace
+                assert server.bandit.impressions.peek(f"tr-{i}") == (
+                    want_lane, "v2" if want_lane == "candidate" else "v1",
+                )
+            snap = server.bandit.snapshot()
+            assert snap["stable"]["pulls"] + snap["candidate"]["pulls"] == 20
+            # the status surface exposes the live posterior
+            status = await (await client.get("/")).json()
+            assert status["bandit"]["active"] is True
+            assert status["bandit"]["impressions_pending"] == 20
+
+        _run_server(body, server)
+
+    def test_feedback_moves_the_posterior_and_promotes_the_winner(
+        self, tmp_path
+    ):
+        from tests.test_registry import _tag_lane
+
+        storage = _memory_storage()
+        storage.get_meta_data_apps().insert(App(0, "banditapp"))
+        app_id = storage.get_meta_data_apps().get_by_name("banditapp").id
+        levents = storage.get_l_events()
+        server = _bandit_server(storage, tmp_path)
+        server.stage_candidate_lane(_tag_lane("v2"), fraction=0.5, persist=False)
+
+        async def body(client):
+            for i in range(30):
+                resp = await client.post(
+                    "/queries.json",
+                    json={"qid": i, "user": f"u{i}"},
+                    headers={TRACE_HEADER: f"tr-{i}"},
+                )
+                assert resp.status == 200
+            # reward every candidate impression, none of stable's
+            n = 0
+            for i in range(30):
+                hit = server.bandit.impressions.peek(f"tr-{i}")
+                if hit and hit[0] == "candidate":
+                    n += 1
+                    levents.insert(reward_event(f"tr-{i}", n), app_id)
+            assert n >= 4
+            deadline = time.monotonic() + 10.0
+            while server._candidate is not None:
+                assert time.monotonic() < deadline, "bandit never promoted"
+                await server._rollout_tick()
+                await asyncio.sleep(0.01)
+            assert server.model_version == "v2"
+            assert not server.bandit.active
+            assert server.bandit_instruments.promoted.value() == 1
+            assert server.bandit_instruments.matched.value() == n
+            # the terminal posterior is persisted for audit
+            saved = server.registry_store.load_bandit_state("bandittest")
+            assert saved["ended"] == "promote"
+            assert saved["candidate"]["rewards"] == n
+
+        _run_server(body, server)
+
+    def test_starved_candidate_retires_with_zero_5xx(self, tmp_path):
+        from tests.test_registry import _tag_lane
+
+        storage = _memory_storage()
+        storage.get_meta_data_apps().insert(App(0, "banditapp"))
+        app_id = storage.get_meta_data_apps().get_by_name("banditapp").id
+        levents = storage.get_l_events()
+        server = _bandit_server(storage, tmp_path)
+        server.stage_candidate_lane(_tag_lane("v2"), fraction=0.5, persist=False)
+
+        async def body(client):
+            statuses = []
+            for i in range(40):
+                resp = await client.post(
+                    "/queries.json",
+                    json={"qid": i, "user": f"u{i}"},
+                    headers={TRACE_HEADER: f"tr-{i}"},
+                )
+                statuses.append(resp.status)
+            assert statuses == [200] * 40  # zero client-visible 5xx
+            n = 0
+            for i in range(40):
+                hit = server.bandit.impressions.peek(f"tr-{i}")
+                if hit and hit[0] == "stable":
+                    n += 1
+                    levents.insert(reward_event(f"tr-{i}", n), app_id)
+            deadline = time.monotonic() + 10.0
+            while server._candidate is not None:
+                assert time.monotonic() < deadline, "bandit never retired"
+                await server._rollout_tick()
+                await asyncio.sleep(0.01)
+            # the loser retired through the ROLLBACK machinery: stable stays
+            assert server.model_version == "v1"
+            assert server.bandit_instruments.retired.value() == 1
+            saved = server.registry_store.load_bandit_state("bandittest")
+            assert saved["ended"] == "retire"
+
+        _run_server(body, server)
+
+    def test_explore_decisions_steer_the_plan_fraction(self, tmp_path):
+        from tests.test_registry import _tag_lane
+
+        storage = _memory_storage()
+        storage.get_meta_data_apps().insert(App(0, "banditapp"))
+        server = _bandit_server(
+            storage, tmp_path, bandit_min_pulls=1000, bandit_epsilon=0.17
+        )
+        server.stage_candidate_lane(_tag_lane("v2"), fraction=0.5, persist=False)
+
+        async def body(client):
+            for i in range(5):
+                resp = await client.post(
+                    "/queries.json", json={"qid": i, "user": f"u{i}"}
+                )
+                assert resp.status == 200
+            await server._rollout_tick()
+            # far below min_pulls: cold-start exploration at epsilon, and
+            # NO promote even though the plain bake gate is satisfied
+            assert server._candidate is not None
+            assert server._plan.fraction == pytest.approx(0.17)
+            assert server._plan.salt == "v2"  # sticky buckets survive
+
+        _run_server(body, server)
+
+    def test_bandit_tailer_failure_degrades_to_plain_bake_gate(self, tmp_path):
+        from tests.test_registry import _tag_lane
+
+        storage = _memory_storage()  # NO banditapp seeded -> tailer raises
+        server = _bandit_server(storage, tmp_path)
+        server.stage_candidate_lane(_tag_lane("v2"), fraction=0.5, persist=False)
+        assert not server.bandit.active  # engage failed, stage survived
+        assert server._candidate is not None
+
+    def test_no_policy_configured_means_no_bandit(self, tmp_path):
+        storage = _memory_storage()
+        server = _bandit_server(storage, tmp_path, bandit_policy=None)
+        assert server.bandit is None
+        # the metric family still exists at zero (eager registration)
+        assert server.bandit_instruments.active.value() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# slow e2e: the acceptance rail
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestEndToEndBanditLifecycle:
+    def test_ingest_train_foldin_stage_reward_promote_then_retire(
+        self, tmp_path
+    ):
+        """Ingest ordered sessions -> train the sequential engine
+        (attention scorer: serving compiles through ops/topk) -> stream
+        fold-in publishes a candidate with lineage -> the bandit stages it
+        as an arm -> feedback accumulates reward -> auto-promote; then the
+        OLD version re-staged and starved of reward auto-retires. Zero
+        client-visible 5xx end to end."""
+        from predictionio_tpu.data.api.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+        from predictionio_tpu.data.storage.base import AccessKey
+        from predictionio_tpu.models.sequential import engine_factory
+        from predictionio_tpu.stream import (
+            CursorStore,
+            EventTailer,
+            StreamConfig,
+            StreamPipeline,
+            trainer_for_models,
+        )
+        from predictionio_tpu.workflow import model_io
+        from predictionio_tpu.workflow.core_workflow import run_train
+        from predictionio_tpu.workflow.create_server import (
+            ServerConfig,
+            _query_server_from_registry,
+        )
+        from predictionio_tpu.workflow.engine_loader import EngineManifest
+
+        storage = _memory_storage()
+        app_id = storage.get_meta_data_apps().insert(App(0, "seqbandit"))
+        key = storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id, ())
+        )
+        engine = engine_factory()
+        manifest = EngineManifest(
+            engine_id="seqbandit",
+            version="1",
+            variant="engine.json",
+            engine_factory="predictionio_tpu.models.sequential.engine_factory",
+        )
+        ep = engine.engine_params_from_variant(
+            {
+                "datasource": {"params": {"appName": "seqbandit"}},
+                "algorithms": [
+                    {
+                        "name": "attention",
+                        "params": {"rank": 4, "numIterations": 2, "context": 4},
+                    }
+                ],
+            }
+        )
+        registry_dir = str(tmp_path / "registry")
+
+        async def body():
+            ev_server = EventServer(storage=storage, config=EventServerConfig())
+            ev_client = TestClient(TestServer(ev_server.make_app()))
+            await ev_client.start_server()
+
+            async def ingest(payload):
+                resp = await ev_client.post(
+                    f"/events.json?accessKey={key}", json=payload
+                )
+                assert resp.status == 201, await resp.text()
+
+            async def ingest_view(user, item, n):
+                await ingest(
+                    {
+                        "event": "view",
+                        "entityType": "user",
+                        "entityId": user,
+                        "targetEntityType": "item",
+                        "targetEntityId": item,
+                        "eventTime": t(n).isoformat(),
+                    }
+                )
+
+            # 1) ordered sessions land through the EventServer; batch train
+            #    publishes v000001 with lineage (the attention scorer)
+            n = 0
+            for u in range(12):
+                for item in ("i0", "i1", "i2", "i3"):
+                    n += 1
+                    await ingest_view(f"u{u}", item, n)
+            run_train(
+                engine, manifest, ep, storage=storage, registry_dir=registry_dir
+            )
+            store = ArtifactStore(registry_dir)
+            assert store.get_state("seqbandit").stable == "v000001"
+
+            # 2) speed layer: fresh sessions fold in, publish v000002 with
+            #    lineage back to v000001
+            levents = storage.get_l_events()
+            tailer = EventTailer(levents, app_id, batch_limit=100)
+            cursors = CursorStore(str(tmp_path / "cursors"))
+            cursor = cursors.load(app_id)
+            cursor.seed(tailer.head_position())
+            cursors.save(cursor)
+            for j in range(10):
+                n += 1
+                await ingest_view("newu", f"i{j % 4}", n)
+            models = model_io.deserialize_models(
+                store.load_blob("seqbandit", "v000001")
+            )
+            trainer = trainer_for_models(models, holdout_every=10_000)
+            pipeline = StreamPipeline(
+                tailer,
+                trainer,
+                cursors,
+                store,
+                StreamConfig(
+                    engine_id="seqbandit",
+                    engine_version="1",
+                    engine_variant="engine.json",
+                    mode="canary",
+                    fraction=0.5,
+                ),
+                stage_hook=lambda v, m, f: None,  # the server stages below
+            )
+            summary = pipeline.run_once()
+            assert summary["published"] == "v000002"
+            m2 = store.get_manifest("seqbandit", "v000002")
+            assert m2.parent_version == "v000001"  # lineage
+
+            # 3) serve v000001 with the bandit armed; stage v000002 as the
+            #    candidate arm on the existing rollout path
+            server = _query_server_from_registry(
+                engine,
+                manifest,
+                store,
+                "v000001",
+                storage,
+                ServerConfig(
+                    bandit_policy="thompson",
+                    bandit_app_name="seqbandit",
+                    bandit_min_pulls=4,
+                    # cold-start exploration at 0.5: both arms must collect
+                    # evidence from ~40 queries before the posterior decides
+                    bandit_epsilon=0.5,
+                    bake_window_s=0.05,
+                    bake_min_requests=5,
+                    bake_check_interval_s=0.02,
+                    max_p95_ratio=1000.0,
+                    max_error_ratio=1000.0,
+                    request_timeout_s=10.0,
+                    max_batch_size=8,
+                ),
+            )
+            q_client = TestClient(TestServer(server.make_app()))
+            await q_client.start_server()
+            statuses: list[int] = []
+
+            async def query(trace, user):
+                resp = await q_client.post(
+                    "/queries.json",
+                    json={"user": user, "recentItems": ["i0"], "num": 3},
+                    headers={TRACE_HEADER: trace},
+                )
+                statuses.append(resp.status)
+                body = await resp.json()
+                assert body["itemScores"], body  # topk path answered
+                return body
+
+            try:
+                resp = await q_client.post(
+                    "/models/candidate",
+                    json={"version": "v000002", "mode": "canary",
+                          "fraction": 0.5},
+                )
+                assert resp.status == 200, await resp.text()
+                assert server.bandit.active
+
+                # 4) live traffic splits by sticky bucket; feedback events
+                #    through the EVENT SERVER reward only candidate answers
+                for i in range(40):
+                    await query(f"e2e-{i}", f"u{i}")
+                fb = 0
+                for i in range(40):
+                    hit = server.bandit.impressions.peek(f"e2e-{i}")
+                    if hit and hit[0] == "candidate":
+                        fb += 1
+                        await ingest(
+                            {
+                                "event": "reward",
+                                "entityType": "user",
+                                "entityId": f"fb{fb}",
+                                "properties": {
+                                    "traceId": f"e2e-{i}", "reward": 1.0,
+                                },
+                            }
+                        )
+                assert fb >= 4
+                deadline = time.monotonic() + 15.0
+                while server.model_version != "v000002":
+                    assert (
+                        time.monotonic() < deadline
+                    ), f"no promote: {server.bandit.snapshot()}"
+                    await asyncio.sleep(0.02)
+                while store.get_state("seqbandit").stable != "v000002":
+                    assert time.monotonic() < deadline, "registry pin stuck"
+                    await asyncio.sleep(0.02)
+                assert server.bandit_instruments.promoted.value() == 1
+
+                # 5) re-stage the OLD version and starve it: the reward
+                #    verdict retires it through the rollback machinery
+                resp = await q_client.post(
+                    "/models/candidate",
+                    json={"version": "v000001", "mode": "canary",
+                          "fraction": 0.5},
+                )
+                assert resp.status == 200, await resp.text()
+                for i in range(40, 90):
+                    await query(f"e2e-{i}", f"u{i}")
+                fb2 = 0
+                for i in range(40, 90):
+                    hit = server.bandit.impressions.peek(f"e2e-{i}")
+                    if hit and hit[0] == "stable":
+                        fb2 += 1
+                        await ingest(
+                            {
+                                "event": "reward",
+                                "entityType": "user",
+                                "entityId": f"fb2-{fb2}",
+                                "properties": {"traceId": f"e2e-{i}"},
+                            }
+                        )
+                deadline = time.monotonic() + 15.0
+                while server._candidate is not None:
+                    assert (
+                        time.monotonic() < deadline
+                    ), f"no retire: {server.bandit.snapshot()}"
+                    await asyncio.sleep(0.02)
+                assert server.model_version == "v000002"  # loser retired
+                assert server.bandit_instruments.retired.value() == 1
+                # the whole lifecycle was invisible to clients
+                assert statuses == [200] * 90
+            finally:
+                await q_client.close()
+            await ev_client.close()
+
+        asyncio.run(body())

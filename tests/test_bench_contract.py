@@ -173,6 +173,8 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
         if name == "roofline":
             return {"roofline_topk_ai": 3.45,
                     "sampler_overhead_frac": 0.002}, None  # CPU phase
+        if name == "sequential":
+            return {"serving_sequential_p50_ms": 0.13}, None  # CPU phase
         if name in ("ann", "secondary"):
             # host-side/backed-independent workloads run on the CPU
             # backend instead of being zeroed by the outage
@@ -193,7 +195,7 @@ def test_preflight_failure_skips_device_phases_fast(monkeypatch, capsys):
     names = [c[0] for c in calls]
     assert [n for n in names if n != "probe"] == [
         "serving_local", "batchpredict", "ann", "evalgrid", "secondary",
-        "elastic", "roofline",
+        "elastic", "roofline", "sequential",
     ]
     assert names.count("probe") == 2  # initial + the single late retry
     assert out["preflight_attempts"] == 2
@@ -227,6 +229,8 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
         if name == "roofline":
             return {"roofline_topk_ai": 3.45,
                     "sampler_overhead_frac": 0.002}, None  # CPU phase
+        if name == "sequential":
+            return {"serving_sequential_p50_ms": 0.13}, None  # CPU phase
         if name in ("ann", "secondary"):
             assert env == {"JAX_PLATFORMS": "cpu"}
             if name == "ann":
@@ -245,7 +249,7 @@ def test_cpu_only_skips_probing_entirely(monkeypatch, capsys):
     assert rc == 0  # a requested CPU-only run that shipped numbers is healthy
     assert calls == [
         "serving_local", "batchpredict", "ann", "evalgrid", "secondary",
-        "elastic", "roofline",
+        "elastic", "roofline", "sequential",
     ]
     assert out["preflight_attempts"] == 0
     assert out["bench_cpu_only"] is True
@@ -295,6 +299,7 @@ def test_failed_serving_retry_keeps_random_label(monkeypatch, capsys):
             "secondary": ({}, None),
             "elastic": ({}, None),
             "roofline": ({}, None),
+            "sequential": ({}, None),
         }
         return results[name]
 
@@ -411,6 +416,7 @@ def test_dead_then_alive_device_recovers_the_capture(monkeypatch, capsys):
             "elastic": ({"fleet_trace_p95_ms": 45.0}, None),
             "roofline": ({"roofline_topk_ai": 3.45,
                           "sampler_overhead_frac": 0.002}, None),
+            "sequential": ({"serving_sequential_p50_ms": 0.13}, None),
         }
         return results[name]
 

@@ -1,0 +1,432 @@
+"""Sequential (session / next-item) engine tests — ISSUE 20.
+
+The load-bearing contracts: ordered per-user session reads ride the PR-5
+``find_after`` total order on EVERY backend (same-creation-time ties
+resolved by event id, paging never skips or double-reads), and the
+transition scorer is EXACTLY the ``e2.markov_chain`` math (parity unit
+holds the template's matrix equal to a direct ``train_markov_chain`` call
+on the same events). Plus: eval folds through ``EventStoreSplitter``,
+both scorers' serving behavior, and the streaming fold-in trainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App, event_seq_key
+from predictionio_tpu.data.storage.jsonl import JSONLStorageClient
+from predictionio_tpu.data.storage.memory import MemoryStorageClient
+from predictionio_tpu.data.storage.sqlite import SQLiteStorageClient
+from predictionio_tpu.e2.markov_chain import train_markov_chain
+from predictionio_tpu.models.sequential import engine_factory
+from predictionio_tpu.models.sequential.engine import (
+    AttentionAlgorithm,
+    AttentionAlgorithmParams,
+    DataSourceParams,
+    EvalParams,
+    MarkovAlgorithm,
+    MarkovAlgorithmParams,
+    Query,
+    SequentialModel,
+    TrainingData,
+    _iter_ordered,
+    build_markov,
+    sequences_from_events,
+    transition_coordinates,
+)
+from predictionio_tpu.workflow.context import WorkflowContext
+
+UTC = dt.timezone.utc
+APP = 5
+
+
+def t(n: int) -> dt.datetime:
+    return dt.datetime(2024, 6, 1, 0, 0, n, tzinfo=UTC)
+
+
+def view(user: str, item: str, n: int, *, eid: str | None = None,
+         ct: dt.datetime | None = None, name: str = "view") -> Event:
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=user,
+        target_entity_type="item",
+        target_entity_id=item,
+        properties=DataMap({}),
+        event_time=t(n),
+        creation_time=ct or t(n),
+        event_id=eid,
+    )
+
+
+# ---------------------------------------------------------------------------
+# ordered reads: per-backend find_after paging feeds the sequential reader
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=["memory", "sqlite", "jsonl"])
+def levents(request, tmp_path):
+    if request.param == "memory":
+        client = MemoryStorageClient()
+    elif request.param == "sqlite":
+        client = SQLiteStorageClient({"PATH": str(tmp_path / "t.db")})
+    else:
+        client = JSONLStorageClient({"PATH": str(tmp_path / "events")})
+    l = client.l_events()
+    l.init(APP)
+    return l
+
+
+class TestOrderedReads:
+    def test_equal_creation_time_tiebreak_feeds_sessions_in_id_order(
+        self, levents
+    ):
+        """All five session events land in the SAME creation second,
+        inserted shuffled: the id tiebreak must reconstruct the session in
+        id (= ingest) order on every backend, even paging one at a time."""
+        tie = t(30)
+        for eid, item in (("e3", "i3"), ("e1", "i1"), ("e4", "i4"),
+                          ("e2", "i2"), ("e0", "i0")):
+            levents.insert(view("u1", item, 1, eid=eid, ct=tie), APP)
+        for page in (1, 2, 50):
+            per_user, vocab = sequences_from_events(
+                _iter_ordered(levents, APP, None, page, 10_000),
+                event_names=("view",),
+                entity_type="user",
+                target_entity_type="item",
+            )
+            assert [vocab[i] for i in per_user["u1"]] == [
+                "i0", "i1", "i2", "i3", "i4",
+            ]
+
+    def test_resumed_page_never_skips_or_dupes_within_a_tie(self, levents):
+        tie = t(7)
+        for eid in ("ca", "cb", "cc", "cd", "ce"):
+            levents.insert(view("u1", f"item-{eid}", 1, eid=eid, ct=tie), APP)
+        seen = [e.event_id for e in _iter_ordered(levents, APP, None, 2, 10_000)]
+        assert seen == ["ca", "cb", "cc", "cd", "ce"]
+
+    def test_head_bound_excludes_live_ingest(self, levents):
+        """The reader snapshots seq_head at entry: an event landing while
+        the scan is in flight must not extend the read (a live stream
+        would otherwise hold the training read open forever)."""
+        for n, eid in ((1, "aa"), (2, "ab")):
+            levents.insert(view("u1", f"i{n}", n, eid=eid), APP)
+        it = _iter_ordered(levents, APP, None, 1, 10_000)
+        first = next(it)
+        assert first.event_id == "aa"
+        levents.insert(view("u1", "i9", 9, eid="zz"), APP)  # after the head
+        assert [e.event_id for e in it] == ["ab"]
+
+    def test_max_events_bounds_the_scan(self, levents):
+        for n in range(10):
+            levents.insert(view("u1", f"i{n}", n, eid=f"e{n}"), APP)
+        assert len(list(_iter_ordered(levents, APP, None, 3, 4))) == 4
+
+    def test_reader_filters_names_and_entity_types(self):
+        events = [
+            view("u1", "i0", 0, eid="a"),
+            view("u1", "i1", 1, eid="b", name="buy"),  # wrong event name
+            dataclasses.replace(view("u1", "i2", 2, eid="c"),
+                                entity_type="session"),  # wrong entity type
+            dataclasses.replace(view("u1", "i3", 3, eid="d"),
+                                target_entity_type="cat"),  # wrong target
+            view("u1", "i4", 4, eid="e"),
+        ]
+        per_user, vocab = sequences_from_events(
+            iter(events), event_names=("view",), entity_type="user",
+            target_entity_type="item",
+        )
+        assert [vocab[i] for i in per_user["u1"]] == ["i0", "i4"]
+
+
+# ---------------------------------------------------------------------------
+# e2 MarkovChain parity
+# ---------------------------------------------------------------------------
+
+
+class TestMarkovParity:
+    def test_template_matrix_matches_e2_train_markov_chain(self):
+        """The template's trainer and a DIRECT e2 call on the same events
+        must produce the identical transition model — probabilities, order,
+        truncation, everything."""
+        rng = np.random.default_rng(42)
+        sequences = [
+            np.asarray(rng.integers(0, 12, size=rng.integers(2, 9)), np.int32)
+            for _ in range(40)
+        ]
+        template, counts = build_markov(sequences, 12, top_n=3)
+        direct = train_markov_chain(
+            transition_coordinates(sequences), 12, top_n=3
+        )
+        assert template.transitions == direct.transitions
+        assert template.n_states == direct.n_states == 12
+        # the raw pair counts kept for the stream merge sum to the number
+        # of consecutive pairs (train_markov_chain alone is top-N lossy)
+        assert sum(counts.values()) == sum(len(s) - 1 for s in sequences)
+
+    def test_hand_computed_probabilities_and_tiebreak(self):
+        # from state 0: ->1 twice, ->2 once, ->3 once => 0.5, 0.25, 0.25;
+        # the 0.25 tie ranks by destination index (e2's (-p, j) sort key)
+        seqs = [np.asarray(s, np.int32)
+                for s in ([0, 1], [0, 1], [0, 3], [0, 2])]
+        model, _ = build_markov(seqs, 4, top_n=10)
+        assert model.transition_probs(0) == [(1, 0.5), (2, 0.25), (3, 0.25)]
+
+    def test_top_n_truncates_probabilities_not_counts(self):
+        seqs = [np.asarray([0, 1, 0, 2, 0, 3], np.int32)]
+        model, counts = build_markov(seqs, 4, top_n=2)
+        assert len(model.transition_probs(0)) == 2
+        # counts keep the full fan-out for the streaming merge
+        assert {(0, 1), (0, 2), (0, 3)} <= set(counts)
+
+
+# ---------------------------------------------------------------------------
+# DataSource: training + eval-grid folds from the event store
+# ---------------------------------------------------------------------------
+
+
+def _seed_sessions(storage, app_name: str, sessions: dict[str, list[str]]):
+    storage.get_meta_data_apps().insert(App(0, app_name))
+    app_id = storage.get_meta_data_apps().get_by_name(app_name).id
+    levents = storage.get_l_events()
+    n = 0
+    for user in sorted(sessions):
+        for item in sessions[user]:
+            n += 1
+            levents.insert(view(user, item, n), app_id)
+    return app_id
+
+
+class TestDataSource:
+    def test_read_training_reconstructs_sessions_in_ingest_order(
+        self, memory_storage
+    ):
+        sessions = {
+            "u1": ["a", "b", "c"],
+            "u2": ["b", "a"],
+            "u3": ["c"],
+        }
+        _seed_sessions(memory_storage, "seqapp", sessions)
+        engine = engine_factory()
+        ep = engine.engine_params_from_variant(
+            {
+                "datasource": {"params": {"appName": "seqapp", "page": 2}},
+                "algorithms": [{"name": "markov", "params": {}}],
+            }
+        )
+        ds, prep, _, _ = engine.make_components(ep)
+        ctx = WorkflowContext(mode="training", _storage=memory_storage)
+        td = prep.prepare(ctx, ds.read_training(ctx))
+        assert td.users == ["u1", "u2", "u3"]
+        got = {
+            u: [td.item_vocab[i] for i in seq]
+            for u, seq in zip(td.users, td.sequences)
+        }
+        assert got == sessions
+
+    def test_read_eval_folds_partition_users_with_held_out_tails(
+        self, memory_storage
+    ):
+        sessions = {f"u{i}": ["a", "b", "c", "d"] for i in range(8)}
+        _seed_sessions(memory_storage, "sevalapp", sessions)
+        ds = type(engine_factory().data_source_classes[""])  # sanity: class
+        from predictionio_tpu.models.sequential.engine import DataSource
+
+        src = DataSource(
+            DataSourceParams(
+                app_name="sevalapp",
+                eval_params=EvalParams(k_fold=2, query_num=3, holdout_tail=2),
+            )
+        )
+        ctx = WorkflowContext(mode="evaluation", _storage=memory_storage)
+        folds = src.read_eval(ctx)
+        assert len(folds) == 2
+        all_users = set(sessions)
+        for td, _, qa in folds:
+            held = {q.user for q, _ in qa}
+            # training users and held-out users partition the population
+            assert set(td.users) | held == all_users
+            assert set(td.users) & held == set()
+            for q, actual in qa:
+                # prefix becomes the query session, tail the continuation
+                assert list(q.recent_items) == ["a", "b"]
+                assert list(actual.items) == ["c", "d"]
+        # the sticky bucket assigns every user to exactly one fold
+        held_by_fold = [{q.user for q, _ in qa} for _, _, qa in folds]
+        assert held_by_fold[0] | held_by_fold[1] == all_users
+        assert held_by_fold[0] & held_by_fold[1] == set()
+
+    def test_read_eval_without_eval_params_raises(self, memory_storage):
+        from predictionio_tpu.models.sequential.engine import DataSource
+
+        _seed_sessions(memory_storage, "noeval", {"u": ["a", "b"]})
+        src = DataSource(DataSourceParams(app_name="noeval"))
+        with pytest.raises(ValueError, match="evalParams"):
+            src.read_eval(WorkflowContext(_storage=memory_storage))
+
+
+# ---------------------------------------------------------------------------
+# scorers
+# ---------------------------------------------------------------------------
+
+
+def _td(sessions: list[list[str]]) -> TrainingData:
+    vocab: list[str] = []
+    index: dict[str, int] = {}
+    seqs = []
+    for s in sessions:
+        row = []
+        for item in s:
+            if item not in index:
+                index[item] = len(vocab)
+                vocab.append(item)
+            row.append(index[item])
+        seqs.append(np.asarray(row, np.int32))
+    return TrainingData([f"u{i}" for i in range(len(seqs))], seqs, vocab)
+
+
+class TestScorers:
+    def test_markov_predict_masks_session_items(self):
+        td = _td([["a", "b"], ["a", "b"], ["a", "c"]])
+        alg = MarkovAlgorithm(MarkovAlgorithmParams(top_n=5))
+        model = alg.train(WorkflowContext(), td)
+        # scoring is from the session's LAST item ("a"); without masking
+        # "b" would win, but "b" is already in the session -> "c" answers
+        r = alg.predict(model, Query(recent_items=("b", "a"), num=2))
+        assert [s.item for s in r.item_scores] == ["c"]
+
+    def test_markov_falls_back_to_stored_last_item_for_bare_user(self):
+        td = _td([["a", "b"], ["a", "b"]])
+        alg = MarkovAlgorithm(MarkovAlgorithmParams())
+        model = alg.train(WorkflowContext(), td)
+        r = alg.predict(model, Query(user="u0", num=1))  # u0 ended on "b"
+        # last item is "b"; no outgoing transition from "b" -> empty result
+        assert r.item_scores == ()
+        r = alg.predict(model, Query(user="missing", num=1))
+        assert r.item_scores == ()
+
+    def test_attention_serves_through_packed_topk_and_bans_session(self):
+        td = _td([["a", "b", "c"], ["a", "b", "c"], ["b", "c", "d"]])
+        alg = AttentionAlgorithm(
+            AttentionAlgorithmParams(rank=4, num_iterations=3, context=4)
+        )
+        model = alg.train(WorkflowContext(), td)
+        assert model.item_in is not None and model.item_out is not None
+        out = alg.predict_batch(
+            model,
+            [Query(recent_items=("a", "b"), num=3),
+             Query(recent_items=("c",), num=2)],
+        )
+        assert len(out) == 2
+        for r, banned in zip(out, ({"a", "b"}, {"c"})):
+            items = [s.item for s in r.item_scores]
+            assert not set(items) & banned
+            scores = [s.score for s in r.item_scores]
+            assert scores == sorted(scores, reverse=True)
+
+    def test_markov_only_model_on_attention_lane_uses_host_scorer(self):
+        td = _td([["a", "b"], ["a", "b"], ["a", "c"]])
+        markov_model = MarkovAlgorithm(MarkovAlgorithmParams()).train(
+            WorkflowContext(), td
+        )
+        assert markov_model.item_in is None
+        alg = AttentionAlgorithm(AttentionAlgorithmParams())
+        got = alg.predict(markov_model, Query(recent_items=("a",), num=1))
+        want = MarkovAlgorithm(MarkovAlgorithmParams()).predict(
+            markov_model, Query(recent_items=("a",), num=1)
+        )
+        assert got == want
+
+    def test_explicit_recent_items_override_stored_last(self):
+        td = _td([["a", "b"], ["c", "d"]])
+        model = MarkovAlgorithm(MarkovAlgorithmParams()).train(
+            WorkflowContext(), td
+        )
+        # u0's stored last is "b", but the explicit session says "c"
+        assert model.session_indices(
+            Query(user="u0", recent_items=("c",))
+        ) == [model.item_index()["c"]]
+
+
+# ---------------------------------------------------------------------------
+# streaming fold-in
+# ---------------------------------------------------------------------------
+
+
+class TestStreamFoldIn:
+    def _seed(self):
+        td = _td([["a", "b"], ["a", "b"], ["b", "c"]])
+        return MarkovAlgorithm(MarkovAlgorithmParams(top_n=5)).train(
+            WorkflowContext(), td
+        )
+
+    def test_snapshot_merges_stream_counts_through_exact_e2_math(self):
+        from predictionio_tpu.stream.trainers import SequentialStreamTrainer
+
+        seed = self._seed()
+        trainer = SequentialStreamTrainer(seed, holdout_every=10_000)
+        # u9 is a stream-only user viewing a stream-only item: a->b->e
+        absorbed = trainer.absorb(
+            [view("u9", "a", 1), view("u9", "b", 2), view("u9", "e", 3)]
+        )
+        assert absorbed == 2  # two transitions; the first event opens the session
+        (model,) = trainer.snapshot()
+        assert isinstance(model, SequentialModel)
+        assert "e" in model.item_vocab  # vocab grew
+        idx = model.item_index()
+        # merged counts: seed had a->b twice; the stream added one more
+        assert model.pair_counts[(idx["a"], idx["b"])] == 3.0
+        assert model.pair_counts[(idx["b"], idx["e"])] == 1.0
+        # and the published matrix is the exact e2 rebuild of those counts
+        from predictionio_tpu.models.sequential.engine import (
+            markov_from_counts,
+        )
+
+        want = markov_from_counts(
+            model.pair_counts, len(model.item_vocab), model.top_n
+        )
+        assert model.markov.transitions == want.transitions
+        # session cursor advanced for serving's bare-user fallback
+        assert model.item_vocab[model.user_last["u9"]] == "e"
+
+    def test_attention_tables_ride_through_fold_in_unchanged(self):
+        from predictionio_tpu.stream.trainers import SequentialStreamTrainer
+
+        td = _td([["a", "b", "c"], ["a", "b", "c"]])
+        seed = AttentionAlgorithm(
+            AttentionAlgorithmParams(rank=4, num_iterations=2)
+        ).train(WorkflowContext(), td)
+        trainer = SequentialStreamTrainer(seed, holdout_every=10_000)
+        trainer.absorb([view("u9", "a", 1), view("u9", "c", 2)])
+        (model,) = trainer.snapshot()
+        assert model.item_in is seed.item_in
+        assert model.item_out is seed.item_out
+
+    def test_trainer_for_models_selects_sequential(self):
+        from predictionio_tpu.stream.pipeline import trainer_for_models
+        from predictionio_tpu.stream.trainers import SequentialStreamTrainer
+
+        trainer = trainer_for_models([self._seed()], holdout_every=10_000)
+        assert isinstance(trainer, SequentialStreamTrainer)
+
+    def test_drift_guard_needs_samples_then_tracks_hit_rate(self):
+        from predictionio_tpu.stream.trainers import SequentialStreamTrainer
+
+        trainer = SequentialStreamTrainer(
+            self._seed(), holdout_every=2, drift_min_samples=4,
+            drift_hit_drop=0.5,
+        )
+        report = trainer.drift()
+        assert report.ok and "insufficient" in report.reason
+        n = 0
+        for _ in range(40):  # repetitive a->b traffic: holdout fills, hits
+            n += 1
+            trainer.absorb([view(f"s{n}", "a", n), view(f"s{n}", "b", n + 1)])
+        assert trainer.drift().ok
